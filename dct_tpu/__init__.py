@@ -1,0 +1,27 @@
+"""dct_tpu — a TPU-native continuous-training framework.
+
+A brand-new JAX/XLA implementation of the capabilities of the reference
+pipeline ``Distributed-Continuous-Training-with-Airflow-PyTorch-Distributed-DDP-``
+(Airflow-orchestrated Spark ETL -> distributed training -> MLflow tracking ->
+blue/green deployment), with the training core rebuilt idiomatically for TPUs:
+
+- pure-functional jitted train/eval steps over a ``jax.sharding.Mesh``
+  (data-parallel by default, with tensor/sequence-parallel extension axes),
+- ``jax.distributed.initialize()`` multi-host rendezvous in place of the
+  reference's env-var + TCP-store gloo rendezvous
+  (reference: jobs/train_lightning_ddp.py:129-143, docker-compose.yml:121-124),
+- XLA collectives over ICI/DCN in place of gloo/NCCL all-reduce,
+- best/last checkpointing + MLflow-compatible tracking preserving the
+  reference's deploy-time model-selection query
+  (reference: dags/azure_auto_deploy.py:32-39).
+"""
+
+__version__ = "0.1.0"
+
+from dct_tpu.config import (  # noqa: F401
+    DataConfig,
+    ModelConfig,
+    TrainConfig,
+    MeshConfig,
+    RunConfig,
+)

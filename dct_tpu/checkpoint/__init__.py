@@ -1,0 +1,6 @@
+from dct_tpu.checkpoint.manager import (  # noqa: F401
+    BestLastCheckpointer,
+    save_checkpoint,
+    load_checkpoint,
+    TrainStateCheckpointer,
+)

@@ -1,0 +1,6 @@
+from dct_tpu.data.dataset import WeatherArrays, load_processed_dataset  # noqa: F401
+from dct_tpu.data.pipeline import (  # noqa: F401
+    train_val_split,
+    BatchLoader,
+)
+from dct_tpu.data.synthetic import generate_weather_csv  # noqa: F401

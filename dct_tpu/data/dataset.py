@@ -1,0 +1,89 @@
+"""Processed-dataset loading: the Spark->trainer parquet contract.
+
+Mirrors the behavior of the reference's WeatherDataset
+(jobs/train_lightning_ddp.py:16-49):
+
+- the ETL step writes a parquet *directory* named ``data.parquet`` inside the
+  processed dir (jobs/preprocess.py:44-51);
+- loading hard-fails with a clear message if it is missing (:22-26);
+- feature columns are discovered dynamically by the ``_norm`` suffix (:37),
+  hard-failing if none exist (:39-40);
+- features load as float32, labels as integer class ids (:45-46).
+
+The TPU-native difference: arrays are plain numpy (host RAM), converted to
+device arrays only at batch-dispatch time with an explicit
+``jax.sharding.NamedSharding`` — there is no per-item Dataset/DataLoader
+object graph, because XLA wants large static-shape batches, not Python
+iteration per sample.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class WeatherArrays:
+    """Column-major host arrays for the whole dataset."""
+
+    features: np.ndarray  # [N, F] float32
+    labels: np.ndarray  # [N] int32
+    feature_names: list[str]
+
+    def __len__(self) -> int:
+        return int(self.features.shape[0])
+
+    @property
+    def input_dim(self) -> int:
+        return int(self.features.shape[1])
+
+
+def load_processed_dataset(
+    processed_dir: str,
+    *,
+    feature_suffix: str = "_norm",
+    label_column: str = "label_encoded",
+    parquet_name: str = "data.parquet",
+) -> WeatherArrays:
+    """Load the ETL output (a parquet file or directory) into host arrays.
+
+    Accepts both a Spark-style parquet directory and a single parquet file,
+    like ``pd.read_parquet`` does in the reference
+    (jobs/train_lightning_ddp.py:31).
+    """
+    parquet_path = os.path.join(processed_dir, parquet_name)
+    if not os.path.exists(parquet_path):
+        raise FileNotFoundError(
+            f"CRITICAL ERROR: Data not found at {parquet_path}. "
+            "Did the preprocessing step finish successfully?"
+        )
+
+    import pyarrow.parquet as pq
+
+    try:
+        table = pq.read_table(parquet_path)
+    except Exception as e:  # pragma: no cover - IO failure surface
+        raise RuntimeError(f"Failed to read Parquet file: {e}") from e
+
+    names = list(table.column_names)
+    feature_cols = [c for c in names if c.endswith(feature_suffix)]
+    if not feature_cols:
+        raise ValueError(
+            f"CRITICAL ERROR: No columns ending with '{feature_suffix}' found. "
+            "Check the preprocessing logic."
+        )
+    if label_column not in names:
+        raise ValueError(
+            f"CRITICAL ERROR: Label column '{label_column}' not found in "
+            f"columns {names}."
+        )
+
+    feats = np.stack(
+        [table.column(c).to_numpy(zero_copy_only=False) for c in feature_cols],
+        axis=1,
+    ).astype(np.float32)
+    labels = table.column(label_column).to_numpy(zero_copy_only=False).astype(np.int32)
+    return WeatherArrays(features=feats, labels=labels, feature_names=feature_cols)

@@ -1,0 +1,47 @@
+"""Synthetic weather.csv generator.
+
+The reference repo ships no data (``data/`` is git-ignored, .gitignore:36-39);
+its pipeline expects a user-provided ``data/raw/weather.csv`` with columns
+Temperature, Humidity, Wind_Speed, Cloud_Cover, Pressure and a string label
+``Rain`` in {"rain", "no rain"} (jobs/preprocess.py:23-29). This module
+produces a schema-compatible CSV with a learnable (linearly separable-ish)
+rain signal so tests and benchmarks can exercise the full ETL->train->deploy
+path hermetically.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+FEATURE_COLUMNS = ["Temperature", "Humidity", "Wind_Speed", "Cloud_Cover", "Pressure"]
+LABEL_COLUMN = "Rain"
+
+
+def generate_weather_csv(path: str, *, rows: int = 2500, seed: int = 0) -> str:
+    """Write a synthetic weather.csv; returns the path."""
+    rng = np.random.default_rng(seed)
+    temperature = rng.normal(18.0, 8.0, rows)
+    humidity = np.clip(rng.normal(60.0, 20.0, rows), 0, 100)
+    wind = np.abs(rng.normal(12.0, 6.0, rows))
+    cloud = np.clip(rng.normal(50.0, 25.0, rows), 0, 100)
+    pressure = rng.normal(1013.0, 8.0, rows)
+
+    # Rain correlates with humidity + cloud cover - pressure anomaly.
+    logit = (
+        0.06 * (humidity - 60.0)
+        + 0.05 * (cloud - 50.0)
+        - 0.08 * (pressure - 1013.0)
+        + rng.normal(0.0, 0.8, rows)
+    )
+    rain = np.where(logit > 0.0, "rain", "no rain")
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    cols = [temperature, humidity, wind, cloud, pressure]
+    with open(path, "w") as f:
+        f.write(",".join(FEATURE_COLUMNS + [LABEL_COLUMN]) + "\n")
+        for i in range(rows):
+            vals = ",".join(f"{c[i]:.4f}" for c in cols)
+            f.write(f"{vals},{rain[i]}\n")
+    return path

@@ -1,0 +1,1 @@
+from dct_tpu.etl.preprocess import preprocess_csv_to_parquet  # noqa: F401

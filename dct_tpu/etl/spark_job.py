@@ -1,0 +1,49 @@
+"""Spark variant of the ETL transform (import-gated; cluster-side only).
+
+Same semantics as :mod:`dct_tpu.etl.preprocess` and as the reference job
+(jobs/preprocess.py:18-51): header+inferSchema CSV read, ``Rain=="rain"->1``
+label encoding, per-column mean/sample-stddev z-score with zero-std guard,
+output restricted to ``[*_norm, label_encoded]`` written overwrite-mode to
+``<out>/data.parquet``. Used when the platform runs the real Spark cluster
+(docker-compose topology, SURVEY §2.1); tests cover the native path and the
+transform parity between the two.
+"""
+
+from __future__ import annotations
+
+import os
+
+from dct_tpu.etl.preprocess import DEFAULT_FEATURES
+
+
+def preprocess_with_spark(
+    input_csv: str,
+    output_dir: str,
+    *,
+    feature_cols: list[str] | None = None,
+    label_col: str = "Rain",
+    positive_label: str = "rain",
+    parquet_name: str = "data.parquet",
+) -> str:
+    from pyspark.sql import SparkSession
+    from pyspark.sql.functions import col, mean, stddev, when
+
+    feature_cols = feature_cols or DEFAULT_FEATURES
+    spark = SparkSession.builder.appName("WeatherPreprocessingTPU").getOrCreate()
+    try:
+        df = spark.read.csv(input_csv, header=True, inferSchema=True)
+        df = df.withColumn(
+            "label_encoded", when(col(label_col) == positive_label, 1).otherwise(0)
+        )
+        for name in feature_cols:
+            stats = df.select(
+                mean(col(name)).alias("mean"), stddev(col(name)).alias("std")
+            ).first()
+            std_val = stats["std"] if stats["std"] else 1.0
+            df = df.withColumn(f"{name}_norm", (col(name) - stats["mean"]) / std_val)
+        final_cols = [f"{c}_norm" for c in feature_cols] + ["label_encoded"]
+        out_path = os.path.join(output_dir, parquet_name)
+        df.select(final_cols).write.mode("overwrite").parquet(out_path)
+        return out_path
+    finally:
+        spark.stop()

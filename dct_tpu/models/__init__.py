@@ -1,0 +1,2 @@
+from dct_tpu.models.mlp import WeatherMLP  # noqa: F401
+from dct_tpu.models.registry import get_model, register_model, MODEL_REGISTRY  # noqa: F401

@@ -1,0 +1,52 @@
+"""Model registry.
+
+The reference hardcodes its single model class inline in the training script
+(jobs/train_lightning_ddp.py:51, re-declared again inside the generated
+score.py at dags/azure_manual_deploy.py:59-77). Here models are registered by
+name so the trainer, the serving package, and the DAGs all resolve the same
+definition from config — no copy-pasted architectures.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from flax import linen as nn
+
+from dct_tpu.config import ModelConfig
+
+MODEL_REGISTRY: dict[str, Callable[..., nn.Module]] = {}
+
+
+def register_model(name: str):
+    def deco(builder: Callable[..., nn.Module]):
+        MODEL_REGISTRY[name] = builder
+        return builder
+
+    return deco
+
+
+def get_model(cfg: ModelConfig, *, input_dim: int | None = None, **kwargs) -> nn.Module:
+    if cfg.name not in MODEL_REGISTRY:
+        raise KeyError(
+            f"Unknown model '{cfg.name}'. Registered: {sorted(MODEL_REGISTRY)}"
+        )
+    dim = cfg.input_dim if input_dim is None else input_dim
+    if dim is None:
+        raise ValueError("input_dim must be provided (inferred from data)")
+    return MODEL_REGISTRY[cfg.name](cfg, input_dim=dim, **kwargs)
+
+
+@register_model("weather_mlp")
+def _build_mlp(cfg: ModelConfig, *, input_dim: int, compute_dtype=None):
+    import jax.numpy as jnp
+
+    from dct_tpu.models.mlp import WeatherMLP
+
+    return WeatherMLP(
+        input_dim=input_dim,
+        hidden_dim=cfg.hidden_dim,
+        num_classes=cfg.num_classes,
+        dropout=cfg.dropout,
+        compute_dtype=compute_dtype or jnp.float32,
+    )

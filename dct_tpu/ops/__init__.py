@@ -1,0 +1,5 @@
+from dct_tpu.ops.losses import (  # noqa: F401
+    masked_cross_entropy,
+    masked_accuracy,
+    softmax_probs,
+)

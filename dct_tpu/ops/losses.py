@@ -1,0 +1,42 @@
+"""Loss and metric ops.
+
+The reference computes ``F.cross_entropy`` (mean over the batch) for train
+and val, plus argmax accuracy (jobs/train_lightning_ddp.py:66-85). Here the
+same math is expressed as *weighted sums plus a weight total*, for two
+TPU-native reasons:
+
+1. fixed-shape padded batches: padding rows carry weight 0, so the weighted
+   mean equals torch's mean over only-real rows;
+2. SPMD: a weighted (sum, count) pair reduces correctly across devices and
+   processes with a single ``psum`` regardless of how rows are sharded —
+   the global mean is exact even when ranks hold different numbers of real
+   rows (torch's ``sync_dist=True`` mean-of-per-rank-means is only
+   approximate in that case; jobs/train_lightning_ddp.py:70,83-84).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_cross_entropy(logits, labels, weight):
+    """Returns (weighted_loss_sum, weight_sum); the mean is sum / count."""
+    logits = jnp.asarray(logits, jnp.float32)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[..., None].astype(jnp.int32), axis=-1)
+    nll = jnp.squeeze(nll, axis=-1)
+    w = jnp.asarray(weight, jnp.float32)
+    return jnp.sum(nll * w), jnp.sum(w)
+
+
+def masked_accuracy(logits, labels, weight):
+    """Returns (weighted_correct_sum, weight_sum)."""
+    preds = jnp.argmax(jnp.asarray(logits, jnp.float32), axis=-1)
+    correct = (preds == labels).astype(jnp.float32)
+    w = jnp.asarray(weight, jnp.float32)
+    return jnp.sum(correct * w), jnp.sum(w)
+
+
+def softmax_probs(logits):
+    return jax.nn.softmax(jnp.asarray(logits, jnp.float32), axis=-1)

@@ -1,0 +1,13 @@
+from dct_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    batch_sharding,
+    replicated_sharding,
+    make_global_batch,
+    shard_state,
+)
+from dct_tpu.parallel.distributed import (  # noqa: F401
+    initialize_from_env,
+    process_index,
+    process_count,
+    is_coordinator,
+)

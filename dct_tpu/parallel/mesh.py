@@ -1,0 +1,85 @@
+"""Device mesh + sharding helpers: the DDP/TP/SP substrate.
+
+The reference's process topology is fixed at deploy time: two containers,
+one rank each, gradients all-reduced by gloo (docker-compose.yml:115-151,
+jobs/train_lightning_ddp.py:136). The TPU-native topology is a named
+``jax.sharding.Mesh`` over all addressable devices:
+
+- ``data``  — batch-sharded axis (the DDP analog; grads all-reduce over ICI),
+- ``model`` — tensor-parallel axis (extension; used by the transformer family),
+- ``seq``   — sequence/context-parallel axis (ring attention).
+
+Everything downstream is declarative: annotate the batch as sharded over
+``data`` and params as replicated (or sharded over ``model``), and XLA
+inserts the collectives. No NCCL/gloo calls to translate.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dct_tpu.config import MeshConfig
+
+AXES = ("data", "model", "seq")
+
+
+def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build a 3-axis mesh; axis size -1 absorbs all remaining devices."""
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = {"data": cfg.data, "model": cfg.model, "seq": cfg.seq}
+    fixed = math.prod(s for s in sizes.values() if s != -1)
+    free = [a for a, s in sizes.items() if s == -1]
+    if len(free) > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    if free:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by fixed axes {sizes}")
+        sizes[free[0]] = n // fixed
+    if math.prod(sizes.values()) != n:
+        raise ValueError(f"Mesh {sizes} does not cover {n} devices")
+    arr = np.array(devices).reshape([sizes[a] for a in AXES])
+    return Mesh(arr, AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dim sharded over ``data``; feature dims replicated."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_state(state, mesh: Mesh):
+    """Replicate the train state across the mesh (pure DP).
+
+    Model/optimizer sharding (FSDP-style) would swap the spec here; for the
+    flagship MLP full replication is optimal — params are tiny, batch math
+    dominates.
+    """
+    return jax.device_put(state, replicated_sharding(mesh))
+
+
+def make_global_batch(mesh: Mesh, *host_arrays):
+    """Turn per-process host arrays into global device arrays sharded on
+    ``data``.
+
+    Single-process: a straight ``device_put`` with the named sharding.
+    Multi-process (``jax.distributed``): each process contributes its local
+    shard via ``make_array_from_process_local_data`` — the explicit version
+    of what torch DDP does implicitly with one-rank-one-batch.
+    """
+    sharding = batch_sharding(mesh)
+    out = []
+    for arr in host_arrays:
+        if jax.process_count() > 1:
+            out.append(jax.make_array_from_process_local_data(sharding, arr))
+        else:
+            out.append(jax.device_put(arr, sharding))
+    return tuple(out)

@@ -1,0 +1,7 @@
+from dct_tpu.tracking.client import (  # noqa: F401
+    TrackingClient,
+    LocalTracking,
+    MlflowTracking,
+    get_tracker,
+    RunInfo,
+)

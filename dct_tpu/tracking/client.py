@@ -1,0 +1,258 @@
+"""Experiment tracking: MLflow-compatible client with a local fallback.
+
+The reference logs to an MLflow server (experiment ``weather_forecasting``,
+metrics train_loss/val_loss/val_acc, best checkpoint under artifact path
+``best_checkpoints``; jobs/train_lightning_ddp.py:92-96,146-164) and the
+deploy DAGs *query* that store for the best run ordered by
+``metrics.val_loss ASC`` (dags/azure_auto_deploy.py:32-39). That query is the
+model-selection database of the whole platform, so the tracking API here is
+shaped around it:
+
+- :class:`MlflowTracking` talks to a real MLflow server (import gated — the
+  training hosts get mlflow via their image, like Dockerfile.pytorch:20);
+- :class:`LocalTracking` is a dependency-free file store with the same
+  surface (start_run/log_metrics/log_artifact/search_best_run), used in
+  tests, on hermetic TPU-VMs, and as the offline fallback;
+- :func:`get_tracker` picks MLflow when importable + configured, local
+  otherwise — training never fails because the tracking plane is down.
+
+All methods are no-ops on non-coordinator processes; the reference relies on
+Lightning to dedup its two per-rank MLflow clients (SURVEY §7 hard parts),
+here the gate is explicit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Protocol
+
+
+@dataclass
+class RunInfo:
+    run_id: str
+    experiment: str
+    metrics: dict = field(default_factory=dict)  # final value per key
+    params: dict = field(default_factory=dict)
+    artifact_dir: str | None = None
+
+
+class TrackingClient(Protocol):
+    def start_run(self, params: dict | None = None) -> str: ...
+    def log_metrics(self, metrics: dict, step: int) -> None: ...
+    def log_artifact(self, local_path: str, artifact_path: str) -> None: ...
+    def end_run(self, status: str = "FINISHED") -> None: ...
+    def search_best_run(self, metric: str = "val_loss", mode: str = "min") -> RunInfo | None: ...
+    def download_artifacts(self, run_id: str, artifact_path: str, dst: str) -> str: ...
+
+
+class LocalTracking:
+    """File-backed store: <root>/<experiment>/<run_id>/{meta.json,
+    metrics.jsonl, artifacts/...}."""
+
+    def __init__(self, root: str | None = None, experiment: str = "weather_forecasting"):
+        self.root = root or os.environ.get("DCT_TRACKING_DIR", "mlruns_local")
+        self.experiment = experiment
+        self._run_id: str | None = None
+        self._active = False
+
+    # -- write surface -------------------------------------------------
+    def _run_dir(self, run_id: str) -> str:
+        return os.path.join(self.root, self.experiment, run_id)
+
+    def start_run(self, params: dict | None = None) -> str:
+        self._run_id = uuid.uuid4().hex[:16]
+        d = self._run_dir(self._run_id)
+        os.makedirs(os.path.join(d, "artifacts"), exist_ok=True)
+        meta = {
+            "run_id": self._run_id,
+            "experiment": self.experiment,
+            "start_time": time.time(),
+            "params": params or {},
+            "status": "RUNNING",
+        }
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        self._active = True
+        return self._run_id
+
+    def log_metrics(self, metrics: dict, step: int) -> None:
+        if not self._active:
+            return
+        d = self._run_dir(self._run_id)
+        with open(os.path.join(d, "metrics.jsonl"), "a") as f:
+            f.write(
+                json.dumps(
+                    {"step": int(step), "time": time.time(),
+                     **{k: float(v) for k, v in metrics.items()}}
+                )
+                + "\n"
+            )
+
+    def log_artifact(self, local_path: str, artifact_path: str) -> None:
+        if not self._active:
+            return
+        d = os.path.join(self._run_dir(self._run_id), "artifacts", artifact_path)
+        os.makedirs(d, exist_ok=True)
+        shutil.copy2(local_path, d)
+
+    def end_run(self, status: str = "FINISHED") -> None:
+        if not self._active:
+            return
+        d = self._run_dir(self._run_id)
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        meta["status"] = status
+        meta["end_time"] = time.time()
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        self._active = False
+
+    # -- query surface (the deploy DAGs' selection query) --------------
+    def _final_metrics(self, run_dir: str) -> dict:
+        path = os.path.join(run_dir, "metrics.jsonl")
+        out: dict = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    out.update(
+                        {k: v for k, v in rec.items() if k not in ("step", "time")}
+                    )
+        return out
+
+    def search_best_run(self, metric: str = "val_loss", mode: str = "min") -> RunInfo | None:
+        """The analog of mlflow ``search_runs(order_by=["metrics.val_loss
+        ASC"], max_results=1)`` (dags/azure_auto_deploy.py:32-35)."""
+        exp_dir = os.path.join(self.root, self.experiment)
+        if not os.path.isdir(exp_dir):
+            return None
+        best: RunInfo | None = None
+        sign = 1.0 if mode == "min" else -1.0
+        for run_id in os.listdir(exp_dir):
+            run_dir = os.path.join(exp_dir, run_id)
+            meta_path = os.path.join(run_dir, "meta.json")
+            if not os.path.isfile(meta_path):
+                continue
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if meta.get("status") != "FINISHED":
+                continue
+            metrics = self._final_metrics(run_dir)
+            if metric not in metrics:
+                continue
+            if best is None or sign * metrics[metric] < sign * best.metrics[metric]:
+                best = RunInfo(
+                    run_id=run_id,
+                    experiment=self.experiment,
+                    metrics=metrics,
+                    params=meta.get("params", {}),
+                    artifact_dir=os.path.join(run_dir, "artifacts"),
+                )
+        return best
+
+    def download_artifacts(self, run_id: str, artifact_path: str, dst: str) -> str:
+        src = os.path.join(self._run_dir(run_id), "artifacts", artifact_path)
+        if not os.path.isdir(src):
+            raise FileNotFoundError(f"No artifacts at {src}")
+        out = os.path.join(dst, artifact_path)
+        os.makedirs(dst, exist_ok=True)
+        if os.path.isdir(out):
+            shutil.rmtree(out)
+        shutil.copytree(src, out)
+        return out
+
+
+class MlflowTracking:
+    """Thin adapter over a real MLflow server (import gated)."""
+
+    def __init__(self, tracking_uri: str, experiment: str = "weather_forecasting"):
+        import mlflow  # gated: present on training-host images, not required here
+
+        self._mlflow = mlflow
+        mlflow.set_tracking_uri(tracking_uri)
+        mlflow.set_experiment(experiment)
+        self.experiment = experiment
+        self._run = None
+
+    def start_run(self, params: dict | None = None) -> str:
+        self._run = self._mlflow.start_run()
+        if params:
+            self._mlflow.log_params(
+                {k: v for k, v in params.items() if v is not None}
+            )
+        return self._run.info.run_id
+
+    def log_metrics(self, metrics: dict, step: int) -> None:
+        self._mlflow.log_metrics({k: float(v) for k, v in metrics.items()}, step=step)
+
+    def log_artifact(self, local_path: str, artifact_path: str) -> None:
+        self._mlflow.log_artifact(local_path, artifact_path=artifact_path)
+
+    def end_run(self, status: str = "FINISHED") -> None:
+        self._mlflow.end_run(status=status)
+
+    def search_best_run(self, metric: str = "val_loss", mode: str = "min") -> RunInfo | None:
+        order = "ASC" if mode == "min" else "DESC"
+        exp = self._mlflow.get_experiment_by_name(self.experiment)
+        if exp is None:
+            return None
+        runs = self._mlflow.search_runs(
+            experiment_ids=[exp.experiment_id],
+            order_by=[f"metrics.{metric} {order}"],
+            max_results=1,
+        )
+        if len(runs) == 0:
+            return None
+        row = runs.iloc[0]
+        return RunInfo(
+            run_id=row["run_id"],
+            experiment=self.experiment,
+            metrics={metric: float(row[f"metrics.{metric}"])},
+        )
+
+    def download_artifacts(self, run_id: str, artifact_path: str, dst: str) -> str:
+        from mlflow.tracking import MlflowClient
+
+        return MlflowClient().download_artifacts(run_id, artifact_path, dst)
+
+
+class NullTracking:
+    """No-op client for non-coordinator processes."""
+
+    def start_run(self, params=None):
+        return "null"
+
+    def log_metrics(self, metrics, step):
+        pass
+
+    def log_artifact(self, local_path, artifact_path):
+        pass
+
+    def end_run(self, status="FINISHED"):
+        pass
+
+    def search_best_run(self, metric="val_loss", mode="min"):
+        return None
+
+    def download_artifacts(self, run_id, artifact_path, dst):
+        raise FileNotFoundError("NullTracking has no artifacts")
+
+
+def get_tracker(
+    *, tracking_uri: str | None, experiment: str, coordinator: bool = True
+):
+    """MLflow if configured + importable, else local file store; Null on
+    non-coordinator ranks (explicit version of Lightning's rank dedup)."""
+    if not coordinator:
+        return NullTracking()
+    if tracking_uri:
+        try:
+            return MlflowTracking(tracking_uri, experiment)
+        except Exception:
+            pass  # server down or mlflow absent -> degrade to local store
+    return LocalTracking(experiment=experiment)

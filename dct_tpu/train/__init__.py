@@ -1,0 +1,3 @@
+from dct_tpu.train.state import TrainState, create_train_state  # noqa: F401
+from dct_tpu.train.steps import make_train_step, make_eval_step  # noqa: F401
+from dct_tpu.train.trainer import Trainer, TrainResult  # noqa: F401

@@ -1,0 +1,61 @@
+"""Train state: params + optimizer state + step + rng, as one pytree.
+
+The reference's equivalent state lives scattered across a LightningModule,
+its implicit torch ``Adam`` state, and Lightning's loop counters
+(jobs/train_lightning_ddp.py:51-88,131-143). Here it is a single immutable
+pytree so the whole update is a pure function ``state -> state`` that XLA
+compiles once and shards over the mesh, and that Orbax can checkpoint/restore
+atomically (the reference can only save weights, never resume; SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.core import FrozenDict
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray  # scalar int32
+    params: Any
+    opt_state: Any
+    rng: jax.Array  # dropout key, folded per step
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    apply_fn: Any = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1, params=new_params, opt_state=new_opt_state
+        )
+
+
+def create_train_state(
+    model, *, input_dim: int, lr: float, seed: int
+) -> TrainState:
+    """Initialize params (torch-matching init lives in the model) and Adam.
+
+    optax.adam defaults (b1=0.9, b2=0.999, eps=1e-8) match torch.optim.Adam
+    defaults, so the optimizer trajectory is comparable to the reference's
+    ``Adam(self.parameters(), lr=0.01)`` (jobs/train_lightning_ddp.py:88).
+    """
+    root = jax.random.PRNGKey(seed)
+    init_key, dropout_key = jax.random.split(root)
+    params = model.init(init_key, jnp.zeros((1, input_dim), jnp.float32))
+    if isinstance(params, FrozenDict):
+        params = params.unfreeze()
+    tx = optax.adam(learning_rate=lr)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        rng=dropout_key,
+        tx=tx,
+        apply_fn=model.apply,
+    )

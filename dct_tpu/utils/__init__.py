@@ -1,0 +1,1 @@
+from dct_tpu.utils.logging import get_logger  # noqa: F401

@@ -1,0 +1,33 @@
+"""Process-aware logging.
+
+The reference's observability is bare prints with PYTHONUNBUFFERED=1
+(Dockerfile.pytorch:26) collected by Airflow task logs. Here every record is
+prefixed with the JAX process index so interleaved multi-host logs from the
+orchestrator's join (dags/2_pytorch_training.py:62-75 analog) stay legible.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def get_logger(name: str = "dct_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        try:
+            import jax
+
+            rank = jax.process_index()
+        except Exception:
+            rank = 0
+        handler.setFormatter(
+            logging.Formatter(
+                f"[%(asctime)s rank={rank}] %(levelname)s %(name)s: %(message)s"
+            )
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""ETL job entry: weather.csv -> normalized parquet directory.
+
+The analog of the reference Spark job (jobs/preprocess.py there): same label
+encoding, same per-column z-score, same ``<out>/data.parquet`` directory
+contract. Uses the real Spark cluster when pyspark is importable and
+``DCT_ETL_ENGINE != native`` (the north star keeps Spark); otherwise runs the
+native vectorized transform — bit-compatible output either way.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _spark_available() -> bool:
+    try:
+        import pyspark  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def main() -> int:
+    input_csv = os.environ.get("DCT_RAW_CSV", "data/raw/weather.csv")
+    output_dir = os.environ.get("DCT_PROCESSED_DIR", "data/processed")
+    engine = os.environ.get("DCT_ETL_ENGINE", "auto")
+
+    print("=" * 80)
+    print("Step 1: Weather Data Preprocessing (TPU-native pipeline)")
+    print("=" * 80)
+
+    if engine == "spark" or (engine == "auto" and _spark_available()):
+        from dct_tpu.etl.spark_job import preprocess_with_spark
+
+        out = preprocess_with_spark(input_csv, output_dir)
+    else:
+        from dct_tpu.etl.preprocess import preprocess_csv_to_parquet
+
+        out = preprocess_csv_to_parquet(input_csv, output_dir)
+
+    print(f"✓ Preprocessing complete: {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""SPMD training entry point — the TPU-native train_lightning_ddp.py.
+
+The orchestrator launches this *identical* script on every TPU-VM host (the
+reference launches the identical train_lightning_ddp.py in both containers,
+dags/2_pytorch_training.py:49-78). Per-host behavior:
+
+1. read rendezvous + hyperparameters from env (reference contract honored:
+   WORLD_SIZE / NODE_RANK / MASTER_ADDR / MASTER_PORT / MLFLOW_TRACKING_URI);
+2. ``jax.distributed.initialize()`` when WORLD_SIZE > 1;
+3. run the Trainer (jit + mesh; XLA collectives replace gloo);
+4. coordinator uploads the best checkpoint to the tracking store under
+   ``best_checkpoints`` (jobs/train_lightning_ddp.py:146-164 analog).
+
+Exit code is 0 only on full success — the orchestration layer's exit-code
+conjunction over hosts (dags/2_pytorch_training.py:62-75) works unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main() -> int:
+    from dct_tpu.config import RunConfig
+    from dct_tpu.parallel.distributed import initialize_from_env
+    from dct_tpu.train.trainer import Trainer
+    from dct_tpu.utils.logging import get_logger
+
+    cfg = RunConfig.from_env()
+    initialize_from_env(cfg.dist)
+
+    log = get_logger("train_tpu")
+    import jax
+
+    log.info(
+        "devices=%d processes=%d process_index=%d platform=%s",
+        jax.device_count(),
+        jax.process_count(),
+        jax.process_index(),
+        jax.devices()[0].platform,
+    )
+
+    trainer = Trainer(cfg)
+    result = trainer.fit()
+
+    log.info(
+        "done: val_loss=%.4f val_acc=%.4f samples/sec=%.1f best=%s",
+        result.val_loss,
+        result.val_acc,
+        result.samples_per_sec,
+        result.best_model_path,
+    )
+    # Only the coordinator writes checkpoints; workers succeed iff training
+    # completed (they'd have raised otherwise). Checking the file on every
+    # rank would fail all multi-host runs at the orchestrator's exit-code
+    # conjunction.
+    if jax.process_index() == 0 and not (
+        result.best_model_path and os.path.exists(result.best_model_path)
+    ):
+        log.error("CRITICAL: no model file produced")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""Dataset contract tests, mirroring the reference's strict checks
+(jobs/train_lightning_ddp.py:22-26,37-46)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from dct_tpu.data.dataset import load_processed_dataset
+from dct_tpu.data.pipeline import BatchLoader, train_val_split
+
+
+def test_load(weather_data):
+    assert weather_data.input_dim == 5
+    assert weather_data.features.dtype == np.float32
+    assert weather_data.labels.dtype == np.int32
+    assert len(weather_data) == 800
+    assert all(n.endswith("_norm") for n in weather_data.feature_names)
+
+
+def test_missing_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="CRITICAL"):
+        load_processed_dataset(str(tmp_path))
+
+
+def test_no_norm_columns_raises(tmp_path):
+    pdir = tmp_path / "data.parquet"
+    pdir.mkdir()
+    pq.write_table(
+        pa.table({"a": [1.0], "label_encoded": [0]}), pdir / "part-0.parquet"
+    )
+    with pytest.raises(ValueError, match="_norm"):
+        load_processed_dataset(str(tmp_path))
+
+
+def test_split_is_deterministic_and_80_20():
+    t1, v1 = train_val_split(100, val_fraction=0.2, seed=42)
+    t2, v2 = train_val_split(100, val_fraction=0.2, seed=42)
+    np.testing.assert_array_equal(t1, t2)
+    assert len(t1) == 80 and len(v1) == 20
+    assert set(t1) | set(v1) == set(range(100))
+    t3, _ = train_val_split(100, val_fraction=0.2, seed=43)
+    assert not np.array_equal(t1, t3)
+
+
+def test_batch_loader_shapes_and_masking(weather_data):
+    idx = np.arange(10)
+    loader = BatchLoader(weather_data, idx, global_batch=4, shuffle=False)
+    batches = list(loader.epoch(0))
+    assert len(batches) == 3  # ceil(10/4)
+    for b in batches:
+        assert b.x.shape == (4, 5)
+        assert b.y.shape == (4,)
+    # Final batch has 2 real rows.
+    assert batches[-1].weight.sum() == 2.0
+    total_real = sum(b.weight.sum() for b in batches)
+    assert total_real == 10.0
+
+
+def test_batch_loader_shuffles_per_epoch(weather_data):
+    idx = np.arange(64)
+    loader = BatchLoader(weather_data, idx, global_batch=64, shuffle=True, seed=1)
+    e0 = next(loader.epoch(0)).x
+    e0_again = next(loader.epoch(0)).x
+    e1 = next(loader.epoch(1)).x
+    np.testing.assert_array_equal(e0, e0_again)
+    assert not np.array_equal(e0, e1)
+
+
+def test_process_sharding_partitions_batch(weather_data):
+    idx = np.arange(16)
+    full = BatchLoader(weather_data, idx, global_batch=8, shuffle=False)
+    shard0 = BatchLoader(
+        weather_data, idx, global_batch=8, shuffle=False, num_processes=2, process_id=0
+    )
+    shard1 = BatchLoader(
+        weather_data, idx, global_batch=8, shuffle=False, num_processes=2, process_id=1
+    )
+    for bf, b0, b1 in zip(full.epoch(0), shard0.epoch(0), shard1.epoch(0)):
+        assert b0.x.shape == (4, 5) and b1.x.shape == (4, 5)
+        merged = np.empty_like(bf.x)
+        merged[0::2] = b0.x
+        merged[1::2] = b1.x
+        np.testing.assert_array_equal(merged, bf.x)

@@ -1,0 +1,62 @@
+"""ETL parity: native transform must reproduce the Spark job's semantics
+(reference jobs/preprocess.py:18-51)."""
+
+import os
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from dct_tpu.etl.preprocess import DEFAULT_FEATURES, preprocess_csv_to_parquet
+
+
+def test_output_is_spark_style_parquet_directory(processed_dir):
+    pdir = os.path.join(processed_dir, "data.parquet")
+    assert os.path.isdir(pdir), "must be a directory like Spark's writer output"
+    assert os.path.exists(os.path.join(pdir, "_SUCCESS"))
+    assert any(f.endswith(".parquet") for f in os.listdir(pdir))
+
+
+def test_columns_restricted_to_norm_plus_label(processed_dir):
+    table = pq.read_table(os.path.join(processed_dir, "data.parquet"))
+    expected = {f"{c}_norm" for c in DEFAULT_FEATURES} | {"label_encoded"}
+    assert set(table.column_names) == expected
+
+
+def test_zscore_semantics(processed_dir, weather_csv):
+    import pyarrow.csv as pacsv
+
+    raw = pacsv.read_csv(weather_csv)
+    table = pq.read_table(os.path.join(processed_dir, "data.parquet"))
+    for c in DEFAULT_FEATURES:
+        col_raw = raw.column(c).to_numpy(zero_copy_only=False).astype(np.float64)
+        col_norm = table.column(f"{c}_norm").to_numpy(zero_copy_only=False)
+        # Spark stddev is the sample stddev (ddof=1).
+        expected = (col_raw - col_raw.mean()) / col_raw.std(ddof=1)
+        np.testing.assert_allclose(col_norm, expected, rtol=1e-10)
+        assert abs(col_norm.mean()) < 1e-9
+        assert abs(col_norm.std(ddof=1) - 1.0) < 1e-9
+
+
+def test_label_encoding(processed_dir, weather_csv):
+    import pyarrow.csv as pacsv
+
+    raw = pacsv.read_csv(weather_csv)
+    labels_raw = raw.column("Rain").to_numpy(zero_copy_only=False)
+    table = pq.read_table(os.path.join(processed_dir, "data.parquet"))
+    enc = table.column("label_encoded").to_numpy(zero_copy_only=False)
+    np.testing.assert_array_equal(enc, (labels_raw == "rain").astype(np.int64))
+
+
+def test_overwrite_mode(weather_csv, tmp_path):
+    out = str(tmp_path / "proc")
+    preprocess_csv_to_parquet(weather_csv, out)
+    marker = os.path.join(out, "data.parquet", "stale_file")
+    open(marker, "w").close()
+    preprocess_csv_to_parquet(weather_csv, out)
+    assert not os.path.exists(marker), "overwrite mode must wipe previous output"
+
+
+def test_missing_input_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        preprocess_csv_to_parquet(str(tmp_path / "nope.csv"), str(tmp_path / "o"))

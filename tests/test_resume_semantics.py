@@ -1,0 +1,69 @@
+"""Resume edge cases surfaced in review: completed-run resume must be a
+no-op that does NOT pollute the tracking store, and crash-safe rotation must
+always leave a complete train-state checkpoint."""
+
+import os
+
+import numpy as np
+
+from dct_tpu.checkpoint.manager import TrainStateCheckpointer
+from dct_tpu.config import DataConfig, ModelConfig, RunConfig, TrainConfig
+from dct_tpu.models.registry import get_model
+from dct_tpu.tracking.client import LocalTracking
+from dct_tpu.train.state import create_train_state
+from dct_tpu.train.trainer import Trainer
+
+
+def test_resume_after_complete_run_is_noop(processed_dir, tmp_path):
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp_path / "m")),
+        train=TrainConfig(epochs=1, batch_size=8, bf16_compute=False),
+    )
+    t1 = LocalTracking(root=str(tmp_path / "runs"))
+    Trainer(cfg, tracker=t1).fit()
+    n_runs = len(os.listdir(os.path.join(str(tmp_path / "runs"), "weather_forecasting")))
+
+    cfg2 = RunConfig(
+        data=cfg.data,
+        train=TrainConfig(epochs=1, batch_size=8, bf16_compute=False, resume=True),
+    )
+    t2 = LocalTracking(root=str(tmp_path / "runs"))
+    result = Trainer(cfg2, tracker=t2).fit()
+    assert result.history == []
+    assert os.path.exists(result.best_model_path)  # still points at the model
+    n_runs_after = len(
+        os.listdir(os.path.join(str(tmp_path / "runs"), "weather_forecasting"))
+    )
+    assert n_runs_after == n_runs, "no-op resume must not create a tracking run"
+
+
+def test_state_rotation_survives_existing_checkpoint(tmp_path, rng):
+    model = get_model(ModelConfig(dropout=0.0), input_dim=5)
+    state = create_train_state(model, input_dim=5, lr=0.01, seed=0)
+    ck = TrainStateCheckpointer(str(tmp_path))
+    ck.save(state)
+    first = np.asarray(state.params["params"]["TorchStyleDense_0"]["bias"]).copy()
+
+    # Second save must rotate, not clobber-then-fail.
+    state2 = state.replace(step=state.step + 5)
+    ck.save(state2)
+    assert ck.exists()
+    restored = ck.restore(create_train_state(model, input_dim=5, lr=0.01, seed=1))
+    assert int(restored.step) == 5
+    np.testing.assert_allclose(
+        np.asarray(restored.params["params"]["TorchStyleDense_0"]["bias"]), first
+    )
+    # No stale rotation dirs left behind.
+    assert sorted(os.listdir(str(tmp_path))) == ["state"]
+
+
+def test_restore_falls_back_to_next_dir(tmp_path, rng):
+    """Simulate a crash after writing state.next but before the swap."""
+    model = get_model(ModelConfig(dropout=0.0), input_dim=5)
+    state = create_train_state(model, input_dim=5, lr=0.01, seed=0)
+    ck = TrainStateCheckpointer(str(tmp_path))
+    ck.save(state)
+    os.rename(os.path.join(str(tmp_path), "state"), os.path.join(str(tmp_path), "state.next"))
+    assert ck.exists()
+    restored = ck.restore(create_train_state(model, input_dim=5, lr=0.01, seed=1))
+    assert int(restored.step) == 0

@@ -1,0 +1,61 @@
+"""Tracking-store tests: the local client must support the deploy DAGs'
+model-selection query (best run by val_loss ASC,
+dags/azure_auto_deploy.py:32-39)."""
+
+import os
+
+from dct_tpu.tracking.client import LocalTracking, get_tracker, NullTracking
+
+
+def _run(tr, val_loss, artifact=None):
+    rid = tr.start_run(params={"lr": 0.01})
+    tr.log_metrics({"train_loss": 1.0}, step=5)
+    tr.log_metrics({"val_loss": val_loss, "val_acc": 0.5}, step=10)
+    if artifact:
+        tr.log_artifact(artifact, "best_checkpoints")
+    tr.end_run()
+    return rid
+
+
+def test_search_best_run_orders_by_val_loss(tmp_path):
+    tr = LocalTracking(root=str(tmp_path), experiment="weather_forecasting")
+    _run(tr, 0.8)
+    best_id = _run(tr, 0.3)
+    _run(tr, 0.5)
+    best = tr.search_best_run("val_loss", "min")
+    assert best is not None
+    assert best.run_id == best_id
+    assert abs(best.metrics["val_loss"] - 0.3) < 1e-9
+
+
+def test_unfinished_runs_excluded(tmp_path):
+    tr = LocalTracking(root=str(tmp_path), experiment="weather_forecasting")
+    _run(tr, 0.9)
+    tr.start_run()  # never ended -> RUNNING
+    tr.log_metrics({"val_loss": 0.01}, step=1)
+    best = tr.search_best_run()
+    assert abs(best.metrics["val_loss"] - 0.9) < 1e-9
+
+
+def test_artifact_roundtrip(tmp_path):
+    src = tmp_path / "model.ckpt"
+    src.write_bytes(b"weights")
+    tr = LocalTracking(root=str(tmp_path / "store"), experiment="weather_forecasting")
+    rid = _run(tr, 0.4, artifact=str(src))
+    out = tr.download_artifacts(rid, "best_checkpoints", str(tmp_path / "dl"))
+    files = os.listdir(out)
+    assert files == ["model.ckpt"]
+    assert open(os.path.join(out, files[0]), "rb").read() == b"weights"
+
+
+def test_get_tracker_fallbacks(tmp_path, monkeypatch):
+    monkeypatch.setenv("DCT_TRACKING_DIR", str(tmp_path))
+    # No URI -> local store.
+    tr = get_tracker(tracking_uri=None, experiment="e")
+    assert isinstance(tr, LocalTracking)
+    # URI set but mlflow missing/unreachable -> degrade to local, not crash.
+    tr2 = get_tracker(tracking_uri="http://nope:5000", experiment="e")
+    assert isinstance(tr2, LocalTracking)
+    # Non-coordinator -> null sink (explicit rank-0 gating).
+    tr3 = get_tracker(tracking_uri=None, experiment="e", coordinator=False)
+    assert isinstance(tr3, NullTracking)
