@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Benchmark: parity-config training throughput, TPU-native vs reference stack.
+
+Measures samples/sec/chip for the reference's exact training configuration
+(MLP 5->64->2, dropout 0.2, Adam lr 0.01, batch 4 per rank, seed 42 —
+reference jobs/train_lightning_ddp.py:14,57-61,88,122) on:
+
+- **ours**: the dct_tpu scan-path trainer on the available accelerator
+  (one real TPU chip here);
+- **baseline**: the reference's compute stack — a torch CPU training loop
+  with identical model/optimizer/batch semantics, measured live on this
+  host (the reference publishes no numbers, BASELINE.md; its runtime is
+  2 CPU-container gloo DDP, so single-process torch-CPU is the per-rank
+  baseline).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+ROWS = int(os.environ.get("DCT_BENCH_ROWS", "20000"))
+BATCH = 4  # per-rank parity batch (jobs/train_lightning_ddp.py:122)
+WARMUP_EPOCHS = 1
+TIMED_EPOCHS = int(os.environ.get("DCT_BENCH_EPOCHS", "3"))
+
+
+def _prepare_data(tmp: str):
+    from dct_tpu.data.dataset import load_processed_dataset
+    from dct_tpu.data.synthetic import generate_weather_csv
+    from dct_tpu.etl.preprocess import preprocess_csv_to_parquet
+
+    csv = os.path.join(tmp, "raw", "weather.csv")
+    generate_weather_csv(csv, rows=ROWS, seed=0)
+    processed = os.path.join(tmp, "processed")
+    preprocess_csv_to_parquet(csv, processed)
+    return load_processed_dataset(processed)
+
+
+def bench_tpu(data) -> tuple[float, float]:
+    """Returns (samples_per_sec_per_chip, final_train_loss)."""
+    import jax
+
+    from dct_tpu.config import MeshConfig, ModelConfig
+    from dct_tpu.data.pipeline import BatchLoader, train_val_split
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.parallel.mesh import make_global_epoch, make_mesh, shard_state
+    from dct_tpu.train.state import create_train_state
+    from dct_tpu.train.steps import make_epoch_train_step
+    from dct_tpu.train.trainer import Trainer
+
+    mesh = make_mesh(MeshConfig())
+    n_chips = mesh.size
+    global_batch = BATCH * mesh.shape["data"]
+
+    train_idx, _ = train_val_split(len(data), val_fraction=0.2, seed=42)
+    loader = BatchLoader(data, train_idx, global_batch=global_batch, shuffle=True, seed=42)
+
+    import jax.numpy as jnp
+
+    model = get_model(
+        ModelConfig(), input_dim=data.input_dim, compute_dtype=jnp.bfloat16
+    )
+    state = create_train_state(model, input_dim=data.input_dim, lr=0.01, seed=42)
+    state = shard_state(state, mesh)
+    epoch_train = make_epoch_train_step()
+
+    # Stage + warm up (compile) once.
+    stacks = [Trainer._stack_epoch(loader, e) for e in range(WARMUP_EPOCHS + TIMED_EPOCHS)]
+    g0 = make_global_epoch(mesh, *stacks[0])
+    state, losses = epoch_train(state, *g0)
+    jax.block_until_ready(losses)
+
+    steps_per_epoch = stacks[0][0].shape[0]
+    t0 = time.perf_counter()
+    for e in range(1, 1 + TIMED_EPOCHS):
+        ge = make_global_epoch(mesh, *stacks[e])
+        state, losses = epoch_train(state, *ge)
+    jax.block_until_ready(losses)
+    dt = time.perf_counter() - t0
+
+    samples = TIMED_EPOCHS * steps_per_epoch * global_batch
+    return samples / dt / n_chips, float(jax.device_get(losses)[-1])
+
+
+def bench_torch_reference(data) -> float:
+    """The reference's per-rank training loop, measured on this host's CPU."""
+    import numpy as np
+    import torch
+    import torch.nn.functional as F
+    from torch.utils.data import DataLoader, TensorDataset
+
+    torch.manual_seed(42)
+    feats = torch.from_numpy(np.ascontiguousarray(data.features))
+    labels = torch.from_numpy(np.ascontiguousarray(data.labels)).long()
+    n_train = int(0.8 * len(feats))
+    ds = TensorDataset(feats[:n_train], labels[:n_train])
+    loader = DataLoader(ds, batch_size=BATCH, shuffle=True, num_workers=0)
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(data.input_dim, 64),
+        torch.nn.ReLU(),
+        torch.nn.Dropout(0.2),
+        torch.nn.Linear(64, 2),
+    )
+    opt = torch.optim.Adam(model.parameters(), lr=0.01)
+    model.train()
+
+    # Warm up one pass over a few hundred steps, then time full epochs.
+    it = iter(loader)
+    for _ in range(min(200, len(loader))):
+        x, y = next(it)
+        opt.zero_grad()
+        F.cross_entropy(model(x), y).backward()
+        opt.step()
+
+    timed = max(1, int(os.environ.get("DCT_BENCH_TORCH_EPOCHS", "1")))
+    t0 = time.perf_counter()
+    steps = 0
+    for _ in range(timed):
+        for x, y in loader:
+            opt.zero_grad()
+            F.cross_entropy(model(x), y).backward()
+            opt.step()
+            steps += 1
+    dt = time.perf_counter() - t0
+    return steps * BATCH / dt
+
+
+def main():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        data = _prepare_data(tmp)
+        baseline = bench_torch_reference(data)
+        ours, last_loss = bench_tpu(data)
+
+    print(
+        json.dumps(
+            {
+                "metric": "weather_parity_train_samples_per_sec_per_chip",
+                "value": round(ours, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(ours / baseline, 2),
+                "baseline_torch_cpu_samples_per_sec": round(baseline, 1),
+                "final_train_loss": round(last_loss, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

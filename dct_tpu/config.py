@@ -106,6 +106,10 @@ class TrainConfig:
     resume: bool = False
     # bfloat16 compute on the MXU; params stay f32. Reference is f32 CPU.
     bf16_compute: bool = True
+    # lax.scan the whole epoch as one XLA program (one dispatch/epoch).
+    # Numerically identical to the eager per-step loop; disable only for
+    # datasets too large to stage an epoch in HBM.
+    use_scan: bool = True
 
     @classmethod
     def from_env(cls) -> "TrainConfig":
@@ -117,6 +121,7 @@ class TrainConfig:
         c.log_every_n_steps = _env("DCT_LOG_EVERY_N_STEPS", c.log_every_n_steps, int)
         c.resume = _env("DCT_RESUME", c.resume, bool)
         c.bf16_compute = _env("DCT_BF16_COMPUTE", c.bf16_compute, bool)
+        c.use_scan = _env("DCT_USE_SCAN", c.use_scan, bool)
         return c
 
 

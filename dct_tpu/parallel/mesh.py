@@ -66,6 +66,24 @@ def shard_state(state, mesh: Mesh):
     return jax.device_put(state, replicated_sharding(mesh))
 
 
+def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """For [S, B, ...] epoch stacks: steps replicated, batch dim sharded."""
+    return NamedSharding(mesh, P(None, "data"))
+
+
+def make_global_epoch(mesh: Mesh, *host_arrays):
+    """Per-process [S, B_local, ...] stacks -> global [S, B, ...] arrays
+    sharded over ``data`` on the batch dim."""
+    sharding = stacked_batch_sharding(mesh)
+    out = []
+    for arr in host_arrays:
+        if jax.process_count() > 1:
+            out.append(jax.make_array_from_process_local_data(sharding, arr))
+        else:
+            out.append(jax.device_put(arr, sharding))
+    return tuple(out)
+
+
 def make_global_batch(mesh: Mesh, *host_arrays):
     """Turn per-process host arrays into global device arrays sharded on
     ``data``.

@@ -34,10 +34,20 @@ from dct_tpu.data.dataset import WeatherArrays, load_processed_dataset
 from dct_tpu.data.pipeline import BatchLoader, train_val_split
 from dct_tpu.models.registry import get_model
 from dct_tpu.parallel.distributed import is_coordinator
-from dct_tpu.parallel.mesh import make_global_batch, make_mesh, shard_state
+from dct_tpu.parallel.mesh import (
+    make_global_batch,
+    make_global_epoch,
+    make_mesh,
+    shard_state,
+)
 from dct_tpu.tracking.client import get_tracker
 from dct_tpu.train.state import create_train_state
-from dct_tpu.train.steps import make_eval_step, make_train_step
+from dct_tpu.train.steps import (
+    make_epoch_eval_step,
+    make_epoch_train_step,
+    make_eval_step,
+    make_train_step,
+)
 
 
 @dataclass
@@ -144,8 +154,13 @@ class Trainer:
                 history=[],
                 state=state,
             )
-        train_step = make_train_step()
-        eval_step = make_eval_step()
+        use_scan = cfg.train.use_scan
+        if use_scan:
+            epoch_train = make_epoch_train_step()
+            epoch_eval = make_epoch_eval_step()
+        else:
+            train_step = make_train_step()
+            eval_step = make_eval_step()
 
         meta = {
             "model": cfg.model.name,
@@ -166,24 +181,57 @@ class Trainer:
         total_samples = 0
         train_time = 0.0
 
+        # Pre-staged validation arrays (order is fixed): stacked AND
+        # transferred to device once, reused every epoch.
+        if use_scan:
+            val_global = make_global_epoch(
+                self.mesh, *self._stack_epoch(val_loader, 0)
+            )
+
         for epoch in range(start_epoch, cfg.train.epochs):
             t0 = time.perf_counter()
-            last_loss = None
-            for batch in train_loader.epoch(epoch):
-                x, y, w = make_global_batch(self.mesh, batch.x, batch.y, batch.weight)
-                state, metrics = train_step(state, x, y, w)
-                global_step += 1
-                total_samples += global_batch
-                if global_step % cfg.train.log_every_n_steps == 0:
-                    self.tracker.log_metrics(
-                        {"train_loss": float(jax.device_get(metrics["train_loss"]))},
-                        step=global_step,
+            if use_scan:
+                xs, ys, ws = self._stack_epoch(train_loader, epoch)
+                gxs, gys, gws = make_global_epoch(self.mesh, xs, ys, ws)
+                n_steps = xs.shape[0]
+                state, losses = epoch_train(state, gxs, gys, gws)
+                jax.block_until_ready(state.params)
+                train_time += time.perf_counter() - t0
+                losses_host = jax.device_get(losses)
+                for i in range(n_steps):
+                    if (global_step + i + 1) % cfg.train.log_every_n_steps == 0:
+                        self.tracker.log_metrics(
+                            {"train_loss": float(losses_host[i])},
+                            step=global_step + i + 1,
+                        )
+                global_step += n_steps
+                total_samples += n_steps * global_batch
+                last_loss = losses_host[-1] if n_steps else None
+            else:
+                last_loss = None
+                for batch in train_loader.epoch(epoch):
+                    x, y, w = make_global_batch(
+                        self.mesh, batch.x, batch.y, batch.weight
                     )
-                last_loss = metrics["train_loss"]
-            jax.block_until_ready(state.params)
-            train_time += time.perf_counter() - t0
+                    state, metrics = train_step(state, x, y, w)
+                    global_step += 1
+                    total_samples += global_batch
+                    if global_step % cfg.train.log_every_n_steps == 0:
+                        self.tracker.log_metrics(
+                            {"train_loss": float(jax.device_get(metrics["train_loss"]))},
+                            step=global_step,
+                        )
+                    last_loss = metrics["train_loss"]
+                jax.block_until_ready(state.params)
+                train_time += time.perf_counter() - t0
 
-            val_loss, val_acc = self._evaluate(state, eval_step, val_loader)
+            if use_scan:
+                ls, accs, c = epoch_eval(state, *val_global)
+                cnt = float(jax.device_get(c))
+                val_loss = float(jax.device_get(ls)) / cnt if cnt else float("nan")
+                val_acc = float(jax.device_get(accs)) / cnt if cnt else float("nan")
+            else:
+                val_loss, val_acc = self._evaluate(state, eval_step, val_loader)
             epoch_rec = {
                 "epoch": epoch,
                 "train_loss": float(jax.device_get(last_loss)) if last_loss is not None else float("nan"),
@@ -227,6 +275,28 @@ class Trainer:
             run_id=run_id,
             state=state,
         )
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _stack_epoch(loader, epoch: int):
+        """Stack one epoch of fixed-shape batches into [S, B_local, ...]
+        host arrays for the scan path."""
+        import numpy as np
+
+        xs, ys, ws = [], [], []
+        for b in loader.epoch(epoch):
+            xs.append(b.x)
+            ys.append(b.y)
+            ws.append(b.weight)
+        if not xs:  # empty split: zero-length scan (returns init carry)
+            lb = loader.local_batch
+            f = loader.data.features.shape[1]
+            return (
+                np.zeros((0, lb, f), np.float32),
+                np.zeros((0, lb), np.int32),
+                np.zeros((0, lb), np.float32),
+            )
+        return np.stack(xs), np.stack(ys), np.stack(ws)
 
     # ------------------------------------------------------------------
     def _evaluate(self, state, eval_step, val_loader) -> tuple[float, float]:
