@@ -1,0 +1,119 @@
+"""DAG 5: ``azure_automated_rollout`` — blue/green + shadow + canary.
+
+Parity with reference dags/azure_auto_deploy.py (same DAG id, :188-196):
+unscheduled; chain prepare_package -> deploy_new_slot -> start_shadow ->
+soak -> start_canary -> soak -> full_rollout, with the reference's stage
+parameters (mirror 20%, canary 10%, 30 s soaks, :152-197). Slot state flows
+between tasks via XCom exactly like the reference (:148-149) when running
+under real Airflow; the compat layer passes a shared ``ti`` dict.
+
+Fixed vs reference: env vars are read individually (no ``client_id``
+clobber, :15-19), and the machine itself lives in
+:mod:`dct_tpu.deploy.rollout` where it is unit-tested against an in-memory
+endpoint — something the reference can only exercise against live Azure.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from datetime import datetime
+
+_REPO = os.environ.get("DCT_REPO_ROOT", os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from dct_tpu.orchestration.compat import DAG, BashOperator, PythonOperator  # noqa: E402
+
+DEPLOY_DIR = os.environ.get("DEPLOY_DIR", "/tmp/dct_deploy_package")
+ENDPOINT_NAME = os.environ.get("ENDPOINT_NAME", "weather-endpoint")
+EXPERIMENT = os.environ.get("DCT_EXPERIMENT", "weather_forecasting")
+SOAK_SECONDS = int(os.environ.get("DCT_SOAK_SECONDS", "30"))
+
+
+def _tracker():
+    from dct_tpu.tracking.client import get_tracker
+
+    return get_tracker(
+        tracking_uri=os.environ.get("MLFLOW_TRACKING_URI"), experiment=EXPERIMENT
+    )
+
+
+def _client():
+    if os.environ.get("DCT_DEPLOY_TARGET", "azure") == "azure":
+        from dct_tpu.deploy.azure import AzureEndpointClient
+
+        return AzureEndpointClient()
+    from dct_tpu.deploy.local import LocalEndpointClient
+
+    return LocalEndpointClient()
+
+
+def _orchestrator():
+    from dct_tpu.deploy.rollout import RolloutOrchestrator
+
+    return RolloutOrchestrator(_client(), ENDPOINT_NAME, soak_seconds=SOAK_SECONDS)
+
+
+def prepare_package(**context):
+    from dct_tpu.deploy.rollout import prepare_package as prep
+
+    info = prep(_tracker(), DEPLOY_DIR)
+    print(f"Package ready: run {info['run_id']} val_loss={info['val_loss']}")
+
+
+def deploy_new_slot(ti=None, **context):
+    new_slot, old_slot = _orchestrator().deploy_new_slot(DEPLOY_DIR)
+    if ti is not None:
+        ti.xcom_push(key="new_slot", value=new_slot)
+        ti.xcom_push(key="old_slot", value=old_slot or "")
+    print(f"Deployed to slot {new_slot} (old: {old_slot})")
+
+
+def _slots(ti):
+    new_slot = ti.xcom_pull(task_ids="deploy_new_slot", key="new_slot")
+    old_slot = ti.xcom_pull(task_ids="deploy_new_slot", key="old_slot") or None
+    return new_slot, old_slot
+
+
+def start_shadow(ti=None, **context):
+    new_slot, old_slot = _slots(ti)
+    if old_slot is None:
+        print("First deployment — skipping shadow, going straight to 100%")
+        _orchestrator().full_rollout(new_slot, None)
+        return
+    _orchestrator().start_shadow(new_slot, old_slot)
+    print(f"Shadow: {old_slot} 100% live, {new_slot} mirroring 20%")
+
+
+def start_canary(ti=None, **context):
+    new_slot, old_slot = _slots(ti)
+    if old_slot is None:
+        return
+    _orchestrator().start_canary(new_slot, old_slot)
+    print(f"Canary: {old_slot} 90% / {new_slot} 10%")
+
+
+def full_rollout(ti=None, **context):
+    new_slot, old_slot = _slots(ti)
+    _orchestrator().full_rollout(new_slot, old_slot)
+    print(f"Full rollout: {new_slot} at 100%, old slot removed")
+
+
+with DAG(
+    dag_id="azure_automated_rollout",
+    description="Automated blue/green rollout with shadow + canary stages",
+    schedule_interval=None,
+    start_date=datetime(2024, 1, 1),
+    catchup=False,
+    tags=["deploy", "tpu-pipeline"],
+) as dag:
+    t_prepare = PythonOperator(task_id="prepare_package", python_callable=prepare_package)
+    t_deploy = PythonOperator(task_id="deploy_new_slot", python_callable=deploy_new_slot)
+    t_shadow = PythonOperator(task_id="start_shadow", python_callable=start_shadow)
+    t_soak1 = BashOperator(task_id="shadow_soak", bash_command=f"sleep {SOAK_SECONDS}")
+    t_canary = PythonOperator(task_id="start_canary", python_callable=start_canary)
+    t_soak2 = BashOperator(task_id="canary_soak", bash_command=f"sleep {SOAK_SECONDS}")
+    t_full = PythonOperator(task_id="full_rollout", python_callable=full_rollout)
+
+    t_prepare >> t_deploy >> t_shadow >> t_soak1 >> t_canary >> t_soak2 >> t_full

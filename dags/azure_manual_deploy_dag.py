@@ -1,0 +1,79 @@
+"""DAG 4: ``azure_manual_deploy`` — force-deploy the best model at 100%.
+
+Parity with reference dags/azure_manual_deploy.py (same DAG id, :170-173):
+unscheduled, two tasks — ``prepare_package`` (best-run query -> deploy
+package) and ``force_deploy`` (get-or-recreate endpoint, deploy ``blue``,
+100% traffic, :137-167).
+
+The packaging/serving generation lives in :mod:`dct_tpu.deploy.rollout` /
+:mod:`dct_tpu.serving.score_gen` (tested, not inline strings like the
+reference :54-134), the endpoint comes from a client factory
+(``DCT_DEPLOY_TARGET=azure`` -> Azure ML, anything else -> the local
+in-memory endpoint for smoke runs), and the reference's env-var clobber bug
+(azure_auto_deploy.py:15-19) is structurally gone.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from datetime import datetime
+
+_REPO = os.environ.get("DCT_REPO_ROOT", os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from dct_tpu.orchestration.compat import DAG, PythonOperator  # noqa: E402
+
+DEPLOY_DIR = os.environ.get("DEPLOY_DIR", "/tmp/dct_deploy_package")
+ENDPOINT_NAME = os.environ.get("ENDPOINT_NAME", "weather-endpoint")
+EXPERIMENT = os.environ.get("DCT_EXPERIMENT", "weather_forecasting")
+
+
+def _tracker():
+    from dct_tpu.tracking.client import get_tracker
+
+    return get_tracker(
+        tracking_uri=os.environ.get("MLFLOW_TRACKING_URI"), experiment=EXPERIMENT
+    )
+
+
+def _client():
+    if os.environ.get("DCT_DEPLOY_TARGET", "azure") == "azure":
+        from dct_tpu.deploy.azure import AzureEndpointClient
+
+        return AzureEndpointClient()
+    from dct_tpu.deploy.local import LocalEndpointClient
+
+    return LocalEndpointClient()
+
+
+def prepare_package(**context):
+    from dct_tpu.deploy.rollout import prepare_package as prep
+
+    info = prep(_tracker(), DEPLOY_DIR)
+    print(f"Package ready: run {info['run_id']} val_loss={info['val_loss']}")
+    return info["run_id"]
+
+
+def force_deploy(**context):
+    from dct_tpu.deploy.rollout import RolloutOrchestrator
+
+    ro = RolloutOrchestrator(_client(), ENDPOINT_NAME)
+    ro.ensure_endpoint()
+    ro.client.deploy(ENDPOINT_NAME, "blue", DEPLOY_DIR)
+    ro.client.set_traffic(ENDPOINT_NAME, {"blue": 100})
+    print(f"Deployed 'blue' at 100% on {ENDPOINT_NAME}")
+
+
+with DAG(
+    dag_id="azure_manual_deploy",
+    description="Manual force-deploy of the best tracked model",
+    schedule_interval=None,
+    start_date=datetime(2024, 1, 1),
+    catchup=False,
+    tags=["deploy", "tpu-pipeline"],
+) as dag:
+    t_prepare = PythonOperator(task_id="prepare_package", python_callable=prepare_package)
+    t_deploy = PythonOperator(task_id="force_deploy", python_callable=force_deploy)
+    t_prepare >> t_deploy

@@ -1,0 +1,116 @@
+"""DAG 2: ``pytorch_training_pipeline`` — the distributed training launch.
+
+Parity with reference dags/2_pytorch_training.py (same DAG id kept for
+drop-in compatibility, :13-21): externally triggered, retries=1/5min, and
+the task chain banner -> zombie cleanup -> host healthcheck -> SPMD launch
+-> checkpoint verification -> trigger ``azure_automated_rollout`` (:94-98).
+
+The launch block semantics are the reference's (:49-78) — identical script
+on every host, staggered start, PID join, exit-code conjunction — but the
+hosts are TPU-VM workers reached via a templated exec mechanism
+(``ssh {host} {cmd}`` by default; ``docker exec {host} {cmd}`` reproduces
+the compose topology), and the program is the JAX SPMD trainer
+``jobs/train_tpu.py``, with rendezvous via ``jax.distributed.initialize``
+instead of a gloo TCP store. ``DCT_TRAIN_HOSTS=local`` collapses the launch
+to a single in-place process (single-host TPU slice: all chips on one VM,
+no multi-process rendezvous needed).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from datetime import datetime, timedelta
+
+_REPO = os.environ.get("DCT_REPO_ROOT", os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from dct_tpu.launch.launcher import (  # noqa: E402
+    build_healthcheck_script,
+    build_spmd_launch_script,
+    build_zombie_cleanup_script,
+)
+from dct_tpu.orchestration.compat import (  # noqa: E402
+    DAG,
+    BashOperator,
+    TriggerDagRunOperator,
+)
+
+HOSTS = os.environ.get("DCT_TRAIN_HOSTS", "local").split(",")
+EXEC = os.environ.get("DCT_EXEC_TEMPLATE", "ssh {host} {cmd}")
+TRAIN_CMD = os.environ.get(
+    "DCT_TRAIN_COMMAND", f"python3 {_REPO}/jobs/train_tpu.py"
+)
+MODELS_DIR = os.environ.get("DCT_MODELS_DIR", "data/models")
+LOCAL_MODE = HOSTS == ["local"]
+
+default_args = {
+    "owner": "dct-tpu",
+    "retries": 1,
+    "retry_delay": timedelta(minutes=5),
+}
+
+with DAG(
+    dag_id="pytorch_training_pipeline",
+    default_args=default_args,
+    description="TPU SPMD training (JAX/XLA) on the processed weather data",
+    schedule_interval=None,  # externally triggered by the ETL DAG
+    start_date=datetime(2024, 1, 1),
+    catchup=False,
+    tags=["training", "tpu-pipeline"],
+) as dag:
+    start = BashOperator(
+        task_id="start_banner",
+        bash_command="echo '=== TPU DISTRIBUTED TRAINING START ==='",
+    )
+
+    if LOCAL_MODE:
+        cleanup = BashOperator(
+            task_id="cleanup_zombies",
+            bash_command="pkill -9 -f '[t]rain_tpu.py' || true; sleep 2; echo 'Cleanup complete'",
+        )
+        health = BashOperator(
+            task_id="check_tpu_hosts",
+            bash_command="python3 -c 'import jax; print(jax.devices())'",
+        )
+        launch = BashOperator(
+            task_id="tpu_spmd_training",
+            bash_command=f"cd {_REPO} && {TRAIN_CMD}",
+            execution_timeout=timedelta(hours=3),
+        )
+    else:
+        cleanup = BashOperator(
+            task_id="cleanup_zombies",
+            bash_command=build_zombie_cleanup_script(
+                HOSTS, exec_template=EXEC, pattern="train_tpu.py"
+            ),
+        )
+        health = BashOperator(
+            task_id="check_tpu_hosts",
+            bash_command=build_healthcheck_script(HOSTS, exec_template=EXEC),
+        )
+        launch = BashOperator(
+            task_id="tpu_spmd_training",
+            bash_command=build_spmd_launch_script(
+                HOSTS, TRAIN_CMD, exec_template=EXEC
+            ),
+            execution_timeout=timedelta(hours=3),
+        )
+
+    verify = BashOperator(
+        task_id="verify_model",
+        bash_command=(
+            f"ls {MODELS_DIR}/*.ckpt > /dev/null 2>&1 "
+            "&& echo 'Model checkpoint present' "
+            "|| (echo 'No checkpoint produced'; exit 1)"
+        ),
+    )
+
+    trigger_deploy = TriggerDagRunOperator(
+        task_id="trigger_azure_rollout",
+        trigger_dag_id="azure_automated_rollout",
+        wait_for_completion=False,
+    )
+
+    start >> cleanup >> health >> launch >> verify >> trigger_deploy

@@ -138,7 +138,10 @@ class TrainStateCheckpointer:
         # serialization; the target treedef at restore time supplies the
         # structure instead.
         leaves = jax.tree.leaves(_to_host(self._tree(state)))
-        ckptr = ocp.PyTreeCheckpointer()
+        # primary_host=None -> every process writes its own (host-local)
+        # checkpoint; the default primary-host-0 mode assumes a shared
+        # filesystem and silently writes nothing on other ranks.
+        ckptr = ocp.PyTreeCheckpointer(primary_host=None)
         import shutil
 
         next_dir = self._dir(self._NEXT)
@@ -166,7 +169,7 @@ class TrainStateCheckpointer:
         candidates = self._restore_candidates()
         if not candidates:
             raise FileNotFoundError(f"No train-state checkpoint under {self.dirpath}")
-        ckptr = ocp.PyTreeCheckpointer()
+        ckptr = ocp.PyTreeCheckpointer(primary_host=None)
         restored = ckptr.restore(candidates[0])
         template = self._tree(state)
         treedef = jax.tree.structure(template)

@@ -6,8 +6,8 @@ Replaces three implicit mechanisms of the reference with explicit ones:
    (jobs/train_lightning_ddp.py:14,117-119) -> a seeded permutation split.
 2. Lightning's auto-injected ``DistributedSampler`` (implicit; every rank
    loads the full dataset at jobs/train_lightning_ddp.py:114 and the sampler
-   hands each rank an interleaved shard) -> an explicit per-process interleaved
-   shard of the shuffled index stream.
+   hands each rank a shard) -> an explicit contiguous per-process block of
+   each shuffled global batch.
 3. ``DataLoader(batch_size=4, shuffle=True)`` with a ragged final batch
    (:122-123) -> fixed-shape batches padded to the global batch size with a
    weight mask, so a single jit-compiled step serves every batch (XLA traces
@@ -54,13 +54,19 @@ class BatchLoader:
 
     ``global_batch`` is the cross-process, cross-device batch (the reference's
     per-rank batch 4 x world_size). Each call to :meth:`epoch` yields batches
-    covering this process's interleaved shard of the (optionally shuffled)
-    index stream; shapes are always ``[global_batch // num_processes, ...]``.
+    covering this process's block of each (optionally shuffled) global batch;
+    shapes are always ``[global_batch // num_processes, ...]``.
 
-    Interleaved sharding (index ``i`` goes to process ``i % num_processes``)
-    matches torch ``DistributedSampler``'s round-robin assignment, and like the
-    sampler we pad the stream (by wrapping) so every process sees the same
-    number of batches — mandatory for SPMD collectives to line up.
+    Sharding is by contiguous block: process ``p`` takes rows
+    ``[p*B_local, (p+1)*B_local)`` of every global batch. Unlike torch
+    ``DistributedSampler``'s round-robin, block sharding means
+    ``jax.make_array_from_process_local_data`` reassembles the global batch
+    in EXACTLY single-process row order — so a W-process run is bitwise the
+    same program as a 1-process run on the same global batch (same dropout
+    mask assignment, same reduction tree), which makes DDP-equivalence
+    directly testable. Like the sampler, the stream is padded (by wrapping)
+    so every process sees the same number of batches — mandatory for SPMD
+    collectives to line up.
     """
 
     def __init__(
@@ -93,11 +99,43 @@ class BatchLoader:
         n = len(self.indices)
         return max(1, -(-n // self.global_batch)) if n else 0
 
-    def epoch(self, epoch: int) -> Iterator[Batch]:
+    def _epoch_indices(self, epoch: int) -> np.ndarray:
         idx = self.indices
         if self.shuffle:
             rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch]))
             idx = idx[rng.permutation(len(idx))]
+        return idx
+
+    def epoch_stacked(self, epoch: int):
+        """The whole epoch as three [S, B_local, ...] arrays in one
+        vectorized gather — identical indices/weights to :meth:`epoch`,
+        built without a per-batch Python loop (the scan path feeds the
+        accelerator one epoch at a time; host assembly must not become the
+        bottleneck)."""
+        idx = self._epoch_indices(epoch)
+        n = len(idx)
+        lb, gb = self.local_batch, self.global_batch
+        if n == 0:
+            f = self.data.features.shape[1]
+            return (
+                np.zeros((0, lb, f), np.float32),
+                np.zeros((0, lb), np.int32),
+                np.zeros((0, lb), np.float32),
+            )
+        steps = -(-n // gb)
+        padded = np.resize(idx, steps * gb)  # wrap-pad, like epoch()
+        weights = np.zeros(steps * gb, np.float32)
+        weights[:n] = 1.0
+        sl = slice(self.process_id * lb, (self.process_id + 1) * lb)
+        mat = padded.reshape(steps, gb)[:, sl]
+        return (
+            self.data.features[mat],
+            self.data.labels[mat],
+            weights.reshape(steps, gb)[:, sl],
+        )
+
+    def epoch(self, epoch: int) -> Iterator[Batch]:
+        idx = self._epoch_indices(epoch)
         n = len(idx)
         if n == 0:
             return
@@ -110,8 +148,12 @@ class BatchLoader:
                 chunk = np.concatenate([chunk, pad])
             weight = np.zeros(self.global_batch, np.float32)
             weight[:real] = 1.0
-            # Interleaved per-process shard (DistributedSampler analog).
-            sl = slice(self.process_id, None, self.num_processes)
+            # Contiguous per-process block (DistributedSampler analog with
+            # order-preserving global reassembly).
+            sl = slice(
+                self.process_id * self.local_batch,
+                (self.process_id + 1) * self.local_batch,
+            )
             yield Batch(
                 x=self.data.features[chunk[sl]],
                 y=self.data.labels[chunk[sl]],

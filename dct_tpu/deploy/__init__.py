@@ -1,0 +1,7 @@
+from dct_tpu.deploy.rollout import (  # noqa: F401
+    EndpointClient,
+    choose_slot,
+    prepare_package,
+    RolloutOrchestrator,
+)
+from dct_tpu.deploy.local import LocalEndpointClient  # noqa: F401

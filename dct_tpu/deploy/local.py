@@ -1,0 +1,104 @@
+"""In-memory endpoint client: the test/local-platform deployment target.
+
+Implements the full :class:`~dct_tpu.deploy.rollout.EndpointClient` surface
+with real serving semantics — ``score()`` actually loads the deployed
+package's model.npz and answers inference requests — so the whole
+train->track->package->rollout->infer path runs hermetically (the reference
+can only exercise this against a live Azure subscription)."""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Deployment:
+    package_dir: str
+    weights: dict
+    meta: dict
+
+
+@dataclass
+class _Endpoint:
+    provisioning_state: str = "Succeeded"
+    traffic: dict = field(default_factory=dict)
+    mirror_traffic: dict = field(default_factory=dict)
+    deployments: dict = field(default_factory=dict)
+
+
+class LocalEndpointClient:
+    def __init__(self):
+        self.endpoints: dict[str, _Endpoint] = {}
+        self.ops: list[tuple] = []  # audit log of control-plane calls
+
+    # -- control plane -------------------------------------------------
+    def endpoint_exists(self, endpoint: str) -> bool:
+        return endpoint in self.endpoints
+
+    def create_endpoint(self, endpoint: str) -> None:
+        self.ops.append(("create_endpoint", endpoint))
+        self.endpoints[endpoint] = _Endpoint()
+
+    def delete_endpoint(self, endpoint: str) -> None:
+        self.ops.append(("delete_endpoint", endpoint))
+        self.endpoints.pop(endpoint, None)
+
+    def provisioning_state(self, endpoint: str) -> str:
+        return self.endpoints[endpoint].provisioning_state
+
+    def get_traffic(self, endpoint: str) -> dict:
+        if endpoint not in self.endpoints:
+            return {}
+        return dict(self.endpoints[endpoint].traffic)
+
+    def set_traffic(self, endpoint: str, traffic: dict) -> None:
+        self.ops.append(("set_traffic", endpoint, dict(traffic)))
+        ep = self.endpoints[endpoint]
+        unknown = set(k for k, v in traffic.items() if v > 0) - set(ep.deployments)
+        if unknown:
+            raise ValueError(f"Traffic to nonexistent deployments: {unknown}")
+        ep.traffic = dict(traffic)
+
+    def get_mirror_traffic(self, endpoint: str) -> dict:
+        return dict(self.endpoints[endpoint].mirror_traffic)
+
+    def set_mirror_traffic(self, endpoint: str, traffic: dict) -> None:
+        self.ops.append(("set_mirror_traffic", endpoint, dict(traffic)))
+        self.endpoints[endpoint].mirror_traffic = dict(traffic)
+
+    def deploy(self, endpoint: str, slot: str, package_dir: str) -> None:
+        import numpy as np
+
+        self.ops.append(("deploy", endpoint, slot, package_dir))
+        npz = np.load(os.path.join(package_dir, "model.npz"))
+        with open(os.path.join(package_dir, "model_meta.json")) as f:
+            meta = json.load(f)
+        self.endpoints[endpoint].deployments[slot] = _Deployment(
+            package_dir=package_dir,
+            weights={k: npz[k] for k in npz.files},
+            meta=meta,
+        )
+
+    def delete_deployment(self, endpoint: str, slot: str) -> None:
+        self.ops.append(("delete_deployment", endpoint, slot))
+        self.endpoints[endpoint].deployments.pop(slot, None)
+
+    def list_deployments(self, endpoint: str) -> list[str]:
+        return list(self.endpoints[endpoint].deployments)
+
+    # -- data plane (what Azure's scoring URI does) --------------------
+    def score(self, endpoint: str, payload: dict, *, slot: str | None = None) -> dict:
+        """Route a request like the live endpoint would: to the given slot,
+        or to the max-live-traffic slot."""
+        from dct_tpu.serving.runtime import score_payload
+
+        ep = self.endpoints[endpoint]
+        if slot is None:
+            live = {k: v for k, v in ep.traffic.items() if v > 0}
+            if not live:
+                raise RuntimeError(f"Endpoint {endpoint} has no live traffic")
+            slot = max(live, key=live.get)
+        dep = ep.deployments[slot]
+        return score_payload(dep.weights, dep.meta, payload["data"])

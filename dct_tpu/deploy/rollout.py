@@ -1,0 +1,199 @@
+"""Deployment: packaging + blue/green + shadow + canary rollout.
+
+The capability re-implemented here is the reference's two deploy DAGs:
+
+- ``prepare_package`` (dags/azure_auto_deploy.py:26-115 and
+  azure_manual_deploy.py:28-134): query the tracking store for the best run
+  by ``val_loss ASC``, download its ``best_checkpoints`` artifact, stage
+  ``model.ckpt`` and the generated serving files into a deploy dir;
+- ``deploy_new_slot`` (azure_auto_deploy.py:118-149): read live traffic,
+  pick the idle slot (no traffic -> ``blue``; else the opposite of the
+  current-max-traffic slot);
+- ``start_shadow`` (:152-161): 100/0 live traffic + 20% mirror to the new
+  slot; ``start_canary`` (:163-172): clear mirror, 90/10 live;
+  ``full_rollout`` (:174-185): 100% new, delete old deployment.
+
+Differences by design: the cloud surface is a small :class:`EndpointClient`
+protocol (Azure impl in :mod:`dct_tpu.deploy.azure`, in-memory impl for
+tests/local platforms in :mod:`dct_tpu.deploy.local`) instead of inline SDK
+calls, the reference's env-var clobber bug (azure_auto_deploy.py:15-19
+assigns five getenvs to one variable) is structurally impossible here, and
+state flows between stages as return values instead of Airflow XCom.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Protocol
+
+BLUE, GREEN = "blue", "green"
+
+
+class EndpointClient(Protocol):
+    """Minimal control surface of a managed online endpoint."""
+
+    def endpoint_exists(self, endpoint: str) -> bool: ...
+    def create_endpoint(self, endpoint: str) -> None: ...
+    def delete_endpoint(self, endpoint: str) -> None: ...
+    def provisioning_state(self, endpoint: str) -> str: ...
+    def get_traffic(self, endpoint: str) -> dict[str, int]: ...
+    def set_traffic(self, endpoint: str, traffic: dict[str, int]) -> None: ...
+    def get_mirror_traffic(self, endpoint: str) -> dict[str, int]: ...
+    def set_mirror_traffic(self, endpoint: str, traffic: dict[str, int]) -> None: ...
+    def deploy(self, endpoint: str, slot: str, package_dir: str) -> None: ...
+    def delete_deployment(self, endpoint: str, slot: str) -> None: ...
+    def list_deployments(self, endpoint: str) -> list[str]: ...
+
+
+def prepare_package(tracker, deploy_dir: str) -> dict:
+    """Best-run query -> deploy package. Returns package info.
+
+    Mirrors the reference flow (wipe deploy dir, find best run, download
+    ``best_checkpoints``, take the first .ckpt, generate serving files) and
+    adds the numpy weight export so serving needs no ML framework.
+    """
+    from dct_tpu.serving.score_gen import generate_score_package
+
+    if os.path.isdir(deploy_dir):
+        shutil.rmtree(deploy_dir)
+    os.makedirs(deploy_dir, exist_ok=True)
+
+    best = tracker.search_best_run("val_loss", "min")
+    if best is None:
+        raise RuntimeError(
+            "No finished runs with val_loss found in the tracking store — "
+            "did the training pipeline run?"
+        )
+    art_dir = tracker.download_artifacts(
+        best.run_id, "best_checkpoints", os.path.join(deploy_dir, "_dl")
+    )
+    ckpts = sorted(glob.glob(os.path.join(art_dir, "*.ckpt")))
+    if not ckpts:
+        raise FileNotFoundError(f"No .ckpt in artifact dir {art_dir}")
+    model_ckpt = os.path.join(deploy_dir, "model.ckpt")
+    shutil.copy2(ckpts[0], model_ckpt)
+    shutil.rmtree(os.path.join(deploy_dir, "_dl"))
+
+    meta = generate_score_package(model_ckpt, deploy_dir)
+    return {
+        "run_id": best.run_id,
+        "val_loss": best.metrics.get("val_loss"),
+        "deploy_dir": deploy_dir,
+        "model_meta": meta,
+    }
+
+
+def choose_slot(traffic: dict[str, int]) -> tuple[str, str | None]:
+    """(new_slot, old_slot) from the live traffic map.
+
+    Reference logic (dags/azure_auto_deploy.py:124-129): empty/zero traffic
+    -> deploy ``blue`` with no old slot; otherwise the slot currently
+    holding the most traffic is old, and new is its blue/green opposite.
+    """
+    live = {k: v for k, v in traffic.items() if v > 0}
+    if not live:
+        return BLUE, None
+    old = max(live, key=live.get)
+    return (GREEN if old == BLUE else BLUE), old
+
+
+@dataclass
+class RolloutEvent:
+    stage: str
+    traffic: dict = field(default_factory=dict)
+    mirror: dict = field(default_factory=dict)
+
+
+class RolloutOrchestrator:
+    """The blue/green + shadow + canary state machine.
+
+    ``run()`` executes: deploy_new_slot -> shadow (soak) -> canary (soak)
+    -> full rollout, with the reference's stage parameters (mirror 20%,
+    canary 10%, 30 s soaks — dags/azure_auto_deploy.py:152-185,189-197).
+    Each stage is also callable individually (the DAGs map one task per
+    stage).
+    """
+
+    def __init__(
+        self,
+        client: EndpointClient,
+        endpoint: str,
+        *,
+        mirror_percent: int = 20,
+        canary_percent: int = 10,
+        soak_seconds: float = 30.0,
+        sleep_fn=time.sleep,
+    ):
+        self.client = client
+        self.endpoint = endpoint
+        self.mirror_percent = mirror_percent
+        self.canary_percent = canary_percent
+        self.soak_seconds = soak_seconds
+        self.sleep_fn = sleep_fn
+        self.events: list[RolloutEvent] = []
+
+    # -- stages --------------------------------------------------------
+    def ensure_endpoint(self) -> None:
+        """Get-or-recreate, deleting a failed endpoint first
+        (dags/azure_manual_deploy.py:141-150)."""
+        c = self.client
+        if c.endpoint_exists(self.endpoint):
+            if c.provisioning_state(self.endpoint).lower() == "failed":
+                c.delete_endpoint(self.endpoint)
+                c.create_endpoint(self.endpoint)
+        else:
+            c.create_endpoint(self.endpoint)
+
+    def deploy_new_slot(self, package_dir: str) -> tuple[str, str | None]:
+        self.ensure_endpoint()
+        new_slot, old_slot = choose_slot(self.client.get_traffic(self.endpoint))
+        self.client.deploy(self.endpoint, new_slot, package_dir)
+        if old_slot is None:
+            # First deployment: take 100% immediately (manual-deploy path,
+            # dags/azure_manual_deploy.py:164-167).
+            self.client.set_traffic(self.endpoint, {new_slot: 100})
+        self._record("deploy_new_slot")
+        return new_slot, old_slot
+
+    def start_shadow(self, new_slot: str, old_slot: str) -> None:
+        self.client.set_traffic(self.endpoint, {old_slot: 100, new_slot: 0})
+        self.client.set_mirror_traffic(self.endpoint, {new_slot: self.mirror_percent})
+        self._record("shadow")
+
+    def start_canary(self, new_slot: str, old_slot: str) -> None:
+        self.client.set_mirror_traffic(self.endpoint, {})
+        self.client.set_traffic(
+            self.endpoint,
+            {old_slot: 100 - self.canary_percent, new_slot: self.canary_percent},
+        )
+        self._record("canary")
+
+    def full_rollout(self, new_slot: str, old_slot: str | None) -> None:
+        self.client.set_traffic(self.endpoint, {new_slot: 100})
+        if old_slot and old_slot in self.client.list_deployments(self.endpoint):
+            self.client.delete_deployment(self.endpoint, old_slot)
+        self._record("full_rollout")
+
+    # -- the full machine ---------------------------------------------
+    def run(self, package_dir: str) -> list[RolloutEvent]:
+        new_slot, old_slot = self.deploy_new_slot(package_dir)
+        if old_slot is not None:
+            self.start_shadow(new_slot, old_slot)
+            self.sleep_fn(self.soak_seconds)
+            self.start_canary(new_slot, old_slot)
+            self.sleep_fn(self.soak_seconds)
+        self.full_rollout(new_slot, old_slot)
+        return self.events
+
+    def _record(self, stage: str) -> None:
+        self.events.append(
+            RolloutEvent(
+                stage=stage,
+                traffic=dict(self.client.get_traffic(self.endpoint)),
+                mirror=dict(self.client.get_mirror_traffic(self.endpoint)),
+            )
+        )

@@ -1,0 +1,6 @@
+from dct_tpu.launch.launcher import (  # noqa: F401
+    build_spmd_launch_script,
+    build_zombie_cleanup_script,
+    build_healthcheck_script,
+    LocalProcessLauncher,
+)

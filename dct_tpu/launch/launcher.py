@@ -1,0 +1,188 @@
+"""SPMD launch: the orchestrator-side process-control machinery.
+
+The reference's launcher is an Airflow BashOperator that ``docker exec``s
+the identical training script into both trainer containers, backgrounded,
+staggered by ``sleep 5``, then ``wait``s on both PIDs and requires exit 0
+from each (dags/2_pytorch_training.py:49-78), preceded by a zombie purge
+(``pkill -9 -f train_lightning_ddp.py || true``, :29-38) and an
+import-healthcheck (:40-46).
+
+Here the same semantics are generated for any host-access mechanism
+(``ssh <host>`` for TPU-VM workers — the north-star topology — or
+``docker exec <host>`` for the compose topology), so the training DAG's
+launch block is one call. :class:`LocalProcessLauncher` applies identical
+semantics to local subprocesses, giving the multi-process CPU rig that
+replaces the reference's two-container test bed (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import time
+from dataclasses import dataclass
+
+
+def _remote(exec_template: str, host: str, command: str) -> str:
+    """Wrap ``command`` for one host. exec_template examples:
+    ``ssh {host} {cmd}``, ``docker exec {host} {cmd}``."""
+    return exec_template.format(host=host, cmd=command)
+
+
+def build_zombie_cleanup_script(
+    hosts: list[str],
+    *,
+    exec_template: str = "ssh {host} {cmd}",
+    pattern: str = "train_tpu.py",
+    settle_seconds: int = 2,
+) -> str:
+    """Kill stale ranks on every host before relaunch (the reference's
+    rendezvous-port hygiene, dags/2_pytorch_training.py:29-38)."""
+    lines = ["echo 'Cleaning up zombie training processes...'"]
+    # Bracket the first char so the pattern cannot match the shell that
+    # carries it (pkill -f would otherwise kill its own wrapping bash).
+    safe_pattern = f"[{pattern[0]}]{pattern[1:]}" if pattern else pattern
+    for host in hosts:
+        kill = f"pkill -9 -f {shlex.quote(safe_pattern)} || true"
+        lines.append(_remote(exec_template, host, f"bash -c {shlex.quote(kill)}"))
+    lines.append(f"sleep {settle_seconds}")
+    lines.append("echo 'Cleanup complete'")
+    return "\n".join(lines)
+
+
+def build_healthcheck_script(
+    hosts: list[str],
+    *,
+    exec_template: str = "ssh {host} {cmd}",
+    check_command: str = "python3 -c 'import jax; print(jax.devices())'",
+) -> str:
+    """Verify every host's runtime imports and sees its accelerators
+    (analog of the per-node ``import torch`` check,
+    dags/2_pytorch_training.py:40-46)."""
+    lines = []
+    for host in hosts:
+        lines.append(f"echo 'Checking {host}...'")
+        lines.append(_remote(exec_template, host, f"bash -c {shlex.quote(check_command)}"))
+    lines.append("echo 'All hosts healthy'")
+    return "\n".join(lines)
+
+
+def build_spmd_launch_script(
+    hosts: list[str],
+    command: str,
+    *,
+    exec_template: str = "ssh {host} {cmd}",
+    coordinator_port: int = 29500,
+    stagger_seconds: int = 5,
+    extra_env: dict[str, str] | None = None,
+) -> str:
+    """Generate the launch block: same program on every host, coordinator
+    env injected, staggered start, PID join, exit-code conjunction.
+
+    Host 0 is the coordinator (MASTER_ADDR), mirroring the reference env
+    contract (docker-compose.yml:121-124) so the same script works under
+    both topologies.
+    """
+    world = len(hosts)
+    master = hosts[0]
+    lines = [f"echo 'Launching SPMD training on {world} hosts...'", "set -m"]
+    pid_vars = []
+    for rank, host in enumerate(hosts):
+        env = {
+            "MASTER_ADDR": master,
+            "MASTER_PORT": str(coordinator_port),
+            "NODE_RANK": str(rank),
+            "WORLD_SIZE": str(world),
+            **(extra_env or {}),
+        }
+        env_prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        full = f"{env_prefix} {command}"
+        lines.append(
+            _remote(exec_template, host, f"bash -c {shlex.quote(full)}") + " &"
+        )
+        pid_var = f"PID{rank}"
+        lines.append(f"{pid_var}=$!")
+        pid_vars.append(pid_var)
+        if rank == 0 and world > 1:
+            lines.append(f"sleep {stagger_seconds}")
+    for rank, pv in enumerate(pid_vars):
+        lines.append(f"wait ${pv}; RC{rank}=$?")
+    conj = " && ".join(f'[ "$RC{r}" -eq 0 ]' for r in range(world))
+    lines.append(
+        f'if {conj}; then echo "All {world} ranks finished successfully"; '
+        f'else echo "Training failed: rank exit codes: '
+        + " ".join(f"$RC{r}" for r in range(world))
+        + '"; exit 1; fi'
+    )
+    return "\n".join(lines)
+
+
+@dataclass
+class RankResult:
+    rank: int
+    returncode: int
+
+
+class LocalProcessLauncher:
+    """The two-container rig, without containers: N local processes running
+    the identical SPMD program with coordinator env, staggered start, join,
+    and exit-code conjunction."""
+
+    def __init__(
+        self,
+        *,
+        coordinator_port: int = 29511,
+        stagger_seconds: float = 1.0,
+        timeout: float = 600.0,
+    ):
+        self.coordinator_port = coordinator_port
+        self.stagger_seconds = stagger_seconds
+        self.timeout = timeout
+
+    def cleanup_zombies(self, pattern: str) -> None:
+        subprocess.run(["pkill", "-9", "-f", pattern], check=False)
+        time.sleep(0.5)
+
+    def launch(
+        self,
+        argv: list[str],
+        *,
+        world_size: int,
+        env: dict[str, str] | None = None,
+    ) -> list[RankResult]:
+        procs: list[subprocess.Popen] = []
+        base_env = dict(os.environ)
+        base_env.update(env or {})
+        try:
+            for rank in range(world_size):
+                rank_env = dict(base_env)
+                rank_env.update(
+                    MASTER_ADDR="127.0.0.1",
+                    MASTER_PORT=str(self.coordinator_port),
+                    NODE_RANK=str(rank),
+                    WORLD_SIZE=str(world_size),
+                )
+                procs.append(subprocess.Popen(argv, env=rank_env))
+                if rank == 0 and world_size > 1:
+                    time.sleep(self.stagger_seconds)
+            results = []
+            deadline = time.monotonic() + self.timeout
+            for rank, p in enumerate(procs):
+                remaining = max(1.0, deadline - time.monotonic())
+                try:
+                    rc = p.wait(timeout=remaining)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    rc = -signal.SIGKILL
+                results.append(RankResult(rank=rank, returncode=rc))
+            return results
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+    @staticmethod
+    def all_succeeded(results: list[RankResult]) -> bool:
+        return all(r.returncode == 0 for r in results)

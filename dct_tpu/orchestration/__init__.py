@@ -1,0 +1,7 @@
+from dct_tpu.orchestration.compat import (  # noqa: F401
+    DAG,
+    BashOperator,
+    PythonOperator,
+    TriggerDagRunOperator,
+    AIRFLOW_AVAILABLE,
+)

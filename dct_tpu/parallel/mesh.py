@@ -71,33 +71,28 @@ def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, "data"))
 
 
-def make_global_epoch(mesh: Mesh, *host_arrays):
-    """Per-process [S, B_local, ...] stacks -> global [S, B, ...] arrays
-    sharded over ``data`` on the batch dim."""
-    sharding = stacked_batch_sharding(mesh)
-    out = []
-    for arr in host_arrays:
-        if jax.process_count() > 1:
-            out.append(jax.make_array_from_process_local_data(sharding, arr))
-        else:
-            out.append(jax.device_put(arr, sharding))
-    return tuple(out)
+def _make_global(sharding: NamedSharding, host_arrays):
+    """Per-process host arrays -> global device arrays under ``sharding``.
+
+    Single-process: a straight ``device_put``. Multi-process
+    (``jax.distributed``): each process contributes its local shard via
+    ``make_array_from_process_local_data`` — the explicit version of what
+    torch DDP does implicitly with one-rank-one-batch.
+    """
+    if jax.process_count() > 1:
+        return tuple(
+            jax.make_array_from_process_local_data(sharding, a) for a in host_arrays
+        )
+    return tuple(jax.device_put(a, sharding) for a in host_arrays)
 
 
 def make_global_batch(mesh: Mesh, *host_arrays):
-    """Turn per-process host arrays into global device arrays sharded on
-    ``data``.
+    """[B_local, ...] per-process arrays -> global [B, ...] sharded on
+    ``data``."""
+    return _make_global(batch_sharding(mesh), host_arrays)
 
-    Single-process: a straight ``device_put`` with the named sharding.
-    Multi-process (``jax.distributed``): each process contributes its local
-    shard via ``make_array_from_process_local_data`` — the explicit version
-    of what torch DDP does implicitly with one-rank-one-batch.
-    """
-    sharding = batch_sharding(mesh)
-    out = []
-    for arr in host_arrays:
-        if jax.process_count() > 1:
-            out.append(jax.make_array_from_process_local_data(sharding, arr))
-        else:
-            out.append(jax.device_put(arr, sharding))
-    return tuple(out)
+
+def make_global_epoch(mesh: Mesh, *host_arrays):
+    """[S, B_local, ...] per-process stacks -> global [S, B, ...] arrays
+    sharded over ``data`` on the batch dim."""
+    return _make_global(stacked_batch_sharding(mesh), host_arrays)

@@ -1,0 +1,2 @@
+from dct_tpu.serving.runtime import mlp_forward_numpy, softmax_numpy, score_payload  # noqa: F401
+from dct_tpu.serving.score_gen import generate_score_package  # noqa: F401

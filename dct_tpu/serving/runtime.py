@@ -1,0 +1,52 @@
+"""Dependency-free inference runtime for the deployed model.
+
+The reference's generated ``score.py`` re-declares the torch model class and
+loads a Lightning checkpoint inside the serving container
+(dags/azure_manual_deploy.py:54-125), pulling torch+lightning into the
+inference image and hardcoding ``input_dim=5`` (:109). Here the deploy
+package carries the weights as a plain ``model.npz`` (+ JSON meta with the
+true input_dim/feature names from the checkpoint), and inference is pure
+numpy — the serving container needs no ML framework at all. These functions
+are the single source of truth; the score.py generator embeds this module's
+source verbatim so the deployed copy cannot drift from the tested one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def softmax_numpy(logits: np.ndarray) -> np.ndarray:
+    z = logits - logits.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def mlp_forward_numpy(weights: dict, x: np.ndarray) -> np.ndarray:
+    """Forward pass of the rain-classifier MLP (dropout is inference-off).
+
+    weights keys: w0 [F,H], b0 [H], w1 [H,C], b1 [C] — exported from the
+    flax checkpoint by the packager.
+    """
+    h = np.maximum(x @ weights["w0"] + weights["b0"], 0.0)
+    return h @ weights["w1"] + weights["b1"]
+
+
+def score_payload(weights: dict, meta: dict, data) -> dict:
+    """The run()-body: validate + forward + softmax.
+
+    Mirrors the reference's response contract
+    (dags/azure_manual_deploy.py:116-124): {"probabilities": [[...], ...]}.
+    Input: {"data": [[feature vector], ...]}.
+    """
+    x = np.asarray(data, dtype=np.float32)
+    if x.ndim == 1:
+        x = x[None, :]
+    expected = int(meta["input_dim"])
+    if x.ndim != 2 or x.shape[1] != expected:
+        raise ValueError(
+            f"Expected shape [N, {expected}] (features: "
+            f"{meta.get('feature_names', '?')}), got {list(x.shape)}"
+        )
+    probs = softmax_numpy(mlp_forward_numpy(weights, x))
+    return {"probabilities": probs.tolist()}

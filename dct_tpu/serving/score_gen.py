@@ -1,0 +1,129 @@
+"""Deploy-package generation: checkpoint -> {model.npz, model_meta.json,
+score.py, conda.yaml}.
+
+The analog of the reference's ``prepare_package`` code-generation block
+(dags/azure_manual_deploy.py:46-134), with its two bugs fixed:
+
+- ``input_dim`` is read from the checkpoint's self-describing meta instead
+  of being hardcoded to 5 (:109);
+- the serving stack is numpy-only (conda.yaml without torch/lightning,
+  :127-134) because weights ship as ``model.npz``.
+
+The generated score.py keeps the reference's operational contract:
+``init()`` locates the model under AZUREML_MODEL_DIR with the same
+expected-path -> nested -> os.walk fallback chain (:79-114), ``run()``
+accepts ``{"data": [[...]]}`` and returns ``{"probabilities": [[...]]}``
+(:116-124). The numerical core is embedded verbatim from
+:mod:`dct_tpu.serving.runtime` so the deployed code equals the tested code.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+
+import numpy as np
+
+
+def export_npz_weights(ckpt_path: str, deploy_dir: str) -> dict:
+    """model.ckpt (flax msgpack) -> model.npz + model_meta.json."""
+    from dct_tpu.checkpoint.manager import load_checkpoint
+
+    params, meta = load_checkpoint(ckpt_path)
+    p = params["params"]
+    weights = {
+        "w0": np.asarray(p["TorchStyleDense_0"]["kernel"], np.float32),
+        "b0": np.asarray(p["TorchStyleDense_0"]["bias"], np.float32),
+        "w1": np.asarray(p["TorchStyleDense_1"]["kernel"], np.float32),
+        "b1": np.asarray(p["TorchStyleDense_1"]["bias"], np.float32),
+    }
+    os.makedirs(deploy_dir, exist_ok=True)
+    np.savez(os.path.join(deploy_dir, "model.npz"), **weights)
+    with open(os.path.join(deploy_dir, "model_meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    return meta
+
+
+_SCORE_TEMPLATE = '''"""Generated inference server entry (numpy-only).
+
+Serves the dct_tpu rain classifier: init() loads model.npz/model_meta.json
+from AZUREML_MODEL_DIR (with nested-path fallbacks), run() scores JSON
+payloads {{"data": [[...feature vector...], ...]}}.
+"""
+
+import json
+import os
+
+import numpy as np
+
+# ---- embedded from dct_tpu.serving.runtime (tested source of truth) ----
+{runtime_source}
+# ------------------------------------------------------------------------
+
+_WEIGHTS = None
+_META = None
+
+
+def _locate(name):
+    base = os.environ.get("AZUREML_MODEL_DIR", ".")
+    expected = os.path.join(base, name)
+    if os.path.exists(expected):
+        return expected
+    nested = os.path.join(base, "deploy_package", name)
+    if os.path.exists(nested):
+        return nested
+    for root, _dirs, files in os.walk(base):
+        if name in files:
+            return os.path.join(root, name)
+    raise FileNotFoundError(f"{{name}} not found under {{base}}")
+
+
+def init():
+    global _WEIGHTS, _META
+    npz = np.load(_locate("model.npz"))
+    _WEIGHTS = {{k: npz[k] for k in npz.files}}
+    with open(_locate("model_meta.json")) as f:
+        _META = json.load(f)
+    print(f"Model loaded: input_dim={{_META['input_dim']}}")
+
+
+def run(raw_data):
+    try:
+        payload = json.loads(raw_data) if isinstance(raw_data, str) else raw_data
+        return score_payload(_WEIGHTS, _META, payload["data"])
+    except Exception as e:
+        return {{"error": str(e)}}
+'''
+
+_CONDA_YAML = """name: dct-tpu-inference
+channels:
+  - conda-forge
+dependencies:
+  - python=3.10
+  - numpy
+  - pip
+  - pip:
+      - azureml-inference-server-http
+"""
+
+
+def generate_score_package(ckpt_path: str, deploy_dir: str) -> dict:
+    """Write the full deploy package; returns the model meta."""
+    meta = export_npz_weights(ckpt_path, deploy_dir)
+
+    from dct_tpu.serving import runtime
+
+    runtime_source = "".join(
+        inspect.getsource(fn)
+        for fn in (runtime.softmax_numpy, runtime.mlp_forward_numpy, runtime.score_payload)
+    )
+    # str.format substitutes values verbatim (braces inside runtime_source
+    # are untouched); only the template's own {{ }} literals are unescaped.
+    score_py = _SCORE_TEMPLATE.format(runtime_source=runtime_source)
+
+    with open(os.path.join(deploy_dir, "score.py"), "w") as f:
+        f.write(score_py)
+    with open(os.path.join(deploy_dir, "conda.yaml"), "w") as f:
+        f.write(_CONDA_YAML)
+    return meta

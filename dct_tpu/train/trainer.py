@@ -279,24 +279,8 @@ class Trainer:
     # ------------------------------------------------------------------
     @staticmethod
     def _stack_epoch(loader, epoch: int):
-        """Stack one epoch of fixed-shape batches into [S, B_local, ...]
-        host arrays for the scan path."""
-        import numpy as np
-
-        xs, ys, ws = [], [], []
-        for b in loader.epoch(epoch):
-            xs.append(b.x)
-            ys.append(b.y)
-            ws.append(b.weight)
-        if not xs:  # empty split: zero-length scan (returns init carry)
-            lb = loader.local_batch
-            f = loader.data.features.shape[1]
-            return (
-                np.zeros((0, lb, f), np.float32),
-                np.zeros((0, lb), np.int32),
-                np.zeros((0, lb), np.float32),
-            )
-        return np.stack(xs), np.stack(ys), np.stack(ws)
+        """One epoch as [S, B_local, ...] host arrays for the scan path."""
+        return loader.epoch_stacked(epoch)
 
     # ------------------------------------------------------------------
     def _evaluate(self, state, eval_step, val_loader) -> tuple[float, float]:

@@ -67,6 +67,24 @@ def test_batch_loader_shuffles_per_epoch(weather_data):
     assert not np.array_equal(e0, e1)
 
 
+def test_epoch_stacked_matches_iterator(weather_data):
+    """The vectorized whole-epoch gather must produce exactly the batches
+    the iterator yields."""
+    idx = np.arange(19)
+    for nproc, pid in [(1, 0), (2, 1)]:
+        loader = BatchLoader(
+            weather_data, idx, global_batch=8, shuffle=True, seed=3,
+            num_processes=nproc, process_id=pid,
+        )
+        xs, ys, ws = loader.epoch_stacked(4)
+        it = list(loader.epoch(4))
+        assert xs.shape[0] == len(it)
+        for i, b in enumerate(it):
+            np.testing.assert_array_equal(xs[i], b.x)
+            np.testing.assert_array_equal(ys[i], b.y)
+            np.testing.assert_array_equal(ws[i], b.weight)
+
+
 def test_process_sharding_partitions_batch(weather_data):
     idx = np.arange(16)
     full = BatchLoader(weather_data, idx, global_batch=8, shuffle=False)
@@ -78,7 +96,8 @@ def test_process_sharding_partitions_batch(weather_data):
     )
     for bf, b0, b1 in zip(full.epoch(0), shard0.epoch(0), shard1.epoch(0)):
         assert b0.x.shape == (4, 5) and b1.x.shape == (4, 5)
-        merged = np.empty_like(bf.x)
-        merged[0::2] = b0.x
-        merged[1::2] = b1.x
-        np.testing.assert_array_equal(merged, bf.x)
+        # Block sharding: concatenation reproduces the global batch order.
+        np.testing.assert_array_equal(np.concatenate([b0.x, b1.x]), bf.x)
+        np.testing.assert_array_equal(
+            np.concatenate([b0.weight, b1.weight]), bf.weight
+        )

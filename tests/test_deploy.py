@@ -1,0 +1,147 @@
+"""Rollout state-machine tests against the in-memory endpoint, covering the
+reference's blue/green + shadow + canary semantics
+(dags/azure_auto_deploy.py:118-197) and endpoint recreate-on-failure
+(dags/azure_manual_deploy.py:141-150)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dct_tpu.checkpoint.manager import save_checkpoint
+from dct_tpu.config import ModelConfig
+from dct_tpu.deploy.local import LocalEndpointClient
+from dct_tpu.deploy.rollout import (
+    BLUE,
+    GREEN,
+    RolloutOrchestrator,
+    choose_slot,
+    prepare_package,
+)
+from dct_tpu.models.registry import get_model
+from dct_tpu.serving.score_gen import generate_score_package
+from dct_tpu.tracking.client import LocalTracking
+
+
+def _package(tmp_path, name="pkg", seed=0):
+    model = get_model(ModelConfig(), input_dim=5)
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 5)))
+    meta = {"model": "weather_mlp", "input_dim": 5, "hidden_dim": 64,
+            "num_classes": 2, "dropout": 0.2, "feature_names": ["a"] * 5}
+    ckpt = save_checkpoint(str(tmp_path / f"{name}.ckpt"), params, meta)
+    deploy = str(tmp_path / name)
+    generate_score_package(ckpt, deploy)
+    return deploy
+
+
+def test_choose_slot():
+    assert choose_slot({}) == (BLUE, None)
+    assert choose_slot({"blue": 0, "green": 0}) == (BLUE, None)
+    assert choose_slot({"blue": 100}) == (GREEN, "blue")
+    assert choose_slot({"green": 90, "blue": 10}) == (BLUE, "green")
+
+
+def test_first_rollout_goes_straight_to_100(tmp_path):
+    client = LocalEndpointClient()
+    ro = RolloutOrchestrator(client, "weather-ep", sleep_fn=lambda s: None)
+    events = ro.run(_package(tmp_path))
+    assert [e.stage for e in events] == ["deploy_new_slot", "full_rollout"]
+    assert client.get_traffic("weather-ep") == {BLUE: 100}
+    out = client.score("weather-ep", {"data": [[0.0] * 5]})
+    assert "probabilities" in out
+
+
+def test_second_rollout_blue_green_shadow_canary(tmp_path):
+    client = LocalEndpointClient()
+    soaks = []
+    ro = RolloutOrchestrator(
+        client, "weather-ep", sleep_fn=lambda s: soaks.append(s), soak_seconds=30
+    )
+    ro.run(_package(tmp_path, "v1", seed=0))
+    ro2 = RolloutOrchestrator(
+        client, "weather-ep", sleep_fn=lambda s: soaks.append(s), soak_seconds=30
+    )
+    events = ro2.run(_package(tmp_path, "v2", seed=1))
+
+    stages = {e.stage: e for e in events}
+    # Shadow: old serves 100%, new mirrored at 20%.
+    assert stages["shadow"].traffic == {BLUE: 100, GREEN: 0}
+    assert stages["shadow"].mirror == {GREEN: 20}
+    # Canary: mirror cleared, 90/10 live.
+    assert stages["canary"].traffic == {BLUE: 90, GREEN: 10}
+    assert stages["canary"].mirror == {}
+    # Full: green 100%, blue deployment deleted.
+    assert stages["full_rollout"].traffic == {GREEN: 100}
+    assert client.list_deployments("weather-ep") == [GREEN]
+    # Two 30 s soaks happened (shadow->canary->full).
+    assert soaks == [30, 30]
+
+
+def test_third_rollout_flips_back_to_blue(tmp_path):
+    client = LocalEndpointClient()
+    ro = lambda: RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None)  # noqa: E731
+    ro().run(_package(tmp_path, "v1", seed=0))
+    ro().run(_package(tmp_path, "v2", seed=1))
+    ro().run(_package(tmp_path, "v3", seed=2))
+    assert client.get_traffic("ep") == {BLUE: 100}
+    assert client.list_deployments("ep") == [BLUE]
+
+
+def test_failed_endpoint_recreated(tmp_path):
+    client = LocalEndpointClient()
+    client.create_endpoint("ep")
+    client.endpoints["ep"].provisioning_state = "Failed"
+    ro = RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None)
+    ro.run(_package(tmp_path))
+    assert ("delete_endpoint", "ep") in client.ops
+    assert client.get_traffic("ep") == {BLUE: 100}
+
+
+def test_prepare_package_selects_best_run(tmp_path):
+    """End-to-end: tracking store with two runs -> package built from the
+    lower-val_loss one (the deploy DAGs' selection policy)."""
+    store = LocalTracking(root=str(tmp_path / "runs"), experiment="weather_forecasting")
+
+    def finished_run(val_loss, seed):
+        model = get_model(ModelConfig(), input_dim=5)
+        params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 5)))
+        meta = {"model": "weather_mlp", "input_dim": 5, "hidden_dim": 64,
+                "num_classes": 2, "dropout": 0.2, "feature_names": ["a"] * 5}
+        ckpt = save_checkpoint(
+            str(tmp_path / f"w-{seed}" / f"weather-best-00-{val_loss:.2f}.ckpt"),
+            params, meta,
+        )
+        store.start_run()
+        store.log_metrics({"val_loss": val_loss, "val_acc": 0.5}, step=1)
+        store.log_artifact(ckpt, "best_checkpoints")
+        store.end_run()
+
+    finished_run(0.9, seed=1)
+    finished_run(0.2, seed=2)
+
+    info = prepare_package(store, str(tmp_path / "deploy"))
+    assert abs(info["val_loss"] - 0.2) < 1e-9
+    for f in ("model.ckpt", "model.npz", "model_meta.json", "score.py", "conda.yaml"):
+        assert os.path.exists(os.path.join(str(tmp_path / "deploy"), f))
+
+
+def test_prepare_package_no_runs_raises(tmp_path):
+    store = LocalTracking(root=str(tmp_path / "empty"))
+    with pytest.raises(RuntimeError, match="No finished runs"):
+        prepare_package(store, str(tmp_path / "deploy"))
+
+
+def test_shadow_serves_old_model(tmp_path):
+    """During shadow, live scoring must still route to the old slot."""
+    client = LocalEndpointClient()
+    ro = RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None)
+    ro.run(_package(tmp_path, "v1", seed=0))
+    v1_out = client.score("ep", {"data": [[1.0] * 5]})
+
+    ro2 = RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None)
+    new_slot, old_slot = ro2.deploy_new_slot(_package(tmp_path, "v2", seed=9))
+    ro2.start_shadow(new_slot, old_slot)
+    shadow_out = client.score("ep", {"data": [[1.0] * 5]})
+    np.testing.assert_allclose(shadow_out["probabilities"], v1_out["probabilities"])

@@ -1,0 +1,131 @@
+"""Serving-package tests: numpy inference parity with the JAX model and the
+generated score.py's operational contract (reference
+dags/azure_manual_deploy.py:54-125 analog, minus the hardcoded input_dim)."""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dct_tpu.checkpoint.manager import save_checkpoint
+from dct_tpu.config import ModelConfig
+from dct_tpu.models.registry import get_model
+from dct_tpu.serving.runtime import mlp_forward_numpy, score_payload, softmax_numpy
+from dct_tpu.serving.score_gen import generate_score_package
+
+
+def _ckpt(tmp_path, input_dim=5):
+    model = get_model(ModelConfig(), input_dim=input_dim)
+    params = model.init(jax.random.PRNGKey(3), jnp.zeros((1, input_dim)))
+    meta = {
+        "model": "weather_mlp",
+        "input_dim": input_dim,
+        "hidden_dim": 64,
+        "num_classes": 2,
+        "dropout": 0.2,
+        "feature_names": [f"f{i}_norm" for i in range(input_dim)],
+    }
+    path = save_checkpoint(str(tmp_path / "model.ckpt"), params, meta)
+    return model, params, path
+
+
+def test_numpy_forward_matches_jax(tmp_path, rng):
+    model, params, ckpt = _ckpt(tmp_path)
+    deploy = str(tmp_path / "pkg")
+    generate_score_package(ckpt, deploy)
+
+    npz = np.load(os.path.join(deploy, "model.npz"))
+    weights = {k: npz[k] for k in npz.files}
+    x = rng.standard_normal((10, 5)).astype(np.float32)
+
+    np_logits = mlp_forward_numpy(weights, x)
+    jax_logits = np.asarray(model.apply(params, jnp.asarray(x), train=False))
+    np.testing.assert_allclose(np_logits, jax_logits, atol=1e-5)
+
+    probs = softmax_numpy(np_logits)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_generated_score_py_end_to_end(tmp_path, rng, monkeypatch):
+    """Import the generated score.py the way azureml-inference-server would:
+    init() then run() on a JSON payload."""
+    _, params, ckpt = _ckpt(tmp_path)
+    deploy = str(tmp_path / "pkg")
+    meta = generate_score_package(ckpt, deploy)
+    assert meta["input_dim"] == 5
+
+    for f in ("score.py", "conda.yaml", "model.npz", "model_meta.json"):
+        assert os.path.exists(os.path.join(deploy, f)), f
+
+    monkeypatch.setenv("AZUREML_MODEL_DIR", deploy)
+    spec = importlib.util.spec_from_file_location(
+        "generated_score", os.path.join(deploy, "score.py")
+    )
+    score = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(score)
+    score.init()
+
+    x = rng.standard_normal((3, 5)).astype(np.float32)
+    out = score.run(json.dumps({"data": x.tolist()}))
+    assert "probabilities" in out
+    assert np.asarray(out["probabilities"]).shape == (3, 2)
+
+    # Error contract: bad input returns {"error": ...}, not an exception.
+    bad = score.run(json.dumps({"data": [[1.0, 2.0]]}))
+    assert "error" in bad and "Expected shape" in bad["error"]
+
+
+def test_score_py_nested_model_dir_fallback(tmp_path, rng, monkeypatch):
+    """The reference's init() walks nested Azure layouts
+    (dags/azure_manual_deploy.py:79-114); ours must too."""
+    _, _, ckpt = _ckpt(tmp_path)
+    deploy = str(tmp_path / "pkg")
+    generate_score_package(ckpt, deploy)
+
+    nested = tmp_path / "azure_root" / "INT" / "somehash" / "deploy_package"
+    os.makedirs(nested)
+    for f in ("model.npz", "model_meta.json"):
+        os.rename(os.path.join(deploy, f), str(nested / f))
+
+    monkeypatch.setenv("AZUREML_MODEL_DIR", str(tmp_path / "azure_root"))
+    spec = importlib.util.spec_from_file_location(
+        "generated_score2", os.path.join(deploy, "score.py")
+    )
+    score = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(score)
+    score.init()
+    out = score.run(json.dumps({"data": [[0.0] * 5]}))
+    assert "probabilities" in out
+
+
+def test_input_dim_from_checkpoint_not_hardcoded(tmp_path, rng, monkeypatch):
+    """A 7-feature model must serve 7-feature payloads (the reference would
+    break: score.py hardcodes input_dim=5)."""
+    _, _, ckpt = _ckpt(tmp_path, input_dim=7)
+    deploy = str(tmp_path / "pkg7")
+    meta = generate_score_package(ckpt, deploy)
+    assert meta["input_dim"] == 7
+
+    monkeypatch.setenv("AZUREML_MODEL_DIR", deploy)
+    spec = importlib.util.spec_from_file_location(
+        "generated_score7", os.path.join(deploy, "score.py")
+    )
+    score = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(score)
+    score.init()
+    out = score.run(json.dumps({"data": [[0.1] * 7]}))
+    assert np.asarray(out["probabilities"]).shape == (1, 2)
+
+
+def test_score_payload_single_vector(tmp_path):
+    weights = {
+        "w0": np.zeros((5, 4), np.float32),
+        "b0": np.zeros(4, np.float32),
+        "w1": np.zeros((4, 2), np.float32),
+        "b1": np.zeros(2, np.float32),
+    }
+    out = score_payload(weights, {"input_dim": 5}, [0.0] * 5)
+    np.testing.assert_allclose(out["probabilities"], [[0.5, 0.5]])
