@@ -1,0 +1,23 @@
+# Airflow orchestrator image (control plane).
+# Parity with the reference's Airflow image (reference Dockerfile:1-19):
+# base Airflow + build toolchain + the deploy/tracking client libraries.
+FROM apache/airflow:2.7.1-python3.10
+
+USER root
+RUN apt-get update && \
+    apt-get install -y --no-install-recommends gcc python3-dev openssh-client && \
+    apt-get clean && rm -rf /var/lib/apt/lists/*
+USER airflow
+
+# Deploy + tracking clients used in-process by the rollout DAGs
+# (dct_tpu/deploy/*, dct_tpu/tracking/*). openssh-client above is the
+# TPU-VM control-plane mechanism (ssh {host} {cmd}).
+RUN pip install --no-cache-dir \
+    azure-ai-ml \
+    azure-identity \
+    mlflow==2.9.2 \
+    pandas \
+    pyarrow \
+    scikit-learn
+
+ENV PYTHONPATH=/opt/airflow/repo
