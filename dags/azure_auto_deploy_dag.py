@@ -46,7 +46,12 @@ def _client():
         return AzureEndpointClient()
     from dct_tpu.deploy.local import LocalEndpointClient
 
-    return LocalEndpointClient()
+    # File-backed state: each stage runs in its own Airflow task process,
+    # so the slot/traffic state must outlive any single _client() instance.
+    # Lives BESIDE the package dir — prepare_package wipes DEPLOY_DIR.
+    return LocalEndpointClient(
+        state_path=DEPLOY_DIR.rstrip("/") + "_endpoint_state.json"
+    )
 
 
 def _orchestrator():

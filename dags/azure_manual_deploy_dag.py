@@ -45,7 +45,11 @@ def _client():
         return AzureEndpointClient()
     from dct_tpu.deploy.local import LocalEndpointClient
 
-    return LocalEndpointClient()
+    # File-backed so deploy state survives per-task processes; lives BESIDE
+    # the package dir — prepare_package wipes DEPLOY_DIR.
+    return LocalEndpointClient(
+        state_path=DEPLOY_DIR.rstrip("/") + "_endpoint_state.json"
+    )
 
 
 def prepare_package(**context):

@@ -36,12 +36,18 @@ from dct_tpu.orchestration.compat import (  # noqa: E402
     TriggerDagRunOperator,
 )
 
+def _abs(p: str) -> str:
+    """Anchor relative paths at the repo root — Airflow BashOperators run
+    in a per-task temp cwd, so bare relative defaults would never resolve."""
+    return p if os.path.isabs(p) else os.path.join(_REPO, p)
+
+
 HOSTS = os.environ.get("DCT_TRAIN_HOSTS", "local").split(",")
 EXEC = os.environ.get("DCT_EXEC_TEMPLATE", "ssh {host} {cmd}")
 TRAIN_CMD = os.environ.get("DCT_TRAIN_COMMAND", f"python3 {_REPO}/jobs/train_tpu.py")
-RAW = os.environ.get("DCT_RAW_CSV", "data/raw/weather.csv")
-PROCESSED = os.environ.get("DCT_PROCESSED_DIR", "data/processed")
-MODELS_DIR = os.environ.get("DCT_MODELS_DIR", "data/models")
+RAW = _abs(os.environ.get("DCT_RAW_CSV", "data/raw/weather.csv"))
+PROCESSED = _abs(os.environ.get("DCT_PROCESSED_DIR", "data/processed"))
+MODELS_DIR = _abs(os.environ.get("DCT_MODELS_DIR", "data/models"))
 KEEP_CHECKPOINTS = int(os.environ.get("DCT_KEEP_CHECKPOINTS", "3"))
 LOCAL_MODE = HOSTS == ["local"]
 
@@ -158,7 +164,7 @@ with DAG(
     check_logs = BashOperator(
         task_id="check_tracking_logs",
         bash_command=(
-            "test -d mlruns_local && echo 'Local tracking runs present' "
+            f"test -d {_abs('mlruns_local')} && echo 'Local tracking runs present' "
             "|| echo 'WARNING: no local tracking dir (MLflow server mode?)'"
         ),
     )

@@ -21,17 +21,25 @@ _REPO = os.environ.get("DCT_REPO_ROOT", os.path.dirname(os.path.dirname(os.path.
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+from dct_tpu.launch.launcher import remote_command  # noqa: E402
 from dct_tpu.orchestration.compat import (  # noqa: E402
     DAG,
     BashOperator,
     TriggerDagRunOperator,
 )
 
+
+def _abs(p: str) -> str:
+    """Anchor relative paths at the repo root — Airflow BashOperators run
+    in a per-task temp cwd, so bare relative defaults would never resolve."""
+    return p if os.path.isabs(p) else os.path.join(_REPO, p)
+
+
 ENGINE = os.environ.get("DCT_ETL_ENGINE", "native")
 SPARK_MASTER = os.environ.get("DCT_SPARK_MASTER_HOST", "spark-master")
-EXEC = os.environ.get("DCT_EXEC_TEMPLATE", "docker exec {host} {cmd}")
-RAW = os.environ.get("DCT_RAW_CSV", "data/raw/weather.csv")
-PROCESSED = os.environ.get("DCT_PROCESSED_DIR", "data/processed")
+EXEC = os.environ.get("DCT_EXEC_TEMPLATE", "docker exec {host} bash -c {cmd}")
+RAW = _abs(os.environ.get("DCT_RAW_CSV", "data/raw/weather.csv"))
+PROCESSED = _abs(os.environ.get("DCT_PROCESSED_DIR", "data/processed"))
 
 default_args = {
     "owner": "dct-tpu",
@@ -56,20 +64,20 @@ with DAG(
     if ENGINE == "spark":
         health = BashOperator(
             task_id="check_spark_cluster",
-            bash_command=EXEC.format(
-                host=SPARK_MASTER,
-                cmd="curl -sf http://localhost:8080 > /dev/null && echo 'Spark master healthy'",
+            bash_command=remote_command(
+                EXEC,
+                SPARK_MASTER,
+                "curl -sf http://localhost:8080 > /dev/null && echo 'Spark master healthy'",
             ),
         )
         preprocess = BashOperator(
             task_id="spark_preprocessing",
-            bash_command=EXEC.format(
-                host=SPARK_MASTER,
-                cmd=(
-                    "spark-submit --master spark://spark-master:7077 "
-                    "--deploy-mode client --conf spark.executor.memory=1g "
-                    "/opt/spark/jobs/preprocess.py"
-                ),
+            bash_command=remote_command(
+                EXEC,
+                SPARK_MASTER,
+                "spark-submit --master spark://spark-master:7077 "
+                "--deploy-mode client --conf spark.executor.memory=1g "
+                "/opt/spark/jobs/preprocess.py",
             ),
             execution_timeout=timedelta(minutes=30),
         )

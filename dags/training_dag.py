@@ -37,12 +37,18 @@ from dct_tpu.orchestration.compat import (  # noqa: E402
     TriggerDagRunOperator,
 )
 
+def _abs(p: str) -> str:
+    """Anchor relative paths at the repo root — Airflow BashOperators run
+    in a per-task temp cwd, so bare relative defaults would never resolve."""
+    return p if os.path.isabs(p) else os.path.join(_REPO, p)
+
+
 HOSTS = os.environ.get("DCT_TRAIN_HOSTS", "local").split(",")
 EXEC = os.environ.get("DCT_EXEC_TEMPLATE", "ssh {host} {cmd}")
 TRAIN_CMD = os.environ.get(
     "DCT_TRAIN_COMMAND", f"python3 {_REPO}/jobs/train_tpu.py"
 )
-MODELS_DIR = os.environ.get("DCT_MODELS_DIR", "data/models")
+MODELS_DIR = _abs(os.environ.get("DCT_MODELS_DIR", "data/models"))
 LOCAL_MODE = HOSTS == ["local"]
 
 default_args = {

@@ -1,10 +1,18 @@
-"""In-memory endpoint client: the test/local-platform deployment target.
+"""Local endpoint client: the test/local-platform deployment target.
 
 Implements the full :class:`~dct_tpu.deploy.rollout.EndpointClient` surface
 with real serving semantics — ``score()`` actually loads the deployed
 package's model.npz and answers inference requests — so the whole
 train->track->package->rollout->infer path runs hermetically (the reference
-can only exercise this against a live Azure subscription)."""
+can only exercise this against a live Azure subscription).
+
+With ``state_path`` set (or ``DCT_LOCAL_ENDPOINT_STATE`` in the env), the
+control-plane state (endpoints, traffic maps, slot->package bindings) is
+persisted as JSON after every mutation and reloaded on construction, so the
+rollout DAG's stages see each other's state even when the orchestrator runs
+every task in a fresh process (as real Airflow does). Deployment weights are
+not serialized — they reload lazily from the deployed package directory.
+"""
 
 from __future__ import annotations
 
@@ -16,8 +24,18 @@ from dataclasses import dataclass, field
 @dataclass
 class _Deployment:
     package_dir: str
-    weights: dict
-    meta: dict
+    _weights: dict | None = None
+    _meta: dict | None = None
+
+    def load(self) -> tuple[dict, dict]:
+        if self._weights is None:
+            import numpy as np
+
+            npz = np.load(os.path.join(self.package_dir, "model.npz"))
+            self._weights = {k: npz[k] for k in npz.files}
+            with open(os.path.join(self.package_dir, "model_meta.json")) as f:
+                self._meta = json.load(f)
+        return self._weights, self._meta
 
 
 @dataclass
@@ -29,9 +47,48 @@ class _Endpoint:
 
 
 class LocalEndpointClient:
-    def __init__(self):
+    def __init__(self, state_path: str | None = None):
+        self.state_path = state_path or os.environ.get("DCT_LOCAL_ENDPOINT_STATE")
         self.endpoints: dict[str, _Endpoint] = {}
         self.ops: list[tuple] = []  # audit log of control-plane calls
+        self._load()
+
+    # -- persistence ---------------------------------------------------
+    def _load(self) -> None:
+        if not (self.state_path and os.path.exists(self.state_path)):
+            return
+        with open(self.state_path) as f:
+            raw = json.load(f)
+        for name, ep in raw.items():
+            self.endpoints[name] = _Endpoint(
+                provisioning_state=ep["provisioning_state"],
+                traffic=dict(ep["traffic"]),
+                mirror_traffic=dict(ep["mirror_traffic"]),
+                deployments={
+                    slot: _Deployment(package_dir=pkg)
+                    for slot, pkg in ep["deployments"].items()
+                },
+            )
+
+    def _save(self) -> None:
+        if not self.state_path:
+            return
+        raw = {
+            name: {
+                "provisioning_state": ep.provisioning_state,
+                "traffic": ep.traffic,
+                "mirror_traffic": ep.mirror_traffic,
+                "deployments": {
+                    slot: dep.package_dir for slot, dep in ep.deployments.items()
+                },
+            }
+            for name, ep in self.endpoints.items()
+        }
+        os.makedirs(os.path.dirname(os.path.abspath(self.state_path)), exist_ok=True)
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(raw, f, indent=2)
+        os.replace(tmp, self.state_path)
 
     # -- control plane -------------------------------------------------
     def endpoint_exists(self, endpoint: str) -> bool:
@@ -40,10 +97,12 @@ class LocalEndpointClient:
     def create_endpoint(self, endpoint: str) -> None:
         self.ops.append(("create_endpoint", endpoint))
         self.endpoints[endpoint] = _Endpoint()
+        self._save()
 
     def delete_endpoint(self, endpoint: str) -> None:
         self.ops.append(("delete_endpoint", endpoint))
         self.endpoints.pop(endpoint, None)
+        self._save()
 
     def provisioning_state(self, endpoint: str) -> str:
         return self.endpoints[endpoint].provisioning_state
@@ -60,6 +119,7 @@ class LocalEndpointClient:
         if unknown:
             raise ValueError(f"Traffic to nonexistent deployments: {unknown}")
         ep.traffic = dict(traffic)
+        self._save()
 
     def get_mirror_traffic(self, endpoint: str) -> dict:
         return dict(self.endpoints[endpoint].mirror_traffic)
@@ -67,23 +127,19 @@ class LocalEndpointClient:
     def set_mirror_traffic(self, endpoint: str, traffic: dict) -> None:
         self.ops.append(("set_mirror_traffic", endpoint, dict(traffic)))
         self.endpoints[endpoint].mirror_traffic = dict(traffic)
+        self._save()
 
     def deploy(self, endpoint: str, slot: str, package_dir: str) -> None:
-        import numpy as np
-
         self.ops.append(("deploy", endpoint, slot, package_dir))
-        npz = np.load(os.path.join(package_dir, "model.npz"))
-        with open(os.path.join(package_dir, "model_meta.json")) as f:
-            meta = json.load(f)
-        self.endpoints[endpoint].deployments[slot] = _Deployment(
-            package_dir=package_dir,
-            weights={k: npz[k] for k in npz.files},
-            meta=meta,
-        )
+        dep = _Deployment(package_dir=package_dir)
+        dep.load()  # fail fast if the package is incomplete
+        self.endpoints[endpoint].deployments[slot] = dep
+        self._save()
 
     def delete_deployment(self, endpoint: str, slot: str) -> None:
         self.ops.append(("delete_deployment", endpoint, slot))
         self.endpoints[endpoint].deployments.pop(slot, None)
+        self._save()
 
     def list_deployments(self, endpoint: str) -> list[str]:
         return list(self.endpoints[endpoint].deployments)
@@ -100,5 +156,5 @@ class LocalEndpointClient:
             if not live:
                 raise RuntimeError(f"Endpoint {endpoint} has no live traffic")
             slot = max(live, key=live.get)
-        dep = ep.deployments[slot]
-        return score_payload(dep.weights, dep.meta, payload["data"])
+        weights, meta = ep.deployments[slot].load()
+        return score_payload(weights, meta, payload["data"])

@@ -7,12 +7,21 @@ from each (dags/2_pytorch_training.py:49-78), preceded by a zombie purge
 (``pkill -9 -f train_lightning_ddp.py || true``, :29-38) and an
 import-healthcheck (:40-46).
 
-Here the same semantics are generated for any host-access mechanism
-(``ssh <host>`` for TPU-VM workers — the north-star topology — or
-``docker exec <host>`` for the compose topology), so the training DAG's
-launch block is one call. :class:`LocalProcessLauncher` applies identical
-semantics to local subprocesses, giving the multi-process CPU rig that
-replaces the reference's two-container test bed (SURVEY §4).
+Here the same semantics are generated for any host-access mechanism, so
+the training DAG's launch block is one call. :class:`LocalProcessLauncher`
+applies identical semantics to local subprocesses, giving the multi-process
+CPU rig that replaces the reference's two-container test bed (SURVEY §4).
+
+Exec-template quoting contract: ``{cmd}`` is substituted with ONE
+shlex-quoted token holding the full shell command, so the template must
+hand it to something that parses a shell command string:
+
+- ``ssh {host} {cmd}``                 — sshd's remote shell re-parses the
+  joined argv, recovering the original command (this is why the token must
+  be quoted exactly once: ssh flattens one quoting level);
+- ``docker exec {host} bash -c {cmd}`` — docker passes argv through
+  verbatim, so an explicit ``bash -c`` consumes the token;
+- ``bash -c {cmd}``                    — in-place execution (tests).
 """
 
 from __future__ import annotations
@@ -25,10 +34,10 @@ import time
 from dataclasses import dataclass
 
 
-def _remote(exec_template: str, host: str, command: str) -> str:
-    """Wrap ``command`` for one host. exec_template examples:
-    ``ssh {host} {cmd}``, ``docker exec {host} {cmd}``."""
-    return exec_template.format(host=host, cmd=command)
+def remote_command(exec_template: str, host: str, command: str) -> str:
+    """Wrap ``command`` for one host per the quoting contract above:
+    the raw command becomes a single quoted ``{cmd}`` token."""
+    return exec_template.format(host=host, cmd=shlex.quote(command))
 
 
 def build_zombie_cleanup_script(
@@ -46,7 +55,7 @@ def build_zombie_cleanup_script(
     safe_pattern = f"[{pattern[0]}]{pattern[1:]}" if pattern else pattern
     for host in hosts:
         kill = f"pkill -9 -f {shlex.quote(safe_pattern)} || true"
-        lines.append(_remote(exec_template, host, f"bash -c {shlex.quote(kill)}"))
+        lines.append(remote_command(exec_template, host, kill))
     lines.append(f"sleep {settle_seconds}")
     lines.append("echo 'Cleanup complete'")
     return "\n".join(lines)
@@ -60,11 +69,13 @@ def build_healthcheck_script(
 ) -> str:
     """Verify every host's runtime imports and sees its accelerators
     (analog of the per-node ``import torch`` check,
-    dags/2_pytorch_training.py:40-46)."""
-    lines = []
+    dags/2_pytorch_training.py:40-46). ``set -e`` makes any host's failed
+    check fail the whole task — without it bash returns the LAST command's
+    status and a broken host would slip through to the SPMD launch."""
+    lines = ["set -e"]
     for host in hosts:
         lines.append(f"echo 'Checking {host}...'")
-        lines.append(_remote(exec_template, host, f"bash -c {shlex.quote(check_command)}"))
+        lines.append(remote_command(exec_template, host, check_command))
     lines.append("echo 'All hosts healthy'")
     return "\n".join(lines)
 
@@ -99,9 +110,7 @@ def build_spmd_launch_script(
         }
         env_prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
         full = f"{env_prefix} {command}"
-        lines.append(
-            _remote(exec_template, host, f"bash -c {shlex.quote(full)}") + " &"
-        )
+        lines.append(remote_command(exec_template, host, full) + " &")
         pid_var = f"PID{rank}"
         lines.append(f"{pid_var}=$!")
         pid_vars.append(pid_var)

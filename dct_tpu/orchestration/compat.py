@@ -13,6 +13,7 @@ TriggerDagRunOperator, and ``>>`` chaining.
 
 from __future__ import annotations
 
+import inspect
 import subprocess
 from typing import Any, Callable
 
@@ -29,15 +30,30 @@ except ImportError:
     _DAG_REGISTRY: dict[str, "DAG"] = {}
     _CURRENT: list["DAG"] = []
 
+    class _TaskInstance:
+        """Stand-in for Airflow's ``ti``: XCom push/pull against the DAG's
+        shared per-run store, so task-to-task state flow (e.g. the rollout
+        DAG's slot handoff) works when DAGs execute through this layer."""
+
+        def __init__(self, store: dict, task_id: str):
+            self._store = store
+            self.task_id = task_id
+
+        def xcom_push(self, key: str, value: Any) -> None:
+            self._store[(self.task_id, key)] = value
+
+        def xcom_pull(self, task_ids: str | None = None, key: str = "return_value"):
+            return self._store.get((task_ids or self.task_id, key))
+
     class _Task:
         def __init__(self, task_id: str, **kwargs: Any):
             self.task_id = task_id
             self.kwargs = kwargs
             self.downstream: list[_Task] = []
             self.upstream: list[_Task] = []
+            self.dag = _CURRENT[-1] if _CURRENT else None
             if _CURRENT:
                 _CURRENT[-1].tasks[task_id] = self
-                self.dag = _CURRENT[-1]
 
         def __rshift__(self, other):
             others = other if isinstance(other, (list, tuple)) else [other]
@@ -72,7 +88,19 @@ except ImportError:
             self.python_callable = python_callable
 
         def execute(self, context: dict | None = None):
-            return self.python_callable(**(context or {}))
+            """Call like Airflow: supply ``ti`` (backed by the DAG's shared
+            XCom store) and pass only the kwargs the callable accepts."""
+            ctx = dict(context or {})
+            if "ti" not in ctx and self.dag is not None:
+                ctx["ti"] = _TaskInstance(self.dag.xcom_store, self.task_id)
+            sig = inspect.signature(self.python_callable)
+            accepts_var_kw = any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in sig.parameters.values()
+            )
+            if not accepts_var_kw:
+                ctx = {k: v for k, v in ctx.items() if k in sig.parameters}
+            return self.python_callable(**ctx)
 
     class TriggerDagRunOperator(_Task):
         def __init__(self, task_id: str, trigger_dag_id: str, **kwargs: Any):
@@ -84,6 +112,9 @@ except ImportError:
             self.dag_id = dag_id
             self.kwargs = kwargs
             self.tasks: dict[str, _Task] = {}
+            # Shared XCom store for tasks executed through this layer
+            # ((task_id, key) -> value); one logical "run" per process.
+            self.xcom_store: dict = {}
             _DAG_REGISTRY[dag_id] = self
 
         def __enter__(self):

@@ -23,13 +23,18 @@ def softmax_numpy(logits: np.ndarray) -> np.ndarray:
 
 
 def mlp_forward_numpy(weights: dict, x: np.ndarray) -> np.ndarray:
-    """Forward pass of the rain-classifier MLP (dropout is inference-off).
+    """Forward pass of a sequential dense stack (dropout is inference-off).
 
-    weights keys: w0 [F,H], b0 [H], w1 [H,C], b1 [C] — exported from the
-    flax checkpoint by the packager.
+    weights keys: w0/b0 .. wN/bN, exported from the flax checkpoint by the
+    packager; ReLU between layers, raw logits at the last.
     """
-    h = np.maximum(x @ weights["w0"] + weights["b0"], 0.0)
-    return h @ weights["w1"] + weights["b1"]
+    n_layers = sum(1 for k in weights if k.startswith("w"))
+    h = x
+    for i in range(n_layers):
+        h = h @ weights[f"w{i}"] + weights[f"b{i}"]
+        if i < n_layers - 1:
+            h = np.maximum(h, 0.0)
+    return h
 
 
 def score_payload(weights: dict, meta: dict, data) -> dict:

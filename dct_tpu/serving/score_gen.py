@@ -27,17 +27,36 @@ import numpy as np
 
 
 def export_npz_weights(ckpt_path: str, deploy_dir: str) -> dict:
-    """model.ckpt (flax msgpack) -> model.npz + model_meta.json."""
+    """model.ckpt (flax msgpack) -> model.npz + model_meta.json.
+
+    Works for any sequential dense stack (every top-level param collection
+    entry holding a kernel+bias pair, ordered by trailing index) — which
+    covers the MLP family. Other model families (e.g. the transformer) need
+    a dedicated serving exporter; packaging such a checkpoint fails loudly
+    here instead of raising a bare KeyError mid-deploy.
+    """
     from dct_tpu.checkpoint.manager import load_checkpoint
 
     params, meta = load_checkpoint(ckpt_path)
     p = params["params"]
-    weights = {
-        "w0": np.asarray(p["TorchStyleDense_0"]["kernel"], np.float32),
-        "b0": np.asarray(p["TorchStyleDense_0"]["bias"], np.float32),
-        "w1": np.asarray(p["TorchStyleDense_1"]["kernel"], np.float32),
-        "b1": np.asarray(p["TorchStyleDense_1"]["bias"], np.float32),
-    }
+
+    def layer_index(name: str) -> int:
+        tail = name.rsplit("_", 1)[-1]
+        return int(tail) if tail.isdigit() else -1
+
+    layers = sorted(p, key=layer_index)
+    if not all(
+        isinstance(p[n], dict) and {"kernel", "bias"} <= set(p[n]) for n in layers
+    ):
+        raise ValueError(
+            f"Serving export supports sequential dense models only; "
+            f"checkpoint model={meta.get('model')!r} has param tree "
+            f"{sorted(p)} — register a dedicated exporter for this family"
+        )
+    weights = {}
+    for i, name in enumerate(layers):
+        weights[f"w{i}"] = np.asarray(p[name]["kernel"], np.float32)
+        weights[f"b{i}"] = np.asarray(p[name]["bias"], np.float32)
     os.makedirs(deploy_dir, exist_ok=True)
     np.savez(os.path.join(deploy_dir, "model.npz"), **weights)
     with open(os.path.join(deploy_dir, "model_meta.json"), "w") as f:

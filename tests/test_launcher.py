@@ -54,6 +54,43 @@ def test_healthcheck_script():
     assert script.count("import jax") == 2
 
 
+def test_healthcheck_fails_on_bad_host():
+    """A failed host check must fail the whole task — bash returns the
+    LAST command's status, so without set -e the trailing success banner
+    would mask the failure."""
+    import subprocess
+
+    script = build_healthcheck_script(
+        ["h0", "h1"], exec_template="bash -c {cmd}", check_command="false"
+    )
+    proc = subprocess.run(["bash", "-c", script], capture_output=True, text=True)
+    assert proc.returncode != 0
+    assert "All hosts healthy" not in proc.stdout
+
+
+def test_ssh_reparse_quoting(tmp_path):
+    """ssh joins its command argv with spaces and the remote shell re-parses
+    the string — flattening exactly one quoting level. Simulate that with a
+    fake ssh and assert the payload ACTUALLY runs on both 'hosts' (a
+    quoting bug here makes the launch a silent no-op that still exits 0)."""
+    import subprocess
+
+    marker = tmp_path / "ran"
+    fake_ssh = tmp_path / "fake_ssh"
+    fake_ssh.write_text('#!/bin/bash\nshift\nexec bash -c "$*"\n')
+    fake_ssh.chmod(0o755)
+    script = build_spmd_launch_script(
+        ["h0", "h1"],
+        f"sh -c 'echo rank=$NODE_RANK >> {marker}'",
+        exec_template=f"{fake_ssh} {{host}} {{cmd}}",
+        stagger_seconds=0,
+    )
+    proc = subprocess.run(["bash", "-c", script], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    content = marker.read_text()
+    assert "rank=0" in content and "rank=1" in content
+
+
 def test_single_host_no_stagger():
     script = build_spmd_launch_script(["only-host"], "python3 t.py")
     assert "sleep" not in script
@@ -68,7 +105,7 @@ def test_launch_script_executes_locally(tmp_path):
     script = build_spmd_launch_script(
         ["h0", "h1"],
         f"sh -c 'echo rank=$NODE_RANK world=$WORLD_SIZE >> {marker}'",
-        exec_template="{cmd}",  # run locally, no ssh
+        exec_template="bash -c {cmd}",  # run locally, no ssh
         stagger_seconds=0,
     )
     proc = subprocess.run(["bash", "-c", script], capture_output=True, text=True)
@@ -84,7 +121,7 @@ def test_launch_script_fails_if_any_rank_fails():
     script = build_spmd_launch_script(
         ["h0", "h1"],
         "sh -c 'exit $NODE_RANK'",  # rank 1 fails
-        exec_template="{cmd}",
+        exec_template="bash -c {cmd}",
         stagger_seconds=0,
     )
     proc = subprocess.run(["bash", "-c", script], capture_output=True, text=True)
