@@ -74,6 +74,13 @@ class ModelConfig:
     hidden_dim: int = 64
     num_classes: int = 2
     dropout: float = 0.2
+    # Transformer-family fields (unused by the MLP): window length consumed
+    # from the weather stream, encoder width/depth, attention heads.
+    seq_len: int = 32
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
 
     @classmethod
     def from_env(cls) -> "ModelConfig":
@@ -82,6 +89,11 @@ class ModelConfig:
         c.hidden_dim = _env("DCT_HIDDEN_DIM", c.hidden_dim, int)
         c.num_classes = _env("DCT_NUM_CLASSES", c.num_classes, int)
         c.dropout = _env("DCT_DROPOUT", c.dropout, float)
+        c.seq_len = _env("DCT_SEQ_LEN", c.seq_len, int)
+        c.d_model = _env("DCT_D_MODEL", c.d_model, int)
+        c.n_heads = _env("DCT_N_HEADS", c.n_heads, int)
+        c.n_layers = _env("DCT_N_LAYERS", c.n_layers, int)
+        c.d_ff = _env("DCT_D_FF", c.d_ff, int)
         return c
 
 

@@ -36,6 +36,21 @@ def train_val_split(
     return perm[:train_size], perm[train_size:]
 
 
+def contiguous_split(
+    n: int, *, val_fraction: float = 0.2, gap: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Time-ordered split for overlapping-window data: train = leading
+    block, val = trailing block, with ``gap`` indices dropped between them.
+
+    A random row split (above) is correct for i.i.d. rows but leaks badly
+    for sliding windows — window ``i`` and ``i+1`` share ``seq_len-1`` rows,
+    so adjacent train/val windows would share almost all content. With
+    ``gap >= seq_len`` no val window overlaps any train window's rows."""
+    train_size = int((1.0 - val_fraction) * n)
+    val_start = min(n, train_size + gap)
+    return np.arange(train_size), np.arange(val_start, n)
+
+
 @dataclass
 class Batch:
     """One fixed-shape global batch.

@@ -19,14 +19,27 @@ FEATURE_COLUMNS = ["Temperature", "Humidity", "Wind_Speed", "Cloud_Cover", "Pres
 LABEL_COLUMN = "Rain"
 
 
+def _ar1(rng, rows: int, mu: float, sigma: float, phi: float = 0.85):
+    """Stationary AR(1) series: mean ``mu``, std ``sigma``, autocorrelation
+    ``phi`` — weather-like temporal persistence, so sequence models can
+    actually forecast the next step (i.i.d. rows would make the windowed
+    task coin-flip by construction)."""
+    eps = rng.normal(0.0, sigma * np.sqrt(1.0 - phi * phi), rows)
+    x = np.empty(rows)
+    x[0] = rng.normal(mu, sigma)
+    for t in range(1, rows):
+        x[t] = mu + phi * (x[t - 1] - mu) + eps[t]
+    return x
+
+
 def generate_weather_csv(path: str, *, rows: int = 2500, seed: int = 0) -> str:
     """Write a synthetic weather.csv; returns the path."""
     rng = np.random.default_rng(seed)
-    temperature = rng.normal(18.0, 8.0, rows)
-    humidity = np.clip(rng.normal(60.0, 20.0, rows), 0, 100)
-    wind = np.abs(rng.normal(12.0, 6.0, rows))
-    cloud = np.clip(rng.normal(50.0, 25.0, rows), 0, 100)
-    pressure = rng.normal(1013.0, 8.0, rows)
+    temperature = _ar1(rng, rows, 18.0, 8.0)
+    humidity = np.clip(_ar1(rng, rows, 60.0, 20.0), 0, 100)
+    wind = np.abs(_ar1(rng, rows, 12.0, 6.0))
+    cloud = np.clip(_ar1(rng, rows, 50.0, 25.0), 0, 100)
+    pressure = _ar1(rng, rows, 1013.0, 8.0)
 
     # Rain correlates with humidity + cloud cover - pressure anomaly.
     logit = (

@@ -16,14 +16,23 @@ from flax import linen as nn
 from dct_tpu.config import ModelConfig
 
 MODEL_REGISTRY: dict[str, Callable[..., nn.Module]] = {}
+# Models that consume [B, S, F] windows instead of [B, F] rows; the Trainer
+# switches the data path (make_windows) and init shape on this trait.
+SEQUENCE_MODELS: set[str] = set()
 
 
-def register_model(name: str):
+def register_model(name: str, *, sequence: bool = False):
     def deco(builder: Callable[..., nn.Module]):
         MODEL_REGISTRY[name] = builder
+        if sequence:
+            SEQUENCE_MODELS.add(name)
         return builder
 
     return deco
+
+
+def is_sequence_model(name: str) -> bool:
+    return name in SEQUENCE_MODELS
 
 
 def get_model(cfg: ModelConfig, *, input_dim: int | None = None, **kwargs) -> nn.Module:
@@ -48,5 +57,27 @@ def _build_mlp(cfg: ModelConfig, *, input_dim: int, compute_dtype=None):
         hidden_dim=cfg.hidden_dim,
         num_classes=cfg.num_classes,
         dropout=cfg.dropout,
+        compute_dtype=compute_dtype or jnp.float32,
+    )
+
+
+@register_model("weather_transformer", sequence=True)
+def _build_transformer(
+    cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None
+):
+    import jax.numpy as jnp
+
+    from dct_tpu.models.transformer import WeatherTransformer
+
+    return WeatherTransformer(
+        input_dim=input_dim,
+        seq_len=cfg.seq_len,
+        d_model=cfg.d_model,
+        n_heads=cfg.n_heads,
+        n_layers=cfg.n_layers,
+        d_ff=cfg.d_ff,
+        num_classes=cfg.num_classes,
+        dropout=cfg.dropout,
+        attn_fn=attn_fn,
         compute_dtype=compute_dtype or jnp.float32,
     )

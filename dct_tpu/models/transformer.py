@@ -1,0 +1,139 @@
+"""Transformer family: long-context sequence models over the weather stream.
+
+The reference scales only the batch axis of a tabular MLP (SURVEY §2.3); this
+family adds the capability its design lacks — sequence models whose context
+is sharded over the mesh — built TPU-first:
+
+- attention is pluggable (:mod:`dct_tpu.ops.attention`): dense for short
+  contexts, blockwise for long single-chip contexts, ring attention over the
+  ``seq`` mesh axis for contexts larger than one chip;
+- tensor parallelism is expressed by PARAM NAMES: projection modules are
+  named ``qkv_proj`` / ``o_proj`` / ``ffn_in`` / ``ffn_out`` and
+  :mod:`dct_tpu.parallel.sharding_rules` maps those names to
+  ``PartitionSpec``s over the ``model`` axis (megatron-style column/row
+  split — one all-reduce per block, inserted by XLA, riding ICI);
+- everything is a pure function of (params, x, rng): same train step, same
+  Trainer, same checkpoint/tracking path as the flagship MLP.
+
+``WeatherTransformer`` is the concrete member: a pre-LN encoder over a
+window of ``seq_len`` past weather rows, mean-pooled into the same
+2-class rain head as the reference's classifier (same loss, same metrics).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dct_tpu.models.mlp import TorchStyleDense
+
+
+def sincos_positions(seq_len: int, d_model: int) -> np.ndarray:
+    """Fixed sinusoidal position table [S, D] (no param => nothing to shard)."""
+    pos = np.arange(seq_len)[:, None].astype(np.float32)
+    i = np.arange(d_model // 2)[None, :].astype(np.float32)
+    ang = pos / np.power(10000.0, 2.0 * i / d_model)
+    out = np.zeros((seq_len, d_model), np.float32)
+    out[:, 0::2] = np.sin(ang)
+    out[:, 1::2] = np.cos(ang)
+    return out
+
+
+class MultiHeadAttention(nn.Module):
+    """MHA with injected attention kernel. Projections are single fused
+    qkv (column-parallel over ``model``) + output (row-parallel)."""
+
+    d_model: int
+    n_heads: int
+    attn_fn: object  # (q, k, v) [B,H,T,D] -> [B,H,T,D]
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, _ = x.shape
+        head_dim = self.d_model // self.n_heads
+        qkv = TorchStyleDense(3 * self.d_model, dtype=self.dtype, name="qkv_proj")(x)
+        # Fused output dim is laid out (H, 3, Dh) so a ``model``-axis shard
+        # of the kernel's output dim is HEAD-aligned: each tensor-parallel
+        # shard owns whole heads' q,k,v — no cross-shard resharding before
+        # attention.
+        qkv = qkv.reshape(b, t, self.n_heads, 3, head_dim)
+        # [B, T, H, 3, Dh] -> 3 x [B, H, T, Dh]
+        q, k, v = (jnp.swapaxes(qkv[:, :, :, j], 1, 2) for j in range(3))
+        o = self.attn_fn(q, k, v)  # [B, H, T, D]
+        o = jnp.moveaxis(o, 1, 2).reshape(b, t, self.d_model)
+        return TorchStyleDense(self.d_model, dtype=self.dtype, name="o_proj")(o)
+
+
+class TransformerBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dropout: float
+    attn_fn: object
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
+        h = MultiHeadAttention(
+            self.d_model, self.n_heads, self.attn_fn, dtype=self.dtype,
+            name="attn",
+        )(h)
+        h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_ffn")(x)
+        h = TorchStyleDense(self.d_ff, dtype=self.dtype, name="ffn_in")(h)
+        h = nn.gelu(h)
+        h = TorchStyleDense(self.d_model, dtype=self.dtype, name="ffn_out")(h)
+        h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class WeatherTransformer(nn.Module):
+    """Encoder over [B, S, F] windows -> [B, num_classes] rain logits."""
+
+    input_dim: int
+    seq_len: int
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    num_classes: int = 2
+    dropout: float = 0.1
+    attn_fn: object = None  # default set in __call__ (dense/blockwise)
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        from dct_tpu.ops.attention import make_attention_fn
+
+        if self.d_model % 2 or self.d_model % self.n_heads:
+            raise ValueError(
+                f"d_model={self.d_model} must be even (sinusoidal positions)"
+                f" and divisible by n_heads={self.n_heads}"
+            )
+        attn_fn = self.attn_fn or make_attention_fn(None)
+        x = jnp.asarray(x, self.compute_dtype)
+        h = TorchStyleDense(self.d_model, dtype=self.compute_dtype, name="in_proj")(x)
+        h = h + jnp.asarray(
+            sincos_positions(self.seq_len, self.d_model), self.compute_dtype
+        )
+        for i in range(self.n_layers):
+            h = TransformerBlock(
+                self.d_model,
+                self.n_heads,
+                self.d_ff,
+                self.dropout,
+                attn_fn,
+                dtype=self.compute_dtype,
+                name=f"block_{i}",
+            )(h, train=train)
+        h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)
+        pooled = h.mean(axis=1)
+        logits = TorchStyleDense(
+            self.num_classes, dtype=self.compute_dtype, name="head"
+        )(pooled)
+        return jnp.asarray(logits, jnp.float32)

@@ -1,0 +1,215 @@
+"""Attention ops: dense, blockwise (flash-style), and ring (sequence-parallel).
+
+The reference has no attention anywhere (5-feature tabular MLP only,
+SURVEY §5.7) — long-context support is a capability this framework adds, and
+it is designed TPU-first rather than bolted on:
+
+- :func:`dense_attention` — the O(T^2)-memory reference numerics; fine for
+  short sequences, and the oracle the other paths are tested against.
+- :func:`blockwise_attention` — online-softmax ``lax.scan`` over KV blocks:
+  O(T) memory on a single chip, XLA fuses the inner block into MXU matmuls.
+- :func:`ring_attention` — sequence parallelism over the mesh's ``seq``
+  axis: each device keeps its Q shard and rotates KV shards around the ring
+  with ``lax.ppermute`` (ICI neighbor hops — bandwidth-optimal, no
+  all-gather), accumulating the same online softmax. Compute on the current
+  block overlaps the DMA of the next block's permute in XLA's schedule.
+
+All three share one accumulation kernel (:func:`_online_block`) so their
+numerical equivalence is structural; tests assert it on an 8-device mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30  # finite "minus infinity": keeps the online max/exp NaN-free
+
+
+def _online_block(q, k, v, scale, mask, m, l, o):
+    """Fold one KV block into the running online-softmax state.
+
+    q [..., Tq, D] · k,v [..., Tk, D] · mask broadcastable to [..., Tq, Tk]
+    (True = attend) · m,l [..., Tq] f32 · o [..., Tq, D] f32.
+    """
+    s = jnp.einsum(
+        "...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        # A fully-masked row would otherwise get p=exp(0)=1 per entry.
+        p = jnp.where(mask, p, 0.0)
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p, v.astype(jnp.float32)
+    )
+    return m_new, l_new, o_new
+
+
+def _finalize(l, o, dtype):
+    return (o / jnp.maximum(l, 1e-20)[..., None]).astype(dtype)
+
+
+def dense_attention(q, k, v, *, causal: bool = False, scale: float | None = None):
+    """Reference numerics: full [Tq, Tk] score matrix. q,k,v [B, H, T, D]."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(
+        q.dtype
+    )
+
+
+def blockwise_attention(
+    q, k, v, *, block_size: int = 512, causal: bool = False,
+    scale: float | None = None,
+):
+    """O(T)-memory attention on one device: scan KV in blocks of
+    ``block_size`` through the shared online-softmax kernel. q,k,v
+    [B, H, T, D]; T must be a multiple of block_size (pad upstream)."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    t = k.shape[-2]
+    if t % block_size:
+        raise ValueError(f"seq len {t} not a multiple of block {block_size}")
+    n_blocks = t // block_size
+    tq = q.shape[-2]
+
+    # [n_blocks, ..., block, D] scan layout.
+    ks = jnp.moveaxis(k.reshape(*k.shape[:-2], n_blocks, block_size, k.shape[-1]), -3, 0)
+    vs = jnp.moveaxis(v.reshape(*v.shape[:-2], n_blocks, block_size, v.shape[-1]), -3, 0)
+
+    q_pos = jnp.arange(tq)
+
+    def body(carry, blk):
+        m, l, o = carry
+        kb, vb, b_idx = blk
+        mask = None
+        if causal:
+            k_pos = b_idx * block_size + jnp.arange(block_size)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        m, l, o = _online_block(q, kb, vb, scale, mask, m, l, o)
+        return (m, l, o), None
+
+    m0 = jnp.full(q.shape[:-1], _NEG, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    (m, l, o), _ = lax.scan(body, (m0, l0, o0), (ks, vs, jnp.arange(n_blocks)))
+    return _finalize(l, o, q.dtype)
+
+
+def _ring_body(q, k, v, *, axis_name: str, ring_size: int, causal: bool,
+               scale: float | None, vary_axes: tuple = ()):
+    """Per-shard ring attention (runs inside shard_map).
+
+    q,k,v are the LOCAL shards [B, h_local, T_local, D]. Each of the
+    ``ring_size`` steps consumes the KV shard that originated on device
+    ``(my_index - step) mod ring_size`` and then forwards it to the next
+    neighbor — a classic ICI ring pipeline.
+    """
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    my = lax.axis_index(axis_name)
+    t_local = q.shape[-2]
+    q_pos = my * t_local + jnp.arange(t_local)
+    perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
+
+    def body(step, carry):
+        k_cur, v_cur, m, l, o = carry
+        src = (my - step) % ring_size
+        mask = None
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        m, l, o = _online_block(q, k_cur, v_cur, scale, mask, m, l, o)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, o)
+
+    # pcast-to-varying: the accumulators inherit q's device-varying axes from
+    # the first iteration on; typing the carry that way up front keeps the
+    # fori_loop carry type fixed.
+    axes = tuple(vary_axes) or (axis_name,)
+    m0 = lax.pcast(jnp.full(q.shape[:-1], _NEG, jnp.float32), axes, to="varying")
+    l0 = lax.pcast(jnp.zeros(q.shape[:-1], jnp.float32), axes, to="varying")
+    o0 = lax.pcast(jnp.zeros(q.shape, jnp.float32), axes, to="varying")
+    _, _, m, l, o = lax.fori_loop(
+        0, ring_size, body, (k, v, m0, l0, o0), unroll=True
+    )
+    return _finalize(l, o, q.dtype)
+
+
+def ring_attention(
+    q, k, v, *, mesh: Mesh, causal: bool = False, scale: float | None = None,
+    seq_axis: str = "seq", data_axis: str = "data", model_axis: str = "model",
+):
+    """Sequence-parallel attention over ``mesh[seq_axis]``.
+
+    q,k,v: GLOBAL [B, H, T, D] arrays (jit-sharded); internally shard_mapped
+    to [B, H/model, T/seq, D] per device. Batch rides ``data_axis``, heads
+    ride ``model_axis`` — so DP x TP x SP compose in one op.
+    """
+    ring_size = mesh.shape[seq_axis]
+    b, h, t, _ = q.shape
+    if b < mesh.shape[data_axis]:
+        # The batch-1 init trace (flax shape inference) cannot tile the data
+        # axis; dense is numerically identical, and no real batch is smaller
+        # than the data axis (BatchLoader guarantees divisibility).
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    if (
+        b % mesh.shape[data_axis]
+        or h % mesh.shape[model_axis]
+        or t % ring_size
+    ):
+        # Anything else is a sizing bug: silently falling back to dense
+        # would discard sequence parallelism (and its O(T/P) memory bound)
+        # on every step with no sign beyond the OOM/slowdown.
+        raise ValueError(
+            f"ring_attention shapes B={b}, H={h}, T={t} do not tile mesh "
+            f"axes data={mesh.shape[data_axis]}, "
+            f"model={mesh.shape[model_axis]}, seq={ring_size}; adjust "
+            "batch/heads/seq_len or the mesh"
+        )
+    spec = P(data_axis, model_axis, seq_axis, None)
+    fn = functools.partial(
+        _ring_body,
+        axis_name=seq_axis,
+        ring_size=ring_size,
+        causal=causal,
+        scale=scale,
+        vary_axes=(data_axis, model_axis, seq_axis),
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def make_attention_fn(mesh: Mesh | None = None, *, causal: bool = False,
+                      block_size: int = 512):
+    """Pick the attention path for the mesh: ring when the ``seq`` axis is
+    populated, blockwise for long single-shard sequences, dense otherwise."""
+    if mesh is not None and mesh.shape.get("seq", 1) > 1:
+        return functools.partial(ring_attention, mesh=mesh, causal=causal)
+
+    def attn(q, k, v):
+        t = q.shape[-2]
+        if t > block_size and t % block_size == 0:
+            return blockwise_attention(
+                q, k, v, block_size=block_size, causal=causal
+            )
+        return dense_attention(q, k, v, causal=causal)
+
+    return attn

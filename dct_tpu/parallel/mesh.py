@@ -27,8 +27,16 @@ from dct_tpu.config import MeshConfig
 AXES = ("data", "model", "seq")
 
 
-def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
-    """Build a 3-axis mesh; axis size -1 absorbs all remaining devices."""
+def make_mesh(
+    cfg: MeshConfig | None = None, devices=None, *, allow_subset: bool = False
+) -> Mesh:
+    """Build a 3-axis mesh; axis size -1 absorbs all remaining devices.
+
+    The mesh must cover every device: silently training on a subset would
+    idle chips (or, multi-host, exclude another process's devices from the
+    collectives). Test rigs that want a small mesh on a big device pool opt
+    in explicitly with ``allow_subset=True``.
+    """
     cfg = cfg or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
@@ -41,9 +49,15 @@ def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
         if n % fixed != 0:
             raise ValueError(f"{n} devices not divisible by fixed axes {sizes}")
         sizes[free[0]] = n // fixed
-    if math.prod(sizes.values()) != n:
-        raise ValueError(f"Mesh {sizes} does not cover {n} devices")
-    arr = np.array(devices).reshape([sizes[a] for a in AXES])
+    need = math.prod(sizes.values())
+    if need > n:
+        raise ValueError(f"Mesh {sizes} needs {need} devices, have {n}")
+    if need != n and not allow_subset:
+        raise ValueError(
+            f"Mesh {sizes} covers {need} of {n} devices; pass "
+            "allow_subset=True if a partial mesh is intended (test rigs)"
+        )
+    arr = np.array(devices[:need]).reshape([sizes[a] for a in AXES])
     return Mesh(arr, AXES)
 
 

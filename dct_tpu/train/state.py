@@ -37,17 +37,22 @@ class TrainState:
 
 
 def create_train_state(
-    model, *, input_dim: int, lr: float, seed: int
+    model, *, input_dim: int, lr: float, seed: int,
+    example_shape: tuple | None = None,
 ) -> TrainState:
     """Initialize params (torch-matching init lives in the model) and Adam.
 
     optax.adam defaults (b1=0.9, b2=0.999, eps=1e-8) match torch.optim.Adam
     defaults, so the optimizer trajectory is comparable to the reference's
     ``Adam(self.parameters(), lr=0.01)`` (jobs/train_lightning_ddp.py:88).
+
+    ``example_shape`` defaults to the MLP's ``(1, input_dim)`` row; sequence
+    models pass ``(1, seq_len, input_dim)``.
     """
     root = jax.random.PRNGKey(seed)
     init_key, dropout_key = jax.random.split(root)
-    params = model.init(init_key, jnp.zeros((1, input_dim), jnp.float32))
+    shape = example_shape if example_shape is not None else (1, input_dim)
+    params = model.init(init_key, jnp.zeros(shape, jnp.float32))
     if isinstance(params, FrozenDict):
         params = params.unfreeze()
     tx = optax.adam(learning_rate=lr)

@@ -31,15 +31,15 @@ import jax.numpy as jnp
 from dct_tpu.checkpoint.manager import BestLastCheckpointer, TrainStateCheckpointer
 from dct_tpu.config import RunConfig
 from dct_tpu.data.dataset import WeatherArrays, load_processed_dataset
-from dct_tpu.data.pipeline import BatchLoader, train_val_split
-from dct_tpu.models.registry import get_model
+from dct_tpu.data.pipeline import BatchLoader, contiguous_split, train_val_split
+from dct_tpu.models.registry import get_model, is_sequence_model
 from dct_tpu.parallel.distributed import is_coordinator
 from dct_tpu.parallel.mesh import (
     make_global_batch,
     make_global_epoch,
     make_mesh,
-    shard_state,
 )
+from dct_tpu.parallel.sharding_rules import shard_state_with_rules
 from dct_tpu.tracking.client import get_tracker
 from dct_tpu.train.state import create_train_state
 from dct_tpu.train.steps import (
@@ -83,9 +83,27 @@ class Trainer:
                 label_column=cfg.data.label_column,
             )
 
-        train_idx, val_idx = train_val_split(
-            len(data), val_fraction=cfg.data.val_fraction, seed=cfg.train.seed
-        )
+        # Sequence models train on sliding windows of the same stream; the
+        # row-wise contract (and everything downstream: split, loader,
+        # checkpointing) is unchanged because WindowArrays mirrors
+        # WeatherArrays.
+        sequence = is_sequence_model(cfg.model.name)
+        if sequence:
+            from dct_tpu.data.windows import make_windows
+
+            data = make_windows(data, cfg.model.seq_len)
+            # Overlapping windows leak under a random split; hold out the
+            # TAIL of the stream, gapped by seq_len so no val window shares
+            # rows with any train window.
+            train_idx, val_idx = contiguous_split(
+                len(data),
+                val_fraction=cfg.data.val_fraction,
+                gap=cfg.model.seq_len,
+            )
+        else:
+            train_idx, val_idx = train_val_split(
+                len(data), val_fraction=cfg.data.val_fraction, seed=cfg.train.seed
+            )
         # Reference semantics: batch_size is per-rank (DataLoader(batch_size=4)
         # per container); global batch = per-device batch x data-parallel size.
         global_batch = cfg.train.batch_size * self.mesh.shape["data"]
@@ -100,17 +118,45 @@ class Trainer:
         )
 
         compute_dtype = jnp.bfloat16 if cfg.train.bf16_compute else jnp.float32
-        model = get_model(
-            cfg.model, input_dim=data.input_dim, compute_dtype=compute_dtype
-        )
-        state = create_train_state(
-            model, input_dim=data.input_dim, lr=cfg.train.lr, seed=cfg.train.seed
-        )
-        state = shard_state(state, self.mesh)
+        if sequence:
+            from dct_tpu.ops.attention import make_attention_fn
 
-        # Per-process state dir: every process saves (params are replicated,
-        # so each host's copy is equivalent) — resume must not depend on
-        # which host a process lands on having the coordinator's disk.
+            model = get_model(
+                cfg.model,
+                input_dim=data.input_dim,
+                compute_dtype=compute_dtype,
+                attn_fn=make_attention_fn(self.mesh),
+            )
+            example_shape = (1, cfg.model.seq_len, data.input_dim)
+        else:
+            model = get_model(
+                cfg.model, input_dim=data.input_dim, compute_dtype=compute_dtype
+            )
+            example_shape = None
+        state = create_train_state(
+            model, input_dim=data.input_dim, lr=cfg.train.lr,
+            seed=cfg.train.seed, example_shape=example_shape,
+        )
+        # Name-pattern rules: tensor-parallel placement for the transformer
+        # family, full replication for the MLP (no patterns match).
+        if jax.process_count() > 1 and (
+            self.mesh.shape["model"] > 1 or self.mesh.shape["seq"] > 1
+        ):
+            # The checkpoint path device_gets params, which requires them
+            # fully addressable per host — true for replicated (DP) params
+            # and for TP/SP within one host, not for TP/SP spanning hosts.
+            raise NotImplementedError(
+                "model/seq mesh axes spanning multiple processes are not "
+                "yet supported by the checkpoint path; keep tensor/sequence "
+                "parallelism within a host and scale across hosts with the "
+                "data axis"
+            )
+        state = shard_state_with_rules(state, self.mesh)
+
+        # Per-process state dir: every process saves (params are host-
+        # addressable: replicated across hosts, TP-sharded only within one)
+        # — resume must not depend on which host a process lands on having
+        # the coordinator's disk.
         state_ckptr = TrainStateCheckpointer(
             os.path.join(
                 cfg.data.models_dir, "train_state", f"p{jax.process_index()}"
@@ -162,14 +208,18 @@ class Trainer:
             train_step = make_train_step()
             eval_step = make_eval_step()
 
+        # Self-describing checkpoint meta: the FULL model config (whichever
+        # family), plus the data-derived facts — enough to rebuild the model
+        # from the checkpoint alone.
+        import dataclasses as _dc
+
         meta = {
+            **_dc.asdict(cfg.model),
             "model": cfg.model.name,
             "input_dim": data.input_dim,
-            "hidden_dim": cfg.model.hidden_dim,
-            "num_classes": cfg.model.num_classes,
-            "dropout": cfg.model.dropout,
             "feature_names": list(data.feature_names),
         }
+        meta.pop("name", None)
         run_id = self.tracker.start_run(params={**meta, "lr": cfg.train.lr,
                                                 "batch_size": cfg.train.batch_size,
                                                 "epochs": cfg.train.epochs,

@@ -34,8 +34,15 @@ def test_mesh_axes_and_sizes():
     mesh2 = make_mesh(MeshConfig(data=4, model=2))
     assert mesh2.shape["data"] == 4 and mesh2.shape["model"] == 2
 
+    # All-fixed axes may take a device subset (test meshes on the 8-dev rig).
+    mesh3 = make_mesh(MeshConfig(data=3, model=1, seq=1), allow_subset=True)
+    assert mesh3.size == 3
+
     with pytest.raises(ValueError):
-        make_mesh(MeshConfig(data=3, model=1, seq=1))
+        make_mesh(MeshConfig(data=16, model=1, seq=1))  # more than we have
+
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(data=-1, model=3, seq=1))  # 8 % 3 != 0
 
 
 def test_batch_actually_sharded_over_data_axis():
