@@ -1,0 +1,141 @@
+"""Pallas TPU flash-attention kernel: the single-chip attention hot path.
+
+The JAX-level paths in :mod:`dct_tpu.ops.attention` rely on XLA fusion;
+this kernel takes manual control of the memory hierarchy per the Pallas TPU
+playbook: each grid step holds one Q block in VMEM, streams KV blocks
+VMEM-resident through the MXU (``jnp.dot`` with f32 accumulation), and keeps
+the online-softmax running stats in registers/VMEM — the score matrix never
+exists in HBM, so memory is O(T·D) instead of O(T²).
+
+Backward uses ``jax.custom_vjp`` with recompute-from-inputs through the
+numerically-identical :func:`~dct_tpu.ops.attention.blockwise_attention`
+(flash-style rematerialization: store only q,k,v, not the score matrix).
+
+CPU rigs run the same kernel with ``interpret=True`` (tests); on TPU it
+compiles to Mosaic. Reference note: the reference has no kernels of any
+kind (pure torch CPU, SURVEY §2.2) — this file is capability the TPU build
+adds at the layer the reference delegates to libtorch.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      causal: bool, scale: float):
+    q = q_ref[:].astype(jnp.float32) * scale  # [bq, D]
+    bq = q.shape[0]
+    t = k_ref.shape[0]
+    n_kv = t // block_k
+    qi = pl.program_id(1)
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, block_k]
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            keep = q_pos >= k_pos
+            s = jnp.where(keep, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(keep, p, 0.0)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
+               scale: float | None, interpret: bool):
+    b, h, t, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(
+            f"seq len {t} must be a multiple of block_q={block_q} and "
+            f"block_k={block_k} (pad upstream)"
+        )
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, causal=causal, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def flash_attention(q, k, v, block_q=128, block_k=128, causal=False,
+                    scale=None, interpret=False):
+    """Flash attention; q,k,v [B, H, T, D] -> [B, H, T, D]."""
+    return _flash_fwd(
+        q, k, v, block_q=block_q, block_k=block_k, causal=causal,
+        scale=scale, interpret=interpret,
+    )
+
+
+def _vjp_fwd(q, k, v, block_q, block_k, causal, scale, interpret):
+    out = _flash_fwd(
+        q, k, v, block_q=block_q, block_k=block_k, causal=causal,
+        scale=scale, interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _vjp_bwd(block_q, block_k, causal, scale, interpret, res, g):
+    # Rematerialized backward: differentiate the numerically-identical
+    # blockwise path from the saved inputs (no score matrix was stored).
+    from dct_tpu.ops.attention import blockwise_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, block_size=block_k, causal=causal, scale=scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
