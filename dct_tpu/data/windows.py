@@ -1,0 +1,64 @@
+"""Sliding-window view over the processed row stream for sequence models.
+
+The reference has no sequence models (SURVEY.md §5.7: inputs are 5-feature
+tabular rows, jobs/preprocess.py:29); the transformer family is this
+framework's extension. The data contract stays identical to the row path:
+:class:`WindowArrays` mirrors :class:`~dct_tpu.data.dataset.WeatherArrays`
+(``features`` / ``labels`` / ``feature_names`` / ``__len__`` /
+``input_dim``), so the split, :class:`~dct_tpu.data.pipeline.BatchLoader`,
+checkpointing, and tracking paths are reused unchanged — only the feature
+rank changes ([N, F] -> [N, S, F]).
+
+Windowing is next-step supervision over the time-ordered stream: window
+``i`` is rows ``[i, i+seq_len)`` and its label is row ``i+seq_len``'s label
+(predict the step after the window). Construction is a zero-copy
+``sliding_window_view``; rows are only materialized when the loader gathers
+a batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+from numpy.lib.stride_tricks import sliding_window_view
+
+from dct_tpu.data.dataset import WeatherArrays
+
+
+@dataclass
+class WindowArrays:
+    """Windowed host arrays; drop-in for WeatherArrays downstream."""
+
+    features: np.ndarray  # [N, S, F] float32 (strided view until gathered)
+    labels: np.ndarray  # [N] int32
+    feature_names: list[str]
+    seq_len: int
+
+    def __len__(self) -> int:
+        return int(self.features.shape[0])
+
+    @property
+    def input_dim(self) -> int:
+        return int(self.features.shape[2])
+
+
+def make_windows(data: WeatherArrays, seq_len: int) -> WindowArrays:
+    """[N, F] rows -> [N-seq_len, seq_len, F] windows with next-step labels."""
+    n = len(data)
+    if seq_len < 1:
+        raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+    if n <= seq_len:
+        raise ValueError(
+            f"Need more than seq_len={seq_len} rows to build windows; "
+            f"dataset has {n}."
+        )
+    # sliding_window_view puts the window axis last: [N-S+1, F, S], zero-copy.
+    windows = sliding_window_view(data.features, seq_len, axis=0)
+    windows = np.moveaxis(windows, -1, 1)  # -> [N-S+1, S, F]
+    return WindowArrays(
+        features=windows[: n - seq_len],
+        labels=data.labels[seq_len:].astype(np.int32),
+        feature_names=list(data.feature_names),
+        seq_len=int(seq_len),
+    )

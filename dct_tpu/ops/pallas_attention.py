@@ -2,10 +2,19 @@
 
 The JAX-level paths in :mod:`dct_tpu.ops.attention` rely on XLA fusion;
 this kernel takes manual control of the memory hierarchy per the Pallas TPU
-playbook: each grid step holds one Q block in VMEM, streams KV blocks
-VMEM-resident through the MXU (``jnp.dot`` with f32 accumulation), and keeps
-the online-softmax running stats in registers/VMEM — the score matrix never
-exists in HBM, so memory is O(T·D) instead of O(T²).
+playbook. The grid is ``(batch*heads, q_blocks, kv_blocks)`` with the KV
+block as the innermost (sequential) dimension, so VMEM residency per grid
+step is one ``[block_q, D]`` Q tile plus one ``[block_k, D]`` K/V tile —
+O(block) regardless of sequence length — while the online-softmax running
+stats (m, l, acc) persist in VMEM scratch across the KV sweep. The score
+matrix never exists in HBM, so memory is O(T·D) instead of O(T²); with
+``causal=True`` KV blocks entirely above the diagonal skip their MXU work.
+
+The running stats use the same online update as
+:func:`dct_tpu.ops.attention._online_block`; they are re-expressed here in
+2-D keepdims layout ([block_q, 1] rows, lane-broadcast scratch tiles)
+because Mosaic wants >=2-D vector layouts in VMEM — tests pin the two
+implementations to the same dense oracle so they cannot drift silently.
 
 Backward uses ``jax.custom_vjp`` with recompute-from-inputs through the
 numerically-identical :func:`~dct_tpu.ops.attention.blockwise_attention`
@@ -25,50 +34,75 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-_NEG = -1e30
+from dct_tpu.ops.attention import _NEG
+
+# Lane width of the m/l scratch tiles: the stats are per-Q-row scalars, but
+# Mosaic lays vectors out in (sublane, lane) tiles, so they live broadcast
+# across a full 128-lane row (the official TPU flash kernels do the same).
+_STATS_LANES = 128
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                      causal: bool, scale: float):
-    q = q_ref[:].astype(jnp.float32) * scale  # [bq, D]
-    bq = q.shape[0]
-    t = k_ref.shape[0]
-    n_kv = t // block_k
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                      block_k: int, n_kv: int, causal: bool, scale: float):
     qi = pl.program_id(1)
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    j = pl.program_id(2)
+    bq = q_ref.shape[0]
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _block():
+        q = q_ref[...].astype(jnp.float32) * scale  # [bq, D]
+        k = k_ref[...].astype(jnp.float32)  # [block_k, D]
+        v = v_ref[...].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bq, block_k]
         if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0
+            )
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1
             )
             keep = q_pos >= k_pos
             s = jnp.where(keep, s, _NEG)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
         if causal:
+            # A fully-masked row would otherwise get p=exp(0)=1 per entry
+            # (same guard as attention._online_block).
             p = jnp.where(keep, p, 0.0)
-        l_new = l * alpha + p.sum(axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    m0 = jnp.full((bq,), _NEG, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc0 = jnp.zeros(q.shape, jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+    if causal:
+        # KV block j overlaps the triangle iff its first key position
+        # j*block_k is <= the block's last query position (qi+1)*bq - 1;
+        # blocks fully above the diagonal skip all compute (their DMA is
+        # also elided — the index map refetches the resident block).
+        pl.when(j * block_k < (qi + 1) * bq)(_block)
+    else:
+        _block()
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[...] = (acc_ref[...] / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
 
 
 def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
@@ -82,22 +116,47 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
             f"seq len {t} must be a multiple of block_q={block_q} and "
             f"block_k={block_k} (pad upstream)"
         )
+    n_kv = t // block_k
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)
     kernel = functools.partial(
-        _flash_fwd_kernel, block_k=block_k, causal=causal, scale=scale
+        _flash_fwd_kernel, block_k=block_k, n_kv=n_kv, causal=causal,
+        scale=scale,
     )
+    if causal:
+        # Skipped above-diagonal blocks would otherwise still be DMA'd:
+        # clamp the index map so they re-address the last needed block
+        # (already resident -> the fetch is elided), saving ~half the KV
+        # HBM traffic for causal attention.
+        def kv_index(bh, i, j):
+            last_needed = ((i + 1) * block_q - 1) // block_k
+            return (bh, jnp.minimum(j, last_needed), 0)
+    else:
+        def kv_index(bh, i, j):
+            return (bh, j, 0)
+    try:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+        )
+    except (AttributeError, TypeError):  # pragma: no cover - older jax
+        compiler_params = None
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, t // block_q),
+        grid=(b * h, t // block_q, n_kv),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((None, t, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((None, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((None, block_k, d), kv_index),
+            pl.BlockSpec((None, block_k, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # m
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # l
+            pltpu.VMEM((block_q, d), jnp.float32),  # acc
+        ],
+        compiler_params=compiler_params,
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, t, d)
@@ -129,9 +188,12 @@ def _vjp_bwd(block_q, block_k, causal, scale, interpret, res, g):
     from dct_tpu.ops.attention import blockwise_attention
 
     q, k, v = res
+    # Clamp like the forward does: a caller whose T is shorter than the
+    # (default 128) block must still get a matching backward.
+    block = min(block_k, k.shape[-2])
     _, vjp = jax.vjp(
         lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, block_size=block_k, causal=causal, scale=scale
+            q_, k_, v_, block_size=block, causal=causal, scale=scale
         ),
         q, k, v,
     )

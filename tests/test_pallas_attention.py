@@ -59,6 +59,29 @@ def test_flash_bf16_io(qkv):
     )
 
 
+def test_flash_short_seq_default_blocks(rng):
+    """T shorter than the default block size: forward clamps the blocks,
+    and the backward must clamp identically instead of crashing."""
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 2, 64, 16)), jnp.float32)
+        for _ in range(3)
+    )
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    g = jax.grad(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: dense_attention(q, k, v, causal=True).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for gf, gd in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd), atol=1e-4)
+
+
 def test_flash_rejects_bad_blocks(qkv):
     q, k, v = qkv
     with pytest.raises(ValueError):
