@@ -220,6 +220,32 @@ class TrackingConfig:
 
 
 @dataclass
+class ProfileConfig:
+    """Tracing/profiling window (absent in the reference — SURVEY §5.1:
+    TensorBoard is installed but nothing writes it; the pipeline DAG's logs
+    check warns on an empty dir, dags/pipeline.py:229-240).
+
+    When enabled, the coordinator traces ONE epoch with ``jax.profiler``
+    into a TensorBoard-compatible directory; per-epoch throughput metrics
+    are logged to the tracker regardless.
+    """
+
+    enabled: bool = False
+    trace_dir: str = "logs/profile"
+    # Which epoch to trace (0-based). Default 1: epoch 0 pays compilation,
+    # which would swamp the steady-state timeline.
+    epoch: int = 1
+
+    @classmethod
+    def from_env(cls) -> "ProfileConfig":
+        c = cls()
+        c.enabled = _env("DCT_PROFILE", c.enabled, bool)
+        c.trace_dir = _env("DCT_TRACE_DIR", c.trace_dir, str)
+        c.epoch = _env("DCT_PROFILE_EPOCH", c.epoch, int)
+        return c
+
+
+@dataclass
 class RunConfig:
     """Top-level bundle passed to the Trainer."""
 
@@ -229,6 +255,7 @@ class RunConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     dist: DistributedConfig = field(default_factory=DistributedConfig)
     tracking: TrackingConfig = field(default_factory=TrackingConfig)
+    profile: ProfileConfig = field(default_factory=ProfileConfig)
 
     @classmethod
     def from_env(cls) -> "RunConfig":
@@ -239,6 +266,7 @@ class RunConfig:
             mesh=MeshConfig.from_env(),
             dist=DistributedConfig.from_env(),
             tracking=TrackingConfig.from_env(),
+            profile=ProfileConfig.from_env(),
         )
 
     def to_dict(self) -> dict:

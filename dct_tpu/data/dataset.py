@@ -40,6 +40,13 @@ class WeatherArrays:
     def input_dim(self) -> int:
         return int(self.features.shape[1])
 
+    def take(self, indices: np.ndarray) -> np.ndarray:
+        """Gather feature rows: [*indices.shape, F]. Uses the native C++
+        data plane when available (numpy fancy-index fallback)."""
+        from dct_tpu import native
+
+        return native.gather_rows(self.features, indices)
+
 
 def load_processed_dataset(
     processed_dir: str,

@@ -22,6 +22,7 @@ from typing import Iterator
 
 import numpy as np
 
+from dct_tpu import native
 from dct_tpu.data.dataset import WeatherArrays
 
 
@@ -131,9 +132,9 @@ class BatchLoader:
         n = len(idx)
         lb, gb = self.local_batch, self.global_batch
         if n == 0:
-            f = self.data.features.shape[1]
+            sample_shape = self.data.features.shape[1:]
             return (
-                np.zeros((0, lb, f), np.float32),
+                np.zeros((0, lb, *sample_shape), np.float32),
                 np.zeros((0, lb), np.int32),
                 np.zeros((0, lb), np.float32),
             )
@@ -144,8 +145,8 @@ class BatchLoader:
         sl = slice(self.process_id * lb, (self.process_id + 1) * lb)
         mat = padded.reshape(steps, gb)[:, sl]
         return (
-            self.data.features[mat],
-            self.data.labels[mat],
+            self.data.take(mat),
+            native.gather_i32(self.data.labels, mat),
             weights.reshape(steps, gb)[:, sl],
         )
 
@@ -170,7 +171,7 @@ class BatchLoader:
                 (self.process_id + 1) * self.local_batch,
             )
             yield Batch(
-                x=self.data.features[chunk[sl]],
-                y=self.data.labels[chunk[sl]],
+                x=self.data.take(chunk[sl]),
+                y=native.gather_i32(self.data.labels, chunk[sl]),
                 weight=weight[sl],
             )

@@ -34,6 +34,10 @@ class WindowArrays:
     labels: np.ndarray  # [N] int32
     feature_names: list[str]
     seq_len: int
+    # The un-windowed [rows, F] stream the strided view points into; the
+    # native gather path copies seq contiguous rows per window from here
+    # instead of fancy-indexing the view.
+    base: np.ndarray | None = None
 
     def __len__(self) -> int:
         return int(self.features.shape[0])
@@ -41,6 +45,16 @@ class WindowArrays:
     @property
     def input_dim(self) -> int:
         return int(self.features.shape[2])
+
+    def take(self, indices: np.ndarray) -> np.ndarray:
+        """Gather windows: [*indices.shape, S, F]. Window index == start
+        row in ``base``, so the native path is one contiguous copy per
+        window."""
+        if self.base is not None:
+            from dct_tpu import native
+
+            return native.gather_windows(self.base, indices, self.seq_len)
+        return self.features[np.asarray(indices)]
 
 
 def make_windows(data: WeatherArrays, seq_len: int) -> WindowArrays:
@@ -53,12 +67,14 @@ def make_windows(data: WeatherArrays, seq_len: int) -> WindowArrays:
             f"Need more than seq_len={seq_len} rows to build windows; "
             f"dataset has {n}."
         )
+    base = np.ascontiguousarray(data.features, dtype=np.float32)
     # sliding_window_view puts the window axis last: [N-S+1, F, S], zero-copy.
-    windows = sliding_window_view(data.features, seq_len, axis=0)
+    windows = sliding_window_view(base, seq_len, axis=0)
     windows = np.moveaxis(windows, -1, 1)  # -> [N-S+1, S, F]
     return WindowArrays(
         features=windows[: n - seq_len],
         labels=data.labels[seq_len:].astype(np.int32),
         feature_names=list(data.feature_names),
         seq_len=int(seq_len),
+        base=base,
     )

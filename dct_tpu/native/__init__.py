@@ -1,0 +1,121 @@
+"""Native host data plane with transparent Python fallback.
+
+Wraps the C++ batch-assembly kernels (``src/data_plane.cpp``) behind numpy-
+in/numpy-out functions. Loading policy:
+
+- first use triggers a (cached, content-addressed) ``g++`` build;
+- ``DCT_NATIVE=0`` disables the native path;
+- any build/load failure silently selects the numpy fallbacks — the native
+  library is a throughput optimization of the host side of the input
+  pipeline, never a correctness dependency.
+
+The reference's analog of this layer is libtorch's C++ DataLoader collation
+(SURVEY §2.2); here it is first-party and TPU-shaped: it assembles the
+contiguous [steps, batch, ...] epoch buffers that ``make_global_epoch``
+transfers to device in one DMA.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("DCT_NATIVE", "1").strip().lower() in ("0", "false", "no"):
+        return None
+    try:
+        from dct_tpu.native.build import build
+
+        path = build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        if lib.dct_native_abi_version() != 1:
+            return None
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.dct_gather_rows.argtypes = [
+            f32p, ctypes.c_int64, i64p, ctypes.c_int64, f32p, ctypes.c_int32,
+        ]
+        lib.dct_gather_windows.argtypes = [
+            f32p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int64, f32p,
+            ctypes.c_int32,
+        ]
+        lib.dct_gather_i32.argtypes = [i32p, i64p, ctypes.c_int64, i32p]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray, *, nthreads: int = 0) -> np.ndarray:
+    """dst[i] = src[idx[i]]; src [N, F] float32, idx any int shape ->
+    [*idx.shape, F]."""
+    idx = np.ascontiguousarray(idx, np.int64)
+    lib = _load()
+    if lib is None or not (
+        src.flags.c_contiguous and src.dtype == np.float32 and src.ndim == 2
+    ):
+        return src[idx]
+    if idx.size and (idx.min() < 0 or idx.max() >= src.shape[0]):
+        raise IndexError("gather_rows: index out of bounds")
+    flat = idx.reshape(-1)
+    out = np.empty((flat.size, src.shape[1]), np.float32)
+    lib.dct_gather_rows(src, src.shape[1], flat, flat.size, out, nthreads)
+    return out.reshape(*idx.shape, src.shape[1])
+
+
+def gather_windows(
+    base: np.ndarray, starts: np.ndarray, seq: int, *, nthreads: int = 0
+) -> np.ndarray:
+    """dst[i] = base[starts[i]:starts[i]+seq]; base [N, F] float32 ->
+    [*starts.shape, seq, F]."""
+    starts = np.ascontiguousarray(starts, np.int64)
+    lib = _load()
+    if lib is None or not (
+        base.flags.c_contiguous and base.dtype == np.float32 and base.ndim == 2
+    ):
+        flat = starts.reshape(-1)
+        out = np.stack([base[s : s + seq] for s in flat]) if flat.size else (
+            np.empty((0, seq, base.shape[1]), base.dtype)
+        )
+        return out.reshape(*starts.shape, seq, base.shape[1])
+    if starts.size and (
+        starts.min() < 0 or starts.max() + seq > base.shape[0]
+    ):
+        raise IndexError("gather_windows: window out of bounds")
+    flat = starts.reshape(-1)
+    out = np.empty((flat.size, seq, base.shape[1]), np.float32)
+    lib.dct_gather_windows(
+        base, base.shape[1], flat, flat.size, seq, out, nthreads
+    )
+    return out.reshape(*starts.shape, seq, base.shape[1])
+
+
+def gather_i32(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """dst[i] = src[idx[i]] for int32 labels."""
+    idx = np.ascontiguousarray(idx, np.int64)
+    lib = _load()
+    if lib is None or not (src.flags.c_contiguous and src.dtype == np.int32):
+        return src[idx]
+    if idx.size and (idx.min() < 0 or idx.max() >= src.shape[0]):
+        raise IndexError("gather_i32: index out of bounds")
+    flat = idx.reshape(-1)
+    out = np.empty(flat.size, np.int32)
+    lib.dct_gather_i32(src, flat, flat.size, out)
+    return out.reshape(idx.shape)

@@ -1,0 +1,65 @@
+"""Build driver for the native data-plane library.
+
+Compiles ``src/data_plane.cpp`` with the system ``g++`` into a content-
+addressed shared object under ``<pkg>/build/`` (gitignored). No setuptools,
+no pybind11 — the ABI is plain C consumed via ctypes, so a single compiler
+invocation is the whole build system. Build failures are non-fatal: the
+Python fallbacks in :mod:`dct_tpu.native` keep everything working.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_PKG_DIR, "src", "data_plane.cpp")
+_BUILD_DIR = os.path.join(_PKG_DIR, "build")
+
+CXX = os.environ.get("DCT_CXX", "g++")
+CXXFLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+
+
+def _source_tag() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha1(f.read()).hexdigest()[:16]
+
+
+def so_path() -> str:
+    return os.path.join(_BUILD_DIR, f"dct_native_{_source_tag()}.so")
+
+
+def build(force: bool = False) -> str | None:
+    """Compile if needed; returns the .so path or None on failure."""
+    out = so_path()
+    if os.path.exists(out) and not force:
+        return out
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # Atomic publish: compile to a temp name, rename into place, so a
+    # concurrent builder (two SPMD processes on one host) never loads a
+    # half-written object.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            [CXX, *CXXFLAGS, _SRC, "-o", tmp],
+            check=True,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        os.replace(tmp, out)
+        return out
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+if __name__ == "__main__":
+    path = build(force=True)
+    print(path if path else "BUILD FAILED")

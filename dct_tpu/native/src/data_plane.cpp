@@ -1,0 +1,98 @@
+// Native host data plane: threaded batch-assembly kernels.
+//
+// The TPU compute path is XLA/Pallas; this library owns the host side of
+// the hot loop — gathering shuffled sample rows / sliding windows from the
+// in-RAM dataset into the contiguous [steps, batch, ...] epoch buffers that
+// are DMA'd to the chip. The reference delegates its equivalent host loop
+// to libtorch's DataLoader collation (C++ under torch, SURVEY §2.2); here
+// it is first-party, dependency-free C++ exposed over a C ABI for ctypes.
+//
+// Contract notes:
+// - all arrays are C-contiguous; callers validate indices (the Python
+//   wrapper bounds-checks before dispatch);
+// - gather_windows copies seq contiguous rows per window start, which is
+//   one memcpy per window instead of numpy's per-element strided iteration
+//   over a sliding_window_view;
+// - work splits across std::thread workers above a size threshold; below
+//   it, threading overhead dominates and a single pass wins.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Rows-per-thread threshold below which threads cost more than they save.
+constexpr int64_t kMinElemsPerThread = 1 << 16;
+
+int pick_threads(int64_t total_elems, int32_t requested) {
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  if (hw <= 0) hw = 1;
+  int cap = requested > 0 ? std::min(requested, hw) : hw;
+  int64_t by_work =
+      std::max<int64_t>(1, total_elems / kMinElemsPerThread);
+  return static_cast<int>(std::min<int64_t>(cap, by_work));
+}
+
+template <typename Fn>
+void parallel_for(int64_t m, int nthreads, Fn&& body) {
+  if (nthreads <= 1) {
+    body(0, m);
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(nthreads);
+  int64_t chunk = (m + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min(m, lo + chunk);
+    if (lo >= hi) break;
+    workers.emplace_back([&body, lo, hi] { body(lo, hi); });
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// dst[i, :] = src[idx[i], :] for i in [0, m); rows are row_elems floats.
+void dct_gather_rows(const float* src, int64_t row_elems, const int64_t* idx,
+                     int64_t m, float* dst, int32_t nthreads) {
+  const size_t row_bytes = static_cast<size_t>(row_elems) * sizeof(float);
+  int nt = pick_threads(m * row_elems, nthreads);
+  parallel_for(m, nt, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(dst + i * row_elems, src + idx[i] * row_elems, row_bytes);
+    }
+  });
+}
+
+// dst[i, :, :] = base[starts[i] : starts[i]+seq, :] — one contiguous copy
+// of seq*row_elems floats per window.
+void dct_gather_windows(const float* base, int64_t row_elems,
+                        const int64_t* starts, int64_t m, int64_t seq,
+                        float* dst, int32_t nthreads) {
+  const int64_t win_elems = seq * row_elems;
+  const size_t win_bytes = static_cast<size_t>(win_elems) * sizeof(float);
+  int nt = pick_threads(m * win_elems, nthreads);
+  parallel_for(m, nt, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(dst + i * win_elems, base + starts[i] * row_elems,
+                  win_bytes);
+    }
+  });
+}
+
+// dst[i] = src[idx[i]] for int32 labels.
+void dct_gather_i32(const int32_t* src, const int64_t* idx, int64_t m,
+                    int32_t* dst) {
+  for (int64_t i = 0; i < m; ++i) dst[i] = src[idx[i]];
+}
+
+// ABI version guard for the ctypes loader.
+int32_t dct_native_abi_version() { return 1; }
+
+}  // extern "C"

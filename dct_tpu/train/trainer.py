@@ -22,7 +22,6 @@ Plus what the reference lacks: true resume from full optimizer state
 from __future__ import annotations
 
 import os
-import time
 from dataclasses import dataclass, field
 
 import jax
@@ -42,6 +41,7 @@ from dct_tpu.parallel.mesh import (
 from dct_tpu.parallel.sharding_rules import shard_state_with_rules
 from dct_tpu.tracking.client import get_tracker
 from dct_tpu.train.state import create_train_state
+from dct_tpu.utils.profiling import EpochTimer, Profiler, annotate
 from dct_tpu.train.steps import (
     make_epoch_eval_step,
     make_epoch_train_step,
@@ -228,8 +228,16 @@ class Trainer:
 
         history: list[dict] = []
         global_step = int(jax.device_get(state.step))
-        total_samples = 0
-        train_time = 0.0
+        # Throughput accounting + optional one-epoch jax.profiler trace
+        # (SURVEY §5.1: the reference installs TensorBoard but never writes
+        # it — here the trace is real TB-compatible profile data).
+        timer = EpochTimer(n_chips=self.mesh.size)
+        profiler = Profiler(
+            cfg.profile.trace_dir,
+            enabled=cfg.profile.enabled,
+            epoch=min(cfg.profile.epoch, cfg.train.epochs - 1),
+            coordinator=self.coordinator,
+        )
 
         # Pre-staged validation arrays (order is fixed): stacked AND
         # transferred to device once, reused every epoch.
@@ -238,69 +246,85 @@ class Trainer:
                 self.mesh, *self._stack_epoch(val_loader, 0)
             )
 
-        for epoch in range(start_epoch, cfg.train.epochs):
-            t0 = time.perf_counter()
-            if use_scan:
-                xs, ys, ws = self._stack_epoch(train_loader, epoch)
-                gxs, gys, gws = make_global_epoch(self.mesh, xs, ys, ws)
-                n_steps = xs.shape[0]
-                state, losses = epoch_train(state, gxs, gys, gws)
-                jax.block_until_ready(state.params)
-                train_time += time.perf_counter() - t0
-                losses_host = jax.device_get(losses)
-                for i in range(n_steps):
-                    if (global_step + i + 1) % cfg.train.log_every_n_steps == 0:
-                        self.tracker.log_metrics(
-                            {"train_loss": float(losses_host[i])},
-                            step=global_step + i + 1,
-                        )
-                global_step += n_steps
-                total_samples += n_steps * global_batch
-                last_loss = losses_host[-1] if n_steps else None
-            else:
-                last_loss = None
-                for batch in train_loader.epoch(epoch):
-                    x, y, w = make_global_batch(
-                        self.mesh, batch.x, batch.y, batch.weight
-                    )
-                    state, metrics = train_step(state, x, y, w)
-                    global_step += 1
-                    total_samples += global_batch
-                    if global_step % cfg.train.log_every_n_steps == 0:
-                        self.tracker.log_metrics(
-                            {"train_loss": float(jax.device_get(metrics["train_loss"]))},
-                            step=global_step,
-                        )
-                    last_loss = metrics["train_loss"]
-                jax.block_until_ready(state.params)
-                train_time += time.perf_counter() - t0
+        try:
+            for epoch in range(start_epoch, cfg.train.epochs):
+                profiler.maybe_start(epoch)
+                timer.start()
+                if use_scan:
+                    with annotate("host_epoch_assembly"):
+                        xs, ys, ws = self._stack_epoch(train_loader, epoch)
+                        gxs, gys, gws = make_global_epoch(self.mesh, xs, ys, ws)
+                    n_steps = xs.shape[0]
+                    state, losses = epoch_train(state, gxs, gys, gws)
+                    jax.block_until_ready(state.params)
+                    epoch_stats = timer.stop(epoch, n_steps * global_batch)
+                    losses_host = jax.device_get(losses)
+                    for i in range(n_steps):
+                        if (global_step + i + 1) % cfg.train.log_every_n_steps == 0:
+                            self.tracker.log_metrics(
+                                {"train_loss": float(losses_host[i])},
+                                step=global_step + i + 1,
+                            )
+                    global_step += n_steps
+                    last_loss = losses_host[-1] if n_steps else None
+                else:
+                    last_loss = None
+                    n_steps = 0
+                    for batch in train_loader.epoch(epoch):
+                        with annotate("host_batch_staging"):
+                            x, y, w = make_global_batch(
+                                self.mesh, batch.x, batch.y, batch.weight
+                            )
+                        state, metrics = train_step(state, x, y, w)
+                        global_step += 1
+                        n_steps += 1
+                        if global_step % cfg.train.log_every_n_steps == 0:
+                            self.tracker.log_metrics(
+                                {"train_loss": float(jax.device_get(metrics["train_loss"]))},
+                                step=global_step,
+                            )
+                        last_loss = metrics["train_loss"]
+                    jax.block_until_ready(state.params)
+                    epoch_stats = timer.stop(epoch, n_steps * global_batch)
 
-            if use_scan:
-                ls, accs, c = epoch_eval(state, *val_global)
-                cnt = float(jax.device_get(c))
-                val_loss = float(jax.device_get(ls)) / cnt if cnt else float("nan")
-                val_acc = float(jax.device_get(accs)) / cnt if cnt else float("nan")
-            else:
-                val_loss, val_acc = self._evaluate(state, eval_step, val_loader)
-            epoch_rec = {
-                "epoch": epoch,
-                "train_loss": float(jax.device_get(last_loss)) if last_loss is not None else float("nan"),
-                "val_loss": val_loss,
-                "val_acc": val_acc,
-            }
-            history.append(epoch_rec)
-            self.tracker.log_metrics(
-                {"val_loss": val_loss, "val_acc": val_acc}, step=global_step
-            )
-            if self.coordinator:
-                ckptr.update(
-                    epoch=epoch,
-                    metrics={"val_loss": val_loss, "val_acc": val_acc},
-                    params=state.params,
-                    meta=meta,
+                if use_scan:
+                    ls, accs, c = epoch_eval(state, *val_global)
+                    cnt = float(jax.device_get(c))
+                    val_loss = float(jax.device_get(ls)) / cnt if cnt else float("nan")
+                    val_acc = float(jax.device_get(accs)) / cnt if cnt else float("nan")
+                else:
+                    val_loss, val_acc = self._evaluate(state, eval_step, val_loader)
+                epoch_rec = {
+                    "epoch": epoch,
+                    "train_loss": float(jax.device_get(last_loss)) if last_loss is not None else float("nan"),
+                    "val_loss": val_loss,
+                    "val_acc": val_acc,
+                }
+                history.append(epoch_rec)
+                self.tracker.log_metrics(
+                    {
+                        "val_loss": val_loss,
+                        "val_acc": val_acc,
+                        "epoch_time": epoch_stats.seconds,
+                        "samples_per_sec": epoch_stats.samples_per_sec,
+                        "samples_per_sec_per_chip": epoch_stats.samples_per_sec_per_chip,
+                    },
+                    step=global_step,
                 )
-            # Every process keeps its own resume state (host-local disk).
-            state_ckptr.save(state)
+                profiler.maybe_stop(epoch)
+                if self.coordinator:
+                    ckptr.update(
+                        epoch=epoch,
+                        metrics={"val_loss": val_loss, "val_acc": val_acc},
+                        params=state.params,
+                        meta=meta,
+                    )
+                # Every process keeps its own resume state (host-local disk).
+                state_ckptr.save(state)
+
+        finally:
+            # Crash-path hygiene: never leave a jax.profiler session open.
+            profiler.close()
 
         # Rank-0 post-train artifact upload, mirroring
         # jobs/train_lightning_ddp.py:146-164 (best, else last.ckpt fallback).
@@ -321,7 +345,7 @@ class Trainer:
             best_model_path=best_path,
             last_model_path=ckptr.last_path,
             history=history,
-            samples_per_sec=(total_samples / train_time) if train_time > 0 else 0.0,
+            samples_per_sec=timer.samples_per_sec,
             run_id=run_id,
             state=state,
         )

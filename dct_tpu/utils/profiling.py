@@ -1,0 +1,131 @@
+"""Tracing / profiling subsystem.
+
+The reference has NO tracing or profiling (SURVEY §5.1): its only
+observability knobs are ``log_every_n_steps=5`` cadence control
+(jobs/train_lightning_ddp.py:139) and stdout prints; TensorBoard is
+installed in the trainer image (Dockerfile.pytorch:16) and a DAG task looks
+for a logs directory (dags/pipeline.py:229-240) but nothing ever writes it.
+This module fills that gap TPU-natively:
+
+- :class:`Profiler` — a coordinator-gated window around ``jax.profiler``
+  device tracing. The trace (XLA ops, fusion boundaries, HBM transfers,
+  ICI collectives) lands in a TensorBoard-compatible ``plugins/profile``
+  directory, satisfying the DAG's TensorBoard-logs check with real content.
+- :class:`EpochTimer` — wall-clock + throughput accounting per epoch
+  (samples/sec and samples/sec/chip, the BASELINE.md north-star metric),
+  ready to be logged as tracking metrics next to val_loss.
+- :func:`annotate` — host-side named spans (``jax.profiler.TraceAnnotation``)
+  so batch assembly and H2D staging show up on the trace timeline alongside
+  device work.
+
+Profiling is a window, not a mode: tracing every step of a long run would
+produce gigabytes and perturb the steady state, so the profiler arms itself
+for one configured epoch and disarms after.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+
+def annotate(name: str):
+    """Named host span that appears on the profiler timeline."""
+    import jax.profiler
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Profiler:
+    """Start/stop ``jax.profiler`` tracing around one epoch window.
+
+    Only the coordinator process traces (every process tracing would write
+    world_size copies; the device timeline of process 0 is representative
+    for SPMD programs). Safe to call when disabled — all methods no-op.
+    """
+
+    def __init__(self, trace_dir: str, *, enabled: bool, epoch: int,
+                 coordinator: bool = True):
+        self.trace_dir = trace_dir
+        self.enabled = bool(enabled) and coordinator
+        self.epoch = int(epoch)
+        self._active = False
+
+    def maybe_start(self, epoch: int) -> None:
+        if not self.enabled or self._active or epoch != self.epoch:
+            return
+        import jax.profiler
+
+        os.makedirs(self.trace_dir, exist_ok=True)
+        jax.profiler.start_trace(self.trace_dir)
+        self._active = True
+
+    def maybe_stop(self, epoch: int) -> None:
+        if not self._active or epoch != self.epoch:
+            return
+        import jax.profiler
+
+        jax.profiler.stop_trace()
+        self._active = False
+
+    def close(self) -> None:
+        """Stop tracing unconditionally (crash-path hygiene: an abandoned
+        trace session would corrupt the output directory)."""
+        if self._active:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+@dataclass
+class EpochStats:
+    epoch: int
+    seconds: float
+    samples: int
+    samples_per_sec: float
+    samples_per_sec_per_chip: float
+
+
+@dataclass
+class EpochTimer:
+    """Accumulates per-epoch wall time and throughput.
+
+    ``n_chips`` divides throughput into the per-chip north-star metric
+    (BASELINE.md): honest accounting means the clock includes host batch
+    assembly and H2D staging, not just device execution.
+    """
+
+    n_chips: int = 1
+    history: list = field(default_factory=list)
+    _t0: float = 0.0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, epoch: int, samples: int) -> EpochStats:
+        dt = time.perf_counter() - self._t0
+        sps = samples / dt if dt > 0 else 0.0
+        stats = EpochStats(
+            epoch=epoch,
+            seconds=dt,
+            samples=samples,
+            samples_per_sec=sps,
+            samples_per_sec_per_chip=sps / max(self.n_chips, 1),
+        )
+        self.history.append(stats)
+        return stats
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(s.seconds for s in self.history)
+
+    @property
+    def total_samples(self) -> int:
+        return sum(s.samples for s in self.history)
+
+    @property
+    def samples_per_sec(self) -> float:
+        t = self.total_seconds
+        return self.total_samples / t if t > 0 else 0.0

@@ -1,0 +1,90 @@
+"""Native C++ data plane: build, parity with numpy, loader integration.
+
+The library is an optimization, never a correctness dependency — but in
+this image g++ IS available, so the build must succeed (a silent fallback
+here would mean shipping the slow path unnoticed).
+"""
+
+import numpy as np
+import pytest
+
+from dct_tpu import native
+
+
+@pytest.fixture(scope="module")
+def rows():
+    r = np.random.default_rng(0)
+    return np.ascontiguousarray(r.standard_normal((500, 7)), np.float32)
+
+
+def test_native_builds_and_loads():
+    assert native.available(), (
+        "native data plane failed to build/load despite g++ being present"
+    )
+
+
+def test_gather_rows_matches_numpy(rows):
+    idx = np.random.default_rng(1).integers(0, 500, size=(13, 8))
+    np.testing.assert_array_equal(native.gather_rows(rows, idx), rows[idx])
+
+
+def test_gather_rows_fallback_non_f32(rows):
+    src = rows.astype(np.float64)  # not f32 -> numpy fallback path
+    idx = np.arange(10)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def test_gather_rows_bounds(rows):
+    with pytest.raises(IndexError):
+        native.gather_rows(rows, np.array([0, 500]))
+    with pytest.raises(IndexError):
+        native.gather_rows(rows, np.array([-1]))
+
+
+def test_gather_windows_matches_view(rows):
+    from numpy.lib.stride_tricks import sliding_window_view
+
+    seq = 16
+    view = np.moveaxis(sliding_window_view(rows, seq, axis=0), -1, 1)
+    starts = np.random.default_rng(2).integers(0, 500 - seq, size=(4, 5))
+    np.testing.assert_array_equal(
+        native.gather_windows(rows, starts, seq), view[starts]
+    )
+
+
+def test_gather_windows_bounds(rows):
+    with pytest.raises(IndexError):
+        native.gather_windows(rows, np.array([500 - 16 + 1]), 16)
+
+
+def test_gather_i32():
+    src = np.arange(100, dtype=np.int32) * 3
+    idx = np.array([[5, 7], [99, 0]])
+    np.testing.assert_array_equal(native.gather_i32(src, idx), src[idx])
+
+
+def test_window_arrays_take_uses_base(weather_data):
+    from dct_tpu.data.windows import make_windows
+
+    win = make_windows(weather_data, seq_len=8)
+    idx = np.array([0, 3, 11])
+    np.testing.assert_array_equal(win.take(idx), win.features[idx])
+
+
+def test_batch_loader_native_vs_fallback(weather_data, monkeypatch):
+    """epoch_stacked must be bit-identical whether or not the native
+    library is in play."""
+    from dct_tpu.data.pipeline import BatchLoader, train_val_split
+
+    tr, _ = train_val_split(len(weather_data), seed=42)
+    loader = BatchLoader(
+        weather_data, tr, global_batch=32, shuffle=True, seed=42
+    )
+    xs, ys, ws = loader.epoch_stacked(0)
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    xs2, ys2, ws2 = loader.epoch_stacked(0)
+    np.testing.assert_array_equal(xs, xs2)
+    np.testing.assert_array_equal(ys, ys2)
+    np.testing.assert_array_equal(ws, ws2)

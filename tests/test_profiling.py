@@ -1,0 +1,110 @@
+"""Profiling subsystem: trace window, throughput accounting, trainer wiring.
+
+The reference has no tracing at all (SURVEY §5.1); these tests pin the
+framework's replacement: a jax.profiler window around one epoch that
+produces TensorBoard-readable profile data, and per-epoch throughput
+metrics logged next to val_loss.
+"""
+
+import glob
+import os
+
+import pytest
+
+from dct_tpu.config import ProfileConfig, RunConfig
+from dct_tpu.utils.profiling import EpochTimer, Profiler
+
+
+def test_epoch_timer_accounting():
+    t = EpochTimer(n_chips=4)
+    t.start()
+    s = t.stop(epoch=0, samples=400)
+    assert s.samples == 400 and s.seconds >= 0.0
+    assert s.samples_per_sec_per_chip == pytest.approx(s.samples_per_sec / 4)
+    t.start()
+    t.stop(epoch=1, samples=100)
+    assert t.total_samples == 500
+    assert t.samples_per_sec > 0
+
+
+def test_profiler_disabled_is_noop(tmp_path):
+    p = Profiler(str(tmp_path / "trace"), enabled=False, epoch=0)
+    p.maybe_start(0)
+    p.maybe_stop(0)
+    p.close()
+    assert not os.path.exists(str(tmp_path / "trace"))
+
+
+def test_profiler_noncoordinator_is_noop(tmp_path):
+    p = Profiler(str(tmp_path / "trace"), enabled=True, epoch=0,
+                 coordinator=False)
+    p.maybe_start(0)
+    p.close()
+    assert not os.path.exists(str(tmp_path / "trace"))
+
+
+def test_profiler_writes_tensorboard_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    trace_dir = str(tmp_path / "trace")
+    p = Profiler(trace_dir, enabled=True, epoch=1)
+    p.maybe_start(0)  # wrong epoch: must not arm
+    assert not p._active
+    p.maybe_start(1)
+    assert p._active
+    jax.block_until_ready(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+    p.maybe_stop(1)
+    assert not p._active
+    # TensorBoard profile layout: <dir>/plugins/profile/<run>/*.xplane.pb
+    assert glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*", "*.xplane.pb")
+    )
+
+
+def test_profile_config_env(monkeypatch):
+    monkeypatch.setenv("DCT_PROFILE", "1")
+    monkeypatch.setenv("DCT_TRACE_DIR", "/tmp/tr")
+    monkeypatch.setenv("DCT_PROFILE_EPOCH", "0")
+    c = ProfileConfig.from_env()
+    assert c.enabled and c.trace_dir == "/tmp/tr" and c.epoch == 0
+    assert RunConfig.from_env().profile.enabled
+
+
+@pytest.mark.slow
+def test_trainer_emits_trace_and_throughput(weather_data, tmp_path):
+    from dct_tpu.train.trainer import Trainer
+
+    cfg = RunConfig.from_env()
+    cfg.data.models_dir = str(tmp_path / "models")
+    cfg.train.epochs = 2
+    cfg.train.batch_size = 32
+    cfg.profile = ProfileConfig(
+        enabled=True, trace_dir=str(tmp_path / "trace"), epoch=1
+    )
+
+    class RecordingTracker:
+        def __init__(self):
+            self.metrics = []
+
+        def start_run(self, params=None):
+            return "rid"
+
+        def log_metrics(self, m, step=None):
+            self.metrics.append(m)
+
+        def log_artifact(self, *a, **k):
+            pass
+
+        def end_run(self):
+            pass
+
+    tracker = RecordingTracker()
+    result = Trainer(cfg, tracker=tracker).fit(weather_data)
+    assert result.samples_per_sec > 0
+    per_epoch = [m for m in tracker.metrics if "samples_per_sec" in m]
+    assert len(per_epoch) == 2
+    assert all(m["epoch_time"] > 0 for m in per_epoch)
+    assert glob.glob(
+        os.path.join(str(tmp_path / "trace"), "plugins", "profile", "*", "*")
+    )
