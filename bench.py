@@ -141,10 +141,18 @@ def bench_torch_reference(data) -> float:
 def main():
     import tempfile
 
+    from dct_tpu.utils.platform import ensure_live_backend
+
+    # A wedged TPU control plane would block jax init forever; the bench
+    # must always print its JSON line, so probe first and fall back to CPU.
+    ensure_live_backend()
+
     with tempfile.TemporaryDirectory() as tmp:
         data = _prepare_data(tmp)
         baseline = bench_torch_reference(data)
         ours, last_loss = bench_tpu(data)
+
+    import jax
 
     print(
         json.dumps(
@@ -155,6 +163,7 @@ def main():
                 "vs_baseline": round(ours / baseline, 2),
                 "baseline_torch_cpu_samples_per_sec": round(baseline, 1),
                 "final_train_loss": round(last_loss, 4),
+                "platform": jax.default_backend(),
             }
         )
     )

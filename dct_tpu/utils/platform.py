@@ -1,0 +1,89 @@
+"""Backend liveness probing: never let a dead accelerator hang the job.
+
+JAX backend initialization is a blocking call with no timeout: if the TPU
+runtime's control plane is unreachable (dead tunnel, stale session claim,
+relay wedged by a killed process), ``jax.devices()`` blocks forever inside
+PJRT client creation — there is no in-process way to interrupt it. The
+reference pipeline has the same class of failure (a stale rank holding the
+gloo rendezvous port) and guards it with a pre-launch zombie purge
+(dags/2_pytorch_training.py:29-38, SURVEY §5.2); the TPU-native analog is
+this **subprocess probe**: initialize the default backend in a disposable
+child with a hard timeout, and if it does not come up, fall back to CPU in
+the parent *before* any backend init, so benches/health checks always
+complete and report rather than hanging their orchestrator.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+# The child honors JAX_PLATFORMS env over any sitecustomize config clobber
+# (mirroring ensure_live_backend's own policy) so it initializes exactly the
+# backend the parent would.
+_PROBE_SRC = (
+    "import os, jax; w = os.environ.get('JAX_PLATFORMS'); "
+    "jax.config.update('jax_platforms', w) if (w and jax.config.jax_platforms != w) else None; "
+    "jax.devices(); print(jax.default_backend())"
+)
+
+
+def probe_default_backend(timeout: float = 150.0) -> str | None:
+    """Initialize the default JAX backend in a child process.
+
+    Returns the backend name on success, None if init hangs/fails.
+    """
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if res.returncode != 0:
+        return None
+    out = res.stdout.strip().splitlines()
+    return out[-1] if out else None
+
+
+def ensure_live_backend(timeout: float | None = None) -> str:
+    """Make sure this process's first backend init cannot hang.
+
+    - An explicit ``JAX_PLATFORMS`` env var wins over any sitecustomize
+      config clobber (restored into jax config here).
+    - A cpu-only selection needs no probe.
+    - Anything else — including the empty config, where JAX auto-detects
+      an accelerator — is probed in a subprocess; on failure this process
+      (and children, via env) is pinned to CPU.
+
+    Must be called before any jax backend initializes. Returns the platform
+    that will be used ("cpu" or the probed default, e.g. "tpu").
+    ``timeout`` defaults to the ``DCT_BACKEND_PROBE_TIMEOUT`` env var
+    (seconds, 150 if unset) so every caller honors the knob.
+    """
+    import jax
+
+    if timeout is None:
+        timeout = float(os.environ.get("DCT_BACKEND_PROBE_TIMEOUT", "150"))
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want and jax.config.jax_platforms != want:
+        jax.config.update("jax_platforms", want)
+    platforms = want or jax.config.jax_platforms or ""
+    if platforms == "cpu":
+        return "cpu"
+
+    backend = probe_default_backend(timeout=timeout)
+    if backend is not None:
+        return backend
+
+    sys.stderr.write(
+        f"[dct_tpu] default backend ({(platforms or 'auto')!r}) failed to "
+        f"initialize within {timeout:.0f}s — falling back to CPU\n"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
