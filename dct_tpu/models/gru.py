@@ -1,0 +1,99 @@
+"""Recurrent family: GRU over weather windows, shaped for the TPU.
+
+The reference has a single tabular MLP (jobs/train_lightning_ddp.py:51-88);
+this family adds recurrent sequence modeling on the same windowed data path
+as the transformer. TPU-first structure:
+
+- the input-to-gate projections for ALL timesteps are one large fused
+  matmul ([B, S, F] x [F, 3H]) executed before the recurrence — the MXU
+  sees a big batched GEMM instead of S small ones;
+- only the hidden-to-gate product lives inside the ``lax.scan`` over time
+  (the irreducibly sequential part), so the compiled loop body is one
+  [B, H] x [H, 3H] matmul plus elementwise gates — static shapes, no
+  Python-level stepping;
+- gate math follows torch.nn.GRU semantics (reset gate applied to the
+  hidden gate pre-activation including its bias), so a torch GRU with the
+  same weights is a drop-in numerical oracle for tests.
+
+Parameters use the same TorchStyleDense naming scheme as the other
+families; no tensor-parallel name rules match, so the GRU shards
+data-parallel with replicated params — same layout as the flagship MLP.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dct_tpu.models.mlp import TorchStyleDense
+
+
+class GRULayer(nn.Module):
+    """One GRU layer: [B, S, D_in] -> (outputs [B, S, H], last state [B, H])."""
+
+    hidden: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs):
+        b = xs.shape[0]
+        # Fused input projections for every timestep at once: [B, S, 3H]
+        # laid out as (r, z, n) gate blocks.
+        x_gates = TorchStyleDense(3 * self.hidden, dtype=self.dtype,
+                                  name="x_gates")(xs)
+        wh = self.param(
+            "h_kernel",
+            nn.initializers.lecun_normal(),
+            (self.hidden, 3 * self.hidden),
+            jnp.float32,
+        )
+        bh = self.param(
+            "h_bias", nn.initializers.zeros, (3 * self.hidden,), jnp.float32
+        )
+        wh_c = jnp.asarray(wh, self.dtype)
+        bh_c = jnp.asarray(bh, self.dtype)
+        h_dim = self.hidden
+
+        def step(h, xg):
+            hg = h @ wh_c + bh_c  # [B, 3H]
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            # torch.nn.GRU applies the reset gate to the full hidden gate
+            # pre-activation (including its bias): n = tanh(xn + r*(Wh h + b)).
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1.0 - z) * n + z * h
+            return h_new, h_new
+
+        h0 = jnp.zeros((b, h_dim), self.dtype)
+        last, outs = jax.lax.scan(step, h0, jnp.swapaxes(x_gates, 0, 1))
+        return jnp.swapaxes(outs, 0, 1), last
+
+
+class WeatherGRU(nn.Module):
+    """Stacked GRU over [B, S, F] windows -> [B, num_classes] rain logits."""
+
+    input_dim: int
+    hidden_dim: int = 64
+    n_layers: int = 2
+    num_classes: int = 2
+    dropout: float = 0.2
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        h = jnp.asarray(x, self.compute_dtype)
+        last = None
+        for i in range(self.n_layers):
+            h, last = GRULayer(
+                self.hidden_dim, dtype=self.compute_dtype, name=f"gru_{i}"
+            )(h)
+            if i < self.n_layers - 1:
+                h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
+        pooled = nn.Dropout(rate=self.dropout, deterministic=not train)(last)
+        logits = TorchStyleDense(
+            self.num_classes, dtype=self.compute_dtype, name="head"
+        )(pooled)
+        return jnp.asarray(logits, jnp.float32)

@@ -61,6 +61,27 @@ def _build_mlp(cfg: ModelConfig, *, input_dim: int, compute_dtype=None):
     )
 
 
+@register_model("weather_gru", sequence=True)
+def _build_gru(
+    cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None
+):
+    # attn_fn is part of the sequence-model builder interface (the Trainer
+    # supplies a mesh-aware attention kernel); recurrence has no use for it.
+    del attn_fn
+    import jax.numpy as jnp
+
+    from dct_tpu.models.gru import WeatherGRU
+
+    return WeatherGRU(
+        input_dim=input_dim,
+        hidden_dim=cfg.hidden_dim,
+        n_layers=cfg.n_layers,
+        num_classes=cfg.num_classes,
+        dropout=cfg.dropout,
+        compute_dtype=compute_dtype or jnp.float32,
+    )
+
+
 @register_model("weather_transformer", sequence=True)
 def _build_transformer(
     cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None

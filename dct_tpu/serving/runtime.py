@@ -1,17 +1,26 @@
-"""Dependency-free inference runtime for the deployed model.
+"""Dependency-free inference runtime for every deployed model family.
 
 The reference's generated ``score.py`` re-declares the torch model class and
 loads a Lightning checkpoint inside the serving container
 (dags/azure_manual_deploy.py:54-125), pulling torch+lightning into the
 inference image and hardcoding ``input_dim=5`` (:109). Here the deploy
 package carries the weights as a plain ``model.npz`` (+ JSON meta with the
-true input_dim/feature names from the checkpoint), and inference is pure
-numpy — the serving container needs no ML framework at all. These functions
-are the single source of truth; the score.py generator embeds this module's
-source verbatim so the deployed copy cannot drift from the tested one.
+true architecture/feature names from the checkpoint), and inference is pure
+numpy — the serving container needs no ML framework at all, for ANY family:
+
+- ``weather_mlp``        — sequential dense stack (w0/b0.. keys);
+- ``weather_gru``        — stacked GRU over windows (flat flax-path keys);
+- ``weather_transformer``— encoder over windows (flat flax-path keys).
+
+:func:`score_payload` dispatches on ``meta["model"]`` and validates the
+payload shape per family. This module is the single source of truth: the
+score.py generator embeds its source verbatim so the deployed copy cannot
+drift from the tested one.
 """
 
 from __future__ import annotations
+
+import math
 
 import numpy as np
 
@@ -20,6 +29,35 @@ def softmax_numpy(logits: np.ndarray) -> np.ndarray:
     z = logits - logits.max(axis=-1, keepdims=True)
     e = np.exp(z)
     return e / e.sum(axis=-1, keepdims=True)
+
+
+def _sigmoid(x: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _gelu_tanh(x: np.ndarray) -> np.ndarray:
+    # jax.nn.gelu default (approximate=True): the tanh approximation.
+    return 0.5 * x * (
+        1.0 + np.tanh(np.sqrt(2.0 / np.pi) * (x + 0.044715 * x**3))
+    )
+
+
+def _layernorm(x: np.ndarray, scale: np.ndarray, bias: np.ndarray,
+               eps: float = 1e-6) -> np.ndarray:
+    mu = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * scale + bias
+
+
+def _sincos_positions(seq_len: int, d_model: int) -> np.ndarray:
+    # Mirrors dct_tpu.models.transformer.sincos_positions.
+    pos = np.arange(seq_len)[:, None].astype(np.float32)
+    i = np.arange(d_model // 2)[None, :].astype(np.float32)
+    ang = pos / np.power(10000.0, 2.0 * i / d_model)
+    out = np.zeros((seq_len, d_model), np.float32)
+    out[:, 0::2] = np.sin(ang)
+    out[:, 1::2] = np.cos(ang)
+    return out
 
 
 def mlp_forward_numpy(weights: dict, x: np.ndarray) -> np.ndarray:
@@ -37,21 +75,122 @@ def mlp_forward_numpy(weights: dict, x: np.ndarray) -> np.ndarray:
     return h
 
 
+def gru_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
+    """Stacked GRU inference; weights carry flax paths
+    (``gru_<i>/x_gates/kernel`` etc., gate order r,z,n — torch semantics:
+    reset gate applied to the full hidden pre-activation)."""
+    n_layers = int(meta["n_layers"])
+    h_seq = x
+    h = None
+    for i in range(n_layers):
+        xg = h_seq @ weights[f"gru_{i}/x_gates/kernel"] + weights[
+            f"gru_{i}/x_gates/bias"
+        ]  # [N, S, 3H]
+        wh = weights[f"gru_{i}/h_kernel"]
+        bh = weights[f"gru_{i}/h_bias"]
+        h = np.zeros((x.shape[0], wh.shape[0]), np.float32)
+        # Only the last layer's final state feeds the head; intermediate
+        # layers need the full output sequence as the next layer's input.
+        keep_seq = i < n_layers - 1
+        outs = []
+        for t in range(xg.shape[1]):
+            hg = h @ wh + bh
+            xr, xz, xn = np.split(xg[:, t], 3, axis=-1)
+            hr, hz, hn = np.split(hg, 3, axis=-1)
+            r = _sigmoid(xr + hr)
+            z = _sigmoid(xz + hz)
+            n = np.tanh(xn + r * hn)
+            h = (1.0 - z) * n + z * h
+            if keep_seq:
+                outs.append(h)
+        if keep_seq:
+            h_seq = np.stack(outs, axis=1)
+    return h @ weights["head/kernel"] + weights["head/bias"]
+
+
+def transformer_forward_numpy(
+    weights: dict, meta: dict, x: np.ndarray
+) -> np.ndarray:
+    """Pre-LN encoder inference with dense (non-causal) attention; weights
+    carry flax paths (``block_<i>/attn/qkv_proj/kernel`` etc.)."""
+    d_model = int(meta["d_model"])
+    n_heads = int(meta["n_heads"])
+    n_layers = int(meta["n_layers"])
+    head_dim = d_model // n_heads
+    n, s, _ = x.shape
+
+    h = x @ weights["in_proj/kernel"] + weights["in_proj/bias"]
+    h = h + _sincos_positions(s, d_model)
+    for i in range(n_layers):
+        pre = f"block_{i}"
+        a = _layernorm(
+            h, weights[f"{pre}/ln_attn/scale"], weights[f"{pre}/ln_attn/bias"]
+        )
+        qkv = a @ weights[f"{pre}/attn/qkv_proj/kernel"] + weights[
+            f"{pre}/attn/qkv_proj/bias"
+        ]
+        qkv = qkv.reshape(n, s, n_heads, 3, head_dim)
+        q, k, v = (np.swapaxes(qkv[:, :, :, j], 1, 2) for j in range(3))
+        scores = q @ np.swapaxes(k, -1, -2) / math.sqrt(head_dim)
+        o = softmax_numpy(scores) @ v  # [N, H, S, Dh]
+        o = np.moveaxis(o, 1, 2).reshape(n, s, d_model)
+        o = o @ weights[f"{pre}/attn/o_proj/kernel"] + weights[
+            f"{pre}/attn/o_proj/bias"
+        ]
+        h = h + o
+        f = _layernorm(
+            h, weights[f"{pre}/ln_ffn/scale"], weights[f"{pre}/ln_ffn/bias"]
+        )
+        f = _gelu_tanh(f @ weights[f"{pre}/ffn_in/kernel"] + weights[f"{pre}/ffn_in/bias"])
+        f = f @ weights[f"{pre}/ffn_out/kernel"] + weights[f"{pre}/ffn_out/bias"]
+        h = h + f
+    h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
+    pooled = h.mean(axis=1)
+    return pooled @ weights["head/kernel"] + weights["head/bias"]
+
+
+def forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
+    """Dispatch inference on the checkpoint's model family."""
+    family = meta.get("model", "weather_mlp")
+    if family == "weather_gru":
+        return gru_forward_numpy(weights, meta, x)
+    if family == "weather_transformer":
+        return transformer_forward_numpy(weights, meta, x)
+    return mlp_forward_numpy(weights, x)
+
+
+_SEQUENCE_FAMILIES = ("weather_gru", "weather_transformer")
+
+
 def score_payload(weights: dict, meta: dict, data) -> dict:
     """The run()-body: validate + forward + softmax.
 
     Mirrors the reference's response contract
     (dags/azure_manual_deploy.py:116-124): {"probabilities": [[...], ...]}.
-    Input: {"data": [[feature vector], ...]}.
+    Row families take {"data": [[feature vector], ...]}; sequence families
+    take {"data": [[[row x seq_len] window], ...]} (one window may be passed
+    un-batched).
     """
     x = np.asarray(data, dtype=np.float32)
-    if x.ndim == 1:
-        x = x[None, :]
     expected = int(meta["input_dim"])
-    if x.ndim != 2 or x.shape[1] != expected:
-        raise ValueError(
-            f"Expected shape [N, {expected}] (features: "
-            f"{meta.get('feature_names', '?')}), got {list(x.shape)}"
-        )
-    probs = softmax_numpy(mlp_forward_numpy(weights, x))
+    family = meta.get("model", "weather_mlp")
+    if family in _SEQUENCE_FAMILIES:
+        seq_len = int(meta["seq_len"])
+        if x.ndim == 2:
+            x = x[None, :, :]
+        if x.ndim != 3 or x.shape[1] != seq_len or x.shape[2] != expected:
+            raise ValueError(
+                f"Expected shape [N, {seq_len}, {expected}] (windows of "
+                f"features: {meta.get('feature_names', '?')}), got "
+                f"{list(x.shape)}"
+            )
+    else:
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.ndim != 2 or x.shape[1] != expected:
+            raise ValueError(
+                f"Expected shape [N, {expected}] (features: "
+                f"{meta.get('feature_names', '?')}), got {list(x.shape)}"
+            )
+    probs = softmax_numpy(forward_numpy(weights, meta, x))
     return {"probabilities": probs.tolist()}

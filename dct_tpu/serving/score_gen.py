@@ -26,37 +26,55 @@ import os
 import numpy as np
 
 
+def _flatten_params(tree: dict, prefix: str = "") -> dict:
+    """Flax param tree -> flat {'a/b/c': ndarray} dict (npz-friendly)."""
+    out: dict = {}
+    for name, val in tree.items():
+        key = f"{prefix}/{name}" if prefix else str(name)
+        if isinstance(val, dict):
+            out.update(_flatten_params(val, key))
+        else:
+            out[key] = np.asarray(val, np.float32)
+    return out
+
+
 def export_npz_weights(ckpt_path: str, deploy_dir: str) -> dict:
     """model.ckpt (flax msgpack) -> model.npz + model_meta.json.
 
-    Works for any sequential dense stack (every top-level param collection
-    entry holding a kernel+bias pair, ordered by trailing index) — which
-    covers the MLP family. Other model families (e.g. the transformer) need
-    a dedicated serving exporter; packaging such a checkpoint fails loudly
-    here instead of raising a bare KeyError mid-deploy.
+    The MLP family exports as an anonymous sequential dense stack
+    (``w0/b0..`` keys — what :func:`runtime.mlp_forward_numpy` consumes and
+    what existing deployments already serve). Sequence families
+    (transformer, GRU) export the flax param tree flattened to
+    ``/``-joined keys; :func:`runtime.forward_numpy` dispatches on
+    ``meta["model"]``.
     """
     from dct_tpu.checkpoint.manager import load_checkpoint
 
     params, meta = load_checkpoint(ckpt_path)
     p = params["params"]
+    family = meta.get("model", "weather_mlp")
 
-    def layer_index(name: str) -> int:
-        tail = name.rsplit("_", 1)[-1]
-        return int(tail) if tail.isdigit() else -1
+    if family in ("weather_gru", "weather_transformer"):
+        weights = _flatten_params(p)
+    else:
+        def layer_index(name: str) -> int:
+            tail = name.rsplit("_", 1)[-1]
+            return int(tail) if tail.isdigit() else -1
 
-    layers = sorted(p, key=layer_index)
-    if not all(
-        isinstance(p[n], dict) and {"kernel", "bias"} <= set(p[n]) for n in layers
-    ):
-        raise ValueError(
-            f"Serving export supports sequential dense models only; "
-            f"checkpoint model={meta.get('model')!r} has param tree "
-            f"{sorted(p)} — register a dedicated exporter for this family"
-        )
-    weights = {}
-    for i, name in enumerate(layers):
-        weights[f"w{i}"] = np.asarray(p[name]["kernel"], np.float32)
-        weights[f"b{i}"] = np.asarray(p[name]["bias"], np.float32)
+        layers = sorted(p, key=layer_index)
+        if not all(
+            isinstance(p[n], dict) and {"kernel", "bias"} <= set(p[n])
+            for n in layers
+        ):
+            raise ValueError(
+                f"Serving export for model={family!r} expects a sequential "
+                f"dense stack; checkpoint has param tree {sorted(p)} — "
+                "register a dedicated exporter for this family"
+            )
+        weights = {}
+        for i, name in enumerate(layers):
+            weights[f"w{i}"] = np.asarray(p[name]["kernel"], np.float32)
+            weights[f"b{i}"] = np.asarray(p[name]["bias"], np.float32)
     os.makedirs(deploy_dir, exist_ok=True)
     np.savez(os.path.join(deploy_dir, "model.npz"), **weights)
     with open(os.path.join(deploy_dir, "model_meta.json"), "w") as f:
@@ -133,9 +151,11 @@ def generate_score_package(ckpt_path: str, deploy_dir: str) -> dict:
 
     from dct_tpu.serving import runtime
 
-    runtime_source = "".join(
-        inspect.getsource(fn)
-        for fn in (runtime.softmax_numpy, runtime.mlp_forward_numpy, runtime.score_payload)
+    # Embed the WHOLE runtime module (every family's forward + dispatch);
+    # drop the __future__ import, which must stay file-leading and is
+    # unneeded at serving time.
+    runtime_source = inspect.getsource(runtime).replace(
+        "from __future__ import annotations\n", ""
     )
     # str.format substitutes values verbatim (braces inside runtime_source
     # are untouched); only the template's own {{ }} literals are unescaped.

@@ -9,6 +9,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dct_tpu.checkpoint.manager import save_checkpoint
 from dct_tpu.config import ModelConfig
@@ -118,6 +119,75 @@ def test_input_dim_from_checkpoint_not_hardcoded(tmp_path, rng, monkeypatch):
     score.init()
     out = score.run(json.dumps({"data": [[0.1] * 7]}))
     assert np.asarray(out["probabilities"]).shape == (1, 2)
+
+
+def _seq_ckpt(tmp_path, name, seq_len=10, input_dim=5):
+    cfg = ModelConfig(
+        name=name, seq_len=seq_len, d_model=16, n_heads=2, n_layers=2, d_ff=32
+    )
+    model = get_model(cfg, input_dim=input_dim)
+    params = model.init(
+        jax.random.PRNGKey(5), jnp.zeros((1, seq_len, input_dim))
+    )
+    meta = {
+        "model": name,
+        "input_dim": input_dim,
+        "seq_len": seq_len,
+        "d_model": 16,
+        "n_heads": 2,
+        "n_layers": 2,
+        "d_ff": 32,
+        "num_classes": 2,
+        "dropout": 0.0,
+        "feature_names": [f"f{i}_norm" for i in range(input_dim)],
+    }
+    path = save_checkpoint(str(tmp_path / f"{name}.ckpt"), params, meta)
+    return model, params, path, meta
+
+
+@pytest.mark.parametrize("name", ["weather_gru", "weather_transformer"])
+def test_sequence_family_numpy_parity(tmp_path, rng, name):
+    """Every deployable family's numpy inference must match the JAX model."""
+    from dct_tpu.serving.runtime import forward_numpy
+
+    model, params, ckpt, meta = _seq_ckpt(tmp_path, name)
+    deploy = str(tmp_path / f"pkg_{name}")
+    generate_score_package(ckpt, deploy)
+
+    npz = np.load(os.path.join(deploy, "model.npz"))
+    weights = {k: npz[k] for k in npz.files}
+    x = rng.standard_normal((4, 10, 5)).astype(np.float32)
+
+    np_logits = forward_numpy(weights, meta, x)
+    jax_logits = np.asarray(model.apply(params, jnp.asarray(x), train=False))
+    np.testing.assert_allclose(np_logits, jax_logits, atol=2e-5)
+
+
+@pytest.mark.parametrize("name", ["weather_gru", "weather_transformer"])
+def test_sequence_family_score_py_end_to_end(tmp_path, rng, monkeypatch, name):
+    _, _, ckpt, meta = _seq_ckpt(tmp_path, name)
+    deploy = str(tmp_path / f"pkg_{name}")
+    generate_score_package(ckpt, deploy)
+
+    monkeypatch.setenv("AZUREML_MODEL_DIR", deploy)
+    spec = importlib.util.spec_from_file_location(
+        f"generated_score_{name}", os.path.join(deploy, "score.py")
+    )
+    score = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(score)
+    score.init()
+
+    win = rng.standard_normal((2, 10, 5)).astype(np.float32)
+    out = score.run(json.dumps({"data": win.tolist()}))
+    assert np.asarray(out["probabilities"]).shape == (2, 2)
+
+    # One un-batched window is accepted.
+    out1 = score.run(json.dumps({"data": win[0].tolist()}))
+    assert np.asarray(out1["probabilities"]).shape == (1, 2)
+
+    # Wrong window length -> error contract, not an exception.
+    bad = score.run(json.dumps({"data": win[:, :4].tolist()}))
+    assert "error" in bad and "Expected shape" in bad["error"]
 
 
 def test_score_payload_single_vector(tmp_path):
