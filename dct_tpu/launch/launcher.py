@@ -88,18 +88,27 @@ def build_spmd_launch_script(
     coordinator_port: int = 29500,
     stagger_seconds: int = 5,
     extra_env: dict[str, str] | None = None,
+    fail_fast_poll_seconds: int = 2,
 ) -> str:
     """Generate the launch block: same program on every host, coordinator
-    env injected, staggered start, PID join, exit-code conjunction.
+    env injected, staggered start, fail-fast join, exit-code conjunction.
 
     Host 0 is the coordinator (MASTER_ADDR), mirroring the reference env
     contract (docker-compose.yml:121-124) so the same script works under
     both topologies.
+
+    Fail-fast join: the reference ``wait``s each rank sequentially
+    (dags/2_pytorch_training.py:62-75), so a dead worker leaves the
+    coordinator blocked in a collective until the 3-hour task timeout.
+    Here a polling loop reaps ranks as they exit and, on the first nonzero
+    exit, terminates the remaining launch processes — the failure surfaces
+    in seconds. (For ssh templates the kill stops the local client; any
+    orphaned remote rank is covered by the next run's zombie purge, the
+    same hygiene model as the reference.)
     """
     world = len(hosts)
     master = hosts[0]
     lines = [f"echo 'Launching SPMD training on {world} hosts...'", "set -m"]
-    pid_vars = []
     for rank, host in enumerate(hosts):
         env = {
             "MASTER_ADDR": master,
@@ -111,21 +120,59 @@ def build_spmd_launch_script(
         env_prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
         full = f"{env_prefix} {command}"
         lines.append(remote_command(exec_template, host, full) + " &")
-        pid_var = f"PID{rank}"
-        lines.append(f"{pid_var}=$!")
-        pid_vars.append(pid_var)
+        lines.append(f"PID{rank}=$!")
+        lines.append(f"DONE{rank}=0")
         if rank == 0 and world > 1:
             lines.append(f"sleep {stagger_seconds}")
-    for rank, pv in enumerate(pid_vars):
-        lines.append(f"wait ${pv}; RC{rank}=$?")
-    conj = " && ".join(f'[ "$RC{r}" -eq 0 ]' for r in range(world))
+    ranks = range(world)
+    # set -m gives each background job its own process group (PGID = leader
+    # PID); kill the GROUP — a plain kill of the wrapper shell is deferred
+    # by bash until its foreground child finishes, leaving the actual rank
+    # running. Reaped ranks are skipped via their DONE flag.
+    lines.append("kill_survivors() {")
+    for s in ranks:
+        lines.append(
+            f'  [ "$DONE{s}" -eq 0 ] && kill -- "-$PID{s}" 2>/dev/null'
+        )
+    lines.append("  :")
+    lines.append("}")
+    lines.append("FAILED=0")
+    lines.append(f"REMAINING={world}")
+    lines.append('while [ "$REMAINING" -gt 0 ]; do')
+    for r in ranks:
+        lines.extend([
+            f'  if [ "$DONE{r}" -eq 0 ] && ! kill -0 "$PID{r}" 2>/dev/null; then',
+            f'    wait "$PID{r}"; RC{r}=$?; DONE{r}=1; '
+            f"REMAINING=$((REMAINING-1))",
+            f'    echo "Rank {r} exited with code $RC{r}"',
+            f'    if [ "$RC{r}" -ne 0 ] && [ "$FAILED" -eq 0 ]; then',
+            "      FAILED=1",
+            '      echo "Rank failure detected - terminating remaining ranks (fail-fast)"',
+            "      kill_survivors",
+            "    fi",
+            "  fi",
+        ])
+    lines.append(
+        f'  [ "$REMAINING" -gt 0 ] && sleep {fail_fast_poll_seconds}'
+    )
+    lines.append("done")
+    conj = " && ".join(f'[ "$RC{r}" -eq 0 ]' for r in ranks)
     lines.append(
         f'if {conj}; then echo "All {world} ranks finished successfully"; '
         f'else echo "Training failed: rank exit codes: '
-        + " ".join(f"$RC{r}" for r in range(world))
+        + " ".join(f"$RC{r}" for r in ranks)
         + '"; exit 1; fi'
     )
     return "\n".join(lines)
+
+
+def _kill_group(p: "subprocess.Popen") -> None:
+    """SIGKILL a rank's whole process group (falls back to the direct
+    child if the group is already gone)."""
+    try:
+        os.killpg(p.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        p.kill()
 
 
 @dataclass
@@ -145,10 +192,14 @@ class LocalProcessLauncher:
         coordinator_port: int = 29511,
         stagger_seconds: float = 1.0,
         timeout: float = 600.0,
+        fail_fast: bool = True,
+        poll_seconds: float = 0.2,
     ):
         self.coordinator_port = coordinator_port
         self.stagger_seconds = stagger_seconds
         self.timeout = timeout
+        self.fail_fast = fail_fast
+        self.poll_seconds = poll_seconds
 
     def cleanup_zombies(self, pattern: str) -> None:
         subprocess.run(["pkill", "-9", "-f", pattern], check=False)
@@ -173,24 +224,56 @@ class LocalProcessLauncher:
                     NODE_RANK=str(rank),
                     WORLD_SIZE=str(world_size),
                 )
-                procs.append(subprocess.Popen(argv, env=rank_env))
+                # Own process group per rank so a fail-fast kill reaches the
+                # whole rank tree, not just the direct child.
+                procs.append(
+                    subprocess.Popen(argv, env=rank_env, start_new_session=True)
+                )
                 if rank == 0 and world_size > 1:
                     time.sleep(self.stagger_seconds)
-            results = []
+            # Poll-based join: reap ranks as they exit; with fail_fast, the
+            # first nonzero exit kills the survivors immediately instead of
+            # leaving them blocked in a collective until the timeout (the
+            # reference's sequential wait has exactly that failure mode,
+            # dags/2_pytorch_training.py:62-75).
+            codes: dict[int, int] = {}
+            killed = False
             deadline = time.monotonic() + self.timeout
+            while len(codes) < world_size and time.monotonic() < deadline:
+                progressed = False
+                for rank, p in enumerate(procs):
+                    if rank in codes:
+                        continue
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    codes[rank] = rc
+                    progressed = True
+                    if rc != 0 and self.fail_fast and not killed:
+                        killed = True
+                        for q in procs:
+                            if q.poll() is None:
+                                _kill_group(q)
+                if not progressed and len(codes) < world_size:
+                    time.sleep(self.poll_seconds)
             for rank, p in enumerate(procs):
-                remaining = max(1.0, deadline - time.monotonic())
-                try:
-                    rc = p.wait(timeout=remaining)
-                except subprocess.TimeoutExpired:
-                    p.kill()
-                    rc = -signal.SIGKILL
-                results.append(RankResult(rank=rank, returncode=rc))
-            return results
+                if rank not in codes:  # deadline hit
+                    # Final poll: a rank that finished during the last
+                    # sleep window keeps its real exit code.
+                    rc = p.poll()
+                    if rc is None:
+                        _kill_group(p)
+                        p.wait()
+                        rc = -signal.SIGKILL
+                    codes[rank] = rc
+            return [
+                RankResult(rank=r, returncode=codes[r])
+                for r in range(world_size)
+            ]
         finally:
             for p in procs:
                 if p.poll() is None:
-                    p.kill()
+                    _kill_group(p)
 
     @staticmethod
     def all_succeeded(results: list[RankResult]) -> bool:

@@ -26,8 +26,9 @@ def test_launch_script_env_contract():
     assert "MASTER_PORT=29500" in script
     # Staggered start after rank 0 only.
     assert script.count("sleep 5") == 1
-    # Join + exit-code conjunction over both ranks.
-    assert "wait $PID0" in script and "wait $PID1" in script
+    # Fail-fast join + exit-code conjunction over both ranks.
+    assert 'wait "$PID0"' in script and 'wait "$PID1"' in script
+    assert "terminating remaining ranks" in script
     assert '[ "$RC0" -eq 0 ] && [ "$RC1" -eq 0 ]' in script
     assert "exit 1" in script
 
@@ -93,7 +94,7 @@ def test_ssh_reparse_quoting(tmp_path):
 
 def test_single_host_no_stagger():
     script = build_spmd_launch_script(["only-host"], "python3 t.py")
-    assert "sleep" not in script
+    assert "sleep 5" not in script  # no stagger (poll-loop sleeps remain)
     assert "WORLD_SIZE=1" in script
 
 
@@ -127,6 +128,53 @@ def test_launch_script_fails_if_any_rank_fails():
     proc = subprocess.run(["bash", "-c", script], capture_output=True, text=True)
     assert proc.returncode == 1
     assert "Training failed" in proc.stdout
+
+
+def test_launch_script_fail_fast_kills_survivors():
+    """A dead rank must fail the launch in seconds, not leave the healthy
+    rank blocked until the task timeout."""
+    import subprocess
+    import time as _time
+
+    script = build_spmd_launch_script(
+        ["h0", "h1"],
+        # Rank 0 would run for 100s; rank 1 dies immediately.
+        "sh -c 'if [ $NODE_RANK -eq 1 ]; then exit 3; else sleep 100; fi'",
+        exec_template="bash -c {cmd}",
+        stagger_seconds=0,
+        fail_fast_poll_seconds=1,
+    )
+    t0 = _time.monotonic()
+    proc = subprocess.run(["bash", "-c", script], capture_output=True, text=True)
+    elapsed = _time.monotonic() - t0
+    assert proc.returncode == 1
+    assert "fail-fast" in proc.stdout
+    assert elapsed < 30, f"fail-fast took {elapsed:.1f}s"
+
+
+def test_local_launcher_fail_fast(tmp_path):
+    """LocalProcessLauncher: first nonzero exit kills the surviving rank."""
+    import time as _time
+
+    launcher = LocalProcessLauncher(
+        stagger_seconds=0.0, timeout=60.0, poll_seconds=0.1
+    )
+    t0 = _time.monotonic()
+    results = launcher.launch(
+        [
+            sys.executable,
+            "-c",
+            "import os, sys, time\n"
+            "rank = int(os.environ['NODE_RANK'])\n"
+            "sys.exit(5) if rank == 1 else time.sleep(60)\n",
+        ],
+        world_size=2,
+    )
+    elapsed = _time.monotonic() - t0
+    assert elapsed < 30, f"fail-fast took {elapsed:.1f}s"
+    assert not LocalProcessLauncher.all_succeeded(results)
+    assert results[1].returncode == 5
+    assert results[0].returncode != 0  # killed, not left running
 
 
 @pytest.mark.slow
