@@ -31,7 +31,7 @@ if _REPO_ROOT not in sys.path:
 ROWS = int(os.environ.get("DCT_BENCH_ROWS", "20000"))
 BATCH = 4  # per-rank parity batch (jobs/train_lightning_ddp.py:122)
 WARMUP_EPOCHS = 1
-TIMED_EPOCHS = int(os.environ.get("DCT_BENCH_EPOCHS", "3"))
+TIMED_EPOCHS = max(1, int(os.environ.get("DCT_BENCH_EPOCHS", "3")))
 
 
 def _prepare_data(tmp: str):
@@ -74,19 +74,54 @@ def bench_tpu(data) -> tuple[float, float]:
     state = shard_state(state, mesh)
     epoch_train = make_epoch_train_step()
 
-    # Warm up (compile) once; the timed region below includes everything
-    # the real trainer does per epoch — host batch assembly, H2D transfer,
-    # and compute — matching what the torch baseline's timed DataLoader
-    # loop includes.
-    warm = Trainer._stack_epoch(loader, 0)
-    state, losses = epoch_train(state, *make_global_epoch(mesh, *warm))
+    # The timed region includes everything the real trainer does per epoch
+    # — host batch assembly, H2D transfer, and compute — matching what the
+    # torch baseline's timed DataLoader loop includes.
+    #
+    # Epoch fusion (DCT_BENCH_FUSE=0 to disable): all timed epochs are
+    # stacked host-side into ONE [E*S, B, ...] scan — a single H2D staging
+    # and a single dispatch for the whole timed region. Identical update
+    # sequence to per-epoch dispatch (each epoch keeps its own shuffle);
+    # on a real chip behind a slow control plane, per-dispatch latency at
+    # the tiny parity batch otherwise dominates the measurement.
+    import numpy as np
+
+    fuse = os.environ.get("DCT_BENCH_FUSE", "1").strip().lower() not in (
+        "0", "false", "no"
+    )
+    # One warm epoch in BOTH modes: the timed region then starts from the
+    # identical model state / step counter, so the per-step update sequence
+    # (incl. step-folded dropout keys) is the same fused or not.
+    warm_g = make_global_epoch(mesh, *Trainer._stack_epoch(loader, 0))
+    steps_per_epoch = warm_g[0].shape[0]
+    state, losses = epoch_train(state, *warm_g)
     jax.block_until_ready(losses)
 
-    steps_per_epoch = warm[0].shape[0]
+    if fuse:
+        # AOT-compile the fused [E*S, ...] shape outside the timed region.
+        fused_specs = tuple(
+            jax.ShapeDtypeStruct(
+                (TIMED_EPOCHS * steps_per_epoch, *g.shape[1:]),
+                g.dtype,
+                sharding=g.sharding,
+            )
+            for g in warm_g
+        )
+        fused_fn = epoch_train.lower(state, *fused_specs).compile()
+
     t0 = time.perf_counter()
-    for e in range(1, 1 + TIMED_EPOCHS):
-        stack = Trainer._stack_epoch(loader, e)
-        state, losses = epoch_train(state, *make_global_epoch(mesh, *stack))
+    if fuse:
+        stacks = [
+            Trainer._stack_epoch(loader, e) for e in range(1, 1 + TIMED_EPOCHS)
+        ]
+        fused = tuple(
+            np.concatenate(cols, axis=0) for cols in zip(*stacks)
+        )
+        state, losses = fused_fn(state, *make_global_epoch(mesh, *fused))
+    else:
+        for e in range(1, 1 + TIMED_EPOCHS):
+            stack = Trainer._stack_epoch(loader, e)
+            state, losses = epoch_train(state, *make_global_epoch(mesh, *stack))
     jax.block_until_ready(losses)
     dt = time.perf_counter() - t0
 

@@ -14,7 +14,7 @@ Reproduces the exact data semantics of the reference Spark job
   unchanged.
 
 The north star keeps the real Spark cluster for production ETL (see
-``jobs/preprocess_spark.py``); this native path is the same transform without
+``dct_tpu/etl/spark_job.py``); this native path is the same transform without
 a JVM for single-host runs, tests, and benches. It is vectorized numpy/arrow
 on the host — ETL is IO-bound, not a TPU problem.
 """
