@@ -14,10 +14,13 @@ Two tiers, mirroring and extending the reference:
    feature names, architecture) so serving never hardcodes ``input_dim=5``
    like the reference's score.py does (dags/azure_manual_deploy.py:109).
 
-2. **Resume tier** (Orbax) — full TrainState (params + Adam moments + step +
-   rng), which the reference cannot do at all (``fit()`` never gets a
-   ckpt_path; jobs/train_lightning_ddp.py:143). Continuous training can
-   therefore actually continue rather than restart from scratch.
+2. **Resume tier** (per-process ``state.npz`` with crash-safe directory
+   rotation) — full TrainState (params + Adam moments + step + rng), which
+   the reference cannot do at all (``fit()`` never gets a ckpt_path;
+   jobs/train_lightning_ddp.py:143). Continuous training can therefore
+   actually continue rather than restart from scratch. Cross-process-
+   sharded leaves (TP/SP spanning hosts) save as local shards and
+   reassemble on restore — no allgather, no cross-process coordination.
 """
 
 from __future__ import annotations
@@ -30,13 +33,40 @@ import numpy as np
 from flax import serialization
 
 
-def _to_host(tree):
-    return jax.tree.map(lambda a: np.asarray(jax.device_get(a)), tree)
+def needs_cross_process_gather(tree) -> bool:
+    """True when any leaf is sharded across processes (not addressable
+    from this host alone)."""
+    return any(
+        isinstance(a, jax.Array) and not a.is_fully_addressable
+        for a in jax.tree.leaves(tree)
+    )
+
+
+def to_host(tree):
+    """Device tree -> host numpy tree.
+
+    Arrays sharded across processes (tensor/sequence parallelism spanning
+    hosts) are not fully addressable and cannot be ``device_get``; they are
+    assembled with a cross-process allgather instead. NB: the allgather is
+    a COLLECTIVE — when any leaf is non-addressable
+    (:func:`needs_cross_process_gather`), every process must call this
+    function (the Trainer does: it gathers on all ranks, then gates the
+    file write on the coordinator).
+    """
+
+    def one(a):
+        if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+        return np.asarray(jax.device_get(a))
+
+    return jax.tree.map(one, tree)
 
 
 def save_checkpoint(path: str, params: Any, meta: dict) -> str:
     """Serialize {meta, params} to a single msgpack file."""
-    payload = {"meta": dict(meta), "params": _to_host(params)}
+    payload = {"meta": dict(meta), "params": to_host(params)}
     data = serialization.msgpack_serialize(payload)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = path + ".tmp"
@@ -97,7 +127,8 @@ class BestLastCheckpointer:
 
 
 class TrainStateCheckpointer:
-    """Orbax-backed full train-state save/restore for true resume."""
+    """Full train-state save/restore for true resume (per-process npz
+    with crash-safe rotation; shard-local for cross-process arrays)."""
 
     def __init__(self, dirpath: str):
         self.dirpath = os.path.abspath(dirpath)
@@ -118,7 +149,7 @@ class TrainStateCheckpointer:
         return [
             d
             for d in (self._dir(self._LIVE), self._dir(self._NEXT), self._dir(self._OLD))
-            if os.path.isdir(d)
+            if os.path.exists(os.path.join(d, "state.npz"))
         ]
 
     @staticmethod
@@ -130,24 +161,60 @@ class TrainStateCheckpointer:
             "rng": state.rng,
         }
 
-    def save(self, state) -> str:
-        import orbax.checkpoint as ocp
+    @staticmethod
+    def _index_key(index) -> tuple:
+        """Deterministic key for a shard's global position (start offsets).
+        Replicated copies on different local devices share a key — saved
+        once, fanned back out on restore."""
+        return tuple(sl.start or 0 for sl in index)
 
+    def save(self, state) -> str:
+        """Persist this process's ADDRESSABLE view of the train state.
+
+        Fully-addressable leaves (replicated params, single-host runs) are
+        saved whole; leaves sharded across processes (TP/SP spanning
+        hosts) are saved as this process's local shards only — RAM and
+        disk stay proportional to the local share, with no allgather, at
+        exactly the scale cross-host sharding exists for. Each leaf i is
+        stored as key ``"i"`` (whole) or keys ``"i_s0..i_sK"`` (shards).
+
+        Storage is a plain ``state.npz`` per process — deliberately NOT an
+        orbax pytree directory: orbax's save finalization (structure
+        metadata, ocdbt manifest merge) is gated on the primary host even
+        with ``primary_host=None``, so nonzero ranks' private directories
+        end up unreadable. This tier is host-local numpy by construction
+        and needs zero cross-process coordination.
+        """
         # Flatten to an index-keyed dict: optax opt_states contain
         # namedtuples that do not round-trip through generic tree
         # serialization; the target treedef at restore time supplies the
         # structure instead.
-        leaves = jax.tree.leaves(_to_host(self._tree(state)))
-        # primary_host=None -> every process writes its own (host-local)
-        # checkpoint; the default primary-host-0 mode assumes a shared
-        # filesystem and silently writes nothing on other ranks.
-        ckptr = ocp.PyTreeCheckpointer(primary_host=None)
+        leaves = jax.tree.leaves(self._tree(state))
+        entries: dict[str, np.ndarray] = {}
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                # One copy per distinct global position: replicated copies
+                # on several local devices dedupe to a single entry.
+                by_key = {}
+                for s in leaf.addressable_shards:
+                    by_key.setdefault(self._index_key(s.index), s)
+                for j, k in enumerate(sorted(by_key)):
+                    entries[f"{i}_s{j}"] = np.asarray(by_key[k].data)
+            else:
+                entries[str(i)] = np.asarray(jax.device_get(leaf))
         import shutil
 
         next_dir = self._dir(self._NEXT)
         if os.path.isdir(next_dir):
             shutil.rmtree(next_dir)
-        ckptr.save(next_dir, {str(i): leaf for i, leaf in enumerate(leaves)})
+        os.makedirs(next_dir)
+        # Atomic publish: a save preempted mid-write must never leave a
+        # torn state.npz that _restore_candidates would accept.
+        final = os.path.join(next_dir, "state.npz")
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **entries)
+        os.replace(tmp, final)
 
         live, old = self._dir(self._LIVE), self._dir(self._OLD)
         if os.path.isdir(old):
@@ -162,18 +229,53 @@ class TrainStateCheckpointer:
     def exists(self) -> bool:
         return bool(self._restore_candidates())
 
-    def restore(self, state):
-        """Restore into the structure of ``state`` (apply_fn/tx kept)."""
-        import orbax.checkpoint as ocp
+    def _reassemble(self, template, parts: list[np.ndarray]):
+        """Local shards -> global jax.Array with the template's sharding.
+        Requires the same mesh/process topology that saved the state."""
+        sharding = template.sharding
+        gshape = template.shape
+        dev_idx = sharding.addressable_devices_indices_map(gshape)
+        keys = sorted({self._index_key(ix) for ix in dev_idx.values()})
+        if len(keys) != len(parts):
+            raise ValueError(
+                f"Shard-saved leaf has {len(parts)} local parts but the "
+                f"current topology expects {len(keys)} distinct local "
+                "shards; resume requires the same mesh/process topology "
+                "that saved the state"
+            )
+        part_by_key = dict(zip(keys, parts))
+        arrays = [
+            jax.device_put(part_by_key[self._index_key(ix)], d)
+            for d, ix in dev_idx.items()
+        ]
+        return jax.make_array_from_single_device_arrays(
+            gshape, sharding, arrays
+        )
 
+    def restore(self, state):
+        """Restore into the structure (and shardings) of ``state``
+        (apply_fn/tx kept). Whole-saved leaves come back as host numpy;
+        shard-saved leaves are reassembled onto this process's devices
+        under the template leaf's sharding."""
         candidates = self._restore_candidates()
         if not candidates:
             raise FileNotFoundError(f"No train-state checkpoint under {self.dirpath}")
-        ckptr = ocp.PyTreeCheckpointer(primary_host=None)
-        restored = ckptr.restore(candidates[0])
+        npz = np.load(os.path.join(candidates[0], "state.npz"))
+        restored = {k: npz[k] for k in npz.files}
         template = self._tree(state)
         treedef = jax.tree.structure(template)
-        leaves = [restored[str(i)] for i in range(treedef.num_leaves)]
+        tleaves = jax.tree.leaves(template)
+        leaves = []
+        for i, t in enumerate(tleaves):
+            if str(i) in restored:
+                leaves.append(restored[str(i)])
+                continue
+            parts = []
+            while f"{i}_s{len(parts)}" in restored:
+                parts.append(restored[f"{i}_s{len(parts)}"])
+            if not parts:
+                raise KeyError(f"Checkpoint {candidates[0]} missing leaf {i}")
+            leaves.append(self._reassemble(t, parts))
         tree = jax.tree.unflatten(treedef, leaves)
         return state.replace(
             step=jax.numpy.asarray(tree["step"]),

@@ -61,6 +61,42 @@ def make_mesh(
     return Mesh(arr, AXES)
 
 
+def process_data_block(mesh: Mesh) -> tuple[int, int]:
+    """How the global batch splits across PROCESSES: (num_blocks, my_block).
+
+    The data loader must feed each process exactly the rows its addressable
+    devices own under :func:`batch_sharding`. For pure DP every process owns
+    distinct data-axis rows -> (process_count, process_index) semantics. For
+    tensor/sequence parallelism spanning processes, several processes share
+    the same data rows (the batch is replicated across them), so they share
+    a block and each must supply the identical full block.
+    """
+    pid = jax.process_index()
+    grid = mesh.devices  # [data, model, seq]
+    my_rows = sorted(
+        {
+            idx[0]
+            for idx in np.ndindex(grid.shape)
+            if grid[idx].process_index == pid
+        }
+    )
+    if not my_rows:
+        raise ValueError(f"process {pid} owns no devices in mesh {mesh}")
+    rows = len(my_rows)
+    data_size = grid.shape[0]
+    if (
+        my_rows != list(range(my_rows[0], my_rows[0] + rows))
+        or my_rows[0] % rows
+        or data_size % rows
+    ):
+        raise ValueError(
+            f"process {pid}'s data-axis rows {my_rows} are not a contiguous "
+            f"aligned block of the {data_size}-row data axis; reorder the "
+            "mesh devices so each process's rows are contiguous"
+        )
+    return data_size // rows, my_rows[0] // rows
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Batch dim sharded over ``data``; feature dims replicated."""
     return NamedSharding(mesh, P("data"))

@@ -27,7 +27,12 @@ from dataclasses import dataclass, field
 import jax
 import jax.numpy as jnp
 
-from dct_tpu.checkpoint.manager import BestLastCheckpointer, TrainStateCheckpointer
+from dct_tpu.checkpoint.manager import (
+    BestLastCheckpointer,
+    TrainStateCheckpointer,
+    needs_cross_process_gather,
+    to_host,
+)
 from dct_tpu.config import RunConfig
 from dct_tpu.data.dataset import WeatherArrays, load_processed_dataset
 from dct_tpu.data.pipeline import BatchLoader, contiguous_split, train_val_split
@@ -37,6 +42,7 @@ from dct_tpu.parallel.mesh import (
     make_global_batch,
     make_global_epoch,
     make_mesh,
+    process_data_block,
 )
 from dct_tpu.parallel.sharding_rules import shard_state_with_rules
 from dct_tpu.tracking.client import get_tracker
@@ -107,14 +113,18 @@ class Trainer:
         # Reference semantics: batch_size is per-rank (DataLoader(batch_size=4)
         # per container); global batch = per-device batch x data-parallel size.
         global_batch = cfg.train.batch_size * self.mesh.shape["data"]
-        nproc = jax.process_count()
+        # Loader sharding follows the MESH, not the raw process count: DP
+        # processes own distinct blocks of each global batch; processes that
+        # only split the model/seq axes share their data rows and must feed
+        # identical blocks (process_data_block encodes both cases).
+        n_blocks, block_id = process_data_block(self.mesh)
         train_loader = BatchLoader(
             data, train_idx, global_batch=global_batch, shuffle=True,
-            seed=cfg.train.seed, num_processes=nproc, process_id=jax.process_index(),
+            seed=cfg.train.seed, num_processes=n_blocks, process_id=block_id,
         )
         val_loader = BatchLoader(
             data, val_idx, global_batch=global_batch, shuffle=False,
-            seed=cfg.train.seed, num_processes=nproc, process_id=jax.process_index(),
+            seed=cfg.train.seed, num_processes=n_blocks, process_id=block_id,
         )
 
         compute_dtype = jnp.bfloat16 if cfg.train.bf16_compute else jnp.float32
@@ -138,25 +148,15 @@ class Trainer:
             seed=cfg.train.seed, example_shape=example_shape,
         )
         # Name-pattern rules: tensor-parallel placement for the transformer
-        # family, full replication for the MLP (no patterns match).
-        if jax.process_count() > 1 and (
-            self.mesh.shape["model"] > 1 or self.mesh.shape["seq"] > 1
-        ):
-            # The checkpoint path device_gets params, which requires them
-            # fully addressable per host — true for replicated (DP) params
-            # and for TP/SP within one host, not for TP/SP spanning hosts.
-            raise NotImplementedError(
-                "model/seq mesh axes spanning multiple processes are not "
-                "yet supported by the checkpoint path; keep tensor/sequence "
-                "parallelism within a host and scale across hosts with the "
-                "data axis"
-            )
+        # family, full replication for the MLP (no patterns match). TP/SP
+        # axes may span processes: the checkpoint tier assembles such
+        # params with a cross-process allgather (checkpoint.manager.to_host),
+        # called on EVERY rank before the coordinator-gated write.
         state = shard_state_with_rules(state, self.mesh)
 
-        # Per-process state dir: every process saves (params are host-
-        # addressable: replicated across hosts, TP-sharded only within one)
-        # — resume must not depend on which host a process lands on having
-        # the coordinator's disk.
+        # Per-process state dir: every process saves its own resume state
+        # (host-local disk) — resume must not depend on which host a
+        # process lands on having the coordinator's disk.
         state_ckptr = TrainStateCheckpointer(
             os.path.join(
                 cfg.data.models_dir, "train_state", f"p{jax.process_index()}"
@@ -164,7 +164,10 @@ class Trainer:
         )
         start_epoch = 0
         if cfg.train.resume and state_ckptr.exists():
-            state = state_ckptr.restore(state)
+            # Restore yields host arrays; re-apply the mesh placement.
+            state = shard_state_with_rules(
+                state_ckptr.restore(state), self.mesh
+            )
             steps_per_epoch = max(train_loader.num_batches, 1)
             start_epoch = int(jax.device_get(state.step)) // steps_per_epoch
         if cfg.train.resume and jax.process_count() > 1:
@@ -184,6 +187,7 @@ class Trainer:
                 )
 
         ckptr = BestLastCheckpointer(cfg.data.models_dir)
+        params_cross_process = needs_cross_process_gather(state.params)
 
         if start_epoch >= cfg.train.epochs:
             # Nothing to train (e.g. resume after a completed run). Do NOT
@@ -312,11 +316,17 @@ class Trainer:
                     step=global_step,
                 )
                 profiler.maybe_stop(epoch)
+                # Host-gather BEFORE the coordinator gate: with TP/SP
+                # spanning processes this is a collective every rank must
+                # join; in the common fully-addressable case only the
+                # coordinator pays the device-to-host copy.
+                if params_cross_process or self.coordinator:
+                    host_params = to_host(state.params)
                 if self.coordinator:
                     ckptr.update(
                         epoch=epoch,
                         metrics={"val_loss": val_loss, "val_acc": val_acc},
-                        params=state.params,
+                        params=host_params,
                         meta=meta,
                     )
                 # Every process keeps its own resume state (host-local disk).
