@@ -145,10 +145,15 @@ class TrainStateCheckpointer:
     def _dir(self, name: str) -> str:
         return os.path.join(self.dirpath, name)
 
+    def _rotation_dirs(self) -> tuple[str, ...]:
+        return (
+            self._dir(self._LIVE), self._dir(self._NEXT), self._dir(self._OLD)
+        )
+
     def _restore_candidates(self) -> list[str]:
         return [
             d
-            for d in (self._dir(self._LIVE), self._dir(self._NEXT), self._dir(self._OLD))
+            for d in self._rotation_dirs()
             if os.path.exists(os.path.join(d, "state.npz"))
         ]
 
@@ -176,7 +181,10 @@ class TrainStateCheckpointer:
         hosts) are saved as this process's local shards only — RAM and
         disk stay proportional to the local share, with no allgather, at
         exactly the scale cross-host sharding exists for. Each leaf i is
-        stored as key ``"i"`` (whole) or keys ``"i_s0..i_sK"`` (shards).
+        stored as key ``"i"`` (whole) or keys ``"i_s<off0>x<off1>..."``
+        (shards, named by their GLOBAL start offsets so restore matches by
+        position, not ordinal — a changed process->device mapping is then
+        a detected error instead of a silent global permutation).
 
         Storage is a plain ``state.npz`` per process — deliberately NOT an
         orbax pytree directory: orbax's save finalization (structure
@@ -198,8 +206,9 @@ class TrainStateCheckpointer:
                 by_key = {}
                 for s in leaf.addressable_shards:
                     by_key.setdefault(self._index_key(s.index), s)
-                for j, k in enumerate(sorted(by_key)):
-                    entries[f"{i}_s{j}"] = np.asarray(by_key[k].data)
+                for k, s in by_key.items():
+                    off = "x".join(map(str, k))
+                    entries[f"{i}_s{off}"] = np.asarray(s.data)
             else:
                 entries[str(i)] = np.asarray(jax.device_get(leaf))
         import shutil
@@ -227,23 +236,27 @@ class TrainStateCheckpointer:
         return live
 
     def exists(self) -> bool:
-        return bool(self._restore_candidates())
+        # Any rotation dir counts: a dir in an unreadable (legacy) format
+        # must route resume into restore()'s loud error, not a silent
+        # from-scratch restart that overwrites the old progress.
+        return any(os.path.isdir(d) for d in self._rotation_dirs())
 
-    def _reassemble(self, template, parts: list[np.ndarray]):
-        """Local shards -> global jax.Array with the template's sharding.
-        Requires the same mesh/process topology that saved the state."""
+    def _reassemble(self, template, part_by_key: dict):
+        """Offset-keyed local shards -> global jax.Array with the
+        template's sharding. Shards are matched by their stored global
+        offsets, so a topology whose local shard positions differ from the
+        saving run fails loudly instead of permuting data."""
         sharding = template.sharding
         gshape = template.shape
         dev_idx = sharding.addressable_devices_indices_map(gshape)
-        keys = sorted({self._index_key(ix) for ix in dev_idx.values()})
-        if len(keys) != len(parts):
+        want = {self._index_key(ix) for ix in dev_idx.values()}
+        if want != set(part_by_key):
             raise ValueError(
-                f"Shard-saved leaf has {len(parts)} local parts but the "
-                f"current topology expects {len(keys)} distinct local "
-                "shards; resume requires the same mesh/process topology "
-                "that saved the state"
+                f"Shard-saved leaf holds offsets {sorted(part_by_key)} but "
+                f"the current topology needs {sorted(want)}; resume "
+                "requires the same mesh/process topology that saved the "
+                "state"
             )
-        part_by_key = dict(zip(keys, parts))
         arrays = [
             jax.device_put(part_by_key[self._index_key(ix)], d)
             for d, ix in dev_idx.items()
@@ -259,6 +272,14 @@ class TrainStateCheckpointer:
         under the template leaf's sharding."""
         candidates = self._restore_candidates()
         if not candidates:
+            legacy = [d for d in self._rotation_dirs() if os.path.isdir(d)]
+            if legacy:
+                raise RuntimeError(
+                    f"Checkpoint dir(s) {legacy} exist but contain no "
+                    "state.npz — an unreadable (pre-npz/orbax) format. "
+                    "Delete them to restart from scratch, or restore with "
+                    "the version that wrote them."
+                )
             raise FileNotFoundError(f"No train-state checkpoint under {self.dirpath}")
         npz = np.load(os.path.join(candidates[0], "state.npz"))
         restored = {k: npz[k] for k in npz.files}
@@ -270,12 +291,18 @@ class TrainStateCheckpointer:
             if str(i) in restored:
                 leaves.append(restored[str(i)])
                 continue
-            parts = []
-            while f"{i}_s{len(parts)}" in restored:
-                parts.append(restored[f"{i}_s{len(parts)}"])
-            if not parts:
+            prefix = f"{i}_s"
+            part_by_key = {
+                # 0-d leaves have an empty offset suffix -> key ().
+                tuple(
+                    int(o) for o in k[len(prefix):].split("x")
+                ) if k[len(prefix):] else (): v
+                for k, v in restored.items()
+                if k.startswith(prefix)
+            }
+            if not part_by_key:
                 raise KeyError(f"Checkpoint {candidates[0]} missing leaf {i}")
-            leaves.append(self._reassemble(t, parts))
+            leaves.append(self._reassemble(t, part_by_key))
         tree = jax.tree.unflatten(treedef, leaves)
         return state.replace(
             step=jax.numpy.asarray(tree["step"]),

@@ -81,6 +81,11 @@ class ModelConfig:
     n_heads: int = 4
     n_layers: int = 2
     d_ff: int = 256
+    # MoE-family fields (weather_moe): expert count, switch-routing
+    # capacity factor, load-balance loss weight.
+    n_experts: int = 4
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
 
     @classmethod
     def from_env(cls) -> "ModelConfig":
@@ -94,6 +99,11 @@ class ModelConfig:
         c.n_heads = _env("DCT_N_HEADS", c.n_heads, int)
         c.n_layers = _env("DCT_N_LAYERS", c.n_layers, int)
         c.d_ff = _env("DCT_D_FF", c.d_ff, int)
+        c.n_experts = _env("DCT_N_EXPERTS", c.n_experts, int)
+        c.capacity_factor = _env("DCT_CAPACITY_FACTOR", c.capacity_factor, float)
+        c.router_aux_weight = _env(
+            "DCT_ROUTER_AUX_WEIGHT", c.router_aux_weight, float
+        )
         return c
 
 

@@ -1,0 +1,195 @@
+"""Mixture-of-Experts family: switch-routed FFN with expert parallelism.
+
+The reference has exactly one dense MLP and one parallelism axis (2-rank
+DDP, SURVEY §2.3); this family completes the mesh's parallelism matrix —
+experts shard over the ``model`` axis (expert parallelism), composing with
+batch DP and attention TP/SP in the same jitted step.
+
+TPU-first routing: no ragged tensors, no data-dependent shapes. Top-1
+(switch) routing is expressed as dense one-hot dispatch/combine einsums
+with a STATIC per-expert capacity:
+
+    dispatch [N_tokens, E, C]  (one-hot: token -> (expert, slot))
+    expert_in = einsum('nec,nd->ecd', dispatch, tokens)
+    expert_out = per-expert FFN batched over E      <- MXU batched GEMMs
+    out = einsum('nec,ecd->nd', dispatch, expert_out) * gate
+
+Tokens over capacity are dropped (their dispatch row is zero); the block's
+residual connection passes them through unchanged — standard switch
+behavior. Expert weights are [E, D, F] tensors named ``experts_in`` /
+``experts_out``; the sharding rules place them ``P("model", None, None)``,
+so each expert-parallel shard owns E/shards whole experts and XLA inserts
+the token all-to-all implied by the dispatch einsum.
+
+A load-balance auxiliary loss (Switch Transformer's f·P dot) is returned
+via ``self.sow("aux_loss", ...)``; the train step folds every sown
+``aux_loss`` into the objective, weighted by ``router_aux_weight``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dct_tpu.models.mlp import TorchStyleDense, torch_linear_init
+from dct_tpu.models.transformer import MultiHeadAttention, sincos_positions
+
+
+class MoEFFN(nn.Module):
+    """Switch (top-1) mixture of expert FFNs over flattened tokens."""
+
+    d_model: int
+    d_ff: int
+    n_experts: int
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # [B, S, D] -> [B, S, D]
+        b, s, d = x.shape
+        n = b * s
+        e = self.n_experts
+        capacity = max(1, int(self.capacity_factor * n / e))
+        tokens = x.reshape(n, d)
+
+        logits = TorchStyleDense(e, dtype=jnp.float32, name="router")(
+            jnp.asarray(tokens, jnp.float32)
+        )  # [N, E] — routing in f32: tiny matmul, decides everything
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)  # [N]
+        gate = jnp.max(probs, axis=-1)  # [N]
+
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [N, E]
+        # Slot of each token within its expert (arrival order).
+        position = jnp.cumsum(onehot, axis=0) - onehot  # [N, E]
+        keep = (position < capacity).astype(jnp.float32) * onehot
+        slot = jax.nn.one_hot(
+            jnp.sum(position * onehot, axis=-1).astype(jnp.int32),
+            capacity,
+            dtype=jnp.float32,
+        )  # [N, C]
+        dispatch = keep[:, :, None] * slot[:, None, :]  # [N, E, C]
+
+        # Switch load-balance loss: E * sum_e(frac_tokens_e * mean_prob_e),
+        # sown pre-weighted — the train step adds every aux_loss leaf as-is.
+        frac = onehot.mean(axis=0)
+        mean_prob = probs.mean(axis=0)
+        self.sow(
+            "aux_loss",
+            "load_balance",
+            self.aux_weight * e * jnp.sum(frac * mean_prob),
+        )
+
+        w_in = self.param(
+            "experts_in_kernel", torch_linear_init(), (e, d, self.d_ff),
+            jnp.float32,
+        )
+        b_in = self.param(
+            "experts_in_bias",
+            lambda k, sh, dt=jnp.float32: torch_linear_init()(k, sh, dt, fan_in=d),
+            (e, self.d_ff),
+            jnp.float32,
+        )
+        w_out = self.param(
+            "experts_out_kernel", torch_linear_init(), (e, self.d_ff, d),
+            jnp.float32,
+        )
+        b_out = self.param(
+            "experts_out_bias",
+            lambda k, sh, dt=jnp.float32: torch_linear_init()(
+                k, sh, dt, fan_in=self.d_ff
+            ),
+            (e, d),
+            jnp.float32,
+        )
+
+        ct = self.dtype
+        disp = jnp.asarray(dispatch, ct)
+        toks = jnp.asarray(tokens, ct)
+        expert_in = jnp.einsum("nec,nd->ecd", disp, toks)  # [E, C, D]
+        h = jnp.einsum("ecd,edf->ecf", expert_in, jnp.asarray(w_in, ct))
+        h = nn.gelu(h + jnp.asarray(b_in, ct)[:, None, :])
+        out_e = jnp.einsum("ecf,efd->ecd", h, jnp.asarray(w_out, ct))
+        out_e = out_e + jnp.asarray(b_out, ct)[:, None, :]
+        out = jnp.einsum("nec,ecd->nd", disp, out_e)
+        out = out * jnp.asarray(gate, ct)[:, None]
+        return out.reshape(b, s, d)
+
+
+class MoEBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    n_experts: int
+    capacity_factor: float
+    dropout: float
+    attn_fn: object
+    aux_weight: float = 0.01
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
+        h = MultiHeadAttention(
+            self.d_model, self.n_heads, self.attn_fn, dtype=self.dtype,
+            name="attn",
+        )(h)
+        h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_ffn")(x)
+        h = MoEFFN(
+            self.d_model, self.d_ff, self.n_experts, self.capacity_factor,
+            aux_weight=self.aux_weight, dtype=self.dtype, name="moe",
+        )(h)
+        h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class WeatherMoE(nn.Module):
+    """MoE encoder over [B, S, F] windows -> [B, num_classes] rain logits."""
+
+    input_dim: int
+    seq_len: int
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 128
+    n_experts: int = 4
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+    num_classes: int = 2
+    dropout: float = 0.1
+    attn_fn: object = None
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        from dct_tpu.ops.attention import make_attention_fn
+
+        attn_fn = self.attn_fn or make_attention_fn(None)
+        x = jnp.asarray(x, self.compute_dtype)
+        h = TorchStyleDense(self.d_model, dtype=self.compute_dtype, name="in_proj")(x)
+        h = h + jnp.asarray(
+            sincos_positions(self.seq_len, self.d_model), self.compute_dtype
+        )
+        for i in range(self.n_layers):
+            h = MoEBlock(
+                self.d_model,
+                self.n_heads,
+                self.d_ff,
+                self.n_experts,
+                self.capacity_factor,
+                self.dropout,
+                attn_fn,
+                aux_weight=self.router_aux_weight,
+                dtype=self.compute_dtype,
+                name=f"block_{i}",
+            )(h, train=train)
+        h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)
+        pooled = h.mean(axis=1)
+        logits = TorchStyleDense(
+            self.num_classes, dtype=self.compute_dtype, name="head"
+        )(pooled)
+        return jnp.asarray(logits, jnp.float32)

@@ -82,6 +82,31 @@ def _build_gru(
     )
 
 
+@register_model("weather_moe", sequence=True)
+def _build_moe(
+    cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None
+):
+    import jax.numpy as jnp
+
+    from dct_tpu.models.moe import WeatherMoE
+
+    return WeatherMoE(
+        input_dim=input_dim,
+        seq_len=cfg.seq_len,
+        d_model=cfg.d_model,
+        n_heads=cfg.n_heads,
+        n_layers=cfg.n_layers,
+        d_ff=cfg.d_ff,
+        n_experts=cfg.n_experts,
+        capacity_factor=cfg.capacity_factor,
+        router_aux_weight=cfg.router_aux_weight,
+        num_classes=cfg.num_classes,
+        dropout=cfg.dropout,
+        attn_fn=attn_fn,
+        compute_dtype=compute_dtype or jnp.float32,
+    )
+
+
 @register_model("weather_transformer", sequence=True)
 def _build_transformer(
     cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None

@@ -26,10 +26,23 @@ _RULES = (
     ("ffn_out", P("model", None), P()),
 )
 
+# Expert parallelism: MoE expert weights are [E, ...] stacks; sharding the
+# leading expert dim over ``model`` gives each shard whole experts (the
+# dispatch einsum's token exchange compiles to an all-to-all over the same
+# axis). The router stays replicated (no rule matches it).
+_EXPERT_RULES = {
+    "experts_in_kernel": P("model", None, None),
+    "experts_in_bias": P("model", None),
+    "experts_out_kernel": P("model", None, None),
+    "experts_out_bias": P("model", None),
+}
+
 
 def spec_for_path(path) -> P:
     names = [str(getattr(k, "key", k)) for k in path]
     leaf = names[-1] if names else ""
+    if leaf in _EXPERT_RULES:
+        return _EXPERT_RULES[leaf]
     for pattern, kernel_spec, bias_spec in _RULES:
         if any(pattern in n for n in names):
             if leaf == "kernel":

@@ -108,6 +108,25 @@ def gru_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
     return h @ weights["head/kernel"] + weights["head/bias"]
 
 
+def _mha_numpy(weights: dict, prefix: str, h: np.ndarray,
+               n_heads: int) -> np.ndarray:
+    """Dense (non-causal) multi-head attention matching
+    dct_tpu.models.transformer.MultiHeadAttention's fused-qkv layout."""
+    n, s, d_model = h.shape
+    head_dim = d_model // n_heads
+    qkv = h @ weights[f"{prefix}/qkv_proj/kernel"] + weights[
+        f"{prefix}/qkv_proj/bias"
+    ]
+    qkv = qkv.reshape(n, s, n_heads, 3, head_dim)
+    q, k, v = (np.swapaxes(qkv[:, :, :, j], 1, 2) for j in range(3))
+    scores = q @ np.swapaxes(k, -1, -2) / math.sqrt(head_dim)
+    o = softmax_numpy(scores) @ v  # [N, H, S, Dh]
+    o = np.moveaxis(o, 1, 2).reshape(n, s, d_model)
+    return o @ weights[f"{prefix}/o_proj/kernel"] + weights[
+        f"{prefix}/o_proj/bias"
+    ]
+
+
 def transformer_forward_numpy(
     weights: dict, meta: dict, x: np.ndarray
 ) -> np.ndarray:
@@ -116,8 +135,7 @@ def transformer_forward_numpy(
     d_model = int(meta["d_model"])
     n_heads = int(meta["n_heads"])
     n_layers = int(meta["n_layers"])
-    head_dim = d_model // n_heads
-    n, s, _ = x.shape
+    s = x.shape[1]
 
     h = x @ weights["in_proj/kernel"] + weights["in_proj/bias"]
     h = h + _sincos_positions(s, d_model)
@@ -126,24 +144,70 @@ def transformer_forward_numpy(
         a = _layernorm(
             h, weights[f"{pre}/ln_attn/scale"], weights[f"{pre}/ln_attn/bias"]
         )
-        qkv = a @ weights[f"{pre}/attn/qkv_proj/kernel"] + weights[
-            f"{pre}/attn/qkv_proj/bias"
-        ]
-        qkv = qkv.reshape(n, s, n_heads, 3, head_dim)
-        q, k, v = (np.swapaxes(qkv[:, :, :, j], 1, 2) for j in range(3))
-        scores = q @ np.swapaxes(k, -1, -2) / math.sqrt(head_dim)
-        o = softmax_numpy(scores) @ v  # [N, H, S, Dh]
-        o = np.moveaxis(o, 1, 2).reshape(n, s, d_model)
-        o = o @ weights[f"{pre}/attn/o_proj/kernel"] + weights[
-            f"{pre}/attn/o_proj/bias"
-        ]
-        h = h + o
+        h = h + _mha_numpy(weights, f"{pre}/attn", a, n_heads)
         f = _layernorm(
             h, weights[f"{pre}/ln_ffn/scale"], weights[f"{pre}/ln_ffn/bias"]
         )
         f = _gelu_tanh(f @ weights[f"{pre}/ffn_in/kernel"] + weights[f"{pre}/ffn_in/bias"])
         f = f @ weights[f"{pre}/ffn_out/kernel"] + weights[f"{pre}/ffn_out/bias"]
         h = h + f
+    h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
+    pooled = h.mean(axis=1)
+    return pooled @ weights["head/kernel"] + weights["head/bias"]
+
+
+def _moe_ffn_numpy(weights: dict, prefix: str, h: np.ndarray,
+                   capacity_factor: float) -> np.ndarray:
+    """Switch (top-1) MoE inference matching dct_tpu.models.moe.MoEFFN:
+    same routing, capacity, and drop semantics as training."""
+    b, s, d = h.shape
+    n = b * s
+    tokens = h.reshape(n, d)
+    logits = tokens @ weights[f"{prefix}/router/kernel"] + weights[
+        f"{prefix}/router/bias"
+    ]
+    probs = softmax_numpy(logits)
+    e = probs.shape[-1]
+    capacity = max(1, int(capacity_factor * n / e))
+    expert_idx = np.argmax(probs, axis=-1)
+    gate = np.max(probs, axis=-1)
+
+    out = np.zeros_like(tokens)
+    w_in = weights[f"{prefix}/experts_in_kernel"]
+    b_in = weights[f"{prefix}/experts_in_bias"]
+    w_out = weights[f"{prefix}/experts_out_kernel"]
+    b_out = weights[f"{prefix}/experts_out_bias"]
+    for ex in range(e):
+        ids = np.nonzero(expert_idx == ex)[0][:capacity]  # arrival order
+        if ids.size == 0:
+            continue
+        t = tokens[ids]
+        a = _gelu_tanh(t @ w_in[ex] + b_in[ex])
+        out[ids] = (a @ w_out[ex] + b_out[ex]) * gate[ids, None]
+    return out.reshape(b, s, d)
+
+
+def moe_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
+    """MoE encoder inference (same skeleton as the transformer, with the
+    dense FFN replaced by the switch-routed expert mixture)."""
+    d_model = int(meta["d_model"])
+    n_heads = int(meta["n_heads"])
+    n_layers = int(meta["n_layers"])
+    capacity_factor = float(meta.get("capacity_factor", 1.25))
+    s = x.shape[1]
+
+    h = x @ weights["in_proj/kernel"] + weights["in_proj/bias"]
+    h = h + _sincos_positions(s, d_model)
+    for i in range(n_layers):
+        pre = f"block_{i}"
+        a = _layernorm(
+            h, weights[f"{pre}/ln_attn/scale"], weights[f"{pre}/ln_attn/bias"]
+        )
+        h = h + _mha_numpy(weights, f"{pre}/attn", a, n_heads)
+        f = _layernorm(
+            h, weights[f"{pre}/ln_ffn/scale"], weights[f"{pre}/ln_ffn/bias"]
+        )
+        h = h + _moe_ffn_numpy(weights, f"{pre}/moe", f, capacity_factor)
     h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
     pooled = h.mean(axis=1)
     return pooled @ weights["head/kernel"] + weights["head/bias"]
@@ -156,10 +220,12 @@ def forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
         return gru_forward_numpy(weights, meta, x)
     if family == "weather_transformer":
         return transformer_forward_numpy(weights, meta, x)
+    if family == "weather_moe":
+        return moe_forward_numpy(weights, meta, x)
     return mlp_forward_numpy(weights, x)
 
 
-_SEQUENCE_FAMILIES = ("weather_gru", "weather_transformer")
+_SEQUENCE_FAMILIES = ("weather_gru", "weather_transformer", "weather_moe")
 
 
 def score_payload(weights: dict, meta: dict, data) -> dict:

@@ -54,7 +54,7 @@ def export_npz_weights(ckpt_path: str, deploy_dir: str) -> dict:
     p = params["params"]
     family = meta.get("model", "weather_mlp")
 
-    if family in ("weather_gru", "weather_transformer"):
+    if family in ("weather_gru", "weather_transformer", "weather_moe"):
         weights = _flatten_params(p)
     else:
         def layer_index(name: str) -> int:

@@ -52,9 +52,13 @@ def create_train_state(
     root = jax.random.PRNGKey(seed)
     init_key, dropout_key = jax.random.split(root)
     shape = example_shape if example_shape is not None else (1, input_dim)
-    params = model.init(init_key, jnp.zeros(shape, jnp.float32))
-    if isinstance(params, FrozenDict):
-        params = params.unfreeze()
+    variables = model.init(init_key, jnp.zeros(shape, jnp.float32))
+    if isinstance(variables, FrozenDict):
+        variables = variables.unfreeze()
+    # Keep ONLY the trainable collection: models may sow auxiliary outputs
+    # (e.g. MoE load-balance losses) into other collections during init,
+    # which must not enter the optimizer.
+    params = {"params": variables["params"]}
     tx = optax.adam(learning_rate=lr)
     return TrainState(
         step=jnp.zeros((), jnp.int32),

@@ -33,13 +33,23 @@ def _train_body(state: TrainState, x, y, weight):
 
     Computes the global weighted-mean CE (the reference's ``train_loss``,
     jobs/train_lightning_ddp.py:70), its grads, and the Adam update.
+    Models may sow extra objective terms into the ``aux_loss`` collection
+    (e.g. the MoE family's pre-weighted load-balance loss); every sown
+    leaf is added to the objective. For models that sow nothing the
+    collection is empty and this is a no-op.
     """
     step_rng = jax.random.fold_in(state.rng, state.step)
 
     def loss_fn(params):
-        logits = state.apply_fn(params, x, train=True, rngs={"dropout": step_rng})
+        logits, updates = state.apply_fn(
+            params, x, train=True, rngs={"dropout": step_rng},
+            mutable=["aux_loss"],
+        )
         loss_sum, count = masked_cross_entropy(logits, y, weight)
-        return loss_sum / jnp.maximum(count, 1.0)
+        loss = loss_sum / jnp.maximum(count, 1.0)
+        for leaf in jax.tree.leaves(updates):
+            loss = loss + leaf
+        return loss
 
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
     return state.apply_gradients(grads), loss
@@ -48,8 +58,11 @@ def _train_body(state: TrainState, x, y, weight):
 def _eval_body(state: TrainState, x, y, weight):
     """One eval step -> (loss_sum, acc_sum, count) running-sum triple
     (the reference's ``val_loss``/``val_acc``,
-    jobs/train_lightning_ddp.py:73-85)."""
-    logits = state.apply_fn(state.params, x, train=False)
+    jobs/train_lightning_ddp.py:73-85). Sown aux losses are training
+    regularizers only; val_loss stays pure CE."""
+    logits, _ = state.apply_fn(
+        state.params, x, train=False, mutable=["aux_loss"]
+    )
     loss_sum, count = masked_cross_entropy(logits, y, weight)
     acc_sum, _ = masked_accuracy(logits, y, weight)
     return loss_sum, acc_sum, count

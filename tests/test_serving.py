@@ -126,9 +126,12 @@ def _seq_ckpt(tmp_path, name, seq_len=10, input_dim=5):
         name=name, seq_len=seq_len, d_model=16, n_heads=2, n_layers=2, d_ff=32
     )
     model = get_model(cfg, input_dim=input_dim)
-    params = model.init(
+    variables = model.init(
         jax.random.PRNGKey(5), jnp.zeros((1, seq_len, input_dim))
     )
+    # Models may sow aux collections during init; checkpoints carry only
+    # the trainable params (as create_train_state/Trainer do).
+    params = {"params": variables["params"]}
     meta = {
         "model": name,
         "input_dim": input_dim,
@@ -137,6 +140,8 @@ def _seq_ckpt(tmp_path, name, seq_len=10, input_dim=5):
         "n_heads": 2,
         "n_layers": 2,
         "d_ff": 32,
+        "n_experts": 4,
+        "capacity_factor": 1.25,
         "num_classes": 2,
         "dropout": 0.0,
         "feature_names": [f"f{i}_norm" for i in range(input_dim)],
@@ -145,7 +150,7 @@ def _seq_ckpt(tmp_path, name, seq_len=10, input_dim=5):
     return model, params, path, meta
 
 
-@pytest.mark.parametrize("name", ["weather_gru", "weather_transformer"])
+@pytest.mark.parametrize("name", ["weather_gru", "weather_transformer", "weather_moe"])
 def test_sequence_family_numpy_parity(tmp_path, rng, name):
     """Every deployable family's numpy inference must match the JAX model."""
     from dct_tpu.serving.runtime import forward_numpy
@@ -163,7 +168,7 @@ def test_sequence_family_numpy_parity(tmp_path, rng, name):
     np.testing.assert_allclose(np_logits, jax_logits, atol=2e-5)
 
 
-@pytest.mark.parametrize("name", ["weather_gru", "weather_transformer"])
+@pytest.mark.parametrize("name", ["weather_gru", "weather_transformer", "weather_moe"])
 def test_sequence_family_score_py_end_to_end(tmp_path, rng, monkeypatch, name):
     _, _, ckpt, meta = _seq_ckpt(tmp_path, name)
     deploy = str(tmp_path / f"pkg_{name}")
