@@ -158,6 +158,16 @@ class TrainStateCheckpointer:
         ]
 
     @staticmethod
+    def _dir_is_torn(d: str) -> bool:
+        """A rotation dir left by a save preempted before its atomic
+        rename: empty, or containing only *.tmp debris."""
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return True
+        return all(n.endswith(".tmp") for n in names)
+
+    @staticmethod
     def _tree(state) -> dict:
         return {
             "step": state.step,
@@ -236,10 +246,17 @@ class TrainStateCheckpointer:
         return live
 
     def exists(self) -> bool:
-        # Any rotation dir counts: a dir in an unreadable (legacy) format
-        # must route resume into restore()'s loud error, not a silent
-        # from-scratch restart that overwrites the old progress.
-        return any(os.path.isdir(d) for d in self._rotation_dirs())
+        # A readable checkpoint, or a dir in an unreadable (legacy) format
+        # — the latter must route resume into restore()'s loud error, not
+        # a silent from-scratch restart that overwrites the old progress.
+        # Torn-save debris (only *.tmp content) does NOT count: the save
+        # protocol itself creates those and a fresh start is correct.
+        if self._restore_candidates():
+            return True
+        return any(
+            os.path.isdir(d) and not self._dir_is_torn(d)
+            for d in self._rotation_dirs()
+        )
 
     def _reassemble(self, template, part_by_key: dict):
         """Offset-keyed local shards -> global jax.Array with the
@@ -272,7 +289,11 @@ class TrainStateCheckpointer:
         under the template leaf's sharding."""
         candidates = self._restore_candidates()
         if not candidates:
-            legacy = [d for d in self._rotation_dirs() if os.path.isdir(d)]
+            legacy = [
+                d
+                for d in self._rotation_dirs()
+                if os.path.isdir(d) and not self._dir_is_torn(d)
+            ]
             if legacy:
                 raise RuntimeError(
                     f"Checkpoint dir(s) {legacy} exist but contain no "

@@ -132,6 +132,10 @@ class TrainConfig:
     # Numerically identical to the eager per-step loop; disable only for
     # datasets too large to stage an epoch in HBM.
     use_scan: bool = True
+    # Weight-update (ZeRO-1 style) sharding: split Adam moments' leading
+    # dim over the data axis; XLA reduce-scatters grads into the shards
+    # and all-gathers updates. Memory win at scale; off for parity.
+    shard_opt_state: bool = False
 
     @classmethod
     def from_env(cls) -> "TrainConfig":
@@ -144,6 +148,7 @@ class TrainConfig:
         c.resume = _env("DCT_RESUME", c.resume, bool)
         c.bf16_compute = _env("DCT_BF16_COMPUTE", c.bf16_compute, bool)
         c.use_scan = _env("DCT_USE_SCAN", c.use_scan, bool)
+        c.shard_opt_state = _env("DCT_SHARD_OPT_STATE", c.shard_opt_state, bool)
         return c
 
 

@@ -83,7 +83,9 @@ class MoEFFN(nn.Module):
         )
 
         w_in = self.param(
-            "experts_in_kernel", torch_linear_init(), (e, d, self.d_ff),
+            "experts_in_kernel",
+            lambda k, sh, dt=jnp.float32: torch_linear_init()(k, sh, dt, fan_in=d),
+            (e, d, self.d_ff),
             jnp.float32,
         )
         b_in = self.param(
@@ -93,7 +95,11 @@ class MoEFFN(nn.Module):
             jnp.float32,
         )
         w_out = self.param(
-            "experts_out_kernel", torch_linear_init(), (e, self.d_ff, d),
+            "experts_out_kernel",
+            lambda k, sh, dt=jnp.float32: torch_linear_init()(
+                k, sh, dt, fan_in=self.d_ff
+            ),
+            (e, self.d_ff, d),
             jnp.float32,
         )
         b_out = self.param(

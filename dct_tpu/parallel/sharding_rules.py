@@ -52,21 +52,51 @@ def spec_for_path(path) -> P:
     return P()
 
 
-def state_shardings(state, mesh: Mesh):
+def _opt_shard_spec(leaf, mesh: Mesh) -> P | None:
+    """Weight-update (ZeRO-1 style) sharding for an optimizer-state leaf:
+    split the leading dim over ``data`` when it divides evenly. XLA then
+    reduce-scatters gradients into the sharded Adam moments and
+    all-gathers the updates back onto the replicated params — the
+    cross-replica weight-update sharding recipe, expressed purely as a
+    layout annotation."""
+    shape = getattr(leaf, "shape", ())
+    data = mesh.shape["data"]
+    if data > 1 and len(shape) >= 1 and shape[0] % data == 0 and shape[0] >= data:
+        return P("data", *([None] * (len(shape) - 1)))
+    return None
+
+
+def state_shardings(state, mesh: Mesh, *, shard_opt: bool = False):
     """NamedSharding tree for a TrainState under the name-pattern rules.
     Scalars/rngs/unmatched params replicate; matched params (and their
-    mirrored Adam moments) shard over ``model``."""
+    mirrored Adam moments) shard over ``model``. With ``shard_opt``,
+    otherwise-replicated optimizer-state leaves additionally shard their
+    leading dim over ``data`` (see :func:`_opt_shard_spec`)."""
 
     def one(path, leaf):
         if getattr(leaf, "ndim", 0) == 0:
             return NamedSharding(mesh, P())
-        return NamedSharding(mesh, spec_for_path(path))
+        spec = spec_for_path(path)
+        if (
+            shard_opt
+            and spec == P()
+            and any(
+                str(getattr(k, "key", getattr(k, "name", k))) == "opt_state"
+                for k in path
+            )
+        ):
+            opt_spec = _opt_shard_spec(leaf, mesh)
+            if opt_spec is not None:
+                spec = opt_spec
+        return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(one, state)
 
 
-def shard_state_with_rules(state, mesh: Mesh):
+def shard_state_with_rules(state, mesh: Mesh, *, shard_opt: bool = False):
     """Place a TrainState: tensor-parallel where rules match, replicated
     elsewhere (the pure-DP MLP matches nothing and fully replicates,
-    keeping :func:`dct_tpu.parallel.mesh.shard_state` semantics)."""
-    return jax.device_put(state, state_shardings(state, mesh))
+    keeping :func:`dct_tpu.parallel.mesh.shard_state` semantics).
+    ``shard_opt`` opts optimizer state into data-axis weight-update
+    sharding."""
+    return jax.device_put(state, state_shardings(state, mesh, shard_opt=shard_opt))

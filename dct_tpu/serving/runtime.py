@@ -127,11 +127,11 @@ def _mha_numpy(weights: dict, prefix: str, h: np.ndarray,
     ]
 
 
-def transformer_forward_numpy(
-    weights: dict, meta: dict, x: np.ndarray
-) -> np.ndarray:
-    """Pre-LN encoder inference with dense (non-causal) attention; weights
-    carry flax paths (``block_<i>/attn/qkv_proj/kernel`` etc.)."""
+def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn) -> np.ndarray:
+    """Shared pre-LN encoder skeleton (in_proj + positions, per-block
+    attention and FFN residuals, final LN + mean-pool + head). ``ffn`` is
+    ``(weights, block_prefix, h) -> h_ffn`` — the only point where the
+    transformer and MoE families differ."""
     d_model = int(meta["d_model"])
     n_heads = int(meta["n_heads"])
     n_layers = int(meta["n_layers"])
@@ -148,12 +148,23 @@ def transformer_forward_numpy(
         f = _layernorm(
             h, weights[f"{pre}/ln_ffn/scale"], weights[f"{pre}/ln_ffn/bias"]
         )
-        f = _gelu_tanh(f @ weights[f"{pre}/ffn_in/kernel"] + weights[f"{pre}/ffn_in/bias"])
-        f = f @ weights[f"{pre}/ffn_out/kernel"] + weights[f"{pre}/ffn_out/bias"]
-        h = h + f
+        h = h + ffn(weights, pre, f)
     h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
     pooled = h.mean(axis=1)
     return pooled @ weights["head/kernel"] + weights["head/bias"]
+
+
+def transformer_forward_numpy(
+    weights: dict, meta: dict, x: np.ndarray
+) -> np.ndarray:
+    """Pre-LN encoder inference with dense (non-causal) attention; weights
+    carry flax paths (``block_<i>/attn/qkv_proj/kernel`` etc.)."""
+
+    def dense_ffn(w, pre, f):
+        f = _gelu_tanh(f @ w[f"{pre}/ffn_in/kernel"] + w[f"{pre}/ffn_in/bias"])
+        return f @ w[f"{pre}/ffn_out/kernel"] + w[f"{pre}/ffn_out/bias"]
+
+    return _encoder_numpy(weights, meta, x, dense_ffn)
 
 
 def _moe_ffn_numpy(weights: dict, prefix: str, h: np.ndarray,
@@ -190,27 +201,12 @@ def _moe_ffn_numpy(weights: dict, prefix: str, h: np.ndarray,
 def moe_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
     """MoE encoder inference (same skeleton as the transformer, with the
     dense FFN replaced by the switch-routed expert mixture)."""
-    d_model = int(meta["d_model"])
-    n_heads = int(meta["n_heads"])
-    n_layers = int(meta["n_layers"])
     capacity_factor = float(meta.get("capacity_factor", 1.25))
-    s = x.shape[1]
 
-    h = x @ weights["in_proj/kernel"] + weights["in_proj/bias"]
-    h = h + _sincos_positions(s, d_model)
-    for i in range(n_layers):
-        pre = f"block_{i}"
-        a = _layernorm(
-            h, weights[f"{pre}/ln_attn/scale"], weights[f"{pre}/ln_attn/bias"]
-        )
-        h = h + _mha_numpy(weights, f"{pre}/attn", a, n_heads)
-        f = _layernorm(
-            h, weights[f"{pre}/ln_ffn/scale"], weights[f"{pre}/ln_ffn/bias"]
-        )
-        h = h + _moe_ffn_numpy(weights, f"{pre}/moe", f, capacity_factor)
-    h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
-    pooled = h.mean(axis=1)
-    return pooled @ weights["head/kernel"] + weights["head/bias"]
+    def moe_ffn(w, pre, f):
+        return _moe_ffn_numpy(w, f"{pre}/moe", f, capacity_factor)
+
+    return _encoder_numpy(weights, meta, x, moe_ffn)
 
 
 def forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:

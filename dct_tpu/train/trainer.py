@@ -152,7 +152,9 @@ class Trainer:
         # axes may span processes: the checkpoint tier assembles such
         # params with a cross-process allgather (checkpoint.manager.to_host),
         # called on EVERY rank before the coordinator-gated write.
-        state = shard_state_with_rules(state, self.mesh)
+        state = shard_state_with_rules(
+            state, self.mesh, shard_opt=cfg.train.shard_opt_state
+        )
 
         # Per-process state dir: every process saves its own resume state
         # (host-local disk) — resume must not depend on which host a
@@ -166,7 +168,8 @@ class Trainer:
         if cfg.train.resume and state_ckptr.exists():
             # Restore yields host arrays; re-apply the mesh placement.
             state = shard_state_with_rules(
-                state_ckptr.restore(state), self.mesh
+                state_ckptr.restore(state), self.mesh,
+                shard_opt=cfg.train.shard_opt_state,
             )
             steps_per_epoch = max(train_loader.num_batches, 1)
             start_epoch = int(jax.device_get(state.step)) // steps_per_epoch
