@@ -164,7 +164,9 @@ class TrainStateCheckpointer:
         try:
             names = os.listdir(d)
         except OSError:
-            return True
+            # Unreadable is NOT torn: route into restore()'s loud error
+            # rather than silently restarting over existing progress.
+            return False
         return all(n.endswith(".tmp") for n in names)
 
     @staticmethod

@@ -166,6 +166,7 @@ class MeshConfig:
     data: int = -1
     model: int = 1
     seq: int = 1
+    pipe: int = 1
 
     @classmethod
     def from_env(cls) -> "MeshConfig":
@@ -173,6 +174,7 @@ class MeshConfig:
         c.data = _env("DCT_MESH_DATA", c.data, int)
         c.model = _env("DCT_MESH_MODEL", c.model, int)
         c.seq = _env("DCT_MESH_SEQ", c.seq, int)
+        c.pipe = _env("DCT_MESH_PIPE", c.pipe, int)
         return c
 
 

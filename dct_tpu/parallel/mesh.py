@@ -24,7 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dct_tpu.config import MeshConfig
 
-AXES = ("data", "model", "seq")
+AXES = ("data", "model", "seq", "pipe")
 
 
 def make_mesh(
@@ -40,7 +40,10 @@ def make_mesh(
     cfg = cfg or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    sizes = {"data": cfg.data, "model": cfg.model, "seq": cfg.seq}
+    sizes = {
+        "data": cfg.data, "model": cfg.model, "seq": cfg.seq,
+        "pipe": cfg.pipe,
+    }
     fixed = math.prod(s for s in sizes.values() if s != -1)
     free = [a for a, s in sizes.items() if s == -1]
     if len(free) > 1:

@@ -1,0 +1,136 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over ``pipe``.
+
+The reference has no pipeline parallelism (SURVEY §2.3 lists PP as an
+extension point); this module supplies it TPU-natively, completing the
+mesh's DP x TP x SP x EP x PP matrix:
+
+- stages are a STACKED pytree (leading dim = stage) sharded
+  ``P("pipe", ...)`` — each pipeline device holds one stage's params;
+- the batch is split into microbatches that stream through the stages
+  inside one ``shard_map``: every tick, each stage applies its params to
+  its current activation and ``lax.ppermute``s the result to the next
+  stage (a neighbor hop over ICI), while stage 0 ingests the next
+  microbatch and the last stage banks its finished one;
+- the schedule is the classic GPipe fill/drain: ``M + P - 1`` ticks for
+  ``M`` microbatches over ``P`` stages, bubble fraction ``(P-1)/(M+P-1)``;
+- the BACKWARD schedule is not hand-written: ``jax.grad`` through the
+  scan+ppermute forward yields the reverse pipeline automatically
+  (ppermute transposes to the reverse permutation), so the same jitted
+  train step machinery works unchanged.
+
+Stages must share one param structure (e.g. equal groups of identical
+blocks) — that is what makes the stacked-pytree layout expressible as a
+single sharded array per leaf.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(stage_params: list):
+    """[per-stage pytrees with identical structure] -> stacked pytree
+    (leading dim = n_stages), ready to shard ``P('pipe', ...)``."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params)
+
+
+def stage_params_sharding(stacked, mesh: Mesh, axis: str = "pipe"):
+    """NamedSharding tree placing the stage dim on the ``pipe`` axis."""
+    def one(leaf):
+        spec = P(axis, *([None] * (leaf.ndim - 1)))
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(one, stacked)
+
+
+def _pipeline_body(params, xs, *, stage_fn, axis: str, n_stages: int):
+    """Runs inside shard_map: params [1, ...] local stage slice; xs
+    [M, mb, ...] microbatches (replicated). Returns [M, mb, ...] outputs
+    (replicated via a final psum broadcast from the last stage)."""
+    stage = lax.axis_index(axis)
+    local = jax.tree.map(lambda a: a[0], params)
+    m = xs.shape[0]
+    ticks = m + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    # The carry becomes device-varying over the pipe axis from the first
+    # tick (stage-dependent compute); type the initial carry that way so
+    # the scan carry type is fixed (same recipe as ring attention).
+    act0 = lax.pcast(jnp.zeros_like(xs[0]), (axis,), to="varying")
+    ys0 = lax.pcast(jnp.zeros_like(xs), (axis,), to="varying")
+
+    def tick(carry, t):
+        act, ys = carry
+        # Stage 0 ingests microbatch t (index clamps past the end during
+        # the drain ticks; the result is never banked then).
+        mb = lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
+        )
+        inp = jnp.where(stage == 0, mb, act)
+        out = stage_fn(local, inp)
+        # The last stage finished microbatch t-(P-1) this tick.
+        done_idx = t - (n_stages - 1)
+        banked = lax.dynamic_update_index_in_dim(
+            ys, out, jnp.clip(done_idx, 0, m - 1), axis=0
+        )
+        take = jnp.logical_and(stage == n_stages - 1, done_idx >= 0)
+        ys = jnp.where(take, banked, ys)
+        # Rotate activations one stage forward (ICI neighbor hop).
+        act = lax.ppermute(out, axis, perm)
+        return (act, ys), None
+
+    (_, ys), _ = lax.scan(tick, (act0, ys0), jnp.arange(ticks))
+    # Replicate the last stage's banked outputs to every pipe device.
+    ys = lax.psum(jnp.where(stage == n_stages - 1, ys, jnp.zeros_like(ys)), axis)
+    return ys
+
+
+def pipeline_apply(
+    stage_fn,
+    stacked_params,
+    x,
+    *,
+    mesh: Mesh,
+    axis: str = "pipe",
+    n_microbatches: int | None = None,
+):
+    """Apply ``n_stages`` chained stages to ``x`` [B, ...] with GPipe
+    microbatch streaming over ``mesh[axis]``.
+
+    ``stage_fn(params_one_stage, activation) -> activation`` must preserve
+    the activation shape (stages are homogeneous). ``n_microbatches``
+    defaults to the pipeline depth (bubble fraction ~1/2; raise it to
+    amortize the bubble). Differentiable: jax.grad produces the reverse
+    pipeline schedule.
+    """
+    n_stages = mesh.shape[axis]
+    first = jax.tree.leaves(stacked_params)[0]
+    if first.shape[0] != n_stages:
+        raise ValueError(
+            f"stacked params have {first.shape[0]} stages but mesh axis "
+            f"'{axis}' has {n_stages} devices"
+        )
+    b = x.shape[0]
+    m = n_microbatches or n_stages
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by n_microbatches {m}")
+    xs = x.reshape(m, b // m, *x.shape[1:])
+
+    body = functools.partial(
+        _pipeline_body, stage_fn=stage_fn, axis=axis, n_stages=n_stages
+    )
+    param_specs = jax.tree.map(
+        lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params
+    )
+    ys = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+    )(stacked_params, xs)
+    return ys.reshape(b, *x.shape[1:])

@@ -27,7 +27,7 @@ from dct_tpu.train.steps import make_train_step
 
 def test_mesh_axes_and_sizes():
     mesh = make_mesh(MeshConfig())
-    assert mesh.axis_names == ("data", "model", "seq")
+    assert mesh.axis_names == ("data", "model", "seq", "pipe")
     assert mesh.shape["data"] == 8
     assert mesh.shape["model"] == 1
 
