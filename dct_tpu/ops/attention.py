@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -75,13 +76,10 @@ def dense_attention(q, k, v, *, causal: bool = False, scale: float | None = None
     )
 
 
-def blockwise_attention(
-    q, k, v, *, block_size: int = 512, causal: bool = False,
-    scale: float | None = None,
-):
-    """O(T)-memory attention on one device: scan KV in blocks of
-    ``block_size`` through the shared online-softmax kernel. q,k,v
-    [B, H, T, D]; T must be a multiple of block_size (pad upstream)."""
+def _blockwise_stats(q, k, v, *, block_size: int, causal: bool,
+                     scale: float | None):
+    """Shared blockwise scan returning the raw online-softmax state
+    (m, l, o) — finalized by the callers into output (and optionally lse)."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     t = k.shape[-2]
     if t % block_size:
@@ -109,7 +107,137 @@ def blockwise_attention(
     l0 = jnp.zeros(q.shape[:-1], jnp.float32)
     o0 = jnp.zeros(q.shape, jnp.float32)
     (m, l, o), _ = lax.scan(body, (m0, l0, o0), (ks, vs, jnp.arange(n_blocks)))
+    return m, l, o
+
+
+def blockwise_attention(
+    q, k, v, *, block_size: int = 512, causal: bool = False,
+    scale: float | None = None,
+):
+    """O(T)-memory attention on one device: scan KV in blocks of
+    ``block_size`` through the shared online-softmax kernel. q,k,v
+    [B, H, T, D]; T must be a multiple of block_size (pad upstream)."""
+    m, l, o = _blockwise_stats(
+        q, k, v, block_size=block_size, causal=causal, scale=scale
+    )
     return _finalize(l, o, q.dtype)
+
+
+def blockwise_attention_lse(
+    q, k, v, *, block_size: int = 512, causal: bool = False,
+    scale: float | None = None,
+):
+    """Blockwise attention returning (o, lse [..., T] f32) — the JAX-level
+    twin of :func:`dct_tpu.ops.pallas_attention.flash_attention_lse`, used
+    as its rematerialized backward."""
+    m, l, o = _blockwise_stats(
+        q, k, v, block_size=block_size, causal=causal, scale=scale
+    )
+    return _finalize(l, o, q.dtype), m + jnp.log(jnp.maximum(l, 1e-20))
+
+
+def flash_interpret_mode() -> bool | None:
+    """Resolve whether the Pallas flash kernel is usable here, and how.
+
+    Returns False (real Mosaic kernel), True (interpret mode), or None
+    (don't use flash). Policy, overridable via ``DCT_FLASH``:
+
+    - ``auto`` (default): Mosaic on the TPU backend; None elsewhere —
+      interpret mode is orders of magnitude slower than XLA's fused
+      blockwise path, so CPU rigs fall back unless they opt in.
+    - ``interpret``: force interpret mode (CPU test rigs).
+    - ``on``/``1``: Mosaic on TPU, interpret elsewhere.
+    - ``off``/``0``: never.
+    """
+    mode = os.environ.get("DCT_FLASH", "auto").strip().lower()
+    on_tpu = jax.default_backend() == "tpu"
+    if mode in ("off", "0", "false", "no"):
+        return None
+    if mode == "interpret":
+        return True
+    if mode in ("on", "1", "true", "yes"):
+        return False if on_tpu else True
+    return False if on_tpu else None
+
+
+def select_attention_path(
+    t: int, *, mesh: Mesh | None = None, block_size: int = 512,
+    flash_block: int = 128, flash_min_len: int = 256,
+) -> str:
+    """The attention-path policy, exposed for tests and the bench:
+    'ring' | 'flash' | 'blockwise' | 'dense'. ``t`` is the (single-shard)
+    sequence length."""
+    if mesh is not None and mesh.shape.get("seq", 1) > 1:
+        return "ring"
+    if (
+        flash_interpret_mode() is not None
+        and t >= flash_min_len
+        and t % flash_block == 0
+    ):
+        return "flash"
+    if t > block_size and t % block_size == 0:
+        return "blockwise"
+    return "dense"
+
+
+def _merge_lse(o, lse, o_j, lse_j):
+    """Fold a finalized (o_j, lse_j) attention block into the running
+    (o, lse) pair: softmax-weighted combine — the online-softmax update
+    factored across already-normalized results."""
+    lse_new = jnp.logaddexp(lse, lse_j)
+    w = jnp.exp(lse - lse_new)[..., None]
+    w_j = jnp.exp(lse_j - lse_new)[..., None]
+    return o * w + o_j.astype(jnp.float32) * w_j, lse_new
+
+
+def _ring_body_flash(q, k, v, *, axis_name: str, ring_size: int,
+                     causal: bool, scale: float | None, interpret: bool,
+                     block_q: int = 128, block_k: int = 128):
+    """Ring attention whose per-shard block compute is the Pallas flash
+    kernel. Runs inside shard_map on LOCAL shards [B, h_local, T_local, D].
+
+    Causal structure over ring steps (my = this device's seq index,
+    src = origin of the current KV shard = (my - step) mod ring):
+    step 0 is always the diagonal shard (standard causal mask, offsets
+    cancel); for step >= 1 the shard is either fully visible (src < my,
+    i.e. my >= step) or fully masked — so only two STATIC kernel variants
+    are needed, selected by a traced ``lax.cond``. Fully-masked steps
+    contribute (o=0, lse=-inf) and vanish in the merge.
+    """
+    from dct_tpu.ops.pallas_attention import flash_attention_lse
+
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    my = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
+
+    def call(q_, k_, v_, causal_):
+        return flash_attention_lse(
+            q_, k_, v_, block_q, block_k, causal_, scale, interpret
+        )
+
+    k_cur, v_cur = k, v
+    o = None
+    for step in range(ring_size):  # static unroll: ring_size is mesh shape
+        if step == 0:
+            o_j, lse_j = call(q, k_cur, v_cur, causal)
+            o, lse = o_j.astype(jnp.float32), lse_j
+        else:
+            if causal:
+                o_j, lse_j = lax.cond(
+                    my >= step,
+                    lambda kc=k_cur, vc=v_cur: call(q, kc, vc, False),
+                    lambda: (
+                        jnp.zeros(q.shape, q.dtype),
+                        jnp.full(q.shape[:-1], _NEG, jnp.float32),
+                    ),
+                )
+            else:
+                o_j, lse_j = call(q, k_cur, v_cur, False)
+            o, lse = _merge_lse(o, lse, o_j, lse_j)
+        if step < ring_size - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    return o.astype(q.dtype)
 
 
 def _ring_body(q, k, v, *, axis_name: str, ring_size: int, causal: bool,
@@ -155,12 +283,19 @@ def _ring_body(q, k, v, *, axis_name: str, ring_size: int, causal: bool,
 def ring_attention(
     q, k, v, *, mesh: Mesh, causal: bool = False, scale: float | None = None,
     seq_axis: str = "seq", data_axis: str = "data", model_axis: str = "model",
+    use_flash: bool | None = None,
 ):
     """Sequence-parallel attention over ``mesh[seq_axis]``.
 
     q,k,v: GLOBAL [B, H, T, D] arrays (jit-sharded); internally shard_mapped
     to [B, H/model, T/seq, D] per device. Batch rides ``data_axis``, heads
     ride ``model_axis`` — so DP x TP x SP compose in one op.
+
+    ``use_flash``: True forces the Pallas flash per-shard block compute,
+    False disables it, None (default) follows the
+    :func:`flash_interpret_mode` policy. Interpret-vs-Mosaic is always
+    resolved from the backend; the JAX-level online-softmax body is the
+    fallback when flash is off or the local shard is not block-aligned.
     """
     ring_size = mesh.shape[seq_axis]
     b, h, t, _ = q.shape
@@ -184,6 +319,33 @@ def ring_attention(
             "batch/heads/seq_len or the mesh"
         )
     spec = P(data_axis, model_axis, seq_axis, None)
+    interpret = flash_interpret_mode()
+    if use_flash is None:
+        flash_on = interpret is not None
+    elif use_flash:
+        # Forced on: interpret everywhere except a real TPU backend.
+        flash_on = True
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+    else:
+        flash_on = False
+    t_local = t // ring_size
+    if flash_on and t_local % 128 == 0 and t_local >= 128:
+        fn = functools.partial(
+            _ring_body_flash,
+            axis_name=seq_axis,
+            ring_size=ring_size,
+            causal=causal,
+            scale=scale,
+            interpret=bool(interpret),
+        )
+        # check_vma=False: pallas interpret mode evaluates the kernel
+        # jaxpr with non-varying internal consts, tripping the vma checker
+        # (jax suggests exactly this workaround); numerics are unaffected.
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
     fn = functools.partial(
         _ring_body,
         axis_name=seq_axis,
@@ -199,14 +361,24 @@ def ring_attention(
 
 def make_attention_fn(mesh: Mesh | None = None, *, causal: bool = False,
                       block_size: int = 512):
-    """Pick the attention path for the mesh: ring when the ``seq`` axis is
-    populated, blockwise for long single-shard sequences, dense otherwise."""
+    """Pick the attention path per :func:`select_attention_path`: ring when
+    the ``seq`` axis is populated (itself flash-per-shard when available),
+    the Pallas flash kernel for long single-shard sequences on TPU,
+    blockwise/dense otherwise."""
     if mesh is not None and mesh.shape.get("seq", 1) > 1:
         return functools.partial(ring_attention, mesh=mesh, causal=causal)
 
     def attn(q, k, v):
         t = q.shape[-2]
-        if t > block_size and t % block_size == 0:
+        path = select_attention_path(t, block_size=block_size)
+        if path == "flash":
+            from dct_tpu.ops.pallas_attention import flash_attention
+
+            return flash_attention(
+                q, k, v, causal=causal,
+                interpret=bool(flash_interpret_mode()),
+            )
+        if path == "blockwise":
             return blockwise_attention(
                 q, k, v, block_size=block_size, causal=causal
             )

@@ -44,8 +44,13 @@ from dct_tpu.ops.attention import _NEG
 _STATS_LANES = 128
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                      block_k: int, n_kv: int, causal: bool, scale: float):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
+                      n_kv: int, causal: bool, scale: float,
+                      with_lse: bool):
+    if with_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        m_ref, l_ref, acc_ref = rest
     qi = pl.program_id(1)
     j = pl.program_id(2)
     bq = q_ref.shape[0]
@@ -103,10 +108,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finalize():
         l = l_ref[:, :1]
         o_ref[...] = (acc_ref[...] / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+        if with_lse:
+            # log-sum-exp per Q row, lane-broadcast ([block_q, LANES] like
+            # the running stats) — callers slice lane 0.
+            lse_ref[...] = jnp.broadcast_to(
+                m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-20)), lse_ref.shape
+            )
 
 
 def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
-               scale: float | None, interpret: bool):
+               scale: float | None, interpret: bool, with_lse: bool = False):
     b, h, t, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     block_q = min(block_q, t)
@@ -122,7 +133,7 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
     vf = v.reshape(b * h, t, d)
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, n_kv=n_kv, causal=causal,
-        scale=scale,
+        scale=scale, with_lse=with_lse,
     )
     if causal:
         # Skipped above-diagonal blocks would otherwise still be DMA'd:
@@ -141,6 +152,29 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
         )
     except (AttributeError, TypeError):  # pragma: no cover - older jax
         compiler_params = None
+    # Under a vma-checked shard_map the outputs must declare the inputs'
+    # device-varying axes explicitly; outside shard_map (and on jax
+    # versions without vma typing) this resolves to no kwarg at all.
+    try:
+        vma = frozenset().union(*(jax.typeof(a).vma for a in (q, k, v)))
+    except AttributeError:  # pragma: no cover - older jax
+        vma = frozenset()
+    vma_kw = {"vma": vma} if vma else {}
+    out_specs = [
+        pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0)),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((b * h, t, d), q.dtype, **vma_kw)]
+    if with_lse:
+        out_specs.append(
+            pl.BlockSpec(
+                (None, block_q, _STATS_LANES), lambda bh, i, j: (bh, i, 0)
+            )
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct(
+                (b * h, t, _STATS_LANES), jnp.float32, **vma_kw
+            )
+        )
     out = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, n_kv),
@@ -149,8 +183,8 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
             pl.BlockSpec((None, block_k, d), kv_index),
             pl.BlockSpec((None, block_k, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=out_shape if with_lse else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # m
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # l
@@ -159,6 +193,9 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
         compiler_params=compiler_params,
         interpret=interpret,
     )(qf, kf, vf)
+    if with_lse:
+        o, lse = out
+        return o.reshape(b, h, t, d), lse[:, :, 0].reshape(b, h, t)
     return out.reshape(b, h, t, d)
 
 
@@ -201,3 +238,45 @@ def _vjp_bwd(block_q, block_k, causal, scale, interpret, res, g):
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_lse(q, k, v, block_q=128, block_k=128, causal=False,
+                        scale=None, interpret=False):
+    """Flash attention that also returns the per-row log-sum-exp:
+    (o [B,H,T,D], lse [B,H,T] f32). The lse makes finalized outputs
+    MERGEABLE — ring attention combines per-KV-shard flash results with
+    softmax weights ``exp(lse_j - logaddexp_j lse_j)``, which is exactly
+    the online-softmax accumulation factored across kernel calls."""
+    return _flash_fwd(
+        q, k, v, block_q=block_q, block_k=block_k, causal=causal,
+        scale=scale, interpret=interpret, with_lse=True,
+    )
+
+
+def _vjp_lse_fwd(q, k, v, block_q, block_k, causal, scale, interpret):
+    out = _flash_fwd(
+        q, k, v, block_q=block_q, block_k=block_k, causal=causal,
+        scale=scale, interpret=interpret, with_lse=True,
+    )
+    return out, (q, k, v)
+
+
+def _vjp_lse_bwd(block_q, block_k, causal, scale, interpret, res, g):
+    # Rematerialize through the numerically-identical JAX-level blockwise
+    # path, which carries the SAME (o, lse) pair — so cotangents w.r.t.
+    # the lse output (the ring merge weights depend on it) flow correctly.
+    from dct_tpu.ops.attention import blockwise_attention_lse
+
+    q, k, v = res
+    block = min(block_k, k.shape[-2])
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention_lse(
+            q_, k_, v_, block_size=block, causal=causal, scale=scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention_lse.defvjp(_vjp_lse_fwd, _vjp_lse_bwd)
