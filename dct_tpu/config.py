@@ -82,10 +82,12 @@ class ModelConfig:
     n_layers: int = 2
     d_ff: int = 256
     # MoE-family fields (weather_moe): expert count, switch-routing
-    # capacity factor, load-balance loss weight.
+    # capacity factor, load-balance loss weight, dispatch engine
+    # ('einsum' | 'sorted' | 'auto' — models/moe.py module docstring).
     n_experts: int = 4
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.01
+    moe_dispatch: str = "auto"
 
     @classmethod
     def from_env(cls) -> "ModelConfig":
@@ -104,6 +106,7 @@ class ModelConfig:
         c.router_aux_weight = _env(
             "DCT_ROUTER_AUX_WEIGHT", c.router_aux_weight, float
         )
+        c.moe_dispatch = _env("DCT_MOE_DISPATCH", c.moe_dispatch, str)
         return c
 
 

@@ -5,21 +5,36 @@ DDP, SURVEY §2.3); this family completes the mesh's parallelism matrix —
 experts shard over the ``model`` axis (expert parallelism), composing with
 batch DP and attention TP/SP in the same jitted step.
 
-TPU-first routing: no ragged tensors, no data-dependent shapes. Top-1
-(switch) routing is expressed as dense one-hot dispatch/combine einsums
-with a STATIC per-expert capacity:
+TPU-first routing: no ragged tensors, no data-dependent shapes. Two
+dispatch engines share one router and one capacity policy:
 
-    dispatch [N_tokens, E, C]  (one-hot: token -> (expert, slot))
-    expert_in = einsum('nec,nd->ecd', dispatch, tokens)
-    expert_out = per-expert FFN batched over E      <- MXU batched GEMMs
-    out = einsum('nec,ecd->nd', dispatch, expert_out) * gate
+- ``einsum`` — dense one-hot dispatch/combine einsums with a STATIC
+  per-expert capacity:
+
+      dispatch [N_tokens, E, C]  (one-hot: token -> (expert, slot))
+      expert_in = einsum('nec,nd->ecd', dispatch, tokens)
+      expert_out = per-expert FFN batched over E      <- MXU batched GEMMs
+      out = einsum('nec,ecd->nd', dispatch, expert_out) * gate
+
+  Exact arrival-order capacity semantics, but the dispatch tensors are
+  O(N·E·C) — it stops scaling once E·C outgrows a few hundred.
+
+- ``sorted`` — segment-based dispatch with the same static shapes and
+  O(N log N + N·D) cost: stable-sort tokens by expert, rank them within
+  their expert (bincount prefix sums), scatter the first ``capacity``
+  of each into a [E, C, D] expert buffer, run the batched GEMMs, gather
+  back and unsort. Under expert parallelism the buffer is exchanged with
+  an EXPLICIT ``lax.all_to_all`` over the ``model`` axis inside a
+  shard_map: each model-rank routes its 1/ep slice of the local tokens
+  (so expert compute is sharded, not replicated), sends per-destination
+  slots, computes its own experts, reverses the exchange, and
+  all-gathers the combined outputs — the canonical MoE a2a pipeline,
+  visible as ``all-to-all`` in the compiled HLO (asserted by tests).
 
 Tokens over capacity are dropped (their dispatch row is zero); the block's
 residual connection passes them through unchanged — standard switch
 behavior. Expert weights are [E, D, F] tensors named ``experts_in`` /
-``experts_out``; the sharding rules place them ``P("model", None, None)``,
-so each expert-parallel shard owns E/shards whole experts and XLA inserts
-the token all-to-all implied by the dispatch einsum.
+``experts_out``; the sharding rules place them ``P("model", None, None)``.
 
 A load-balance auxiliary loss (Switch Transformer's f·P dot) is returned
 via ``self.sow("aux_loss", ...)``; the train step folds every sown
@@ -30,14 +45,81 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
 from flax import linen as nn
 
 from dct_tpu.models.mlp import TorchStyleDense, torch_linear_init
 from dct_tpu.models.transformer import MultiHeadAttention, sincos_positions
 
 
+def _expert_ffn(batch, w_in, b_in, w_out, b_out):
+    """Batched per-expert GEMMs: [..., E, C, D] x [E, D, F] — the MXU hot
+    path shared by both dispatch engines."""
+    h = jnp.einsum("...ecd,edf->...ecf", batch, w_in)
+    h = nn.gelu(h + b_in[:, None, :])
+    out = jnp.einsum("...ecf,efd->...ecd", h, w_out)
+    return out + b_out[:, None, :]
+
+
+def _sorted_moe(tokens, expert_idx, gate, w_in, b_in, w_out, b_out, *,
+                e_total: int, capacity: int, ep_axis: str | None = None):
+    """Segment-based switch dispatch on LOCAL arrays.
+
+    tokens [N, D] (compute dtype), expert_idx [N] int32, gate [N]
+    (compute dtype); expert weights are the LOCAL shard [E_local, ...]
+    (E_local == e_total when not expert-parallel). With ``ep_axis`` the
+    [e_total, C, D] buffer is reshaped [ep, E_local, C, D] and exchanged
+    with ``lax.all_to_all`` so each rank computes only its own experts.
+    """
+    n, d = tokens.shape
+    e_local = w_in.shape[0]
+    ep = e_total // e_local
+
+    order = jnp.argsort(expert_idx)  # stable: preserves arrival order
+    sorted_e = expert_idx[order]
+    counts = jnp.bincount(expert_idx, length=e_total)
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(n) - starts[sorted_e]  # rank within expert
+    keep = pos < capacity
+    # Row e*C+c of the buffer is (expert e, slot c); dropped tokens all
+    # target the sentinel row, which is sliced off before compute.
+    dst = jnp.where(keep, sorted_e * capacity + pos, e_total * capacity)
+    buf = jnp.zeros((e_total * capacity + 1, d), tokens.dtype)
+    buf = buf.at[dst].set(tokens[order])
+    expert_in = buf[:-1].reshape(e_total, capacity, d)
+
+    if ep_axis is not None and ep > 1:
+        z = expert_in.reshape(ep, e_local, capacity, d)
+        # tiled=False all_to_all REMOVES the split axis and INSERTS the
+        # source axis at concat_axis: [dst, le, C, d] -> [src, le, C, d]
+        # (each rank keeps only its own experts' slots, one per source).
+        z = lax.all_to_all(z, ep_axis, split_axis=0, concat_axis=0)
+        out_e = _expert_ffn(z, w_in, b_in, w_out, b_out)
+        # Same exchange returns results to their source rank; the [owner,
+        # le] leading dims then flatten to global-expert order.
+        out_e = lax.all_to_all(out_e, ep_axis, split_axis=0, concat_axis=0)
+        out_e = out_e.reshape(e_total, capacity, d)
+    else:
+        out_e = _expert_ffn(expert_in, w_in, b_in, w_out, b_out)
+
+    out_flat = jnp.concatenate(
+        [out_e.reshape(e_total * capacity, d), jnp.zeros((1, d), out_e.dtype)]
+    )
+    out_sorted = out_flat[dst] * keep[:, None].astype(out_e.dtype)
+    out = out_sorted[jnp.argsort(order)]  # unsort
+    return out * gate[:, None]
+
+
 class MoEFFN(nn.Module):
-    """Switch (top-1) mixture of expert FFNs over flattened tokens."""
+    """Switch (top-1) mixture of expert FFNs over flattened tokens.
+
+    ``dispatch``: 'einsum' | 'sorted' | 'auto' (module docstring); 'auto'
+    picks sorted once the one-hot dispatch tensors would dominate.
+    ``mesh`` routes the sorted engine through its shard_map/all_to_all
+    path when the ``model`` (expert) axis — or any token axis — is
+    populated; without a mesh the engine runs single-shard.
+    """
 
     d_model: int
     d_ff: int
@@ -45,6 +127,8 @@ class MoEFFN(nn.Module):
     capacity_factor: float = 1.25
     aux_weight: float = 0.01
     dtype: jnp.dtype = jnp.float32
+    dispatch: str = "auto"
+    mesh: object = None
 
     @nn.compact
     def __call__(self, x):  # [B, S, D] -> [B, S, D]
@@ -62,15 +146,6 @@ class MoEFFN(nn.Module):
         gate = jnp.max(probs, axis=-1)  # [N]
 
         onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [N, E]
-        # Slot of each token within its expert (arrival order).
-        position = jnp.cumsum(onehot, axis=0) - onehot  # [N, E]
-        keep = (position < capacity).astype(jnp.float32) * onehot
-        slot = jax.nn.one_hot(
-            jnp.sum(position * onehot, axis=-1).astype(jnp.int32),
-            capacity,
-            dtype=jnp.float32,
-        )  # [N, C]
-        dispatch = keep[:, :, None] * slot[:, None, :]  # [N, E, C]
 
         # Switch load-balance loss: E * sum_e(frac_tokens_e * mean_prob_e),
         # sown pre-weighted — the train step adds every aux_loss leaf as-is.
@@ -112,16 +187,116 @@ class MoEFFN(nn.Module):
         )
 
         ct = self.dtype
+        wi, bi = jnp.asarray(w_in, ct), jnp.asarray(b_in, ct)
+        wo, bo = jnp.asarray(w_out, ct), jnp.asarray(b_out, ct)
+
+        engine = self.dispatch
+        if engine == "auto":
+            # One-hot dispatch materializes [N, E, C] twice; past ~2^21
+            # elements the sort-based engine wins on both memory and time.
+            engine = "sorted" if n * e * capacity >= (1 << 21) else "einsum"
+        mesh = self.mesh
+        if engine == "sorted" and mesh is not None:
+            dp = mesh.shape.get("data", 1)
+            sp = mesh.shape.get("seq", 1)
+            ep = mesh.shape.get("model", 1)
+            sharded = dp > 1 or sp > 1 or ep > 1
+            ok = (
+                b % dp == 0 and s % sp == 0 and e % ep == 0
+                and ((b // dp) * (s // sp)) % ep == 0
+            )
+            if sharded and not ok:
+                if b < dp:
+                    # The batch-1 flax init trace cannot tile the data
+                    # axis (same escape as ring_attention's dense path);
+                    # the einsum engine creates identical params.
+                    engine = "einsum"
+                elif self.dispatch == "sorted":
+                    raise ValueError(
+                        f"sorted MoE dispatch cannot tile tokens [B={b}, "
+                        f"S={s}] experts E={e} over mesh data={dp}, "
+                        f"seq={sp}, model={ep}"
+                    )
+                else:
+                    engine = "einsum"  # auto: fall back rather than fail
+            elif sharded:
+                out = self._sorted_sharded(
+                    jnp.asarray(x, ct), expert_idx.reshape(b, s),
+                    jnp.asarray(gate, ct).reshape(b, s),
+                    wi, bi, wo, bo, mesh=mesh, dp=dp, sp=sp, ep=ep,
+                )
+                return out
+
+        if engine == "sorted":
+            out = _sorted_moe(
+                jnp.asarray(tokens, ct), expert_idx.astype(jnp.int32),
+                jnp.asarray(gate, ct), wi, bi, wo, bo,
+                e_total=e, capacity=capacity,
+            )
+            return out.reshape(b, s, d)
+
+        # Slot of each token within its expert (arrival order).
+        position = jnp.cumsum(onehot, axis=0) - onehot  # [N, E]
+        keep = (position < capacity).astype(jnp.float32) * onehot
+        slot = jax.nn.one_hot(
+            jnp.sum(position * onehot, axis=-1).astype(jnp.int32),
+            capacity,
+            dtype=jnp.float32,
+        )  # [N, C]
+        dispatch = keep[:, :, None] * slot[:, None, :]  # [N, E, C]
+
         disp = jnp.asarray(dispatch, ct)
         toks = jnp.asarray(tokens, ct)
         expert_in = jnp.einsum("nec,nd->ecd", disp, toks)  # [E, C, D]
-        h = jnp.einsum("ecd,edf->ecf", expert_in, jnp.asarray(w_in, ct))
-        h = nn.gelu(h + jnp.asarray(b_in, ct)[:, None, :])
-        out_e = jnp.einsum("ecf,efd->ecd", h, jnp.asarray(w_out, ct))
-        out_e = out_e + jnp.asarray(b_out, ct)[:, None, :]
+        h = jnp.einsum("ecd,edf->ecf", expert_in, wi)
+        h = nn.gelu(h + bi[:, None, :])
+        out_e = jnp.einsum("ecf,efd->ecd", h, wo)
+        out_e = out_e + bo[:, None, :]
         out = jnp.einsum("nec,ecd->nd", disp, out_e)
         out = out * jnp.asarray(gate, ct)[:, None]
         return out.reshape(b, s, d)
+
+    def _sorted_sharded(self, x, expert_idx, gate, wi, bi, wo, bo, *,
+                        mesh, dp: int, sp: int, ep: int):
+        """Sorted dispatch under the mesh: shard_map over (data, seq,
+        model). Each model-rank routes its 1/ep slice of the local tokens
+        (expert compute is SHARDED, not replicated), exchanges expert
+        buffers with lax.all_to_all, and all-gathers the combined outputs
+        back to replicated-over-model activations."""
+        b, s, d = x.shape
+        e = self.n_experts
+        n_local = (b // dp) * (s // sp)
+        chunk = n_local // ep
+        cap = max(1, int(self.capacity_factor * chunk / e))
+
+        def body(xb, ei, gt, wi, bi, wo, bo):
+            toks = xb.reshape(-1, d)
+            ei = ei.reshape(-1).astype(jnp.int32)
+            gt = gt.reshape(-1)
+            r = lax.axis_index("model")
+            my = lambda a: lax.dynamic_slice_in_dim(a, r * chunk, chunk, 0)
+            out_my = _sorted_moe(
+                my(toks), my(ei), my(gt), wi, bi, wo, bo,
+                e_total=e, capacity=cap, ep_axis="model",
+            )
+            out = lax.all_gather(out_my, "model", axis=0, tiled=True)
+            return out.reshape(xb.shape)
+
+        # check_vma=False: the closing all_gather makes the output
+        # replicated over ``model``, but the vma type system cannot prove
+        # value-equality after a collective; numerics are pinned against
+        # the single-shard engine by tests.
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                P("data", "seq", None), P("data", "seq"), P("data", "seq"),
+                P("model", None, None), P("model", None),
+                P("model", None, None), P("model", None),
+            ),
+            out_specs=P("data", "seq", None),
+            check_vma=False,
+        )(x, expert_idx, gate, wi, bi, wo, bo)
 
 
 class MoEBlock(nn.Module):
@@ -134,6 +309,8 @@ class MoEBlock(nn.Module):
     attn_fn: object
     aux_weight: float = 0.01
     dtype: jnp.dtype = jnp.float32
+    dispatch: str = "auto"
+    mesh: object = None
 
     @nn.compact
     def __call__(self, x, *, train: bool):
@@ -147,7 +324,8 @@ class MoEBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype, name="ln_ffn")(x)
         h = MoEFFN(
             self.d_model, self.d_ff, self.n_experts, self.capacity_factor,
-            aux_weight=self.aux_weight, dtype=self.dtype, name="moe",
+            aux_weight=self.aux_weight, dtype=self.dtype,
+            dispatch=self.dispatch, mesh=self.mesh, name="moe",
         )(h)
         h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
         return x + h
@@ -169,6 +347,8 @@ class WeatherMoE(nn.Module):
     dropout: float = 0.1
     attn_fn: object = None
     compute_dtype: jnp.dtype = jnp.float32
+    dispatch: str = "auto"
+    mesh: object = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -191,6 +371,8 @@ class WeatherMoE(nn.Module):
                 attn_fn,
                 aux_weight=self.router_aux_weight,
                 dtype=self.compute_dtype,
+                dispatch=self.dispatch,
+                mesh=self.mesh,
                 name=f"block_{i}",
             )(h, train=train)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)

