@@ -1,20 +1,34 @@
 #!/usr/bin/env python3
-"""Benchmark: parity-config training throughput, TPU-native vs reference stack.
+"""Benchmark: parity-config throughput + scaled-config MFU, honest both ways.
 
-Measures samples/sec/chip for the reference's exact training configuration
-(MLP 5->64->2, dropout 0.2, Adam lr 0.01, batch 4 per rank, seed 42 —
-reference jobs/train_lightning_ddp.py:14,57-61,88,122) on:
+Three stories in one JSON line (VERDICT r1 item 1):
 
-- **ours**: the dct_tpu scan-path trainer on the available accelerator
-  (one real TPU chip here);
-- **baseline**: the reference's compute stack — a torch CPU training loop
-  with identical model/optimizer/batch semantics, measured live on this
-  host (the reference publishes no numbers, BASELINE.md; its runtime is
-  2 CPU-container gloo DDP, so single-process torch-CPU is the per-rank
-  baseline).
+1. **Parity config** (the reference's exact training configuration — MLP
+   5->64->2, dropout 0.2, Adam lr 0.01, batch 4 per rank, seed 42;
+   reference jobs/train_lightning_ddp.py:14,57-61,88,122), two numbers:
+   - ``value`` — the fused scan-path number (all timed epochs stacked into
+     one AOT dispatch): the framework's best case at the tiny parity batch,
+     where per-dispatch latency otherwise dominates;
+   - ``trainer_loop_samples_per_sec_per_chip`` — the REAL ``Trainer.fit()``
+     loop at the same config, paying eval, checkpointing, resume-state
+     saves, and per-epoch dispatch. This is what the product delivers.
+   Baseline: the reference's compute stack (torch CPU loop with identical
+   model/optimizer/batch semantics) measured live on this host.
+
+2. **Scaled config** — a transformer at MXU-relevant sizes (d_model 512,
+   seq 1024, bf16) with ``mfu`` = analytic matmul FLOPs/step / step time /
+   chip peak bf16 FLOPs (peak from the device kind; override with
+   DCT_PEAK_TFLOPS). The parity MLP cannot utilize an MXU (~1e-6 MFU);
+   this is the number that says how well the framework maps to the
+   hardware. Includes Pallas-flash vs XLA-blockwise attention step times.
+
+3. **Scaled MoE** — sorted/segment dispatch vs one-hot einsum dispatch
+   step times at a capacity where the [N,E,C] einsum tensors dominate.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N,
+   "trainer_loop_samples_per_sec_per_chip": N, "scaled": {...},
+   "moe": {...}, ...}
 """
 
 from __future__ import annotations
@@ -129,6 +143,228 @@ def bench_tpu(data) -> tuple[float, float]:
     return samples / dt / n_chips, float(jax.device_get(losses)[-1])
 
 
+def bench_trainer_loop(data, tmp: str) -> float:
+    """The PRODUCT number: Trainer.fit() at parity config — eval,
+    best/last checkpointing, resume-state saves, logging, per-epoch
+    dispatch all included. Returns samples/sec/chip."""
+    from dct_tpu.config import (
+        DataConfig, RunConfig, TrackingConfig, TrainConfig,
+    )
+    from dct_tpu.tracking.client import LocalTracking
+    from dct_tpu.train.trainer import Trainer
+
+    cfg = RunConfig(
+        data=DataConfig(models_dir=os.path.join(tmp, "bench_models")),
+        train=TrainConfig(epochs=1 + TIMED_EPOCHS, batch_size=BATCH),
+        tracking=TrackingConfig(experiment="bench"),
+    )
+    tracker = LocalTracking(
+        root=os.path.join(tmp, "bench_runs"), experiment="bench"
+    )
+    trainer = Trainer(cfg, tracker=tracker)
+    result = trainer.fit(data)
+    return result.steady_samples_per_sec_per_chip
+
+
+# --- Scaled-config MFU ----------------------------------------------------
+
+SCALED = dict(d_model=512, n_heads=8, n_layers=2, d_ff=2048, seq_len=1024)
+SCALED_BATCH = 16
+
+
+def _chip_peak_tflops() -> float | None:
+    """Best-effort bf16 peak per chip from the device kind; None when
+    unknown (mfu is then omitted). Override with DCT_PEAK_TFLOPS."""
+    import jax
+
+    env = os.environ.get("DCT_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = jax.devices()[0].device_kind.lower()
+    for pat, peak in (
+        ("v6", 918.0), ("v5p", 459.0), ("v5 lite", 197.0), ("v5e", 197.0),
+        ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+    ):
+        if pat in kind:
+            return peak
+    return None
+
+
+def transformer_train_flops(cfg: dict, batch: int, input_dim: int) -> float:
+    """Analytic matmul FLOPs for ONE optimizer step (fwd + bwd ~ 3x fwd).
+    Counts projection/FFN GEMMs (2*params*tokens) and attention score/
+    value einsums (4*B*H*S^2*Dh per layer); elementwise ops excluded."""
+    d, ff = cfg["d_model"], cfg["d_ff"]
+    s, h, L = cfg["seq_len"], cfg["n_heads"], cfg["n_layers"]
+    tokens = batch * s
+    proj_params = L * (4 * d * d + 2 * d * ff) + input_dim * d + d * 2
+    fwd = 2.0 * proj_params * tokens + 4.0 * batch * h * s * s * (d // h) * L
+    return 3.0 * fwd
+
+
+def _time_step(step_fn, state, args, *, n: int = 8) -> float:
+    """Seconds per optimizer step, post-compilation."""
+    import jax
+
+    st = state
+    for _ in range(2):  # warmup (compile + cache)
+        st, _m = step_fn(st, *args)
+    jax.block_until_ready(st.params)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        st, _m = step_fn(st, *args)
+    jax.block_until_ready(st.params)
+    return (time.perf_counter() - t0) / n
+
+
+def bench_scaled_transformer() -> dict:
+    """MXU-relevant transformer: step time, MFU, flash vs blockwise."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dct_tpu.config import MeshConfig, ModelConfig
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.ops.attention import (
+        blockwise_attention, flash_interpret_mode,
+    )
+    from dct_tpu.parallel.mesh import make_global_batch, make_mesh
+    from dct_tpu.parallel.sharding_rules import shard_state_with_rules
+    from dct_tpu.train.state import create_train_state
+    from dct_tpu.train.steps import make_train_step
+
+    on_tpu = jax.default_backend() == "tpu"
+    scaled = dict(SCALED)
+    batch = SCALED_BATCH
+    if not on_tpu:  # CPU sanity runs: keep it minutes, not hours
+        scaled.update(d_model=128, d_ff=256, seq_len=256, n_layers=2)
+        batch = 4
+
+    mesh = make_mesh(MeshConfig())
+    input_dim = 5
+    cfg = ModelConfig(name="weather_transformer", **scaled)
+
+    def build(attn_fn):
+        model = get_model(
+            cfg, input_dim=input_dim, compute_dtype=jnp.bfloat16,
+            attn_fn=attn_fn,
+        )
+        return model
+
+    def blockwise_fn(q, k, v):
+        return blockwise_attention(q, k, v, block_size=min(512, q.shape[-2]))
+
+    model_bw = build(blockwise_fn)
+    state = create_train_state(
+        model_bw, input_dim=input_dim, lr=1e-3, seed=0,
+        example_shape=(1, scaled["seq_len"], input_dim),
+    )
+    state = shard_state_with_rules(state, mesh)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(
+        (batch, scaled["seq_len"], input_dim)
+    ).astype(np.float32)
+    y = rng.integers(0, 2, batch).astype(np.int32)
+    w = np.ones(batch, np.float32)
+    gx, gy, gw = make_global_batch(mesh, x, y, w)
+
+    step = make_train_step(donate=False)
+    t_blockwise = _time_step(step, state, (gx, gy, gw))
+
+    t_flash = None
+    if flash_interpret_mode() is False:  # real Mosaic kernel available
+        from dct_tpu.ops.pallas_attention import flash_attention
+
+        def flash_fn(q, k, v):
+            return flash_attention(q, k, v)
+
+        state_fl = state.replace(apply_fn=build(flash_fn).apply)
+        t_flash = _time_step(step, state_fl, (gx, gy, gw))
+
+    t_best = min(t for t in (t_blockwise, t_flash) if t is not None)
+    flops = transformer_train_flops(scaled, batch, input_dim)
+    peak = _chip_peak_tflops() if on_tpu else None
+    out = {
+        "config": {**scaled, "batch": batch, "dtype": "bfloat16"},
+        "step_time_ms": round(t_best * 1e3, 2),
+        "flops_per_step": flops,
+        "tflops_per_sec": round(flops / t_best / 1e12, 2),
+        "attn_blockwise_ms": round(t_blockwise * 1e3, 2),
+        "attn_flash_ms": round(t_flash * 1e3, 2) if t_flash else None,
+        "samples_per_sec_per_chip": round(batch / t_best / mesh.size, 1),
+    }
+    if peak:
+        out["chip_peak_bf16_tflops"] = peak
+        out["mfu"] = round(flops / t_best / (peak * 1e12), 4)
+    return out
+
+
+def bench_scaled_moe() -> dict:
+    """Sorted/segment MoE dispatch vs the one-hot einsum engine at a size
+    where the [N,E,C] dispatch tensors dominate the einsum path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dct_tpu.config import MeshConfig, ModelConfig
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.parallel.mesh import make_global_batch, make_mesh
+    from dct_tpu.parallel.sharding_rules import shard_state_with_rules
+    from dct_tpu.train.state import create_train_state
+    from dct_tpu.train.steps import make_train_step
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # E=32 puts the einsum engine's [N,E,C] dispatch tensors well past
+        # the FFN cost (the regime the sorted engine exists for).
+        size = dict(
+            d_model=512, n_heads=8, n_layers=2, d_ff=1024, seq_len=512,
+            n_experts=32,
+        )
+        batch = 8
+    else:
+        size = dict(
+            d_model=64, n_heads=4, n_layers=1, d_ff=128, seq_len=64,
+            n_experts=4,
+        )
+        batch = 4
+
+    mesh = make_mesh(MeshConfig())
+    input_dim = 5
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((batch, size["seq_len"], input_dim)).astype(
+        np.float32
+    )
+    y = rng.integers(0, 2, batch).astype(np.int32)
+    w = np.ones(batch, np.float32)
+    gx, gy, gw = make_global_batch(mesh, x, y, w)
+    step = make_train_step(donate=False)
+
+    times = {}
+    state_sorted = None
+    for engine in ("sorted", "einsum"):
+        cfg = ModelConfig(name="weather_moe", moe_dispatch=engine, **size)
+        model = get_model(
+            cfg, input_dim=input_dim, compute_dtype=jnp.bfloat16, mesh=mesh
+        )
+        if state_sorted is None:
+            state_sorted = create_train_state(
+                model, input_dim=input_dim, lr=1e-3, seed=0,
+                example_shape=(1, size["seq_len"], input_dim),
+            )
+            state_sorted = shard_state_with_rules(state_sorted, mesh)
+        st = state_sorted.replace(apply_fn=model.apply)
+        times[engine] = _time_step(step, st, (gx, gy, gw), n=5)
+
+    return {
+        "config": {**size, "batch": batch, "dtype": "bfloat16"},
+        "sorted_ms": round(times["sorted"] * 1e3, 2),
+        "einsum_ms": round(times["einsum"] * 1e3, 2),
+        "sorted_speedup": round(times["einsum"] / times["sorted"], 2),
+    }
+
+
 def bench_torch_reference(data) -> float:
     """The reference's per-rank training loop, measured on this host's CPU."""
     import numpy as np
@@ -182,26 +418,38 @@ def main():
     # must always print its JSON line, so probe first and fall back to CPU.
     ensure_live_backend()
 
+    skip_scaled = os.environ.get("DCT_BENCH_SCALED", "1").strip().lower() in (
+        "0", "false", "no"
+    )
+
     with tempfile.TemporaryDirectory() as tmp:
         data = _prepare_data(tmp)
         baseline = bench_torch_reference(data)
         ours, last_loss = bench_tpu(data)
+        trainer_loop = bench_trainer_loop(data, tmp)
+        scaled = None if skip_scaled else bench_scaled_transformer()
+        moe = None if skip_scaled else bench_scaled_moe()
 
     import jax
 
-    print(
-        json.dumps(
-            {
-                "metric": "weather_parity_train_samples_per_sec_per_chip",
-                "value": round(ours, 1),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(ours / baseline, 2),
-                "baseline_torch_cpu_samples_per_sec": round(baseline, 1),
-                "final_train_loss": round(last_loss, 4),
-                "platform": jax.default_backend(),
-            }
-        )
-    )
+    record = {
+        "metric": "weather_parity_train_samples_per_sec_per_chip",
+        "value": round(ours, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(ours / baseline, 2),
+        "baseline_torch_cpu_samples_per_sec": round(baseline, 1),
+        "trainer_loop_samples_per_sec_per_chip": round(trainer_loop, 1),
+        "trainer_loop_vs_baseline": round(trainer_loop / baseline, 2),
+        "final_train_loss": round(last_loss, 4),
+        "platform": jax.default_backend(),
+    }
+    if scaled is not None:
+        record["scaled"] = scaled
+        if "mfu" in scaled:
+            record["mfu"] = scaled["mfu"]
+    if moe is not None:
+        record["moe"] = moe
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

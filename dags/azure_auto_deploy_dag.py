@@ -108,7 +108,7 @@ def full_rollout(ti=None, **context):
 with DAG(
     dag_id="azure_automated_rollout",
     description="Automated blue/green rollout with shadow + canary stages",
-    schedule_interval=None,
+    schedule=None,
     start_date=datetime(2024, 1, 1),
     catchup=False,
     tags=["deploy", "tpu-pipeline"],

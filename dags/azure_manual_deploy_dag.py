@@ -73,7 +73,7 @@ def force_deploy(**context):
 with DAG(
     dag_id="azure_manual_deploy",
     description="Manual force-deploy of the best tracked model",
-    schedule_interval=None,
+    schedule=None,
     start_date=datetime(2024, 1, 1),
     catchup=False,
     tags=["deploy", "tpu-pipeline"],

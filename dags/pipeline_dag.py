@@ -45,6 +45,9 @@ def _abs(p: str) -> str:
 HOSTS = os.environ.get("DCT_TRAIN_HOSTS", "local").split(",")
 EXEC = os.environ.get("DCT_EXEC_TEMPLATE", "ssh {host} {cmd}")
 TRAIN_CMD = os.environ.get("DCT_TRAIN_COMMAND", f"python3 {_REPO}/jobs/train_tpu.py")
+# Continuous training: resume the optimizer trajectory each run
+# (see dags/training_dag.py for the full rationale).
+RESUME = os.environ.get("DCT_RESUME", "1")
 RAW = _abs(os.environ.get("DCT_RAW_CSV", "data/raw/weather.csv"))
 PROCESSED = _abs(os.environ.get("DCT_PROCESSED_DIR", "data/processed"))
 MODELS_DIR = _abs(os.environ.get("DCT_MODELS_DIR", "data/models"))
@@ -74,7 +77,7 @@ with DAG(
     dag_id="distributed_data_pipeline",
     default_args=default_args,
     description="Full ETL -> TPU SPMD training -> verification pipeline",
-    schedule_interval="@daily",
+    schedule="@daily",
     start_date=datetime(2024, 1, 1),
     catchup=False,
     tags=["etl", "training", "tpu-pipeline"],
@@ -120,7 +123,7 @@ with DAG(
         )
         launch = BashOperator(
             task_id="tpu_spmd_training",
-            bash_command=f"cd {_REPO} && {TRAIN_CMD}",
+            bash_command=f"cd {_REPO} && DCT_RESUME={RESUME} {TRAIN_CMD}",
             execution_timeout=timedelta(hours=3),
         )
     else:
@@ -148,7 +151,10 @@ with DAG(
         )
         launch = BashOperator(
             task_id="tpu_spmd_training",
-            bash_command=build_spmd_launch_script(HOSTS, TRAIN_CMD, exec_template=EXEC),
+            bash_command=build_spmd_launch_script(
+                HOSTS, TRAIN_CMD, exec_template=EXEC,
+                extra_env={"DCT_RESUME": RESUME},
+            ),
             execution_timeout=timedelta(hours=3),
         )
 

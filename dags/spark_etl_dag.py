@@ -51,7 +51,7 @@ with DAG(
     dag_id="spark_etl_pipeline",
     default_args=default_args,
     description="Weather ETL: raw CSV -> normalized parquet handoff",
-    schedule_interval="@daily",
+    schedule="@daily",
     start_date=datetime(2024, 1, 1),
     catchup=False,
     tags=["etl", "tpu-pipeline"],

@@ -50,6 +50,13 @@ TRAIN_CMD = os.environ.get(
 )
 MODELS_DIR = _abs(os.environ.get("DCT_MODELS_DIR", "data/models"))
 LOCAL_MODE = HOSTS == ["local"]
+# Continuous training: each scheduled run RESUMES the optimizer trajectory
+# from the previous run's full train state and extends it by DCT_EPOCHS
+# more epochs on the refreshed data (Trainer.fit semantics) — unlike the
+# reference, which re-trains from scratch daily (its fit() never gets a
+# ckpt_path, reference jobs/train_lightning_ddp.py:143). Set DCT_RESUME=0
+# to restore scratch-daily behavior.
+RESUME = os.environ.get("DCT_RESUME", "1")
 
 default_args = {
     "owner": "dct-tpu",
@@ -61,7 +68,7 @@ with DAG(
     dag_id="pytorch_training_pipeline",
     default_args=default_args,
     description="TPU SPMD training (JAX/XLA) on the processed weather data",
-    schedule_interval=None,  # externally triggered by the ETL DAG
+    schedule=None,  # externally triggered by the ETL DAG
     start_date=datetime(2024, 1, 1),
     catchup=False,
     tags=["training", "tpu-pipeline"],
@@ -82,7 +89,7 @@ with DAG(
         )
         launch = BashOperator(
             task_id="tpu_spmd_training",
-            bash_command=f"cd {_REPO} && {TRAIN_CMD}",
+            bash_command=f"cd {_REPO} && DCT_RESUME={RESUME} {TRAIN_CMD}",
             execution_timeout=timedelta(hours=3),
         )
     else:
@@ -99,7 +106,8 @@ with DAG(
         launch = BashOperator(
             task_id="tpu_spmd_training",
             bash_command=build_spmd_launch_script(
-                HOSTS, TRAIN_CMD, exec_template=EXEC
+                HOSTS, TRAIN_CMD, exec_template=EXEC,
+                extra_env={"DCT_RESUME": RESUME},
             ),
             execution_timeout=timedelta(hours=3),
         )

@@ -185,8 +185,14 @@ class TrainStateCheckpointer:
         once, fanned back out on restore."""
         return tuple(sl.start or 0 for sl in index)
 
-    def save(self, state) -> str:
+    def save(self, state, meta: dict | None = None) -> str:
         """Persist this process's ADDRESSABLE view of the train state.
+
+        ``meta``: small JSON-able run facts (epochs_completed,
+        target_epochs, ...) stored beside the arrays and returned by
+        :meth:`load_meta` — the continuous-training re-run semantics
+        (Trainer.fit) are decided from these, not from step arithmetic
+        that breaks when the dataset size changes between daily runs.
 
         Fully-addressable leaves (replicated params, single-host runs) are
         saved whole; leaves sharded across processes (TP/SP spanning
@@ -236,6 +242,14 @@ class TrainStateCheckpointer:
         with open(tmp, "wb") as f:
             np.savez(f, **entries)
         os.replace(tmp, final)
+        if meta is not None:
+            import json
+
+            mfinal = os.path.join(next_dir, "meta.json")
+            mtmp = mfinal + ".tmp"
+            with open(mtmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(mtmp, mfinal)
 
         live, old = self._dir(self._LIVE), self._dir(self._OLD)
         if os.path.isdir(old):
@@ -246,6 +260,19 @@ class TrainStateCheckpointer:
         if os.path.isdir(old):
             shutil.rmtree(old)
         return live
+
+    def load_meta(self) -> dict:
+        """Run facts saved beside the newest restorable checkpoint
+        (empty dict when the checkpoint predates meta support)."""
+        import json
+
+        for d in self._restore_candidates():
+            path = os.path.join(d, "meta.json")
+            if os.path.exists(path):
+                with open(path) as f:
+                    return dict(json.load(f))
+            return {}
+        return {}
 
     def exists(self) -> bool:
         # A readable checkpoint, or a dir in an unreadable (legacy) format

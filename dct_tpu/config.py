@@ -88,6 +88,10 @@ class ModelConfig:
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.01
     moe_dispatch: str = "auto"
+    # Pipeline-parallel family (weather_transformer_pp): stage count over
+    # the mesh's ``pipe`` axis; microbatches default to the stage count.
+    n_stages: int = 2
+    n_microbatches: int | None = None
 
     @classmethod
     def from_env(cls) -> "ModelConfig":
@@ -107,6 +111,9 @@ class ModelConfig:
             "DCT_ROUTER_AUX_WEIGHT", c.router_aux_weight, float
         )
         c.moe_dispatch = _env("DCT_MOE_DISPATCH", c.moe_dispatch, str)
+        c.n_stages = _env("DCT_N_STAGES", c.n_stages, int)
+        mb = os.environ.get("DCT_N_MICROBATCHES")
+        c.n_microbatches = int(mb) if mb else c.n_microbatches
         return c
 
 

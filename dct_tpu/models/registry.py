@@ -112,6 +112,37 @@ def _build_moe(
     )
 
 
+@register_model("weather_transformer_pp", sequence=True)
+def _build_transformer_pp(
+    cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None,
+    mesh=None,
+):
+    # The passed attn_fn may be mesh-bound (ring over ``seq``); stages run
+    # inside the pipeline shard_map where nesting it is illegal — the PP
+    # family always uses the single-shard dense/blockwise/flash path.
+    del attn_fn
+    import jax.numpy as jnp
+
+    from dct_tpu.models.transformer import WeatherTransformerPP
+    from dct_tpu.ops.attention import make_attention_fn
+
+    return WeatherTransformerPP(
+        input_dim=input_dim,
+        seq_len=cfg.seq_len,
+        d_model=cfg.d_model,
+        n_heads=cfg.n_heads,
+        n_layers=cfg.n_layers,
+        d_ff=cfg.d_ff,
+        num_classes=cfg.num_classes,
+        dropout=cfg.dropout,
+        n_stages=cfg.n_stages,
+        n_microbatches=cfg.n_microbatches,
+        attn_fn=make_attention_fn(None),
+        mesh=mesh,
+        compute_dtype=compute_dtype or jnp.float32,
+    )
+
+
 @register_model("weather_transformer", sequence=True)
 def _build_transformer(
     cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None,

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -90,6 +91,118 @@ class TransformerBlock(nn.Module):
         h = TorchStyleDense(self.d_model, dtype=self.dtype, name="ffn_out")(h)
         h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
         return x + h
+
+
+class _StageBlocks(nn.Module):
+    """One pipeline stage: ``layers_per_stage`` identical pre-LN blocks.
+
+    Deterministic (no dropout): the PP family applies dropout OUTSIDE the
+    pipelined region so stages need no rng threading through shard_map.
+    """
+
+    d_model: int
+    n_heads: int
+    d_ff: int
+    layers_per_stage: int
+    attn_fn: object
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h):
+        for i in range(self.layers_per_stage):
+            h = TransformerBlock(
+                self.d_model, self.n_heads, self.d_ff, 0.0, self.attn_fn,
+                dtype=self.dtype, name=f"block_{i}",
+            )(h, train=False)
+        return h
+
+
+class WeatherTransformerPP(nn.Module):
+    """Pipeline-parallel transformer: ``n_layers`` grouped into
+    ``n_stages`` homogeneous stages streamed GPipe-style over the mesh's
+    ``pipe`` axis (:func:`dct_tpu.parallel.pipeline.pipeline_apply`).
+
+    Stage params live in ONE stacked pytree param named ``pp_stages``
+    (leading dim = stage), which the sharding rules place
+    ``P("pipe", ...)`` — each pipeline device holds one stage. Composes
+    with DP (microbatch rows shard over ``data``); TP/SP inside stages
+    are deliberately not composed — attention is the single-shard
+    dense/blockwise/flash path. Embedding, dropout, final LN and the
+    classifier head run outside the pipelined region (replicated).
+
+    Without a mesh (or ``pipe`` = 1, or the batch-1 flax init trace) the
+    stages apply sequentially — the same function, used by tests as the
+    pipeline oracle.
+    """
+
+    input_dim: int
+    seq_len: int
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    num_classes: int = 2
+    dropout: float = 0.1
+    n_stages: int = 2
+    n_microbatches: int | None = None
+    attn_fn: object = None
+    mesh: object = None
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        from dct_tpu.ops.attention import make_attention_fn
+        from dct_tpu.parallel.pipeline import pipeline_apply
+
+        if self.n_layers % self.n_stages:
+            raise ValueError(
+                f"n_layers={self.n_layers} must divide into "
+                f"n_stages={self.n_stages} homogeneous stages"
+            )
+        attn_fn = self.attn_fn or make_attention_fn(None)
+        ct = self.compute_dtype
+        stage_mod = _StageBlocks(
+            self.d_model, self.n_heads, self.d_ff,
+            self.n_layers // self.n_stages, attn_fn, dtype=ct,
+        )
+
+        def init_stages(rng):
+            zeros = jnp.zeros((1, self.seq_len, self.d_model), ct)
+            rngs = jax.random.split(rng, self.n_stages)
+            return jax.vmap(
+                lambda r: stage_mod.init(r, zeros)["params"]
+            )(rngs)
+
+        stacked = self.param("pp_stages", init_stages)
+
+        x = jnp.asarray(x, ct)
+        h = TorchStyleDense(self.d_model, dtype=ct, name="in_proj")(x)
+        h = h + jnp.asarray(sincos_positions(self.seq_len, self.d_model), ct)
+        h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
+
+        mesh = self.mesh
+        b = h.shape[0]
+        pipe = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        m = self.n_microbatches or max(pipe, 1)
+        dp = mesh.shape.get("data", 1) if mesh is not None else 1
+        if pipe > 1 and b % m == 0 and (b // m) % dp == 0:
+            h = pipeline_apply(
+                lambda p, a: stage_mod.apply({"params": p}, a),
+                stacked, h, mesh=mesh, n_microbatches=m,
+                data_axis="data" if dp > 1 else None,
+            )
+        else:
+            # Sequential oracle: init trace, pipe=1, or untileable batch.
+            for i in range(self.n_stages):
+                p_i = jax.tree.map(lambda a, i=i: a[i], stacked)
+                h = stage_mod.apply({"params": p_i}, h)
+
+        h = nn.LayerNorm(dtype=ct, name="ln_out")(h)
+        pooled = h.mean(axis=1)
+        logits = TorchStyleDense(self.num_classes, dtype=ct, name="head")(
+            pooled
+        )
+        return jnp.asarray(logits, jnp.float32)
 
 
 class WeatherTransformer(nn.Module):

@@ -374,8 +374,10 @@ def make_attention_fn(mesh: Mesh | None = None, *, causal: bool = False,
         if path == "flash":
             from dct_tpu.ops.pallas_attention import flash_attention
 
+            bq = int(os.environ.get("DCT_FLASH_BLOCK_Q", "128"))
+            bk = int(os.environ.get("DCT_FLASH_BLOCK_K", "128"))
             return flash_attention(
-                q, k, v, causal=causal,
+                q, k, v, block_q=bq, block_k=bk, causal=causal,
                 interpret=bool(flash_interpret_mode()),
             )
         if path == "blockwise":

@@ -9,13 +9,76 @@ commands, and callables so tests can validate DAG wiring and execute Python
 tasks without an Airflow installation. The surface covered is exactly what
 the five DAGs use: DAG (context manager), BashOperator, PythonOperator,
 TriggerDagRunOperator, and ``>>`` chaining.
+
+The stand-ins are STRICT about the API surface: constructor kwargs (and
+``default_args`` keys) are validated against the Airflow 2.7 signatures
+(the version the Dockerfile pins, reference Dockerfile:2), and the
+pre-2.4 ``schedule_interval`` parameter raises the same deprecation
+warning the real scheduler logs — so a DAG file that would trip on a real
+2.7 DagBag import fails HERE, in tests, not on the production scheduler.
+Airflow cannot be installed in hermetic rigs; this validation is the
+strongest available stand-in for a real ``airflow dags list`` check (the
+Airflow image itself still exists for deployments that can build it).
 """
 
 from __future__ import annotations
 
 import inspect
 import subprocess
+import warnings
 from typing import Any, Callable
+
+# Airflow 2.7 API surfaces (airflow.models.dag.DAG and BaseOperator
+# keyword parameters, trimmed to realistic DAG-file usage; an unknown
+# kwarg raises TypeError exactly like the real constructors).
+_DAG_PARAMS = frozenset({
+    "description", "schedule", "schedule_interval", "timetable",
+    "start_date", "end_date", "full_filepath", "template_searchpath",
+    "template_undefined", "user_defined_macros", "user_defined_filters",
+    "default_args", "concurrency", "max_active_tasks", "max_active_runs",
+    "dagrun_timeout", "sla_miss_callback", "default_view", "orientation",
+    "catchup", "on_success_callback", "on_failure_callback", "doc_md",
+    "params", "access_control", "is_paused_upon_creation", "jinja_environment_kwargs",
+    "render_template_as_native_obj", "tags", "owner_links", "auto_register",
+    "fail_stop",
+})
+_BASE_OPERATOR_PARAMS = frozenset({
+    "owner", "email", "email_on_retry", "email_on_failure", "retries",
+    "retry_delay", "retry_exponential_backoff", "max_retry_delay",
+    "start_date", "end_date", "depends_on_past", "ignore_first_depends_on_past",
+    "wait_for_past_depends_before_skipping", "wait_for_downstream",
+    "dag", "params", "default_args", "priority_weight", "weight_rule",
+    "queue", "pool", "pool_slots", "sla", "execution_timeout",
+    "on_execute_callback", "on_failure_callback", "on_success_callback",
+    "on_retry_callback", "pre_execute", "post_execute", "trigger_rule",
+    "resources", "run_as_user", "task_concurrency", "max_active_tis_per_dag",
+    "max_active_tis_per_dagrun", "executor_config", "do_xcom_push",
+    "multiple_outputs", "inlets", "outlets", "task_group", "doc", "doc_md",
+    "doc_json", "doc_yaml", "doc_rst",
+})
+_OPERATOR_EXTRA_PARAMS = {
+    "BashOperator": frozenset({
+        "env", "append_env", "output_encoding", "skip_on_exit_code", "cwd",
+    }),
+    "PythonOperator": frozenset({
+        "op_args", "op_kwargs", "templates_dict", "templates_exts",
+        "show_return_value_in_logs",
+    }),
+    "TriggerDagRunOperator": frozenset({
+        "trigger_run_id", "conf", "logical_date", "execution_date",
+        "reset_dag_run", "wait_for_completion", "poke_interval",
+        "allowed_states", "failed_states", "deferrable",
+    }),
+}
+
+
+def _validate_kwargs(ctor: str, kwargs: dict, allowed: frozenset) -> None:
+    unknown = set(kwargs) - allowed
+    if unknown:
+        raise TypeError(
+            f"{ctor}() got unexpected keyword argument(s) "
+            f"{sorted(unknown)} — not part of the Airflow 2.7 API"
+        )
 
 try:  # pragma: no cover - exercised only on real Airflow images
     from airflow import DAG  # type: ignore
@@ -47,6 +110,10 @@ except ImportError:
 
     class _Task:
         def __init__(self, task_id: str, **kwargs: Any):
+            extra = _OPERATOR_EXTRA_PARAMS.get(type(self).__name__, frozenset())
+            _validate_kwargs(
+                type(self).__name__, kwargs, _BASE_OPERATOR_PARAMS | extra
+            )
             self.task_id = task_id
             self.kwargs = kwargs
             self.downstream: list[_Task] = []
@@ -109,6 +176,24 @@ except ImportError:
 
     class DAG:
         def __init__(self, dag_id: str, **kwargs: Any):
+            _validate_kwargs("DAG", kwargs, _DAG_PARAMS)
+            if "schedule_interval" in kwargs:
+                # Airflow 2.7 still accepts it but logs RemovedInAirflow3;
+                # surfacing it as a warning keeps DAG files honest before
+                # they meet a real scheduler.
+                warnings.warn(
+                    "schedule_interval is deprecated since Airflow 2.4; "
+                    "use schedule=",
+                    DeprecationWarning,
+                    stacklevel=2,
+                )
+            bad = set(kwargs.get("default_args") or {}) - _BASE_OPERATOR_PARAMS
+            if bad:
+                raise TypeError(
+                    f"DAG default_args contain non-operator key(s) "
+                    f"{sorted(bad)} — not part of the Airflow 2.7 "
+                    "BaseOperator API"
+                )
             self.dag_id = dag_id
             self.kwargs = kwargs
             self.tasks: dict[str, _Task] = {}

@@ -98,6 +98,7 @@ def pipeline_apply(
     mesh: Mesh,
     axis: str = "pipe",
     n_microbatches: int | None = None,
+    data_axis: str | None = None,
 ):
     """Apply ``n_stages`` chained stages to ``x`` [B, ...] with GPipe
     microbatch streaming over ``mesh[axis]``.
@@ -107,6 +108,10 @@ def pipeline_apply(
     defaults to the pipeline depth (bubble fraction ~1/2; raise it to
     amortize the bubble). Differentiable: jax.grad produces the reverse
     pipeline schedule.
+
+    ``data_axis``: compose DP x PP — the within-microbatch batch dim
+    shards over that mesh axis (each data-parallel group runs its own
+    pipeline over its rows); None replicates the batch over the mesh.
     """
     n_stages = mesh.shape[axis]
     first = jax.tree.leaves(stacked_params)[0]
@@ -119,6 +124,11 @@ def pipeline_apply(
     m = n_microbatches or n_stages
     if b % m:
         raise ValueError(f"batch {b} not divisible by n_microbatches {m}")
+    if data_axis is not None and (b // m) % mesh.shape[data_axis]:
+        raise ValueError(
+            f"microbatch {b // m} not divisible by mesh axis "
+            f"'{data_axis}' ({mesh.shape[data_axis]})"
+        )
     xs = x.reshape(m, b // m, *x.shape[1:])
 
     body = functools.partial(
@@ -127,10 +137,11 @@ def pipeline_apply(
     param_specs = jax.tree.map(
         lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params
     )
+    xs_spec = P(None, data_axis, *([None] * (x.ndim - 1)))
     ys = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, xs_spec),
+        out_specs=xs_spec,
     )(stacked_params, xs)
     return ys.reshape(b, *x.shape[1:])

