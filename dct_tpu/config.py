@@ -146,6 +146,10 @@ class TrainConfig:
     # dim over the data axis; XLA reduce-scatters grads into the shards
     # and all-gathers updates. Memory win at scale; off for parity.
     shard_opt_state: bool = False
+    # Gradient accumulation: microbatches summed per optimizer update
+    # (effective batch = batch_size * data_parallel * this) — capability
+    # the reference lacks; 1 = parity behavior.
+    grad_accum_steps: int = 1
 
     @classmethod
     def from_env(cls) -> "TrainConfig":
@@ -159,6 +163,7 @@ class TrainConfig:
         c.bf16_compute = _env("DCT_BF16_COMPUTE", c.bf16_compute, bool)
         c.use_scan = _env("DCT_USE_SCAN", c.use_scan, bool)
         c.shard_opt_state = _env("DCT_SHARD_OPT_STATE", c.shard_opt_state, bool)
+        c.grad_accum_steps = _env("DCT_GRAD_ACCUM_STEPS", c.grad_accum_steps, int)
         return c
 
 

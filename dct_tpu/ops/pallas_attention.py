@@ -16,9 +16,13 @@ The running stats use the same online update as
 because Mosaic wants >=2-D vector layouts in VMEM — tests pin the two
 implementations to the same dense oracle so they cannot drift silently.
 
-Backward uses ``jax.custom_vjp`` with recompute-from-inputs through the
-numerically-identical :func:`~dct_tpu.ops.attention.blockwise_attention`
-(flash-style rematerialization: store only q,k,v, not the score matrix).
+Backward is a pair of FlashAttention-2-style Pallas kernels (dK/dV with
+the Q sweep innermost, dQ with the KV sweep innermost): the forward saves
+only (q, k, v, o, lse) and each backward block recovers its softmax
+weights from the lse — O(T·D) memory end to end, with ``delta`` =
+rowsum(dO⊙O) recomputed in-kernel rather than shipped through HBM.
+``DCT_FLASH_BWD=remat`` swaps in the older differentiate-through-
+blockwise escape hatch.
 
 CPU rigs run the same kernel with ``interpret=True`` (tests); on TPU it
 compiles to Mosaic. Reference note: the reference has no kernels of any
@@ -30,6 +34,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -199,6 +204,201 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
     return out.reshape(b, h, t, d)
 
 
+def _bwd_block(q, k, v, do, lse, delta, scale, keep):
+    """Shared per-(i,j) backward math in f32: returns (p, ds) with
+    p = softmax weights recovered from the forward lse, ds = the score
+    cotangent. q,do [bq,d] · k,v [bk,d] · lse,delta [bq,1]."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [bq, bk]
+    p = jnp.exp(s - lse)
+    if keep is not None:
+        p = jnp.where(keep, p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [bq, bk]
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *,
+                           block_q: int, n_q: int, causal: bool,
+                           scale: float):
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    bk = k_ref.shape[0]
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _block():
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        o = o_ref[...].astype(jnp.float32)
+        lse = lse_ref[:, :1]
+        # delta_i = rowsum(dO ⊙ O): recomputed per block (cheap VPU work)
+        # instead of shipping a [bh, T] side input through HBM.
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        keep = None
+        if causal:
+            bq = q.shape[0]
+            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            keep = q_pos >= k_pos
+        p, ds = _bwd_block(q, k, v, do, lse, delta, scale, keep)
+        # dV_j += P^T dO_i ; dK_j += dS^T Q_i  (contract over the q rows)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # q block i contributes to kv block j iff its last query position
+        # reaches the block's first key position.
+        pl.when((i + 1) * block_q > j * k_ref.shape[0])(_block)
+    else:
+        _block()
+
+    @pl.when(i == n_q - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                         dq_ref, dq_acc, *, block_k: int, n_kv: int,
+                         causal: bool, scale: float):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    bq = q_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _block():
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        o = o_ref[...].astype(jnp.float32)
+        lse = lse_ref[:, :1]
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        keep = None
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            keep = q_pos >= k_pos
+        _, ds = _bwd_block(q, k, v, do, lse, delta, scale, keep)
+        # dQ_i += dS K_j
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(j * block_k < (i + 1) * bq)(_block)
+    else:
+        _block()
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
+               causal: bool, scale: float | None, interpret: bool):
+    """FlashAttention-2-style backward: two Pallas kernels (dK/dV with the
+    Q sweep innermost; dQ with the KV sweep innermost). The score matrix
+    is recovered blockwise from the forward's lse — nothing O(T^2) ever
+    touches HBM in the backward either."""
+    b, h, t, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    n_q = t // block_q
+    n_kv = t // block_k
+    flat = lambda a: a.reshape(b * h, t, d)
+    qf, kf, vf, of, dof = map(flat, (q, k, v, o, do))
+    # Forward lse [B,H,T] -> lane-broadcast [bh, T, LANES] (Mosaic wants
+    # >=2-D vector tiles; lane 0 is read back in-kernel).
+    lsef = jnp.broadcast_to(
+        lse.reshape(b * h, t, 1), (b * h, t, _STATS_LANES)
+    )
+    try:
+        vma = frozenset().union(*(jax.typeof(a).vma for a in (q, k, v)))
+    except AttributeError:  # pragma: no cover - older jax
+        vma = frozenset()
+    vma_kw = {"vma": vma} if vma else {}
+
+    q_spec = pl.BlockSpec((None, block_q, d), lambda bh, j, i: (bh, i, 0))
+    kv_spec = pl.BlockSpec((None, block_k, d), lambda bh, j, i: (bh, j, 0))
+    lse_spec = pl.BlockSpec(
+        (None, block_q, _STATS_LANES), lambda bh, j, i: (bh, i, 0)
+    )
+    try:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+        )
+    except (AttributeError, TypeError):  # pragma: no cover - older jax
+        compiler_params = None
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkdv_kernel, block_q=block_q, n_q=n_q,
+            causal=causal, scale=scale,
+        ),
+        grid=(b * h, n_kv, n_q),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype, **vma_kw),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype, **vma_kw),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),  # dk accumulator
+            pltpu.VMEM((block_k, d), jnp.float32),  # dv accumulator
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(qf, kf, vf, of, dof, lsef)
+
+    q_spec2 = pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0))
+    kv_spec2 = pl.BlockSpec((None, block_k, d), lambda bh, i, j: (bh, j, 0))
+    lse_spec2 = pl.BlockSpec(
+        (None, block_q, _STATS_LANES), lambda bh, i, j: (bh, i, 0)
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_k=block_k, n_kv=n_kv,
+            causal=causal, scale=scale,
+        ),
+        grid=(b * h, n_q, n_kv),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, q_spec2, lse_spec2],
+        out_specs=q_spec2,
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype, **vma_kw),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(qf, kf, vf, of, dof, lsef)
+
+    unflat = lambda a: a.reshape(b, h, t, d)
+    return unflat(dq), unflat(dk), unflat(dv)
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
@@ -212,29 +412,32 @@ def flash_attention(q, k, v, block_q=128, block_k=128, causal=False,
 
 
 def _vjp_fwd(q, k, v, block_q, block_k, causal, scale, interpret):
-    out = _flash_fwd(
+    out, lse = _flash_fwd(
         q, k, v, block_q=block_q, block_k=block_k, causal=causal,
-        scale=scale, interpret=interpret,
+        scale=scale, interpret=interpret, with_lse=True,
     )
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _vjp_bwd(block_q, block_k, causal, scale, interpret, res, g):
-    # Rematerialized backward: differentiate the numerically-identical
-    # blockwise path from the saved inputs (no score matrix was stored).
-    from dct_tpu.ops.attention import blockwise_attention
+    q, k, v, o, lse = res
+    if os.environ.get("DCT_FLASH_BWD", "kernel").strip().lower() == "remat":
+        # Escape hatch: differentiate the numerically-identical blockwise
+        # path instead of running the backward kernels.
+        from dct_tpu.ops.attention import blockwise_attention
 
-    q, k, v = res
-    # Clamp like the forward does: a caller whose T is shorter than the
-    # (default 128) block must still get a matching backward.
-    block = min(block_k, k.shape[-2])
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, block_size=block, causal=causal, scale=scale
-        ),
-        q, k, v,
+        block = min(block_k, k.shape[-2])
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: blockwise_attention(
+                q_, k_, v_, block_size=block, causal=causal, scale=scale
+            ),
+            q, k, v,
+        )
+        return vjp(g)
+    return _flash_bwd(
+        q, k, v, o, lse, g, block_q=block_q, block_k=block_k,
+        causal=causal, scale=scale, interpret=interpret,
     )
-    return vjp(g)
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -167,6 +167,48 @@ def transformer_forward_numpy(
     return _encoder_numpy(weights, meta, x, dense_ffn)
 
 
+def transformer_pp_forward_numpy(
+    weights: dict, meta: dict, x: np.ndarray
+) -> np.ndarray:
+    """Pipeline-parallel transformer inference: the ``pp_stages`` param is
+    a stacked tree (leading dim = stage,
+    dct_tpu.models.transformer.WeatherTransformerPP); serving just
+    unstacks it and applies the stages sequentially — pipelining is a
+    training-time throughput construct, numerically the sequential stack."""
+    d_model = int(meta["d_model"])
+    n_heads = int(meta["n_heads"])
+    n_layers = int(meta["n_layers"])
+    n_stages = int(meta["n_stages"])
+    layers_per_stage = n_layers // n_stages
+    s = x.shape[1]
+
+    h = x @ weights["in_proj/kernel"] + weights["in_proj/bias"]
+    h = h + _sincos_positions(s, d_model)
+    stage_keys = {
+        k[len("pp_stages/"):]: v
+        for k, v in weights.items()
+        if k.startswith("pp_stages/")
+    }
+    for st in range(n_stages):
+        w = {k: v[st] for k, v in stage_keys.items()}
+        for i in range(layers_per_stage):
+            pre = f"block_{i}"
+            a = _layernorm(
+                h, w[f"{pre}/ln_attn/scale"], w[f"{pre}/ln_attn/bias"]
+            )
+            h = h + _mha_numpy(w, f"{pre}/attn", a, n_heads)
+            f = _layernorm(
+                h, w[f"{pre}/ln_ffn/scale"], w[f"{pre}/ln_ffn/bias"]
+            )
+            f = _gelu_tanh(
+                f @ w[f"{pre}/ffn_in/kernel"] + w[f"{pre}/ffn_in/bias"]
+            )
+            h = h + (f @ w[f"{pre}/ffn_out/kernel"] + w[f"{pre}/ffn_out/bias"])
+    h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
+    pooled = h.mean(axis=1)
+    return pooled @ weights["head/kernel"] + weights["head/bias"]
+
+
 def _moe_ffn_numpy(weights: dict, prefix: str, h: np.ndarray,
                    capacity_factor: float) -> np.ndarray:
     """Switch (top-1) MoE inference matching dct_tpu.models.moe.MoEFFN:
@@ -216,12 +258,17 @@ def forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
         return gru_forward_numpy(weights, meta, x)
     if family == "weather_transformer":
         return transformer_forward_numpy(weights, meta, x)
+    if family == "weather_transformer_pp":
+        return transformer_pp_forward_numpy(weights, meta, x)
     if family == "weather_moe":
         return moe_forward_numpy(weights, meta, x)
     return mlp_forward_numpy(weights, x)
 
 
-_SEQUENCE_FAMILIES = ("weather_gru", "weather_transformer", "weather_moe")
+_SEQUENCE_FAMILIES = (
+    "weather_gru", "weather_transformer", "weather_transformer_pp",
+    "weather_moe",
+)
 
 
 def score_payload(weights: dict, meta: dict, data) -> dict:

@@ -54,7 +54,10 @@ def export_npz_weights(ckpt_path: str, deploy_dir: str) -> dict:
     p = params["params"]
     family = meta.get("model", "weather_mlp")
 
-    if family in ("weather_gru", "weather_transformer", "weather_moe"):
+    if family in (
+        "weather_gru", "weather_transformer", "weather_transformer_pp",
+        "weather_moe",
+    ):
         weights = _flatten_params(p)
     else:
         def layer_index(name: str) -> int:

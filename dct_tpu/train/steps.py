@@ -68,17 +68,64 @@ def _eval_body(state: TrainState, x, y, weight):
     return loss_sum, acc_sum, count
 
 
-def make_train_step(donate: bool = True):
-    """Per-batch jitted step: (state, x, y, weight) -> (state, metrics)."""
+def _train_accum_body(state: TrainState, x, y, weight, accum_steps: int):
+    """One optimizer step over ``accum_steps`` microbatches: grads are
+    accumulated in a ``lax.scan`` (one resident microbatch of activations
+    at a time — effective batch grows without growing live HBM) and
+    applied once. Exactly equal to one big-batch step for the CE term
+    (the weighted-sum/total decomposition is linear; ``total`` is
+    param-independent); sown aux losses average over microbatches."""
+    b = x.shape[0]
+    step_rng = jax.random.fold_in(state.rng, state.step)
+    xs = x.reshape(accum_steps, b // accum_steps, *x.shape[1:])
+    ys = y.reshape(accum_steps, b // accum_steps)
+    ws = weight.reshape(accum_steps, b // accum_steps)
+    total = jnp.maximum(weight.sum(), 1.0)
+
+    def chunk_loss(params, cx, cy, cw, rng):
+        logits, updates = state.apply_fn(
+            params, cx, train=True, rngs={"dropout": rng},
+            mutable=["aux_loss"],
+        )
+        loss_sum, _ = masked_cross_entropy(logits, cy, cw)
+        loss = loss_sum / total
+        for leaf in jax.tree.leaves(updates):
+            loss = loss + leaf / accum_steps
+        return loss
+
+    grad_fn = jax.value_and_grad(chunk_loss)
+
+    def body(carry, chunk):
+        gacc, lacc, i = carry
+        cx, cy, cw = chunk
+        loss_i, g = grad_fn(
+            state.params, cx, cy, cw, jax.random.fold_in(step_rng, i)
+        )
+        return (jax.tree.map(jnp.add, gacc, g), lacc + loss_i, i + 1), None
+
+    zeros = jax.tree.map(jnp.zeros_like, state.params)
+    (grads, loss, _), _ = jax.lax.scan(
+        body, (zeros, jnp.zeros(()), jnp.zeros((), jnp.int32)), (xs, ys, ws)
+    )
+    return state.apply_gradients(grads), loss
+
+
+def make_train_step(donate: bool = True, accum_steps: int = 1):
+    """Per-batch jitted step: (state, x, y, weight) -> (state, metrics).
+    ``accum_steps`` > 1 splits the batch into that many microbatches and
+    accumulates gradients before the single optimizer update."""
 
     def train_step(state: TrainState, x, y, weight):
-        new_state, loss = _train_body(state, x, y, weight)
+        if accum_steps > 1:
+            new_state, loss = _train_accum_body(state, x, y, weight, accum_steps)
+        else:
+            new_state, loss = _train_body(state, x, y, weight)
         return new_state, {"train_loss": loss}
 
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
 
 
-def make_epoch_train_step(donate: bool = True):
+def make_epoch_train_step(donate: bool = True, accum_steps: int = 1):
     """Whole-epoch training as one XLA program: ``lax.scan`` of
     ``_train_body`` over the stacked batches [S, B, ...].
 
@@ -89,11 +136,24 @@ def make_epoch_train_step(donate: bool = True):
     TPU step, so this is where the throughput win over the eager loop
     comes from. Returns (state, losses[S]) so per-step logging cadence
     (log_every_n_steps, :139) is preserved from the host side.
+
+    ``accum_steps`` > 1 groups every ``accum_steps`` consecutive stacked
+    batches into ONE optimizer update (gradient accumulation); S must be
+    divisible (the Trainer truncates the remainder).
     """
 
     def epoch_train(state: TrainState, xs, ys, ws):
-        def body(st, batch):
-            return _train_body(st, *batch)
+        if accum_steps > 1:
+            s, b = xs.shape[0], xs.shape[1]
+            xs = xs.reshape(s // accum_steps, accum_steps * b, *xs.shape[2:])
+            ys = ys.reshape(s // accum_steps, accum_steps * b)
+            ws = ws.reshape(s // accum_steps, accum_steps * b)
+
+            def body(st, batch):
+                return _train_accum_body(st, *batch, accum_steps)
+        else:
+            def body(st, batch):
+                return _train_body(st, *batch)
 
         return jax.lax.scan(body, state, (xs, ys, ws))
 

@@ -227,11 +227,12 @@ class Trainer:
                 f"{cfg.train.epochs}). Set a positive epoch budget."
             )
         use_scan = cfg.train.use_scan
+        accum = max(1, cfg.train.grad_accum_steps)
         if use_scan:
-            epoch_train = make_epoch_train_step()
+            epoch_train = make_epoch_train_step(accum_steps=accum)
             epoch_eval = make_epoch_eval_step()
         else:
-            train_step = make_train_step()
+            train_step = make_train_step(accum_steps=accum)
             eval_step = make_eval_step()
 
         # Self-describing checkpoint meta: the FULL model config (whichever
@@ -279,43 +280,70 @@ class Trainer:
                 if use_scan:
                     with annotate("host_epoch_assembly"):
                         xs, ys, ws = self._stack_epoch(train_loader, epoch)
+                        if accum > 1:
+                            # Whole accumulation groups only; the ragged
+                            # tail (< accum batches) is dropped, like
+                            # drop_last on the group granularity.
+                            s_eff = (xs.shape[0] // accum) * accum
+                            xs, ys, ws = xs[:s_eff], ys[:s_eff], ws[:s_eff]
                         gxs, gys, gws = make_global_epoch(self.mesh, xs, ys, ws)
                     n_steps = xs.shape[0]
                     state, losses = epoch_train(state, gxs, gys, gws)
                     jax.block_until_ready(state.params)
                     epoch_stats = timer.stop(epoch, n_steps * global_batch)
                     losses_host = jax.device_get(losses)
-                    for i in range(n_steps):
+                    n_updates = len(losses_host)
+                    for i in range(n_updates):
                         if (global_step + i + 1) % cfg.train.log_every_n_steps == 0:
                             self.tracker.log_metrics(
                                 {"train_loss": float(losses_host[i])},
                                 step=global_step + i + 1,
                             )
-                    global_step += n_steps
+                    global_step += n_updates
                     # Reference parity: the logged train_loss is the
                     # EPOCH-AGGREGATED mean (Lightning epoch aggregation of
                     # jobs/train_lightning_ddp.py:70), not the last batch.
                     epoch_loss = float(losses_host.mean()) if n_steps else None
                 else:
+                    import numpy as _np
+
                     loss_sum = 0.0
                     n_steps = 0
+                    n_updates = 0
+                    pending: list = []
                     for batch in train_loader.epoch(epoch):
+                        pending.append(batch)
+                        if len(pending) < accum:
+                            continue
                         with annotate("host_batch_staging"):
-                            x, y, w = make_global_batch(
-                                self.mesh, batch.x, batch.y, batch.weight
-                            )
+                            if accum > 1:
+                                bx = _np.concatenate([b.x for b in pending])
+                                by = _np.concatenate([b.y for b in pending])
+                                bw = _np.concatenate(
+                                    [b.weight for b in pending]
+                                )
+                            else:
+                                bx, by, bw = (
+                                    pending[0].x, pending[0].y,
+                                    pending[0].weight,
+                                )
+                            x, y, w = make_global_batch(self.mesh, bx, by, bw)
+                        pending = []
                         state, metrics = train_step(state, x, y, w)
                         global_step += 1
-                        n_steps += 1
+                        n_steps += accum
+                        n_updates += 1
                         loss_host = float(jax.device_get(metrics["train_loss"]))
                         loss_sum += loss_host
                         if global_step % cfg.train.log_every_n_steps == 0:
                             self.tracker.log_metrics(
                                 {"train_loss": loss_host}, step=global_step
                             )
+                    # A ragged tail (< accum batches) is dropped, matching
+                    # the scan path's group-granular drop_last.
                     jax.block_until_ready(state.params)
                     epoch_stats = timer.stop(epoch, n_steps * global_batch)
-                    epoch_loss = loss_sum / n_steps if n_steps else None
+                    epoch_loss = loss_sum / n_updates if n_updates else None
 
                 if use_scan:
                     ls, accs, c = epoch_eval(state, *val_global)

@@ -142,6 +142,7 @@ def _seq_ckpt(tmp_path, name, seq_len=10, input_dim=5):
         "d_ff": 32,
         "n_experts": 4,
         "capacity_factor": 1.25,
+        "n_stages": 2,
         "num_classes": 2,
         "dropout": 0.0,
         "feature_names": [f"f{i}_norm" for i in range(input_dim)],
@@ -150,7 +151,11 @@ def _seq_ckpt(tmp_path, name, seq_len=10, input_dim=5):
     return model, params, path, meta
 
 
-@pytest.mark.parametrize("name", ["weather_gru", "weather_transformer", "weather_moe"])
+@pytest.mark.parametrize(
+    "name",
+    ["weather_gru", "weather_transformer", "weather_transformer_pp",
+     "weather_moe"],
+)
 def test_sequence_family_numpy_parity(tmp_path, rng, name):
     """Every deployable family's numpy inference must match the JAX model."""
     from dct_tpu.serving.runtime import forward_numpy
@@ -168,7 +173,11 @@ def test_sequence_family_numpy_parity(tmp_path, rng, name):
     np.testing.assert_allclose(np_logits, jax_logits, atol=2e-5)
 
 
-@pytest.mark.parametrize("name", ["weather_gru", "weather_transformer", "weather_moe"])
+@pytest.mark.parametrize(
+    "name",
+    ["weather_gru", "weather_transformer", "weather_transformer_pp",
+     "weather_moe"],
+)
 def test_sequence_family_score_py_end_to_end(tmp_path, rng, monkeypatch, name):
     _, _, ckpt, meta = _seq_ckpt(tmp_path, name)
     deploy = str(tmp_path / f"pkg_{name}")
