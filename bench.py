@@ -173,33 +173,12 @@ SCALED_BATCH = 16
 
 
 def _chip_peak_tflops() -> float | None:
-    """Best-effort bf16 peak per chip from the device kind; None when
-    unknown (mfu is then omitted). Override with DCT_PEAK_TFLOPS."""
-    import jax
+    """Peak bf16 TFLOPs per chip (dct_tpu.utils.profiling owns the table;
+    override with DCT_PEAK_TFLOPS)."""
+    from dct_tpu.utils.profiling import chip_peak_flops
 
-    env = os.environ.get("DCT_PEAK_TFLOPS")
-    if env:
-        return float(env)
-    kind = jax.devices()[0].device_kind.lower()
-    for pat, peak in (
-        ("v6", 918.0), ("v5p", 459.0), ("v5 lite", 197.0), ("v5e", 197.0),
-        ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
-    ):
-        if pat in kind:
-            return peak
-    return None
-
-
-def transformer_train_flops(cfg: dict, batch: int, input_dim: int) -> float:
-    """Analytic matmul FLOPs for ONE optimizer step (fwd + bwd ~ 3x fwd).
-    Counts projection/FFN GEMMs (2*params*tokens) and attention score/
-    value einsums (4*B*H*S^2*Dh per layer); elementwise ops excluded."""
-    d, ff = cfg["d_model"], cfg["d_ff"]
-    s, h, L = cfg["seq_len"], cfg["n_heads"], cfg["n_layers"]
-    tokens = batch * s
-    proj_params = L * (4 * d * d + 2 * d * ff) + input_dim * d + d * 2
-    fwd = 2.0 * proj_params * tokens + 4.0 * batch * h * s * s * (d // h) * L
-    return 3.0 * fwd
+    peak = chip_peak_flops()
+    return peak / 1e12 if peak else None
 
 
 def _time_step(step_fn, state, args, *, n: int = 8) -> float:
@@ -282,8 +261,12 @@ def bench_scaled_transformer() -> dict:
         state_fl = state.replace(apply_fn=build(flash_fn).apply)
         t_flash = _time_step(step, state_fl, (gx, gy, gw))
 
+    from dct_tpu.utils.profiling import transformer_train_flops
+
     t_best = min(t for t in (t_blockwise, t_flash) if t is not None)
-    flops = transformer_train_flops(scaled, batch, input_dim)
+    flops = transformer_train_flops(
+        batch=batch, input_dim=input_dim, **scaled
+    )
     peak = _chip_peak_tflops() if on_tpu else None
     out = {
         "config": {**scaled, "batch": batch, "dtype": "bfloat16"},

@@ -258,7 +258,23 @@ class Trainer:
         # Throughput accounting + optional one-epoch jax.profiler trace
         # (SURVEY §5.1: the reference installs TensorBoard but never writes
         # it — here the trace is real TB-compatible profile data).
-        timer = EpochTimer(n_chips=self.mesh.size)
+        from dct_tpu.utils.profiling import (
+            chip_peak_flops, transformer_train_flops,
+        )
+
+        flops_per_sample = None
+        if cfg.model.name in ("weather_transformer", "weather_transformer_pp"):
+            flops_per_sample = transformer_train_flops(
+                d_model=cfg.model.d_model, d_ff=cfg.model.d_ff,
+                seq_len=cfg.model.seq_len, n_heads=cfg.model.n_heads,
+                n_layers=cfg.model.n_layers, input_dim=data.input_dim,
+                batch=1, num_classes=cfg.model.num_classes,
+            )
+        timer = EpochTimer(
+            n_chips=self.mesh.size,
+            flops_per_sample=flops_per_sample,
+            peak_flops=chip_peak_flops(),
+        )
         profiler = Profiler(
             cfg.profile.trace_dir,
             enabled=cfg.profile.enabled,
@@ -359,17 +375,17 @@ class Trainer:
                     "val_acc": val_acc,
                 }
                 history.append(epoch_rec)
-                self.tracker.log_metrics(
-                    {
-                        "train_loss_epoch": epoch_rec["train_loss"],
-                        "val_loss": val_loss,
-                        "val_acc": val_acc,
-                        "epoch_time": epoch_stats.seconds,
-                        "samples_per_sec": epoch_stats.samples_per_sec,
-                        "samples_per_sec_per_chip": epoch_stats.samples_per_sec_per_chip,
-                    },
-                    step=global_step,
-                )
+                epoch_metrics = {
+                    "train_loss_epoch": epoch_rec["train_loss"],
+                    "val_loss": val_loss,
+                    "val_acc": val_acc,
+                    "epoch_time": epoch_stats.seconds,
+                    "samples_per_sec": epoch_stats.samples_per_sec,
+                    "samples_per_sec_per_chip": epoch_stats.samples_per_sec_per_chip,
+                }
+                if epoch_stats.mfu is not None:
+                    epoch_metrics["mfu"] = epoch_stats.mfu
+                self.tracker.log_metrics(epoch_metrics, step=global_step)
                 profiler.maybe_stop(epoch)
                 # Host-gather BEFORE the coordinator gate: with TP/SP
                 # spanning processes this is a collective every rank must

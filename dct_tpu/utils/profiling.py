@@ -79,6 +79,45 @@ class Profiler:
             self._active = False
 
 
+def chip_peak_flops() -> float | None:
+    """Best-effort bf16 peak FLOPs/sec per chip from the device kind
+    (None when unknown). Override with DCT_PEAK_TFLOPS."""
+    import jax
+
+    env = os.environ.get("DCT_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    kind = jax.devices()[0].device_kind.lower()
+    for pat, peak_t in (
+        ("v6", 918.0), ("v5p", 459.0), ("v5 lite", 197.0), ("v5e", 197.0),
+        ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+    ):
+        if pat in kind:
+            return peak_t * 1e12
+    return None
+
+
+def transformer_train_flops(
+    *, d_model: int, d_ff: int, seq_len: int, n_heads: int, n_layers: int,
+    input_dim: int, batch: int, num_classes: int = 2,
+) -> float:
+    """Analytic matmul FLOPs for ONE transformer optimizer step
+    (fwd + bwd ~ 3x fwd): projection/FFN GEMMs at 2*params*tokens plus
+    the attention score/value einsums (4*B*H*S^2*Dh per layer);
+    elementwise work excluded. Used for MFU = this / step_time / peak."""
+    tokens = batch * seq_len
+    proj_params = (
+        n_layers * (4 * d_model * d_model + 2 * d_model * d_ff)
+        + input_dim * d_model + d_model * num_classes
+    )
+    fwd = (
+        2.0 * proj_params * tokens
+        + 4.0 * batch * n_heads * seq_len * seq_len
+        * (d_model // n_heads) * n_layers
+    )
+    return 3.0 * fwd
+
+
 @dataclass
 class EpochStats:
     epoch: int
@@ -86,6 +125,9 @@ class EpochStats:
     samples: int
     samples_per_sec: float
     samples_per_sec_per_chip: float
+    # Model-FLOPs utilization (achieved/peak); None when the analytic
+    # FLOPs or the chip peak are unknown (e.g. MLP family, CPU rig).
+    mfu: float | None = None
 
 
 @dataclass
@@ -98,6 +140,10 @@ class EpochTimer:
     """
 
     n_chips: int = 1
+    # Analytic train FLOPs per SAMPLE (transformer_train_flops(batch=1));
+    # with the chip peak this turns throughput into per-epoch MFU.
+    flops_per_sample: float | None = None
+    peak_flops: float | None = None
     history: list = field(default_factory=list)
     _t0: float = 0.0
 
@@ -107,12 +153,19 @@ class EpochTimer:
     def stop(self, epoch: int, samples: int) -> EpochStats:
         dt = time.perf_counter() - self._t0
         sps = samples / dt if dt > 0 else 0.0
+        mfu = None
+        if self.flops_per_sample and self.peak_flops:
+            mfu = (
+                sps / max(self.n_chips, 1) * self.flops_per_sample
+                / self.peak_flops
+            )
         stats = EpochStats(
             epoch=epoch,
             seconds=dt,
             samples=samples,
             samples_per_sec=sps,
             samples_per_sec_per_chip=sps / max(self.n_chips, 1),
+            mfu=mfu,
         )
         self.history.append(stats)
         return stats

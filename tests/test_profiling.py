@@ -108,3 +108,30 @@ def test_trainer_emits_trace_and_throughput(weather_data, tmp_path):
     assert glob.glob(
         os.path.join(str(tmp_path / "trace"), "plugins", "profile", "*", "*")
     )
+
+
+def test_epoch_timer_mfu_accounting():
+    """MFU = per-chip samples/sec x analytic FLOPs/sample / chip peak;
+    None when either input is unknown (MLP family, CPU rig)."""
+    from dct_tpu.utils.profiling import EpochTimer
+
+    t = EpochTimer(n_chips=2, flops_per_sample=1e9, peak_flops=1e12)
+    t.start()
+    stats = t.stop(0, samples=100)
+    assert stats.mfu is not None
+    expected = stats.samples_per_sec_per_chip * 1e9 / 1e12
+    assert abs(stats.mfu - expected) < 1e-9
+
+    t2 = EpochTimer(n_chips=2)
+    t2.start()
+    assert t2.stop(0, samples=100).mfu is None
+
+
+def test_transformer_flops_scales_linearly_in_batch():
+    from dct_tpu.utils.profiling import transformer_train_flops
+
+    kw = dict(d_model=64, d_ff=128, seq_len=32, n_heads=4, n_layers=2,
+              input_dim=5)
+    one = transformer_train_flops(batch=1, **kw)
+    eight = transformer_train_flops(batch=8, **kw)
+    assert abs(eight - 8 * one) < 1e-6 * eight
