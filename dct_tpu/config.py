@@ -88,6 +88,8 @@ class ModelConfig:
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.01
     moe_dispatch: str = "auto"
+    # 1 = switch (top-1); 2+ = GShard-style top-k with normalized gates.
+    router_top_k: int = 1
     # Pipeline-parallel family (weather_transformer_pp): stage count over
     # the mesh's ``pipe`` axis; microbatches default to the stage count.
     n_stages: int = 2
@@ -111,6 +113,7 @@ class ModelConfig:
             "DCT_ROUTER_AUX_WEIGHT", c.router_aux_weight, float
         )
         c.moe_dispatch = _env("DCT_MOE_DISPATCH", c.moe_dispatch, str)
+        c.router_top_k = _env("DCT_ROUTER_TOP_K", c.router_top_k, int)
         c.n_stages = _env("DCT_N_STAGES", c.n_stages, int)
         mb = os.environ.get("DCT_N_MICROBATCHES")
         c.n_microbatches = int(mb) if mb else c.n_microbatches

@@ -119,6 +119,13 @@ class MoEFFN(nn.Module):
     ``mesh`` routes the sorted engine through its shard_map/all_to_all
     path when the ``model`` (expert) axis — or any token axis — is
     populated; without a mesh the engine runs single-shard.
+
+    ``top_k``: 1 = switch routing (raw top prob as gate); k > 1 =
+    GShard-style top-k — each token goes to its k best experts with
+    gates normalized over the k choices, expressed as k*N dispatch
+    entries ordered choice-major so first choices win capacity slots
+    before any second choice. Capacity scales with k
+    (``cf * k * N / E``).
     """
 
     d_model: int
@@ -129,21 +136,35 @@ class MoEFFN(nn.Module):
     dtype: jnp.dtype = jnp.float32
     dispatch: str = "auto"
     mesh: object = None
+    top_k: int = 1
 
     @nn.compact
     def __call__(self, x):  # [B, S, D] -> [B, S, D]
         b, s, d = x.shape
         n = b * s
         e = self.n_experts
-        capacity = max(1, int(self.capacity_factor * n / e))
+        k = self.top_k
+        if not 1 <= k <= e:
+            raise ValueError(f"top_k={k} must be in [1, n_experts={e}]")
+        capacity = max(1, int(self.capacity_factor * k * n / e))
         tokens = x.reshape(n, d)
 
         logits = TorchStyleDense(e, dtype=jnp.float32, name="router")(
             jnp.asarray(tokens, jnp.float32)
         )  # [N, E] — routing in f32: tiny matmul, decides everything
         probs = jax.nn.softmax(logits, axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)  # [N]
-        gate = jnp.max(probs, axis=-1)  # [N]
+        if k == 1:
+            expert_choice = jnp.argmax(probs, axis=-1)[None, :]  # [1, N]
+            gate_choice = jnp.max(probs, axis=-1)[None, :]
+        else:
+            topv, topi = jax.lax.top_k(probs, k)  # [N, k]
+            gates = topv / jnp.maximum(
+                topv.sum(axis=-1, keepdims=True), 1e-9
+            )
+            expert_choice = topi.T  # [k, N], choice-major
+            gate_choice = gates.T
+        expert_idx = expert_choice[0]  # first choice: aux loss + einsum path
+        gate = gate_choice[0]
 
         onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [N, E]
 
@@ -190,11 +211,17 @@ class MoEFFN(nn.Module):
         wi, bi = jnp.asarray(w_in, ct), jnp.asarray(b_in, ct)
         wo, bo = jnp.asarray(w_out, ct), jnp.asarray(b_out, ct)
 
+        # Flat dispatch entries, choice-major ([all 1st choices; all 2nd
+        # choices; ...]): a stable sort / cumsum over this order gives
+        # first choices capacity priority, the GShard convention.
+        flat_idx = expert_choice.reshape(k * n).astype(jnp.int32)
+        flat_gate = jnp.asarray(gate_choice.reshape(k * n), ct)
+
         engine = self.dispatch
         if engine == "auto":
-            # One-hot dispatch materializes [N, E, C] twice; past ~2^21
+            # One-hot dispatch materializes [kN, E, C] twice; past ~2^21
             # elements the sort-based engine wins on both memory and time.
-            engine = "sorted" if n * e * capacity >= (1 << 21) else "einsum"
+            engine = "sorted" if k * n * e * capacity >= (1 << 21) else "einsum"
         mesh = self.mesh
         if engine == "sorted" and mesh is not None:
             dp = mesh.shape.get("data", 1)
@@ -221,63 +248,80 @@ class MoEFFN(nn.Module):
                     engine = "einsum"  # auto: fall back rather than fail
             elif sharded:
                 out = self._sorted_sharded(
-                    jnp.asarray(x, ct), expert_idx.reshape(b, s),
-                    jnp.asarray(gate, ct).reshape(b, s),
+                    jnp.asarray(x, ct),
+                    expert_choice.reshape(k, b, s),
+                    jnp.asarray(gate_choice, ct).reshape(k, b, s),
                     wi, bi, wo, bo, mesh=mesh, dp=dp, sp=sp, ep=ep,
                 )
                 return out
 
+        toks_ct = jnp.asarray(tokens, ct)
         if engine == "sorted":
-            out = _sorted_moe(
-                jnp.asarray(tokens, ct), expert_idx.astype(jnp.int32),
-                jnp.asarray(gate, ct), wi, bi, wo, bo,
+            flat_tokens = jnp.tile(toks_ct, (k, 1)) if k > 1 else toks_ct
+            out2 = _sorted_moe(
+                flat_tokens, flat_idx, flat_gate, wi, bi, wo, bo,
                 e_total=e, capacity=capacity,
             )
+            out = out2.reshape(k, n, d).sum(axis=0) if k > 1 else out2
             return out.reshape(b, s, d)
 
-        # Slot of each token within its expert (arrival order).
-        position = jnp.cumsum(onehot, axis=0) - onehot  # [N, E]
-        keep = (position < capacity).astype(jnp.float32) * onehot
+        # Slot of each entry within its expert (arrival order over the
+        # choice-major flat entries).
+        onehot_f = jax.nn.one_hot(flat_idx, e, dtype=jnp.float32)
+        position = jnp.cumsum(onehot_f, axis=0) - onehot_f  # [kN, E]
+        keep = (position < capacity).astype(jnp.float32) * onehot_f
         slot = jax.nn.one_hot(
-            jnp.sum(position * onehot, axis=-1).astype(jnp.int32),
+            jnp.sum(position * onehot_f, axis=-1).astype(jnp.int32),
             capacity,
             dtype=jnp.float32,
-        )  # [N, C]
-        dispatch = keep[:, :, None] * slot[:, None, :]  # [N, E, C]
+        )  # [kN, C]
+        dispatch = keep[:, :, None] * slot[:, None, :]  # [kN, E, C]
 
         disp = jnp.asarray(dispatch, ct)
-        toks = jnp.asarray(tokens, ct)
+        toks = jnp.tile(toks_ct, (k, 1)) if k > 1 else toks_ct
         expert_in = jnp.einsum("nec,nd->ecd", disp, toks)  # [E, C, D]
         h = jnp.einsum("ecd,edf->ecf", expert_in, wi)
         h = nn.gelu(h + bi[:, None, :])
         out_e = jnp.einsum("ecf,efd->ecd", h, wo)
         out_e = out_e + bo[:, None, :]
-        out = jnp.einsum("nec,ecd->nd", disp, out_e)
-        out = out * jnp.asarray(gate, ct)[:, None]
+        out2 = jnp.einsum("nec,ecd->nd", disp, out_e)
+        out2 = out2 * flat_gate[:, None]
+        out = out2.reshape(k, n, d).sum(axis=0) if k > 1 else out2
         return out.reshape(b, s, d)
 
-    def _sorted_sharded(self, x, expert_idx, gate, wi, bi, wo, bo, *,
-                        mesh, dp: int, sp: int, ep: int):
+    def _sorted_sharded(self, x, expert_choice, gate_choice, wi, bi, wo,
+                        bo, *, mesh, dp: int, sp: int, ep: int):
         """Sorted dispatch under the mesh: shard_map over (data, seq,
         model). Each model-rank routes its 1/ep slice of the local tokens
         (expert compute is SHARDED, not replicated), exchanges expert
         buffers with lax.all_to_all, and all-gathers the combined outputs
-        back to replicated-over-model activations."""
+        back to replicated-over-model activations. ``expert_choice`` /
+        ``gate_choice`` are [k, B, S] (k routing choices per token)."""
         b, s, d = x.shape
         e = self.n_experts
+        k = expert_choice.shape[0]
         n_local = (b // dp) * (s // sp)
         chunk = n_local // ep
-        cap = max(1, int(self.capacity_factor * chunk / e))
+        cap = max(1, int(self.capacity_factor * k * chunk / e))
 
         def body(xb, ei, gt, wi, bi, wo, bo):
             toks = xb.reshape(-1, d)
-            ei = ei.reshape(-1).astype(jnp.int32)
-            gt = gt.reshape(-1)
+            ei = ei.reshape(k, -1).astype(jnp.int32)
+            gt = gt.reshape(k, -1)
             r = lax.axis_index("model")
-            my = lambda a: lax.dynamic_slice_in_dim(a, r * chunk, chunk, 0)
-            out_my = _sorted_moe(
-                my(toks), my(ei), my(gt), wi, bi, wo, bo,
+            tok_my = lax.dynamic_slice_in_dim(toks, r * chunk, chunk, 0)
+            ei_my = lax.dynamic_slice_in_dim(ei, r * chunk, chunk, 1)
+            gt_my = lax.dynamic_slice_in_dim(gt, r * chunk, chunk, 1)
+            flat_tokens = (
+                jnp.tile(tok_my, (k, 1)) if k > 1 else tok_my
+            )
+            out2 = _sorted_moe(
+                flat_tokens, ei_my.reshape(k * chunk),
+                gt_my.reshape(k * chunk), wi, bi, wo, bo,
                 e_total=e, capacity=cap, ep_axis="model",
+            )
+            out_my = (
+                out2.reshape(k, chunk, d).sum(axis=0) if k > 1 else out2
             )
             out = lax.all_gather(out_my, "model", axis=0, tiled=True)
             return out.reshape(xb.shape)
@@ -290,13 +334,14 @@ class MoEFFN(nn.Module):
             body,
             mesh=mesh,
             in_specs=(
-                P("data", "seq", None), P("data", "seq"), P("data", "seq"),
+                P("data", "seq", None),
+                P(None, "data", "seq"), P(None, "data", "seq"),
                 P("model", None, None), P("model", None),
                 P("model", None, None), P("model", None),
             ),
             out_specs=P("data", "seq", None),
             check_vma=False,
-        )(x, expert_idx, gate, wi, bi, wo, bo)
+        )(x, expert_choice, gate_choice, wi, bi, wo, bo)
 
 
 class MoEBlock(nn.Module):
@@ -311,6 +356,7 @@ class MoEBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     dispatch: str = "auto"
     mesh: object = None
+    top_k: int = 1
 
     @nn.compact
     def __call__(self, x, *, train: bool):
@@ -325,7 +371,8 @@ class MoEBlock(nn.Module):
         h = MoEFFN(
             self.d_model, self.d_ff, self.n_experts, self.capacity_factor,
             aux_weight=self.aux_weight, dtype=self.dtype,
-            dispatch=self.dispatch, mesh=self.mesh, name="moe",
+            dispatch=self.dispatch, mesh=self.mesh, top_k=self.top_k,
+            name="moe",
         )(h)
         h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
         return x + h
@@ -349,6 +396,7 @@ class WeatherMoE(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
     dispatch: str = "auto"
     mesh: object = None
+    top_k: int = 1
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -373,6 +421,7 @@ class WeatherMoE(nn.Module):
                 dtype=self.compute_dtype,
                 dispatch=self.dispatch,
                 mesh=self.mesh,
+                top_k=self.top_k,
                 name=f"block_{i}",
             )(h, train=train)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)

@@ -109,6 +109,7 @@ def _build_moe(
         compute_dtype=compute_dtype or jnp.float32,
         dispatch=cfg.moe_dispatch,
         mesh=mesh,
+        top_k=cfg.router_top_k,
     )
 
 

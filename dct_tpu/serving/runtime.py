@@ -210,9 +210,10 @@ def transformer_pp_forward_numpy(
 
 
 def _moe_ffn_numpy(weights: dict, prefix: str, h: np.ndarray,
-                   capacity_factor: float) -> np.ndarray:
-    """Switch (top-1) MoE inference matching dct_tpu.models.moe.MoEFFN:
-    same routing, capacity, and drop semantics as training."""
+                   capacity_factor: float, top_k: int = 1) -> np.ndarray:
+    """MoE inference matching dct_tpu.models.moe.MoEFFN: same routing
+    (switch top-1 or GShard top-k with normalized gates), capacity, and
+    choice-major arrival-order drop semantics as training."""
     b, s, d = h.shape
     n = b * s
     tokens = h.reshape(n, d)
@@ -221,32 +222,42 @@ def _moe_ffn_numpy(weights: dict, prefix: str, h: np.ndarray,
     ]
     probs = softmax_numpy(logits)
     e = probs.shape[-1]
-    capacity = max(1, int(capacity_factor * n / e))
-    expert_idx = np.argmax(probs, axis=-1)
-    gate = np.max(probs, axis=-1)
+    capacity = max(1, int(capacity_factor * top_k * n / e))
+    if top_k == 1:
+        expert_choice = np.argmax(probs, axis=-1)[None, :]
+        gate_choice = np.max(probs, axis=-1)[None, :]
+    else:
+        topi = np.argsort(-probs, axis=-1)[:, :top_k]  # [N, k] best-first
+        topv = np.take_along_axis(probs, topi, axis=-1)
+        gates = topv / np.maximum(topv.sum(axis=-1, keepdims=True), 1e-9)
+        expert_choice = topi.T
+        gate_choice = gates.T
+    flat_idx = expert_choice.reshape(top_k * n)
+    flat_gate = gate_choice.reshape(top_k * n)
 
-    out = np.zeros_like(tokens)
+    out2 = np.zeros((top_k * n, d), tokens.dtype)
     w_in = weights[f"{prefix}/experts_in_kernel"]
     b_in = weights[f"{prefix}/experts_in_bias"]
     w_out = weights[f"{prefix}/experts_out_kernel"]
     b_out = weights[f"{prefix}/experts_out_bias"]
     for ex in range(e):
-        ids = np.nonzero(expert_idx == ex)[0][:capacity]  # arrival order
+        ids = np.nonzero(flat_idx == ex)[0][:capacity]  # choice-major order
         if ids.size == 0:
             continue
-        t = tokens[ids]
+        t = tokens[ids % n]
         a = _gelu_tanh(t @ w_in[ex] + b_in[ex])
-        out[ids] = (a @ w_out[ex] + b_out[ex]) * gate[ids, None]
-    return out.reshape(b, s, d)
+        out2[ids] = (a @ w_out[ex] + b_out[ex]) * flat_gate[ids, None]
+    return out2.reshape(top_k, n, d).sum(axis=0).reshape(b, s, d)
 
 
 def moe_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
     """MoE encoder inference (same skeleton as the transformer, with the
     dense FFN replaced by the switch-routed expert mixture)."""
     capacity_factor = float(meta.get("capacity_factor", 1.25))
+    top_k = int(meta.get("router_top_k", 1))
 
     def moe_ffn(w, pre, f):
-        return _moe_ffn_numpy(w, f"{pre}/moe", f, capacity_factor)
+        return _moe_ffn_numpy(w, f"{pre}/moe", f, capacity_factor, top_k)
 
     return _encoder_numpy(weights, meta, x, moe_ffn)
 

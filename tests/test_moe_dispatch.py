@@ -155,3 +155,77 @@ def test_auto_falls_back_when_untileable():
     params = f.init(jax.random.PRNGKey(0), x)
     out = f.apply(params, x, mutable=["aux_loss"])[0]
     assert out.shape == x.shape
+
+
+def _ffn_k(dispatch, k, mesh=None, capacity_factor=8.0):
+    return MoEFFN(
+        d_model=16, d_ff=32, n_experts=4, capacity_factor=capacity_factor,
+        dispatch=dispatch, mesh=mesh, top_k=k,
+    )
+
+
+def test_top2_sorted_matches_einsum(rng):
+    x = jnp.asarray(rng.standard_normal((4, 8, 16)), jnp.float32)
+    fe = _ffn_k("einsum", 2)
+    params = fe.init(jax.random.PRNGKey(4), x)
+    out_e = fe.apply(params, x, mutable=["aux_loss"])[0]
+    out_s = _ffn_k("sorted", 2).apply(params, x, mutable=["aux_loss"])[0]
+    np.testing.assert_allclose(
+        np.asarray(out_s), np.asarray(out_e), atol=1e-5
+    )
+
+
+def test_top2_sharded_matches_local(rng):
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    x = jnp.asarray(rng.standard_normal((4, 8, 16)), jnp.float32)
+    f_local = _ffn_k("sorted", 2)
+    params = f_local.init(jax.random.PRNGKey(5), x)
+    out_local = f_local.apply(params, x, mutable=["aux_loss"])[0]
+    out_shard = _ffn_k("sorted", 2, mesh=mesh).apply(
+        params, x, mutable=["aux_loss"]
+    )[0]
+    np.testing.assert_allclose(
+        np.asarray(out_shard), np.asarray(out_local), atol=1e-5
+    )
+
+
+def test_top2_differs_from_top1(rng):
+    """k=2 must actually mix two experts (not silently behave as k=1)."""
+    x = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+    f1 = _ffn_k("einsum", 1)
+    params = f1.init(jax.random.PRNGKey(6), x)
+    out1 = f1.apply(params, x, mutable=["aux_loss"])[0]
+    out2 = _ffn_k("einsum", 2).apply(params, x, mutable=["aux_loss"])[0]
+    assert float(jnp.abs(out1 - out2).max()) > 1e-6
+
+
+def test_top2_rejects_bad_k():
+    f = MoEFFN(d_model=16, d_ff=32, n_experts=4, top_k=5)
+    with pytest.raises(ValueError, match="top_k"):
+        f.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 16), jnp.float32))
+
+
+def test_top2_serving_numpy_parity(rng, tmp_path):
+    """The deployed numpy runtime reproduces top-2 routing end to end."""
+    from dct_tpu.serving.runtime import forward_numpy
+    from dct_tpu.serving.score_gen import _flatten_params
+
+    cfg = ModelConfig(
+        name="weather_moe", seq_len=8, d_model=16, n_heads=2, n_layers=2,
+        d_ff=32, n_experts=4, router_top_k=2, dropout=0.0,
+        capacity_factor=8.0,
+    )
+    model = get_model(cfg, input_dim=5)
+    variables = model.init(jax.random.PRNGKey(7), jnp.zeros((1, 8, 5)))
+    params = {"params": variables["params"]}
+    x = rng.standard_normal((3, 8, 5)).astype(np.float32)
+    jax_logits = np.asarray(model.apply(params, jnp.asarray(x), train=False))
+    weights = _flatten_params(params["params"])
+    meta = {
+        "model": "weather_moe", "input_dim": 5, "seq_len": 8,
+        "d_model": 16, "n_heads": 2, "n_layers": 2, "d_ff": 32,
+        "n_experts": 4, "capacity_factor": 8.0, "router_top_k": 2,
+        "num_classes": 2,
+    }
+    np_logits = forward_numpy(weights, meta, x)
+    np.testing.assert_allclose(np_logits, jax_logits, atol=2e-5)
