@@ -105,3 +105,66 @@ def test_tp_across_processes_trains_and_checkpoints(processed_dir, tmp_path):
         str(tmp_path / "r_tp" / "weather_forecasting" / "*" / "metrics.jsonl")
     )
     assert len(runs) == 2
+
+
+@pytest.mark.slow
+def test_ep_all_to_all_across_processes(processed_dir, tmp_path):
+    """Expert parallelism SPANNING processes: the sorted dispatch engine's
+    lax.all_to_all crosses a real process boundary (2 jax.distributed CPU
+    procs, one device each, experts split over the model axis), and the
+    loss trajectory matches the single-process sorted engine (ample
+    capacity -> no drops -> parallelism is layout, not math)."""
+    import glob as _glob
+    import json as _json
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(world_size, mesh_model, models_sub, runs_sub):
+        env = {
+            "PALLAS_AXON_POOL_IPS": "",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "DCT_PROCESSED_DIR": processed_dir,
+            "DCT_MODELS_DIR": str(tmp_path / models_sub),
+            "DCT_TRACKING_DIR": str(tmp_path / runs_sub),
+            "DCT_MODEL": "weather_moe",
+            "DCT_SEQ_LEN": "8",
+            "DCT_D_MODEL": "16",
+            "DCT_N_HEADS": "2",
+            "DCT_N_LAYERS": "1",
+            "DCT_D_FF": "32",
+            "DCT_N_EXPERTS": "4",
+            "DCT_MOE_DISPATCH": "sorted",
+            "DCT_CAPACITY_FACTOR": "8.0",
+            "DCT_EPOCHS": "1",
+            "DCT_BATCH_SIZE": "16",
+            "DCT_BF16_COMPUTE": "0",
+            "DCT_MESH_MODEL": str(mesh_model),
+            "DCT_MESH_DATA": "1",
+            "DCT_RESUME": "0",
+        }
+        launcher = LocalProcessLauncher(
+            coordinator_port=29534, stagger_seconds=1.0, timeout=300
+        )
+        results = launcher.launch(
+            [sys.executable, os.path.join(repo, "jobs", "train_tpu.py")],
+            world_size=world_size,
+            env=env,
+        )
+        assert LocalProcessLauncher.all_succeeded(results), results
+        runs = sorted(
+            _glob.glob(
+                str(tmp_path / runs_sub / "weather_forecasting" / "*" / "metrics.jsonl")
+            ),
+            key=os.path.getmtime,
+        )
+        assert runs
+        last = {}
+        with open(runs[-1]) as f:
+            for line in f:
+                last.update(_json.loads(line))
+        return last
+
+    m_ep = run(2, 2, "m_ep", "r_ep")
+    m_ref = run(1, 1, "m_ep_ref", "r_ep_ref")
+    assert abs(m_ep["val_loss"] - m_ref["val_loss"]) < 1e-3, (m_ep, m_ref)
