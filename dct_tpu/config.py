@@ -134,6 +134,12 @@ class TrainConfig:
     # size, matching the reference's per-rank DataLoader(batch_size=4).
     batch_size: int = 4
     lr: float = 0.01
+    # LR schedule: 'constant' (reference parity) or 'cosine'; optional
+    # linear warmup. decay_steps 0 = auto (the run's total update count).
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    decay_steps: int = 0
+    end_lr_fraction: float = 0.0
     seed: int = 42
     log_every_n_steps: int = 5
     # Improvement over the reference (which never resumes,
@@ -160,6 +166,12 @@ class TrainConfig:
         c.epochs = _env("DCT_EPOCHS", c.epochs, int)
         c.batch_size = _env("DCT_BATCH_SIZE", c.batch_size, int)
         c.lr = _env("DCT_LR", c.lr, float)
+        c.lr_schedule = _env("DCT_LR_SCHEDULE", c.lr_schedule, str)
+        c.warmup_steps = _env("DCT_WARMUP_STEPS", c.warmup_steps, int)
+        c.decay_steps = _env("DCT_DECAY_STEPS", c.decay_steps, int)
+        c.end_lr_fraction = _env(
+            "DCT_END_LR_FRACTION", c.end_lr_fraction, float
+        )
         c.seed = _env("DCT_SEED", c.seed, int)
         c.log_every_n_steps = _env("DCT_LOG_EVERY_N_STEPS", c.log_every_n_steps, int)
         c.resume = _env("DCT_RESUME", c.resume, bool)

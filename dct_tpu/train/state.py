@@ -36,9 +36,42 @@ class TrainState:
         )
 
 
+def make_lr_schedule(
+    lr: float, *, schedule: str = "constant", warmup_steps: int = 0,
+    decay_steps: int = 0, end_lr_fraction: float = 0.0,
+):
+    """Learning-rate schedule factory (the reference has only a fixed
+    ``Adam(lr=0.01)``, jobs/train_lightning_ddp.py:88 — 'constant' keeps
+    that parity default).
+
+    - ``constant``: fixed ``lr`` (optional linear warmup).
+    - ``cosine``: optional linear warmup, then cosine decay over
+      ``decay_steps`` post-warmup steps down to ``lr*end_lr_fraction``.
+    """
+    if schedule == "constant":
+        if warmup_steps > 0:
+            return optax.linear_schedule(0.0, lr, warmup_steps)
+        return lr
+    if schedule == "cosine":
+        if decay_steps <= 0:
+            raise ValueError("cosine schedule needs decay_steps > 0")
+        cos = optax.cosine_decay_schedule(
+            lr, decay_steps, alpha=end_lr_fraction
+        )
+        if warmup_steps > 0:
+            return optax.join_schedules(
+                [optax.linear_schedule(0.0, lr, warmup_steps), cos],
+                [warmup_steps],
+            )
+        return cos
+    raise ValueError(
+        f"Unknown lr schedule '{schedule}' (expected constant|cosine)"
+    )
+
+
 def create_train_state(
     model, *, input_dim: int, lr: float, seed: int,
-    example_shape: tuple | None = None,
+    example_shape: tuple | None = None, lr_schedule=None,
 ) -> TrainState:
     """Initialize params (torch-matching init lives in the model) and Adam.
 
@@ -47,7 +80,9 @@ def create_train_state(
     ``Adam(self.parameters(), lr=0.01)`` (jobs/train_lightning_ddp.py:88).
 
     ``example_shape`` defaults to the MLP's ``(1, input_dim)`` row; sequence
-    models pass ``(1, seq_len, input_dim)``.
+    models pass ``(1, seq_len, input_dim)``. ``lr_schedule`` (an optax
+    schedule or float) overrides the flat ``lr``; resume restores the
+    optimizer step count, so schedules continue where they left off.
     """
     root = jax.random.PRNGKey(seed)
     init_key, dropout_key = jax.random.split(root)
@@ -59,7 +94,7 @@ def create_train_state(
     # (e.g. MoE load-balance losses) into other collections during init,
     # which must not enter the optimizer.
     params = {"params": variables["params"]}
-    tx = optax.adam(learning_rate=lr)
+    tx = optax.adam(learning_rate=lr_schedule if lr_schedule is not None else lr)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,

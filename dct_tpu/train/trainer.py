@@ -148,9 +148,31 @@ class Trainer:
                 cfg.model, input_dim=data.input_dim, compute_dtype=compute_dtype
             )
             example_shape = None
+        lr_schedule = None
+        if cfg.train.lr_schedule != "constant" or cfg.train.warmup_steps > 0:
+            from dct_tpu.train.state import make_lr_schedule
+
+            decay = cfg.train.decay_steps
+            if cfg.train.lr_schedule == "cosine" and decay <= 0:
+                # Auto: decay over this run's total update count.
+                decay = max(
+                    1,
+                    cfg.train.epochs
+                    * (train_loader.num_batches
+                       // max(1, cfg.train.grad_accum_steps))
+                    - cfg.train.warmup_steps,
+                )
+            lr_schedule = make_lr_schedule(
+                cfg.train.lr,
+                schedule=cfg.train.lr_schedule,
+                warmup_steps=cfg.train.warmup_steps,
+                decay_steps=decay,
+                end_lr_fraction=cfg.train.end_lr_fraction,
+            )
         state = create_train_state(
             model, input_dim=data.input_dim, lr=cfg.train.lr,
             seed=cfg.train.seed, example_shape=example_shape,
+            lr_schedule=lr_schedule,
         )
         # Name-pattern rules: tensor-parallel placement for the transformer
         # family, full replication for the MLP (no patterns match). TP/SP
