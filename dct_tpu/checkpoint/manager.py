@@ -211,10 +211,16 @@ class TrainStateCheckpointer:
         end up unreadable. This tier is host-local numpy by construction
         and needs zero cross-process coordination.
         """
-        # Flatten to an index-keyed dict: optax opt_states contain
-        # namedtuples that do not round-trip through generic tree
-        # serialization; the target treedef at restore time supplies the
-        # structure instead.
+        self.wait()
+        return self._publish(self._entries(state), meta)
+
+    def _entries(self, state) -> dict:
+        """Device state -> host {key: ndarray} dict (the npz payload).
+
+        Flattened to an index-keyed dict: optax opt_states contain
+        namedtuples that do not round-trip through generic tree
+        serialization; the target treedef at restore time supplies the
+        structure instead."""
         leaves = jax.tree.leaves(self._tree(state))
         entries: dict[str, np.ndarray] = {}
         for i, leaf in enumerate(leaves):
@@ -229,6 +235,10 @@ class TrainStateCheckpointer:
                     entries[f"{i}_s{off}"] = np.asarray(s.data)
             else:
                 entries[str(i)] = np.asarray(jax.device_get(leaf))
+        return entries
+
+    def _publish(self, entries: dict, meta: dict | None = None) -> str:
+        """Write ``entries`` (+ meta) into state.next, then rotate."""
         import shutil
 
         next_dir = self._dir(self._NEXT)
@@ -261,10 +271,38 @@ class TrainStateCheckpointer:
             shutil.rmtree(old)
         return live
 
+    def save_async(self, state, meta: dict | None = None) -> None:
+        """Overlap the checkpoint write with the next epoch's compute: the
+        device->host snapshot happens NOW (the worker must not touch
+        device arrays a donated train step may alias next epoch), and the
+        npz write + rotation run on a worker thread. At most one write is
+        in flight — a second call joins the first, so the rotation
+        protocol's invariants hold unchanged. Call :meth:`wait` (or any
+        ``save``/``restore``) before reading the checkpoint back."""
+        import threading
+
+        self.wait()
+        entries = self._entries(state)
+
+        def work():
+            self._publish(entries, meta)
+
+        self._pending = threading.Thread(target=work, daemon=True)
+        self._pending.start()
+
+    def wait(self) -> None:
+        """Join any in-flight async write."""
+        t = getattr(self, "_pending", None)
+        if t is not None:
+            t.join()
+            self._pending = None
+
     def load_meta(self) -> dict:
         """Run facts saved beside the newest restorable checkpoint
         (empty dict when the checkpoint predates meta support)."""
         import json
+
+        self.wait()
 
         for d in self._restore_candidates():
             path = os.path.join(d, "meta.json")
@@ -275,6 +313,7 @@ class TrainStateCheckpointer:
         return {}
 
     def exists(self) -> bool:
+        self.wait()
         # A readable checkpoint, or a dir in an unreadable (legacy) format
         # — the latter must route resume into restore()'s loud error, not
         # a silent from-scratch restart that overwrites the old progress.
@@ -316,6 +355,7 @@ class TrainStateCheckpointer:
         (apply_fn/tx kept). Whole-saved leaves come back as host numpy;
         shard-saved leaves are reassembled onto this process's devices
         under the template leaf's sharding."""
+        self.wait()
         candidates = self._restore_candidates()
         if not candidates:
             legacy = [

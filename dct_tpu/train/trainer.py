@@ -424,8 +424,10 @@ class Trainer:
                     )
                 # Every process keeps its own resume state (host-local
                 # disk) plus the run facts the next run's continuation
-                # semantics are decided from.
-                state_ckptr.save(
+                # semantics are decided from. The write overlaps the next
+                # epoch's compute (device->host snapshot is synchronous;
+                # the npz/rotation runs on a worker thread).
+                state_ckptr.save_async(
                     state,
                     meta={
                         "epochs_completed": epoch + 1,
@@ -434,8 +436,10 @@ class Trainer:
                 )
 
         finally:
-            # Crash-path hygiene: never leave a jax.profiler session open.
+            # Crash-path hygiene: never leave a jax.profiler session open
+            # or a resume-state write un-joined.
             profiler.close()
+            state_ckptr.wait()
 
         # Rank-0 post-train artifact upload, mirroring
         # jobs/train_lightning_ddp.py:146-164 (best, else last.ckpt fallback).

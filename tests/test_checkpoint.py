@@ -101,3 +101,31 @@ def test_train_state_resume(tmp_path, rng):
         jax.device_get(cont_a.params),
         jax.device_get(cont_b.params),
     )
+
+
+def test_async_save_overlaps_and_rotates(tmp_path):
+    """save_async publishes identical content to save, keeps the rotation
+    invariants under back-to-back saves, and restore/exists join the
+    in-flight write."""
+    from dct_tpu.checkpoint.manager import TrainStateCheckpointer
+    from dct_tpu.config import ModelConfig
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.train.state import create_train_state
+
+    model = get_model(ModelConfig(dropout=0.0), input_dim=5)
+    state = create_train_state(model, input_dim=5, lr=0.01, seed=0)
+    ck = TrainStateCheckpointer(str(tmp_path))
+    ck.save_async(state, meta={"epochs_completed": 1, "target_epochs": 3})
+    ck.save_async(
+        state.replace(step=state.step + 7),
+        meta={"epochs_completed": 2, "target_epochs": 3},
+    )
+    assert ck.exists()  # joins the write
+    assert ck.load_meta() == {"epochs_completed": 2, "target_epochs": 3}
+    restored = ck.restore(
+        create_train_state(model, input_dim=5, lr=0.01, seed=1)
+    )
+    assert int(restored.step) == 7
+    import os as _os
+
+    assert sorted(_os.listdir(str(tmp_path))) == ["state"]
