@@ -285,17 +285,29 @@ class TrainStateCheckpointer:
         entries = self._entries(state)
 
         def work():
-            self._publish(entries, meta)
+            try:
+                self._publish(entries, meta)
+            except BaseException as e:  # surfaced by the next wait()
+                self._error = e
 
         self._pending = threading.Thread(target=work, daemon=True)
         self._pending.start()
 
     def wait(self) -> None:
-        """Join any in-flight async write."""
+        """Join any in-flight async write; re-raise its failure — a lost
+        background write must be as loud as a failed synchronous save
+        (ENOSPC on the final epoch would otherwise report success while
+        the resume state silently stays one epoch stale)."""
         t = getattr(self, "_pending", None)
         if t is not None:
             t.join()
             self._pending = None
+        err = getattr(self, "_error", None)
+        if err is not None:
+            self._error = None
+            raise RuntimeError(
+                f"async train-state checkpoint write failed: {err!r}"
+            ) from err
 
     def load_meta(self) -> dict:
         """Run facts saved beside the newest restorable checkpoint

@@ -437,9 +437,12 @@ class Trainer:
 
         finally:
             # Crash-path hygiene: never leave a jax.profiler session open
-            # or a resume-state write un-joined.
-            profiler.close()
-            state_ckptr.wait()
+            # or a resume-state write un-joined (each guarded so one
+            # cleanup failing cannot abandon the other).
+            try:
+                profiler.close()
+            finally:
+                state_ckptr.wait()
 
         # Rank-0 post-train artifact upload, mirroring
         # jobs/train_lightning_ddp.py:146-164 (best, else last.ckpt fallback).

@@ -129,3 +129,29 @@ def test_async_save_overlaps_and_rotates(tmp_path):
     import os as _os
 
     assert sorted(_os.listdir(str(tmp_path))) == ["state"]
+
+
+def test_async_save_failure_is_loud(tmp_path, monkeypatch):
+    """A failed background write must raise at the next join, not report
+    success over a stale checkpoint."""
+    from dct_tpu.checkpoint.manager import TrainStateCheckpointer
+    from dct_tpu.config import ModelConfig
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.train.state import create_train_state
+
+    model = get_model(ModelConfig(dropout=0.0), input_dim=5)
+    state = create_train_state(model, input_dim=5, lr=0.01, seed=0)
+    ck = TrainStateCheckpointer(str(tmp_path))
+    monkeypatch.setattr(
+        ck, "_publish",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")),
+    )
+    ck.save_async(state)
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="checkpoint write failed"):
+        ck.wait()
+    # The error is consumed; subsequent operations work again.
+    monkeypatch.undo()
+    ck.save(state)
+    assert ck.exists()
