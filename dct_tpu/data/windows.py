@@ -57,8 +57,16 @@ class WindowArrays:
         return self.features[np.asarray(indices)]
 
 
-def make_windows(data: WeatherArrays, seq_len: int) -> WindowArrays:
-    """[N, F] rows -> [N-seq_len, seq_len, F] windows with next-step labels."""
+def make_windows(
+    data: WeatherArrays, seq_len: int, *, per_position_labels: bool = False
+) -> WindowArrays:
+    """[N, F] rows -> [N-seq_len, seq_len, F] windows with next-step labels.
+
+    ``per_position_labels``: labels become [N, S] — position ``t`` of
+    window ``i`` is supervised with row ``i+t+1``'s label (causal
+    next-step prediction at EVERY position, the causal transformer
+    family's training signal); the final column equals the default
+    window-level label."""
     n = len(data)
     if seq_len < 1:
         raise ValueError(f"seq_len must be >= 1, got {seq_len}")
@@ -71,9 +79,17 @@ def make_windows(data: WeatherArrays, seq_len: int) -> WindowArrays:
     # sliding_window_view puts the window axis last: [N-S+1, F, S], zero-copy.
     windows = sliding_window_view(base, seq_len, axis=0)
     windows = np.moveaxis(windows, -1, 1)  # -> [N-S+1, S, F]
+    if per_position_labels:
+        labels = np.ascontiguousarray(
+            sliding_window_view(
+                data.labels[1:].astype(np.int32), seq_len, axis=0
+            )[: n - seq_len]
+        )  # [N-S, S]; row i column t = label of row i+t+1
+    else:
+        labels = data.labels[seq_len:].astype(np.int32)
     return WindowArrays(
         features=windows[: n - seq_len],
-        labels=data.labels[seq_len:].astype(np.int32),
+        labels=labels,
         feature_names=list(data.feature_names),
         seq_len=int(seq_len),
         base=base,

@@ -19,13 +19,18 @@ MODEL_REGISTRY: dict[str, Callable[..., nn.Module]] = {}
 # Models that consume [B, S, F] windows instead of [B, F] rows; the Trainer
 # switches the data path (make_windows) and init shape on this trait.
 SEQUENCE_MODELS: set[str] = set()
+# Causal per-position families: windows carry [N, S] next-step labels and
+# the model emits [B, S, classes] logits.
+CAUSAL_MODELS: set[str] = set()
 
 
-def register_model(name: str, *, sequence: bool = False):
+def register_model(name: str, *, sequence: bool = False, causal: bool = False):
     def deco(builder: Callable[..., nn.Module]):
         MODEL_REGISTRY[name] = builder
         if sequence:
             SEQUENCE_MODELS.add(name)
+        if causal:
+            CAUSAL_MODELS.add(name)
         return builder
 
     return deco
@@ -33,6 +38,10 @@ def register_model(name: str, *, sequence: bool = False):
 
 def is_sequence_model(name: str) -> bool:
     return name in SEQUENCE_MODELS
+
+
+def is_causal_model(name: str) -> bool:
+    return name in CAUSAL_MODELS
 
 
 def get_model(cfg: ModelConfig, *, input_dim: int | None = None, **kwargs) -> nn.Module:
@@ -110,6 +119,37 @@ def _build_moe(
         dispatch=cfg.moe_dispatch,
         mesh=mesh,
         top_k=cfg.router_top_k,
+    )
+
+
+@register_model("weather_transformer_causal", sequence=True, causal=True)
+def _build_transformer_causal(
+    cfg: ModelConfig, *, input_dim: int, compute_dtype=None, attn_fn=None,
+    mesh=None,
+):
+    """Decoder-style causal forecaster: per-position next-step supervision
+    through CAUSAL attention — the product path for the causal flash
+    kernel and the causal ring (the non-causal families never exercise
+    them). The Trainer-supplied attn_fn is non-causal, so this builder
+    constructs its own from the mesh."""
+    del attn_fn
+    import jax.numpy as jnp
+
+    from dct_tpu.models.transformer import WeatherTransformer
+    from dct_tpu.ops.attention import make_attention_fn
+
+    return WeatherTransformer(
+        input_dim=input_dim,
+        seq_len=cfg.seq_len,
+        d_model=cfg.d_model,
+        n_heads=cfg.n_heads,
+        n_layers=cfg.n_layers,
+        d_ff=cfg.d_ff,
+        num_classes=cfg.num_classes,
+        dropout=cfg.dropout,
+        attn_fn=make_attention_fn(mesh, causal=True),
+        per_position=True,
+        compute_dtype=compute_dtype or jnp.float32,
     )
 
 

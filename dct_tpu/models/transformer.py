@@ -206,7 +206,12 @@ class WeatherTransformerPP(nn.Module):
 
 
 class WeatherTransformer(nn.Module):
-    """Encoder over [B, S, F] windows -> [B, num_classes] rain logits."""
+    """Encoder over [B, S, F] windows -> [B, num_classes] rain logits.
+
+    ``per_position``: decoder-style per-position head — [B, S, classes]
+    logits, one next-step forecast per position (pair with a CAUSAL
+    ``attn_fn`` so position t sees only rows <= t; the causal family in
+    the registry wires both)."""
 
     input_dim: int
     seq_len: int
@@ -217,6 +222,7 @@ class WeatherTransformer(nn.Module):
     num_classes: int = 2
     dropout: float = 0.1
     attn_fn: object = None  # default set in __call__ (dense/blockwise)
+    per_position: bool = False
     compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -245,8 +251,13 @@ class WeatherTransformer(nn.Module):
                 name=f"block_{i}",
             )(h, train=train)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)
-        pooled = h.mean(axis=1)
-        logits = TorchStyleDense(
-            self.num_classes, dtype=self.compute_dtype, name="head"
-        )(pooled)
+        if self.per_position:
+            logits = TorchStyleDense(
+                self.num_classes, dtype=self.compute_dtype, name="head"
+            )(h)  # [B, S, classes]
+        else:
+            pooled = h.mean(axis=1)
+            logits = TorchStyleDense(
+                self.num_classes, dtype=self.compute_dtype, name="head"
+            )(pooled)
         return jnp.asarray(logits, jnp.float32)

@@ -111,7 +111,11 @@ def gather_i32(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """dst[i] = src[idx[i]] for int32 labels."""
     idx = np.ascontiguousarray(idx, np.int64)
     lib = _load()
-    if lib is None or not (src.flags.c_contiguous and src.dtype == np.int32):
+    if lib is None or not (
+        src.flags.c_contiguous and src.dtype == np.int32 and src.ndim == 1
+    ):
+        # ndim > 1 (per-position label matrices) must NOT hit the native
+        # scalar-gather path — it indexes src as a flat array.
         return src[idx]
     if idx.size and (idx.min() < 0 or idx.max() >= src.shape[0]):
         raise IndexError("gather_i32: index out of bounds")

@@ -109,9 +109,10 @@ def gru_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
 
 
 def _mha_numpy(weights: dict, prefix: str, h: np.ndarray,
-               n_heads: int) -> np.ndarray:
-    """Dense (non-causal) multi-head attention matching
-    dct_tpu.models.transformer.MultiHeadAttention's fused-qkv layout."""
+               n_heads: int, causal: bool = False) -> np.ndarray:
+    """Multi-head attention matching
+    dct_tpu.models.transformer.MultiHeadAttention's fused-qkv layout
+    (``causal`` masks positions > query, the causal family's path)."""
     n, s, d_model = h.shape
     head_dim = d_model // n_heads
     qkv = h @ weights[f"{prefix}/qkv_proj/kernel"] + weights[
@@ -120,6 +121,10 @@ def _mha_numpy(weights: dict, prefix: str, h: np.ndarray,
     qkv = qkv.reshape(n, s, n_heads, 3, head_dim)
     q, k, v = (np.swapaxes(qkv[:, :, :, j], 1, 2) for j in range(3))
     scores = q @ np.swapaxes(k, -1, -2) / math.sqrt(head_dim)
+    if causal:
+        scores = np.where(
+            np.tril(np.ones((s, s), bool)), scores, -1e30
+        )
     o = softmax_numpy(scores) @ v  # [N, H, S, Dh]
     o = np.moveaxis(o, 1, 2).reshape(n, s, d_model)
     return o @ weights[f"{prefix}/o_proj/kernel"] + weights[
@@ -127,11 +132,15 @@ def _mha_numpy(weights: dict, prefix: str, h: np.ndarray,
     ]
 
 
-def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn) -> np.ndarray:
+def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn, *,
+                   causal: bool = False,
+                   per_position: bool = False) -> np.ndarray:
     """Shared pre-LN encoder skeleton (in_proj + positions, per-block
     attention and FFN residuals, final LN + mean-pool + head). ``ffn`` is
     ``(weights, block_prefix, h) -> h_ffn`` — the only point where the
-    transformer and MoE families differ."""
+    transformer and MoE families differ. The causal family sets both
+    flags; ``per_position`` serves the LAST position's logits (the
+    next-step forecast for the window)."""
     d_model = int(meta["d_model"])
     n_heads = int(meta["n_heads"])
     n_layers = int(meta["n_layers"])
@@ -144,27 +153,30 @@ def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn) -> np.ndarray:
         a = _layernorm(
             h, weights[f"{pre}/ln_attn/scale"], weights[f"{pre}/ln_attn/bias"]
         )
-        h = h + _mha_numpy(weights, f"{pre}/attn", a, n_heads)
+        h = h + _mha_numpy(weights, f"{pre}/attn", a, n_heads, causal)
         f = _layernorm(
             h, weights[f"{pre}/ln_ffn/scale"], weights[f"{pre}/ln_ffn/bias"]
         )
         h = h + ffn(weights, pre, f)
     h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
-    pooled = h.mean(axis=1)
+    pooled = h[:, -1, :] if per_position else h.mean(axis=1)
     return pooled @ weights["head/kernel"] + weights["head/bias"]
 
 
 def transformer_forward_numpy(
-    weights: dict, meta: dict, x: np.ndarray
+    weights: dict, meta: dict, x: np.ndarray, *, causal: bool = False
 ) -> np.ndarray:
-    """Pre-LN encoder inference with dense (non-causal) attention; weights
-    carry flax paths (``block_<i>/attn/qkv_proj/kernel`` etc.)."""
+    """Pre-LN encoder inference; weights carry flax paths
+    (``block_<i>/attn/qkv_proj/kernel`` etc.). ``causal`` serves the
+    decoder-style causal family (per-position head, last position out)."""
 
     def dense_ffn(w, pre, f):
         f = _gelu_tanh(f @ w[f"{pre}/ffn_in/kernel"] + w[f"{pre}/ffn_in/bias"])
         return f @ w[f"{pre}/ffn_out/kernel"] + w[f"{pre}/ffn_out/bias"]
 
-    return _encoder_numpy(weights, meta, x, dense_ffn)
+    return _encoder_numpy(
+        weights, meta, x, dense_ffn, causal=causal, per_position=causal
+    )
 
 
 def transformer_pp_forward_numpy(
@@ -269,6 +281,8 @@ def forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
         return gru_forward_numpy(weights, meta, x)
     if family == "weather_transformer":
         return transformer_forward_numpy(weights, meta, x)
+    if family == "weather_transformer_causal":
+        return transformer_forward_numpy(weights, meta, x, causal=True)
     if family == "weather_transformer_pp":
         return transformer_pp_forward_numpy(weights, meta, x)
     if family == "weather_moe":
@@ -277,8 +291,8 @@ def forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
 
 
 _SEQUENCE_FAMILIES = (
-    "weather_gru", "weather_transformer", "weather_transformer_pp",
-    "weather_moe",
+    "weather_gru", "weather_transformer", "weather_transformer_causal",
+    "weather_transformer_pp", "weather_moe",
 )
 
 

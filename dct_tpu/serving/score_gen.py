@@ -55,8 +55,8 @@ def export_npz_weights(ckpt_path: str, deploy_dir: str) -> dict:
     family = meta.get("model", "weather_mlp")
 
     if family in (
-        "weather_gru", "weather_transformer", "weather_transformer_pp",
-        "weather_moe",
+        "weather_gru", "weather_transformer", "weather_transformer_causal",
+        "weather_transformer_pp", "weather_moe",
     ):
         weights = _flatten_params(p)
     else:

@@ -28,6 +28,15 @@ from dct_tpu.ops.losses import masked_accuracy, masked_cross_entropy
 from dct_tpu.train.state import TrainState
 
 
+def _position_weight(logits, y, weight):
+    """Per-position supervision support: [B, S, C] logits with [B, S]
+    labels broadcast the [B] row weight over positions (padded rows mask
+    every position; the mean stays per-position)."""
+    if logits.ndim == y.ndim + 1 and y.ndim == 2 and weight.ndim == 1:
+        return jnp.broadcast_to(weight[:, None], y.shape)
+    return weight
+
+
 def _train_body(state: TrainState, x, y, weight):
     """One optimization step: (state, batch) -> (new_state, loss).
 
@@ -45,7 +54,8 @@ def _train_body(state: TrainState, x, y, weight):
             params, x, train=True, rngs={"dropout": step_rng},
             mutable=["aux_loss"],
         )
-        loss_sum, count = masked_cross_entropy(logits, y, weight)
+        w = _position_weight(logits, y, weight)
+        loss_sum, count = masked_cross_entropy(logits, y, w)
         loss = loss_sum / jnp.maximum(count, 1.0)
         for leaf in jax.tree.leaves(updates):
             loss = loss + leaf
@@ -63,8 +73,9 @@ def _eval_body(state: TrainState, x, y, weight):
     logits, _ = state.apply_fn(
         state.params, x, train=False, mutable=["aux_loss"]
     )
-    loss_sum, count = masked_cross_entropy(logits, y, weight)
-    acc_sum, _ = masked_accuracy(logits, y, weight)
+    w = _position_weight(logits, y, weight)
+    loss_sum, count = masked_cross_entropy(logits, y, w)
+    acc_sum, _ = masked_accuracy(logits, y, w)
     return loss_sum, acc_sum, count
 
 
@@ -78,16 +89,20 @@ def _train_accum_body(state: TrainState, x, y, weight, accum_steps: int):
     b = x.shape[0]
     step_rng = jax.random.fold_in(state.rng, state.step)
     xs = x.reshape(accum_steps, b // accum_steps, *x.shape[1:])
-    ys = y.reshape(accum_steps, b // accum_steps)
+    ys = y.reshape(accum_steps, b // accum_steps, *y.shape[1:])
     ws = weight.reshape(accum_steps, b // accum_steps)
-    total = jnp.maximum(weight.sum(), 1.0)
+    # Per-position supervision ([B, S] labels) counts every position.
+    positions = y.shape[1] if y.ndim == 2 else 1
+    total = jnp.maximum(weight.sum() * positions, 1.0)
 
     def chunk_loss(params, cx, cy, cw, rng):
         logits, updates = state.apply_fn(
             params, cx, train=True, rngs={"dropout": rng},
             mutable=["aux_loss"],
         )
-        loss_sum, _ = masked_cross_entropy(logits, cy, cw)
+        loss_sum, _ = masked_cross_entropy(
+            logits, cy, _position_weight(logits, cy, cw)
+        )
         loss = loss_sum / total
         for leaf in jax.tree.leaves(updates):
             loss = loss + leaf / accum_steps

@@ -100,8 +100,12 @@ class Trainer:
         sequence = is_sequence_model(cfg.model.name)
         if sequence:
             from dct_tpu.data.windows import make_windows
+            from dct_tpu.models.registry import is_causal_model
 
-            data = make_windows(data, cfg.model.seq_len)
+            data = make_windows(
+                data, cfg.model.seq_len,
+                per_position_labels=is_causal_model(cfg.model.name),
+            )
             # Overlapping windows leak under a random split; hold out the
             # TAIL of the stream, gapped by seq_len so no val window shares
             # rows with any train window.
