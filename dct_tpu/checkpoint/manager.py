@@ -315,14 +315,16 @@ class TrainStateCheckpointer:
         import json
 
         self.wait()
-
-        for d in self._restore_candidates():
-            path = os.path.join(d, "meta.json")
-            if os.path.exists(path):
-                with open(path) as f:
-                    return dict(json.load(f))
+        candidates = self._restore_candidates()
+        if not candidates:
             return {}
-        return {}
+        # candidates[0] to stay paired with restore(), which reads the
+        # same directory's arrays.
+        path = os.path.join(candidates[0], "meta.json")
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return dict(json.load(f))
 
     def exists(self) -> bool:
         self.wait()

@@ -146,6 +146,11 @@ class MoEFFN(nn.Module):
         k = self.top_k
         if not 1 <= k <= e:
             raise ValueError(f"top_k={k} must be in [1, n_experts={e}]")
+        if self.dispatch not in ("auto", "sorted", "einsum"):
+            raise ValueError(
+                f"moe_dispatch={self.dispatch!r} must be "
+                "'auto' | 'sorted' | 'einsum'"
+            )
         capacity = max(1, int(self.capacity_factor * k * n / e))
         tokens = x.reshape(n, d)
 

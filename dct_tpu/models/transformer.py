@@ -191,8 +191,18 @@ class WeatherTransformerPP(nn.Module):
                 stacked, h, mesh=mesh, n_microbatches=m,
                 data_axis="data" if dp > 1 else None,
             )
+        elif pipe > 1 and b >= m * dp:
+            # A real batch that cannot tile the configured pipeline is a
+            # sizing bug: running the sequential path with P('pipe')
+            # params would all-gather every stage each step and silently
+            # discard the pipelining the user configured.
+            raise ValueError(
+                f"batch {b} does not tile n_microbatches={m} x data={dp} "
+                f"for the pipe={pipe} mesh; adjust batch_size or "
+                "n_microbatches"
+            )
         else:
-            # Sequential oracle: init trace, pipe=1, or untileable batch.
+            # Sequential oracle: batch-1 init trace or pipe=1.
             for i in range(self.n_stages):
                 p_i = jax.tree.map(lambda a, i=i: a[i], stacked)
                 h = stage_mod.apply({"params": p_i}, h)

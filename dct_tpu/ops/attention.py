@@ -370,17 +370,23 @@ def make_attention_fn(mesh: Mesh | None = None, *, causal: bool = False,
 
     def attn(q, k, v):
         t = q.shape[-2]
-        path = select_attention_path(t, block_size=block_size)
-        if path == "flash":
+        # Tunable kernel tiles; the selection check uses the SAME values,
+        # so a non-dividing override degrades to blockwise instead of
+        # crashing inside the kernel.
+        bq = int(os.environ.get("DCT_FLASH_BLOCK_Q", "128"))
+        bk = int(os.environ.get("DCT_FLASH_BLOCK_K", "128"))
+        path = select_attention_path(
+            t, block_size=block_size, flash_block=max(bq, bk)
+        )
+        if path == "flash" and t % bq == 0 and t % bk == 0:
             from dct_tpu.ops.pallas_attention import flash_attention
 
-            bq = int(os.environ.get("DCT_FLASH_BLOCK_Q", "128"))
-            bk = int(os.environ.get("DCT_FLASH_BLOCK_K", "128"))
             return flash_attention(
                 q, k, v, block_q=bq, block_k=bk, causal=causal,
                 interpret=bool(flash_interpret_mode()),
             )
-        if path == "blockwise":
+        # 'flash' whose override blocks do not divide t degrades here too.
+        if t > block_size and t % block_size == 0:
             return blockwise_attention(
                 q, k, v, block_size=block_size, causal=causal
             )

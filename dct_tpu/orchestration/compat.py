@@ -187,12 +187,18 @@ except ImportError:
                     DeprecationWarning,
                     stacklevel=2,
                 )
-            bad = set(kwargs.get("default_args") or {}) - _BASE_OPERATOR_PARAMS
+            # Real Airflow forwards default_args to EACH operator ctor, so
+            # operator-specific keys (env, op_kwargs, conf, ...) are legal
+            # there — validate against the union, not BaseOperator alone.
+            allowed_defaults = _BASE_OPERATOR_PARAMS.union(
+                *_OPERATOR_EXTRA_PARAMS.values()
+            )
+            bad = set(kwargs.get("default_args") or {}) - allowed_defaults
             if bad:
                 raise TypeError(
                     f"DAG default_args contain non-operator key(s) "
                     f"{sorted(bad)} — not part of the Airflow 2.7 "
-                    "BaseOperator API"
+                    "operator APIs"
                 )
             self.dag_id = dag_id
             self.kwargs = kwargs

@@ -132,6 +132,29 @@ def _mha_numpy(weights: dict, prefix: str, h: np.ndarray,
     ]
 
 
+def _dense_ffn_numpy(w: dict, pre: str, f: np.ndarray) -> np.ndarray:
+    f = _gelu_tanh(f @ w[f"{pre}/ffn_in/kernel"] + w[f"{pre}/ffn_in/bias"])
+    return f @ w[f"{pre}/ffn_out/kernel"] + w[f"{pre}/ffn_out/bias"]
+
+
+def _pre_ln_block(w: dict, pre: str, h: np.ndarray, n_heads: int, ffn,
+                  causal: bool = False) -> np.ndarray:
+    """One pre-LN residual block (attention + FFN) — the single source of
+    the block math for the transformer, MoE, causal, AND pipeline-stage
+    serving paths (train/serve parity lives or dies here)."""
+    a = _layernorm(h, w[f"{pre}/ln_attn/scale"], w[f"{pre}/ln_attn/bias"])
+    h = h + _mha_numpy(w, f"{pre}/attn", a, n_heads, causal)
+    f = _layernorm(h, w[f"{pre}/ln_ffn/scale"], w[f"{pre}/ln_ffn/bias"])
+    return h + ffn(w, pre, f)
+
+
+def _head_numpy(weights: dict, h: np.ndarray,
+                per_position: bool) -> np.ndarray:
+    h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
+    pooled = h[:, -1, :] if per_position else h.mean(axis=1)
+    return pooled @ weights["head/kernel"] + weights["head/bias"]
+
+
 def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn, *,
                    causal: bool = False,
                    per_position: bool = False) -> np.ndarray:
@@ -149,18 +172,8 @@ def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn, *,
     h = x @ weights["in_proj/kernel"] + weights["in_proj/bias"]
     h = h + _sincos_positions(s, d_model)
     for i in range(n_layers):
-        pre = f"block_{i}"
-        a = _layernorm(
-            h, weights[f"{pre}/ln_attn/scale"], weights[f"{pre}/ln_attn/bias"]
-        )
-        h = h + _mha_numpy(weights, f"{pre}/attn", a, n_heads, causal)
-        f = _layernorm(
-            h, weights[f"{pre}/ln_ffn/scale"], weights[f"{pre}/ln_ffn/bias"]
-        )
-        h = h + ffn(weights, pre, f)
-    h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
-    pooled = h[:, -1, :] if per_position else h.mean(axis=1)
-    return pooled @ weights["head/kernel"] + weights["head/bias"]
+        h = _pre_ln_block(weights, f"block_{i}", h, n_heads, ffn, causal)
+    return _head_numpy(weights, h, per_position)
 
 
 def transformer_forward_numpy(
@@ -170,12 +183,9 @@ def transformer_forward_numpy(
     (``block_<i>/attn/qkv_proj/kernel`` etc.). ``causal`` serves the
     decoder-style causal family (per-position head, last position out)."""
 
-    def dense_ffn(w, pre, f):
-        f = _gelu_tanh(f @ w[f"{pre}/ffn_in/kernel"] + w[f"{pre}/ffn_in/bias"])
-        return f @ w[f"{pre}/ffn_out/kernel"] + w[f"{pre}/ffn_out/bias"]
-
     return _encoder_numpy(
-        weights, meta, x, dense_ffn, causal=causal, per_position=causal
+        weights, meta, x, _dense_ffn_numpy, causal=causal,
+        per_position=causal,
     )
 
 
@@ -204,21 +214,8 @@ def transformer_pp_forward_numpy(
     for st in range(n_stages):
         w = {k: v[st] for k, v in stage_keys.items()}
         for i in range(layers_per_stage):
-            pre = f"block_{i}"
-            a = _layernorm(
-                h, w[f"{pre}/ln_attn/scale"], w[f"{pre}/ln_attn/bias"]
-            )
-            h = h + _mha_numpy(w, f"{pre}/attn", a, n_heads)
-            f = _layernorm(
-                h, w[f"{pre}/ln_ffn/scale"], w[f"{pre}/ln_ffn/bias"]
-            )
-            f = _gelu_tanh(
-                f @ w[f"{pre}/ffn_in/kernel"] + w[f"{pre}/ffn_in/bias"]
-            )
-            h = h + (f @ w[f"{pre}/ffn_out/kernel"] + w[f"{pre}/ffn_out/bias"])
-    h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
-    pooled = h.mean(axis=1)
-    return pooled @ weights["head/kernel"] + weights["head/bias"]
+            h = _pre_ln_block(w, f"block_{i}", h, n_heads, _dense_ffn_numpy)
+    return _head_numpy(weights, h, per_position=False)
 
 
 def _moe_ffn_numpy(weights: dict, prefix: str, h: np.ndarray,

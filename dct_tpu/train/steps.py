@@ -161,7 +161,9 @@ def make_epoch_train_step(donate: bool = True, accum_steps: int = 1):
         if accum_steps > 1:
             s, b = xs.shape[0], xs.shape[1]
             xs = xs.reshape(s // accum_steps, accum_steps * b, *xs.shape[2:])
-            ys = ys.reshape(s // accum_steps, accum_steps * b)
+            # Trailing label dims survive (per-position [S, B, seq] labels
+            # of the causal family).
+            ys = ys.reshape(s // accum_steps, accum_steps * b, *ys.shape[2:])
             ws = ws.reshape(s // accum_steps, accum_steps * b)
 
             def body(st, batch):

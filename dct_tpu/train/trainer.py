@@ -152,18 +152,42 @@ class Trainer:
                 cfg.model, input_dim=data.input_dim, compute_dtype=compute_dtype
             )
             example_shape = None
+        # Per-process state dir, constructed before the LR schedule: a
+        # resumed run must size its cosine horizon from the restored
+        # trajectory, not this run's budget alone.
+        state_ckptr = TrainStateCheckpointer(
+            os.path.join(
+                cfg.data.models_dir, "train_state", f"p{jax.process_index()}"
+            )
+        )
+        updates_per_epoch = train_loader.num_batches // max(
+            1, cfg.train.grad_accum_steps
+        )
+        if cfg.train.grad_accum_steps > 1 and updates_per_epoch == 0:
+            raise ValueError(
+                f"grad_accum_steps={cfg.train.grad_accum_steps} exceeds the "
+                f"{train_loader.num_batches} batches per epoch — every "
+                "epoch would run ZERO optimizer updates"
+            )
+
         lr_schedule = None
         if cfg.train.lr_schedule != "constant" or cfg.train.warmup_steps > 0:
             from dct_tpu.train.state import make_lr_schedule
 
             decay = cfg.train.decay_steps
             if cfg.train.lr_schedule == "cosine" and decay <= 0:
-                # Auto: decay over this run's total update count.
+                # Auto: decay over the FULL trajectory. The optimizer's
+                # restored update count already includes prior runs, so a
+                # continuation sized only to THIS run's budget would start
+                # at (or clamp to) the floor LR and train nothing.
+                prior_epochs = 0
+                if cfg.train.resume and state_ckptr.exists():
+                    prior_epochs = int(
+                        state_ckptr.load_meta().get("epochs_completed", 0)
+                    )
                 decay = max(
                     1,
-                    cfg.train.epochs
-                    * (train_loader.num_batches
-                       // max(1, cfg.train.grad_accum_steps))
+                    (prior_epochs + cfg.train.epochs) * updates_per_epoch
                     - cfg.train.warmup_steps,
                 )
             lr_schedule = make_lr_schedule(
@@ -187,14 +211,6 @@ class Trainer:
             state, self.mesh, shard_opt=cfg.train.shard_opt_state
         )
 
-        # Per-process state dir: every process saves its own resume state
-        # (host-local disk) — resume must not depend on which host a
-        # process lands on having the coordinator's disk.
-        state_ckptr = TrainStateCheckpointer(
-            os.path.join(
-                cfg.data.models_dir, "train_state", f"p{jax.process_index()}"
-            )
-        )
         # Continuous-training semantics (the reference re-trains from
         # scratch daily — its fit() never gets a ckpt_path, reference
         # jobs/train_lightning_ddp.py:143):

@@ -166,3 +166,22 @@ def test_serving_numpy_parity(rng):
     }
     np_logits = forward_numpy(weights, meta, x)
     np.testing.assert_allclose(np_logits, jax_logits, atol=2e-5)
+
+
+def test_epoch_scan_accum_with_per_position_labels(rng):
+    """Review regression: the epoch-scan accumulation reshape must keep
+    the causal family's trailing label axis."""
+    from dct_tpu.train.steps import make_epoch_train_step
+
+    model = get_model(ModelConfig(**CFG), input_dim=5)
+    state = create_train_state(
+        model, input_dim=5, lr=1e-3, seed=0, example_shape=(1, 8, 5)
+    )
+    xs = jnp.asarray(rng.standard_normal((4, 4, 8, 5)), jnp.float32)
+    ys = jnp.asarray(rng.integers(0, 2, (4, 4, 8)), jnp.int32)
+    ws = jnp.ones((4, 4), jnp.float32)
+    state2, losses = make_epoch_train_step(donate=False, accum_steps=2)(
+        state, xs, ys, ws
+    )
+    assert losses.shape == (2,)
+    assert np.isfinite(np.asarray(losses)).all()

@@ -221,3 +221,9 @@ def test_auto_deploy_dag_executes_against_local_endpoint(tmp_path, monkeypatch):
     assert client.list_deployments("weather-endpoint") == ["green"]
     out = client.score("weather-endpoint", {"data": [[0.0] * 5]})
     assert "probabilities" in out
+
+
+def test_compat_default_args_accept_operator_extras():
+    """Review regression: real Airflow forwards default_args to each
+    operator ctor, so operator-specific keys (env, conf, ...) are legal."""
+    DAG(dag_id="x_defaults_check", default_args={"retries": 1, "env": {"A": "1"}})

@@ -143,3 +143,18 @@ def test_ring_unaligned_falls_back(monkeypatch, rng):
     out = ring_attention(q, k, v, mesh=mesh, causal=True)
     ref = dense_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_nondividing_flash_block_override_degrades(monkeypatch, rng):
+    """Review regression: a DCT_FLASH_BLOCK_K that does not divide T must
+    degrade to the blockwise/dense path, not crash inside the kernel."""
+    monkeypatch.setenv("DCT_FLASH", "interpret")
+    monkeypatch.setenv("DCT_FLASH_BLOCK_K", "96")
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 2, 256, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    attn = make_attention_fn(None)
+    out = attn(q, k, v)
+    ref = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

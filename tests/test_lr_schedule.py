@@ -75,3 +75,48 @@ def test_trainer_cosine_schedule_e2e(processed_dir, tmp_path):
     res2 = Trainer(cfg2, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
     assert np.isfinite(res2.val_loss)
     assert int(jax.device_get(res2.state.step)) == 2 * step1
+
+
+def test_cosine_resume_sizes_decay_over_full_trajectory(processed_dir, tmp_path):
+    """Review regression: a continuation run must NOT start at the cosine
+    floor (lr=0) — the auto decay horizon counts the restored epochs, so
+    params keep moving."""
+    import jax as _jax
+
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp_path / "m")),
+        train=TrainConfig(
+            epochs=1, batch_size=8, bf16_compute=False, lr_schedule="cosine"
+        ),
+    )
+    r1 = Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    p1 = _jax.device_get(r1.state.params)
+    cfg2 = RunConfig(
+        data=cfg.data,
+        train=TrainConfig(
+            epochs=1, batch_size=8, bf16_compute=False,
+            lr_schedule="cosine", resume=True,
+        ),
+    )
+    r2 = Trainer(cfg2, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    p2 = _jax.device_get(r2.state.params)
+    diffs = [
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(
+            _jax.tree.leaves(p1), _jax.tree.leaves(p2)
+        )
+    ]
+    assert max(diffs) > 1e-6, "continuation run trained at lr=0"
+
+
+def test_accum_exceeding_epoch_fails_loudly(processed_dir, tmp_path):
+    import pytest as _pytest
+
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp_path / "m2")),
+        train=TrainConfig(
+            epochs=1, batch_size=64, bf16_compute=False, grad_accum_steps=64
+        ),
+    )
+    with _pytest.raises(ValueError, match="ZERO optimizer updates"):
+        Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r2"))).fit()

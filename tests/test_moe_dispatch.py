@@ -229,3 +229,9 @@ def test_top2_serving_numpy_parity(rng, tmp_path):
     }
     np_logits = forward_numpy(weights, meta, x)
     np.testing.assert_allclose(np_logits, jax_logits, atol=2e-5)
+
+
+def test_dispatch_typo_rejected():
+    f = MoEFFN(d_model=16, d_ff=32, n_experts=4, dispatch="sort")
+    with pytest.raises(ValueError, match="moe_dispatch"):
+        f.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 16), jnp.float32))

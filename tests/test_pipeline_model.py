@@ -125,3 +125,14 @@ def test_pp_rejects_indivisible_layers():
         _model(n_layers=3, n_stages=2).init(
             jax.random.PRNGKey(0), jnp.zeros((1, 8, 5))
         )
+
+
+def test_pp_untileable_real_batch_raises(rng):
+    """Review regression: a real batch that cannot tile the configured
+    pipeline must raise, not silently run sequentially."""
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    model = _model(mesh=mesh)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
+    x = jnp.asarray(rng.standard_normal((10, 8, 5)), jnp.float32)  # 10 % 4
+    with pytest.raises(ValueError, match="does not tile"):
+        model.apply(params, x)
