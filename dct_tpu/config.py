@@ -140,6 +140,9 @@ class TrainConfig:
     warmup_steps: int = 0
     decay_steps: int = 0
     end_lr_fraction: float = 0.0
+    # Decoupled weight decay (AdamW); 0 keeps plain Adam (reference
+    # parity — torch.optim.Adam has no decoupled decay).
+    weight_decay: float = 0.0
     seed: int = 42
     log_every_n_steps: int = 5
     # Improvement over the reference (which never resumes,
@@ -172,6 +175,7 @@ class TrainConfig:
         c.end_lr_fraction = _env(
             "DCT_END_LR_FRACTION", c.end_lr_fraction, float
         )
+        c.weight_decay = _env("DCT_WEIGHT_DECAY", c.weight_decay, float)
         c.seed = _env("DCT_SEED", c.seed, int)
         c.log_every_n_steps = _env("DCT_LOG_EVERY_N_STEPS", c.log_every_n_steps, int)
         c.resume = _env("DCT_RESUME", c.resume, bool)

@@ -72,6 +72,7 @@ def make_lr_schedule(
 def create_train_state(
     model, *, input_dim: int, lr: float, seed: int,
     example_shape: tuple | None = None, lr_schedule=None,
+    weight_decay: float = 0.0,
 ) -> TrainState:
     """Initialize params (torch-matching init lives in the model) and Adam.
 
@@ -94,7 +95,13 @@ def create_train_state(
     # (e.g. MoE load-balance losses) into other collections during init,
     # which must not enter the optimizer.
     params = {"params": variables["params"]}
-    tx = optax.adam(learning_rate=lr_schedule if lr_schedule is not None else lr)
+    rate = lr_schedule if lr_schedule is not None else lr
+    if weight_decay > 0.0:
+        # AdamW (decoupled decay) — capability beyond the reference's
+        # plain Adam; 0 preserves the parity trajectory exactly.
+        tx = optax.adamw(learning_rate=rate, weight_decay=weight_decay)
+    else:
+        tx = optax.adam(learning_rate=rate)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,

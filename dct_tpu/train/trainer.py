@@ -200,7 +200,7 @@ class Trainer:
         state = create_train_state(
             model, input_dim=data.input_dim, lr=cfg.train.lr,
             seed=cfg.train.seed, example_shape=example_shape,
-            lr_schedule=lr_schedule,
+            lr_schedule=lr_schedule, weight_decay=cfg.train.weight_decay,
         )
         # Name-pattern rules: tensor-parallel placement for the transformer
         # family, full replication for the MLP (no patterns match). TP/SP
