@@ -168,3 +168,64 @@ def test_ep_all_to_all_across_processes(processed_dir, tmp_path):
     m_ep = run(2, 2, "m_ep", "r_ep")
     m_ref = run(1, 1, "m_ep_ref", "r_ep_ref")
     assert abs(m_ep["val_loss"] - m_ref["val_loss"]) < 1e-3, (m_ep, m_ref)
+
+
+@pytest.mark.slow
+def test_pp_ppermute_across_processes(processed_dir, tmp_path):
+    """Pipeline parallelism SPANNING processes: stages sharded P('pipe')
+    across 2 jax.distributed CPU procs (one device each); the GPipe
+    ppermute hops cross a real process boundary and the loss trajectory
+    matches the single-process sequential stack."""
+    import glob as _glob
+    import json as _json
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(world_size, pipe, models_sub, runs_sub):
+        env = {
+            "PALLAS_AXON_POOL_IPS": "",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "DCT_PROCESSED_DIR": processed_dir,
+            "DCT_MODELS_DIR": str(tmp_path / models_sub),
+            "DCT_TRACKING_DIR": str(tmp_path / runs_sub),
+            "DCT_MODEL": "weather_transformer_pp",
+            "DCT_SEQ_LEN": "8",
+            "DCT_D_MODEL": "16",
+            "DCT_N_HEADS": "2",
+            "DCT_N_LAYERS": "2",
+            "DCT_D_FF": "32",
+            "DCT_N_STAGES": "2",
+            "DCT_EPOCHS": "1",
+            "DCT_BATCH_SIZE": "16",
+            "DCT_BF16_COMPUTE": "0",
+            "DCT_MESH_PIPE": str(pipe),
+            "DCT_MESH_DATA": "1",
+            "DCT_MESH_MODEL": "1",
+            "DCT_RESUME": "0",
+        }
+        launcher = LocalProcessLauncher(
+            coordinator_port=29535, stagger_seconds=1.0, timeout=300
+        )
+        results = launcher.launch(
+            [sys.executable, os.path.join(repo, "jobs", "train_tpu.py")],
+            world_size=world_size,
+            env=env,
+        )
+        assert LocalProcessLauncher.all_succeeded(results), results
+        runs = sorted(
+            _glob.glob(
+                str(tmp_path / runs_sub / "weather_forecasting" / "*" / "metrics.jsonl")
+            ),
+            key=os.path.getmtime,
+        )
+        assert runs
+        last = {}
+        with open(runs[-1]) as f:
+            for line in f:
+                last.update(_json.loads(line))
+        return last
+
+    m_pp = run(2, 2, "m_pp", "r_pp")
+    m_ref = run(1, 1, "m_pp_ref", "r_pp_ref")
+    assert abs(m_pp["val_loss"] - m_ref["val_loss"]) < 1e-3, (m_pp, m_ref)
