@@ -428,8 +428,9 @@ def main():
     }
     if scaled is not None:
         record["scaled"] = scaled
-        if "mfu" in scaled:
-            record["mfu"] = scaled["mfu"]
+        # Always present: null = peak unknown (CPU fallback rig), so the
+        # field's absence can never be mistaken for "not measured".
+        record["mfu"] = scaled.get("mfu")
     if moe is not None:
         record["moe"] = moe
     print(json.dumps(record))
