@@ -3,3 +3,12 @@ from dct_tpu.ops.losses import (  # noqa: F401
     masked_accuracy,
     softmax_probs,
 )
+from dct_tpu.ops.attention import (  # noqa: F401
+    blockwise_attention,
+    blockwise_attention_lse,
+    dense_attention,
+    flash_interpret_mode,
+    make_attention_fn,
+    ring_attention,
+    select_attention_path,
+)

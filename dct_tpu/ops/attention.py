@@ -16,6 +16,15 @@ it is designed TPU-first rather than bolted on:
 
 All three share one accumulation kernel (:func:`_online_block`) so their
 numerical equivalence is structural; tests assert it on an 8-device mesh.
+The Pallas flash kernel (:mod:`dct_tpu.ops.pallas_attention`) slots in per
+:func:`select_attention_path` — single-shard on TPU, and as the per-shard
+block compute inside the ring.
+
+Future work (noted for the next round): causal ring attention uses the
+contiguous P("seq") layout, so device i computes i+1 visible KV blocks —
+a ~2x tail/head load imbalance. The striped ("zigzag") layout (each
+device holds chunks i and 2R-1-i) equalizes the work at the cost of a
+static sequence permutation and paired-chunk masks.
 """
 
 from __future__ import annotations
