@@ -153,8 +153,8 @@ def _seq_ckpt(tmp_path, name, seq_len=10, input_dim=5):
 
 @pytest.mark.parametrize(
     "name",
-    ["weather_gru", "weather_transformer", "weather_transformer_pp",
-     "weather_moe"],
+    ["weather_gru", "weather_transformer", "weather_transformer_causal",
+     "weather_transformer_pp", "weather_moe"],
 )
 def test_sequence_family_numpy_parity(tmp_path, rng, name):
     """Every deployable family's numpy inference must match the JAX model."""
@@ -170,13 +170,16 @@ def test_sequence_family_numpy_parity(tmp_path, rng, name):
 
     np_logits = forward_numpy(weights, meta, x)
     jax_logits = np.asarray(model.apply(params, jnp.asarray(x), train=False))
+    if name == "weather_transformer_causal":
+        # Serving returns the LAST position's forecast for the window.
+        jax_logits = jax_logits[:, -1]
     np.testing.assert_allclose(np_logits, jax_logits, atol=2e-5)
 
 
 @pytest.mark.parametrize(
     "name",
-    ["weather_gru", "weather_transformer", "weather_transformer_pp",
-     "weather_moe"],
+    ["weather_gru", "weather_transformer", "weather_transformer_causal",
+     "weather_transformer_pp", "weather_moe"],
 )
 def test_sequence_family_score_py_end_to_end(tmp_path, rng, monkeypatch, name):
     _, _, ckpt, meta = _seq_ckpt(tmp_path, name)
