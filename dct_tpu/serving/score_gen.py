@@ -38,15 +38,14 @@ def _flatten_params(tree: dict, prefix: str = "") -> dict:
     return out
 
 
-def export_npz_weights(ckpt_path: str, deploy_dir: str) -> dict:
-    """model.ckpt (flax msgpack) -> model.npz + model_meta.json.
+def weights_from_checkpoint(ckpt_path: str) -> tuple[dict, dict]:
+    """model.ckpt (flax msgpack) -> (serving weights dict, meta).
 
-    The MLP family exports as an anonymous sequential dense stack
-    (``w0/b0..`` keys — what :func:`runtime.mlp_forward_numpy` consumes and
-    what existing deployments already serve). Sequence families
-    (transformer, GRU) export the flax param tree flattened to
-    ``/``-joined keys; :func:`runtime.forward_numpy` dispatches on
-    ``meta["model"]``.
+    The MLP family converts to an anonymous sequential dense stack
+    (``w0/b0..`` keys — what :func:`runtime.mlp_forward_numpy` consumes
+    and what existing deployments already serve). Sequence families
+    convert to the flax param tree flattened to ``/``-joined keys;
+    :func:`runtime.forward_numpy` dispatches on ``meta["model"]``.
     """
     from dct_tpu.checkpoint.manager import load_checkpoint
 
@@ -78,6 +77,12 @@ def export_npz_weights(ckpt_path: str, deploy_dir: str) -> dict:
         for i, name in enumerate(layers):
             weights[f"w{i}"] = np.asarray(p[name]["kernel"], np.float32)
             weights[f"b{i}"] = np.asarray(p[name]["bias"], np.float32)
+    return weights, meta
+
+
+def export_npz_weights(ckpt_path: str, deploy_dir: str) -> dict:
+    """model.ckpt -> model.npz + model_meta.json in ``deploy_dir``."""
+    weights, meta = weights_from_checkpoint(ckpt_path)
     os.makedirs(deploy_dir, exist_ok=True)
     np.savez(os.path.join(deploy_dir, "model.npz"), **weights)
     with open(os.path.join(deploy_dir, "model_meta.json"), "w") as f:

@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""Batch inference job: processed parquet in, predictions parquet out.
+
+The reference's only inference surface is the Azure endpoint's generated
+score.py (one JSON request at a time, reference
+dags/azure_manual_deploy.py:116-124); this job is the offline batch
+counterpart the pipeline otherwise lacks — score a whole processed
+dataset locally with the SAME numpy runtime the deployed score.py embeds
+(dct_tpu/serving/runtime.py), so batch and online predictions cannot
+diverge.
+
+Env contract (DCT_* like every job):
+  DCT_CKPT           checkpoint to score with (default: best weather-*.ckpt,
+                     else last.ckpt, under DCT_MODELS_DIR)
+  DCT_MODELS_DIR     where checkpoints live              [data/models]
+  DCT_PROCESSED_DIR  Spark/native parquet dir to score   [data/processed]
+  DCT_PREDICTIONS    output parquet path [data/predictions/predictions.parquet]
+
+Sequence families score sliding windows (prediction i = forecast for the
+row after window i); row families score each row. Output columns:
+``prob_<class>`` per class and ``predicted`` (argmax).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _find_checkpoint(models_dir: str) -> str:
+    explicit = os.environ.get("DCT_CKPT")
+    if explicit:
+        if not os.path.exists(explicit):
+            raise FileNotFoundError(f"DCT_CKPT={explicit} does not exist")
+        return explicit
+    best = sorted(glob.glob(os.path.join(models_dir, "weather-best-*.ckpt")))
+    if best:
+        return best[-1]
+    last = os.path.join(models_dir, "last.ckpt")
+    if os.path.exists(last):
+        return last
+    raise FileNotFoundError(
+        f"No checkpoint under {models_dir} (expected weather-best-*.ckpt "
+        "or last.ckpt; set DCT_CKPT to score a specific file)"
+    )
+
+
+def main() -> None:
+    import pandas as pd
+
+    from dct_tpu.data.dataset import load_processed_dataset
+    from dct_tpu.data.windows import make_windows
+    from dct_tpu.serving.runtime import forward_numpy, softmax_numpy
+    from dct_tpu.serving.score_gen import weights_from_checkpoint
+
+    models_dir = os.environ.get("DCT_MODELS_DIR", "data/models")
+    processed = os.environ.get("DCT_PROCESSED_DIR", "data/processed")
+    out_path = os.environ.get(
+        "DCT_PREDICTIONS", "data/predictions/predictions.parquet"
+    )
+
+    ckpt = _find_checkpoint(models_dir)
+    weights, meta = weights_from_checkpoint(ckpt)
+    family = meta.get("model", "weather_mlp")
+    print(f"Scoring with {ckpt} (family={family})")
+
+    data = load_processed_dataset(processed)
+    if data.input_dim != int(meta.get("input_dim", data.input_dim)):
+        raise ValueError(
+            f"Checkpoint expects input_dim={meta.get('input_dim')} but the "
+            f"processed data has {data.input_dim} features"
+        )
+
+    from dct_tpu.serving.runtime import _SEQUENCE_FAMILIES
+
+    if family in _SEQUENCE_FAMILIES:
+        seq_len = int(meta["seq_len"])
+        windows = make_windows(data, seq_len)
+        x = windows.features[:]  # materialize the strided view
+        index = np.arange(seq_len, seq_len + len(windows))  # forecast row
+        truth = windows.labels
+    else:
+        x = data.features
+        index = np.arange(len(data))
+        truth = data.labels
+
+    logits = forward_numpy(weights, meta, np.asarray(x, np.float32))
+    probs = softmax_numpy(logits)
+    pred = np.argmax(probs, axis=-1)
+
+    frame = {"row": index, "predicted": pred.astype(np.int32)}
+    for c in range(probs.shape[-1]):
+        frame[f"prob_{c}"] = probs[:, c].astype(np.float32)
+    if truth is not None and np.asarray(truth).ndim == 1:
+        frame["label"] = np.asarray(truth, np.int32)
+        acc = float((pred == np.asarray(truth)).mean())
+    else:
+        acc = float("nan")
+
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    pd.DataFrame(frame).to_parquet(out_path, index=False)
+    print(
+        f"✓ Wrote {len(pred)} predictions to {out_path}"
+        + (f" (accuracy vs recorded labels: {acc:.4f})" if acc == acc else "")
+    )
+
+
+if __name__ == "__main__":
+    main()

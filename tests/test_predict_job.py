@@ -1,0 +1,79 @@
+"""Batch-inference job: checkpoint + processed parquet -> predictions
+parquet through the same numpy runtime the deployed score.py embeds."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _train(processed_dir, tmp_path, model_env=None):
+    env = {
+        **os.environ,
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "DCT_PROCESSED_DIR": processed_dir,
+        "DCT_MODELS_DIR": str(tmp_path / "models"),
+        "DCT_TRACKING_DIR": str(tmp_path / "runs"),
+        "DCT_EPOCHS": "1",
+        "DCT_BATCH_SIZE": "8",
+        "DCT_BF16_COMPUTE": "0",
+        **(model_env or {}),
+    }
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "jobs", "train_tpu.py")],
+        env=env, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    return env
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "model_env",
+    [
+        None,  # flagship MLP
+        {"DCT_MODEL": "weather_transformer_causal", "DCT_SEQ_LEN": "8",
+         "DCT_D_MODEL": "16", "DCT_N_HEADS": "2", "DCT_N_LAYERS": "1",
+         "DCT_D_FF": "32"},
+    ],
+)
+def test_predict_job_end_to_end(processed_dir, tmp_path, model_env):
+    env = _train(processed_dir, tmp_path, model_env)
+    out = str(tmp_path / "pred" / "predictions.parquet")
+    env["DCT_PREDICTIONS"] = out
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "jobs", "predict.py")],
+        env=env, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    df = pd.read_parquet(out)
+    assert {"row", "predicted", "prob_0", "prob_1", "label"} <= set(df.columns)
+    assert len(df) > 0
+    np.testing.assert_allclose(
+        df["prob_0"] + df["prob_1"], np.ones(len(df)), atol=1e-5
+    )
+    # A trained model must beat coin-flip on its own training stream.
+    acc = float((df["predicted"] == df["label"]).mean())
+    assert acc > 0.6, acc
+
+
+def test_predict_job_missing_checkpoint(tmp_path, processed_dir):
+    env = {
+        **os.environ,
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "DCT_PROCESSED_DIR": processed_dir,
+        "DCT_MODELS_DIR": str(tmp_path / "empty"),
+    }
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "jobs", "predict.py")],
+        env=env, capture_output=True, text=True,
+    )
+    assert r.returncode != 0
+    assert "No checkpoint" in r.stderr
