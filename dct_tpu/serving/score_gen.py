@@ -48,15 +48,15 @@ def weights_from_checkpoint(ckpt_path: str) -> tuple[dict, dict]:
     :func:`runtime.forward_numpy` dispatches on ``meta["model"]``.
     """
     from dct_tpu.checkpoint.manager import load_checkpoint
+    from dct_tpu.serving.runtime import _SEQUENCE_FAMILIES
 
     params, meta = load_checkpoint(ckpt_path)
     p = params["params"]
     family = meta.get("model", "weather_mlp")
 
-    if family in (
-        "weather_gru", "weather_transformer", "weather_transformer_causal",
-        "weather_transformer_pp", "weather_moe",
-    ):
+    # Single source of truth with runtime's dispatch (a family in one
+    # list but not the other would export through the wrong branch).
+    if family in _SEQUENCE_FAMILIES:
         weights = _flatten_params(p)
     else:
         def layer_index(name: str) -> int:

@@ -40,7 +40,11 @@ def _find_checkpoint(models_dir: str) -> str:
         if not os.path.exists(explicit):
             raise FileNotFoundError(f"DCT_CKPT={explicit} does not exist")
         return explicit
-    best = sorted(glob.glob(os.path.join(models_dir, "weather-best-*.ckpt")))
+    best = sorted(
+        glob.glob(os.path.join(models_dir, "weather-best-*.ckpt")),
+        key=os.path.getmtime,
+    )  # newest by mtime — the filename embeds val_loss, so a lexicographic
+    # sort would pick the WORST model (the deploy DAG uses `ls -t` too)
     if best:
         return best[-1]
     last = os.path.join(models_dir, "last.ckpt")
@@ -83,7 +87,7 @@ def main() -> None:
     if family in _SEQUENCE_FAMILIES:
         seq_len = int(meta["seq_len"])
         windows = make_windows(data, seq_len)
-        x = windows.features[:]  # materialize the strided view
+        x = windows.features  # strided view; chunks are copied below
         index = np.arange(seq_len, seq_len + len(windows))  # forecast row
         truth = windows.labels
     else:
@@ -91,8 +95,15 @@ def main() -> None:
         index = np.arange(len(data))
         truth = data.labels
 
-    logits = forward_numpy(weights, meta, np.asarray(x, np.float32))
-    probs = softmax_numpy(logits)
+    # Chunked scoring: sequence attention materializes
+    # O(chunk * heads * seq^2) scores — a whole-dataset forward would OOM
+    # at exactly the scale a batch job exists for.
+    chunk = int(os.environ.get("DCT_PREDICT_CHUNK", "8192"))
+    probs_parts = []
+    for start in range(0, len(x), chunk):
+        piece = np.ascontiguousarray(x[start:start + chunk], np.float32)
+        probs_parts.append(softmax_numpy(forward_numpy(weights, meta, piece)))
+    probs = np.concatenate(probs_parts, axis=0)
     pred = np.argmax(probs, axis=-1)
 
     frame = {"row": index, "predicted": pred.astype(np.int32)}

@@ -77,3 +77,44 @@ def test_predict_job_missing_checkpoint(tmp_path, processed_dir):
     )
     assert r.returncode != 0
     assert "No checkpoint" in r.stderr
+
+
+def test_predict_chunking_matches_single_pass(processed_dir, tmp_path):
+    """Chunked scoring (review fix) must equal one whole-dataset pass."""
+    env = _train(processed_dir, tmp_path)
+    for chunk, sub in (("64", "a"), ("100000", "b")):
+        e = dict(env)
+        e["DCT_PREDICT_CHUNK"] = chunk
+        e["DCT_PREDICTIONS"] = str(tmp_path / sub / "p.parquet")
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "jobs", "predict.py")],
+            env=e, capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+    a = pd.read_parquet(str(tmp_path / "a" / "p.parquet"))
+    b = pd.read_parquet(str(tmp_path / "b" / "p.parquet"))
+    np.testing.assert_allclose(a["prob_1"], b["prob_1"], atol=1e-6)
+
+
+def test_predict_picks_newest_best_by_mtime(processed_dir, tmp_path):
+    """Review regression: an older-but-lexicographically-later best file
+    must not win over the newest best checkpoint."""
+    import time
+
+    env = _train(processed_dir, tmp_path)
+    models = str(tmp_path / "models")
+    import glob as _glob
+    import shutil
+
+    best = _glob.glob(os.path.join(models, "weather-best-*.ckpt"))[0]
+    decoy = os.path.join(models, "weather-best-99-9.99.ckpt")
+    shutil.copy2(best, decoy)
+    os.utime(decoy, (time.time() - 3600, time.time() - 3600))  # older
+    out = str(tmp_path / "pred2" / "p.parquet")
+    env["DCT_PREDICTIONS"] = out
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "jobs", "predict.py")],
+        env=env, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert os.path.basename(best) in r.stdout, r.stdout
