@@ -162,6 +162,11 @@ class TrainConfig:
     # (effective batch = batch_size * data_parallel * this) — capability
     # the reference lacks; 1 = parity behavior.
     grad_accum_steps: int = 1
+    # Early stopping on val_loss: stop after this many epochs without
+    # improvement (0 = off, reference parity — Lightning users pair
+    # EarlyStopping with the ModelCheckpoint the reference configures).
+    early_stop_patience: int = 0
+    early_stop_min_delta: float = 0.0
 
     @classmethod
     def from_env(cls) -> "TrainConfig":
@@ -183,6 +188,12 @@ class TrainConfig:
         c.use_scan = _env("DCT_USE_SCAN", c.use_scan, bool)
         c.shard_opt_state = _env("DCT_SHARD_OPT_STATE", c.shard_opt_state, bool)
         c.grad_accum_steps = _env("DCT_GRAD_ACCUM_STEPS", c.grad_accum_steps, int)
+        c.early_stop_patience = _env(
+            "DCT_EARLY_STOP_PATIENCE", c.early_stop_patience, int
+        )
+        c.early_stop_min_delta = _env(
+            "DCT_EARLY_STOP_MIN_DELTA", c.early_stop_min_delta, float
+        )
         return c
 
 

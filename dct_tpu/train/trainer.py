@@ -331,6 +331,8 @@ class Trainer:
                 self.mesh, *self._stack_epoch(val_loader, 0)
             )
 
+        es_best: float | None = None
+        es_stale = 0
         try:
             for epoch in range(start_epoch, target_epochs):
                 profiler.maybe_start(epoch)
@@ -454,6 +456,32 @@ class Trainer:
                         "target_epochs": target_epochs,
                     },
                 )
+
+                # Early stopping (monitor val_loss, min mode — the
+                # companion of the reference's ModelCheckpoint policy).
+                # val_loss is a globally-reduced scalar, so every SPMD
+                # rank takes the same branch; a nan never counts as an
+                # improvement.
+                if cfg.train.early_stop_patience > 0:
+                    if es_best is None or val_loss < (
+                        es_best - cfg.train.early_stop_min_delta
+                    ):
+                        es_best = val_loss
+                        es_stale = 0
+                    else:
+                        es_stale += 1
+                        if es_stale >= cfg.train.early_stop_patience:
+                            # Mark the run COMPLETE at the stop point so a
+                            # resumed run EXTENDS (continuous semantics)
+                            # instead of "finishing" the old target.
+                            state_ckptr.save(
+                                state,
+                                meta={
+                                    "epochs_completed": epoch + 1,
+                                    "target_epochs": epoch + 1,
+                                },
+                            )
+                            break
 
         finally:
             # Crash-path hygiene: never leave a jax.profiler session open

@@ -1,0 +1,57 @@
+"""Early stopping on val_loss: stops after `patience` stale epochs, marks
+the run complete at the stop point so continuous-training resume EXTENDS
+rather than re-finishing the abandoned target."""
+
+import numpy as np
+
+from dct_tpu.config import DataConfig, RunConfig, TrainConfig
+from dct_tpu.tracking.client import LocalTracking
+from dct_tpu.train.trainer import Trainer
+
+
+def test_early_stop_halts_before_target(processed_dir, tmp_path):
+    """An impossible min_delta makes every epoch after the first 'stale',
+    so patience=2 stops the 10-epoch budget after exactly 3 epochs."""
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp_path / "m")),
+        train=TrainConfig(
+            epochs=10, batch_size=8, bf16_compute=False,
+            early_stop_patience=2, early_stop_min_delta=1e9,
+        ),
+    )
+    res = Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    assert [h["epoch"] for h in res.history] == [0, 1, 2]
+    assert np.isfinite(res.val_loss)
+
+
+def test_resume_after_early_stop_extends(processed_dir, tmp_path):
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp_path / "m")),
+        train=TrainConfig(
+            epochs=10, batch_size=8, bf16_compute=False,
+            early_stop_patience=1, early_stop_min_delta=1e9,
+        ),
+    )
+    r1 = Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    stopped_at = r1.history[-1]["epoch"] + 1
+    assert stopped_at < 10
+
+    cfg2 = RunConfig(
+        data=cfg.data,
+        train=TrainConfig(
+            epochs=2, batch_size=8, bf16_compute=False, resume=True
+        ),
+    )
+    r2 = Trainer(cfg2, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    # The stopped run counts as COMPLETE: the resume extends by 2 epochs
+    # from the stop point instead of resuming toward the abandoned 10.
+    assert [h["epoch"] for h in r2.history] == [stopped_at, stopped_at + 1]
+
+
+def test_early_stop_off_by_default(processed_dir, tmp_path):
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp_path / "m")),
+        train=TrainConfig(epochs=3, batch_size=8, bf16_compute=False),
+    )
+    res = Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    assert len(res.history) == 3
