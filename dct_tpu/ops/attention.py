@@ -20,11 +20,14 @@ The Pallas flash kernel (:mod:`dct_tpu.ops.pallas_attention`) slots in per
 :func:`select_attention_path` — single-shard on TPU, and as the per-shard
 block compute inside the ring.
 
-Future work (noted for the next round): causal ring attention uses the
-contiguous P("seq") layout, so device i computes i+1 visible KV blocks —
-a ~2x tail/head load imbalance. The striped ("zigzag") layout (each
-device holds chunks i and 2R-1-i) equalizes the work at the cost of a
-static sequence permutation and paired-chunk masks.
+Causal ring attention additionally supports the STRIPED ("zigzag")
+layout: the contiguous P("seq") layout gives device i exactly i+1
+visible KV shards, so the lock-stepped ring runs at the tail device's
+pace — a ~2x load imbalance. Striping splits the sequence into 2R
+chunks and hands device i chunks (i, 2R-1-i); every device then does
+exactly two half-chunk blocks of visible work at every ring step
+(:func:`striped_layout` derivation), so the causal ring is perfectly
+balanced at the cost of one static sequence permutation each way.
 """
 
 from __future__ import annotations
@@ -35,6 +38,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -189,6 +193,39 @@ def select_attention_path(
     return "dense"
 
 
+def striped_layout(t: int, ring_size: int):
+    """Striped ("zigzag") sequence layout for balanced causal ring
+    attention.
+
+    Splits ``t`` positions into ``2*ring_size`` chunks; device i holds
+    chunks (i, 2R-1-i) concatenated. Under a causal mask, chunk x sees
+    chunk y fully iff y < x and diagonally iff y == x, so at ring step s
+    every device's visible work is exactly two half-shard blocks:
+
+    - step 0 (src == my): diag(A_my) + full(B_my, A_my) + diag(B_my)
+    - src < my:            full(A_my, A_src) + full(B_my, A_src)
+    - src > my:            full(B_my, A_src) + full(B_my, B_src)
+
+    (A_i = chunk i, B_i = chunk 2R-1-i; B_my sees every A_src because
+    2R-1-my >= R > src, and never the other way.) Returns ``(perm,
+    inv)`` int arrays: ``x[..., perm, :]`` reorders a contiguous
+    sequence into striped layout, ``o[..., inv, :]`` undoes it.
+    """
+    if t % (2 * ring_size):
+        raise ValueError(
+            f"striped layout needs seq len {t} % {2 * ring_size} == 0"
+        )
+    c = t // (2 * ring_size)
+    order = []
+    for i in range(ring_size):
+        order.extend(range(i * c, (i + 1) * c))
+        j = 2 * ring_size - 1 - i
+        order.extend(range(j * c, (j + 1) * c))
+    perm = np.asarray(order, np.int32)
+    inv = np.argsort(perm).astype(np.int32)
+    return perm, inv
+
+
 def _merge_lse(o, lse, o_j, lse_j):
     """Fold a finalized (o_j, lse_j) attention block into the running
     (o, lse) pair: softmax-weighted combine — the online-softmax update
@@ -249,19 +286,94 @@ def _ring_body_flash(q, k, v, *, axis_name: str, ring_size: int,
     return o.astype(q.dtype)
 
 
+def _ring_body_flash_striped(q, k, v, *, axis_name: str, ring_size: int,
+                             scale: float | None, interpret: bool,
+                             block_q: int = 128, block_k: int = 128):
+    """Balanced CAUSAL ring attention on the striped layout, flash
+    per-shard compute. Local shards are [B, h, L, D] in striped order
+    (first half = chunk ``my``, second half = chunk ``2R-1-my``; see
+    :func:`striped_layout` for the three-case visibility analysis).
+    Every ring step costs exactly two half-chunk flash blocks on every
+    device — the causal ring's tail-device bottleneck is gone."""
+    from dct_tpu.ops.pallas_attention import flash_attention_lse
+
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    my = lax.axis_index(axis_name)
+    half = q.shape[-2] // 2
+    perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
+
+    def call(q_, k_, v_, causal_):
+        return flash_attention_lse(
+            q_, k_, v_, block_q, block_k, causal_, scale, interpret
+        )
+
+    q1, q2 = q[..., :half, :], q[..., half:, :]
+    k_cur, v_cur = k, v
+
+    # Step 0: the diagonal shard. A_my is causal over itself; B_my sees
+    # all of A_my plus its own causal diagonal.
+    k1, v1 = k_cur[..., :half, :], v_cur[..., :half, :]
+    k2, v2 = k_cur[..., half:, :], v_cur[..., half:, :]
+    o1_0, lse1 = call(q1, k1, v1, True)
+    o2a, lse2a = call(q2, k1, v1, False)
+    o2b, lse2b = call(q2, k2, v2, True)
+    o1 = o1_0.astype(jnp.float32)
+    o2, lse2 = _merge_lse(o2a.astype(jnp.float32), lse2a, o2b, lse2b)
+
+    for step in range(1, ring_size):  # static unroll: ring_size is static
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+
+        def visible_low(kc=k_cur, vc=v_cur):
+            # src < my: both halves of q see A_src fully, B_src never.
+            oa, la = call(q, kc[..., :half, :], vc[..., :half, :], False)
+            return (
+                oa[..., :half, :], la[..., :half],
+                oa[..., half:, :], la[..., half:],
+            )
+
+        def visible_high(kc=k_cur, vc=v_cur):
+            # src > my: A_my sees nothing, B_my sees the whole shard.
+            ob, lb = call(q2, kc, vc, False)
+            return (
+                jnp.zeros(q1.shape, q.dtype),
+                jnp.full(q1.shape[:-1], _NEG, jnp.float32),
+                ob, lb,
+            )
+
+        c1o, c1l, c2o, c2l = lax.cond(my >= step, visible_low, visible_high)
+        o1, lse1 = _merge_lse(o1, lse1, c1o, c1l)
+        o2, lse2 = _merge_lse(o2, lse2, c2o, c2l)
+
+    return jnp.concatenate([o1, o2], axis=-2).astype(q.dtype)
+
+
 def _ring_body(q, k, v, *, axis_name: str, ring_size: int, causal: bool,
-               scale: float | None, vary_axes: tuple = ()):
+               scale: float | None, vary_axes: tuple = (),
+               striped: bool = False):
     """Per-shard ring attention (runs inside shard_map).
 
     q,k,v are the LOCAL shards [B, h_local, T_local, D]. Each of the
     ``ring_size`` steps consumes the KV shard that originated on device
     ``(my_index - step) mod ring_size`` and then forwards it to the next
-    neighbor — a classic ICI ring pipeline.
+    neighbor — a classic ICI ring pipeline. With ``striped`` the local
+    shard is in :func:`striped_layout` order and the causal mask is
+    built from the striped GLOBAL positions instead of contiguous ones.
     """
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     my = lax.axis_index(axis_name)
     t_local = q.shape[-2]
-    q_pos = my * t_local + jnp.arange(t_local)
+
+    def positions(dev):
+        if not striped:
+            return dev * t_local + jnp.arange(t_local)
+        c = t_local // 2
+        return jnp.concatenate([
+            dev * c + jnp.arange(c),
+            (2 * ring_size - 1 - dev) * c + jnp.arange(c),
+        ])
+
+    q_pos = positions(my)
     perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
 
     def body(step, carry):
@@ -269,7 +381,7 @@ def _ring_body(q, k, v, *, axis_name: str, ring_size: int, causal: bool,
         src = (my - step) % ring_size
         mask = None
         if causal:
-            k_pos = src * t_local + jnp.arange(t_local)
+            k_pos = positions(src)
             mask = q_pos[:, None] >= k_pos[None, :]
         m, l, o = _online_block(q, k_cur, v_cur, scale, mask, m, l, o)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
@@ -292,7 +404,7 @@ def _ring_body(q, k, v, *, axis_name: str, ring_size: int, causal: bool,
 def ring_attention(
     q, k, v, *, mesh: Mesh, causal: bool = False, scale: float | None = None,
     seq_axis: str = "seq", data_axis: str = "data", model_axis: str = "model",
-    use_flash: bool | None = None,
+    use_flash: bool | None = None, striped: bool | None = None,
 ):
     """Sequence-parallel attention over ``mesh[seq_axis]``.
 
@@ -305,9 +417,21 @@ def ring_attention(
     :func:`flash_interpret_mode` policy. Interpret-vs-Mosaic is always
     resolved from the backend; the JAX-level online-softmax body is the
     fallback when flash is off or the local shard is not block-aligned.
+
+    ``striped``: causal-only. True runs the :func:`striped_layout` ring
+    (perfect per-step load balance — see module docstring); None enables
+    it automatically whenever the flash path is on and the half-chunk is
+    kernel-aligned (that is where balance pays: the flash causal ring
+    skips invisible shards, so the contiguous layout runs at the tail
+    device's pace); False keeps the contiguous layout.
     """
     ring_size = mesh.shape[seq_axis]
     b, h, t, _ = q.shape
+    if striped and not causal:
+        # Validate BEFORE any fallback: a non-causal layer misconfigured
+        # with striped=True must fail at trace time, not pass the batch-1
+        # init trace and surprise on the first real batch.
+        raise ValueError("striped ring layout only applies to causal")
     if b < mesh.shape[data_axis]:
         # The batch-1 init trace (flax shape inference) cannot tile the data
         # axis; dense is numerically identical, and no real batch is smaller
@@ -339,6 +463,58 @@ def ring_attention(
     else:
         flash_on = False
     t_local = t // ring_size
+    half = t_local // 2
+
+    def flash_aligned(n: int) -> bool:
+        # Mosaic tiles want 128-multiples. Interpret mode takes any size
+        # as long as every extent the striped body passes (half-chunk Tq,
+        # whole-shard Tq/Tk) divides its clamped block min(128, extent).
+        if not interpret:
+            return n % 128 == 0
+        divisible = lambda e: e >= 1 and e % min(128, e) == 0
+        return divisible(n) and divisible(t_local)
+    if striped is None:
+        striped = bool(
+            causal
+            and ring_size > 1
+            and t_local % 2 == 0
+            and flash_on
+            and flash_aligned(half)
+        )
+    elif striped:
+        if t_local % 2:
+            raise ValueError(
+                f"striped ring needs T/ring ({t_local}) even; got T={t}, "
+                f"ring={ring_size}"
+            )
+    if striped:
+        perm, inv = striped_layout(t, ring_size)
+        if flash_on and flash_aligned(half):
+            fn = functools.partial(
+                _ring_body_flash_striped,
+                axis_name=seq_axis,
+                ring_size=ring_size,
+                scale=scale,
+                interpret=bool(interpret),
+            )
+            vma_kw = {"check_vma": False}
+        else:
+            fn = functools.partial(
+                _ring_body,
+                axis_name=seq_axis,
+                ring_size=ring_size,
+                causal=True,
+                scale=scale,
+                vary_axes=(data_axis, model_axis, seq_axis),
+                striped=True,
+            )
+            vma_kw = {}
+        qs, ks, vs = (jnp.take(a, perm, axis=-2) for a in (q, k, v))
+        out = jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            **vma_kw,
+        )(qs, ks, vs)
+        return jnp.take(out, inv, axis=-2)
     if flash_on and t_local % 128 == 0 and t_local >= 128:
         fn = functools.partial(
             _ring_body_flash,

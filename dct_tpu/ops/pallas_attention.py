@@ -124,18 +124,23 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
 def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
                scale: float | None, interpret: bool, with_lse: bool = False):
     b, h, t, d = q.shape
+    tk = k.shape[2]  # rectangular Tq != Tk supported (striped ring blocks)
+    if causal and tk != t:
+        raise ValueError(
+            f"causal flash needs square Tq==Tk, got {t} vs {tk}"
+        )
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    if t % block_q or t % block_k:
+    block_k = min(block_k, tk)
+    if t % block_q or tk % block_k:
         raise ValueError(
-            f"seq len {t} must be a multiple of block_q={block_q} and "
-            f"block_k={block_k} (pad upstream)"
+            f"seq lens q={t}, kv={tk} must be multiples of "
+            f"block_q={block_q} and block_k={block_k} (pad upstream)"
         )
-    n_kv = t // block_k
+    n_kv = tk // block_k
     qf = q.reshape(b * h, t, d)
-    kf = k.reshape(b * h, t, d)
-    vf = v.reshape(b * h, t, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, n_kv=n_kv, causal=causal,
         scale=scale, with_lse=with_lse,
@@ -421,7 +426,10 @@ def _vjp_fwd(q, k, v, block_q, block_k, causal, scale, interpret):
 
 def _vjp_bwd(block_q, block_k, causal, scale, interpret, res, g):
     q, k, v, o, lse = res
-    if os.environ.get("DCT_FLASH_BWD", "kernel").strip().lower() == "remat":
+    rectangular = q.shape[-2] != k.shape[-2]  # bwd kernels assume square
+    if rectangular or os.environ.get(
+        "DCT_FLASH_BWD", "kernel"
+    ).strip().lower() == "remat":
         # Escape hatch: differentiate the numerically-identical blockwise
         # path instead of running the backward kernels.
         from dct_tpu.ops.attention import blockwise_attention

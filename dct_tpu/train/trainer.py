@@ -21,6 +21,7 @@ Plus what the reference lacks: true resume from full optimizer state
 
 from __future__ import annotations
 
+import math
 import os
 from dataclasses import dataclass, field
 
@@ -54,6 +55,27 @@ from dct_tpu.train.steps import (
     make_eval_step,
     make_train_step,
 )
+
+
+def early_stop_update(
+    val_loss: float,
+    best: float | None,
+    stale: int,
+    *,
+    patience: int,
+    min_delta: float,
+) -> tuple[float | None, int, bool]:
+    """One early-stopping step (monitor val_loss, min mode): returns the
+    updated ``(best, stale, stop)``. A NaN val_loss never counts as an
+    improvement — in particular a NaN on the FIRST monitored epoch must
+    not seed ``best`` (nothing compares below NaN, which would turn every
+    later finite epoch 'stale' and force a spurious stop)."""
+    improved = not math.isnan(val_loss) and (
+        best is None or val_loss < best - min_delta
+    )
+    if improved:
+        return val_loss, 0, False
+    return best, stale + 1, stale + 1 >= patience
 
 
 @dataclass
@@ -444,44 +466,39 @@ class Trainer:
                         params=host_params,
                         meta=meta,
                     )
-                # Every process keeps its own resume state (host-local
-                # disk) plus the run facts the next run's continuation
-                # semantics are decided from. The write overlaps the next
-                # epoch's compute (device->host snapshot is synchronous;
-                # the npz/rotation runs on a worker thread).
-                state_ckptr.save_async(
-                    state,
-                    meta={
-                        "epochs_completed": epoch + 1,
-                        "target_epochs": target_epochs,
-                    },
-                )
-
                 # Early stopping (monitor val_loss, min mode — the
                 # companion of the reference's ModelCheckpoint policy).
                 # val_loss is a globally-reduced scalar, so every SPMD
                 # rank takes the same branch; a nan never counts as an
-                # improvement.
+                # improvement (including as the first es_best).
+                stop_early = False
                 if cfg.train.early_stop_patience > 0:
-                    if es_best is None or val_loss < (
-                        es_best - cfg.train.early_stop_min_delta
-                    ):
-                        es_best = val_loss
-                        es_stale = 0
-                    else:
-                        es_stale += 1
-                        if es_stale >= cfg.train.early_stop_patience:
-                            # Mark the run COMPLETE at the stop point so a
-                            # resumed run EXTENDS (continuous semantics)
-                            # instead of "finishing" the old target.
-                            state_ckptr.save(
-                                state,
-                                meta={
-                                    "epochs_completed": epoch + 1,
-                                    "target_epochs": epoch + 1,
-                                },
-                            )
-                            break
+                    es_best, es_stale, stop_early = early_stop_update(
+                        val_loss, es_best, es_stale,
+                        patience=cfg.train.early_stop_patience,
+                        min_delta=cfg.train.early_stop_min_delta,
+                    )
+
+                # Every process keeps its own resume state (host-local
+                # disk) plus the run facts the next run's continuation
+                # semantics are decided from. The write overlaps the next
+                # epoch's compute (device->host snapshot is synchronous;
+                # the npz/rotation runs on a worker thread). On an early
+                # stop the run is marked COMPLETE at the stop point
+                # (target_epochs = epochs_completed) so a resumed run
+                # EXTENDS (continuous semantics) instead of "finishing"
+                # the abandoned target.
+                state_ckptr.save_async(
+                    state,
+                    meta={
+                        "epochs_completed": epoch + 1,
+                        "target_epochs": (
+                            epoch + 1 if stop_early else target_epochs
+                        ),
+                    },
+                )
+                if stop_early:
+                    break
 
         finally:
             # Crash-path hygiene: never leave a jax.profiler session open

@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Tile-tuning microbench for the Pallas flash-attention kernel.
+
+Sweeps (block_q, block_k) over the attention shapes the scaled bench uses
+and prints fwd / fwd+bwd step times for flash vs the XLA blockwise path.
+Run on the real chip:  python scripts/tune_flash.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from dct_tpu.utils.platform import ensure_live_backend  # noqa: E402
+
+ensure_live_backend()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from dct_tpu.ops.attention import blockwise_attention  # noqa: E402
+from dct_tpu.ops.pallas_attention import flash_attention  # noqa: E402
+
+
+def timeit(fn, *args, n=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    interpret = jax.default_backend() != "tpu"
+    causal_modes = (False, True)
+    shapes = [
+        # (B, H, T, D)
+        (16, 8, 1024, 64),
+        (8, 8, 2048, 64),
+        (2, 8, 8192, 64),
+    ]
+    blocks = [(128, 128), (128, 256), (128, 512), (256, 256), (256, 512),
+              (512, 512), (256, 1024), (512, 1024)]
+    rng = np.random.default_rng(0)
+    for (b, h, t, d) in shapes:
+        q = jnp.asarray(
+            rng.standard_normal((b, h, t, d)), jnp.bfloat16
+        )
+        k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+        for causal in causal_modes:
+            # XLA blockwise baselines, fwd and fwd+bwd
+            bw = jax.jit(
+                lambda q, k, v: blockwise_attention(
+                    q, k, v, block_size=512, causal=causal
+                )
+            )
+
+            def bw_loss(q, k, v):
+                return blockwise_attention(
+                    q, k, v, block_size=512, causal=causal
+                ).astype(jnp.float32).sum()
+
+            bw_grad = jax.jit(jax.grad(bw_loss, argnums=(0, 1, 2)))
+            t_bw = timeit(bw, q, k, v)
+            t_bwg = timeit(bw_grad, q, k, v)
+            print(
+                f"[{b}x{h}x{t}x{d} causal={causal}] blockwise "
+                f"fwd={t_bw*1e3:.2f}ms fwd+bwd={t_bwg*1e3:.2f}ms",
+                flush=True,
+            )
+            for (bq, bk) in blocks:
+                if t % bq or t % bk:
+                    continue
+                fl = jax.jit(
+                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                        q, k, v, bq, bk, causal, None, interpret
+                    )
+                )
+
+                def fl_loss(q, k, v, bq=bq, bk=bk):
+                    return flash_attention(
+                        q, k, v, bq, bk, causal, None, interpret
+                    ).astype(jnp.float32).sum()
+
+                fl_grad = jax.jit(jax.grad(fl_loss, argnums=(0, 1, 2)))
+                try:
+                    t_fl = timeit(fl, q, k, v)
+                    t_flg = timeit(fl_grad, q, k, v)
+                except Exception as e:  # noqa: BLE001
+                    print(f"  flash bq={bq} bk={bk}: FAILED {type(e).__name__}: {e}")
+                    continue
+                print(
+                    f"  flash bq={bq} bk={bk}: fwd={t_fl*1e3:.2f}ms "
+                    f"({t_bw/t_fl:.2f}x) fwd+bwd={t_flg*1e3:.2f}ms "
+                    f"({t_bwg/t_flg:.2f}x)",
+                    flush=True,
+                )
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,7 @@ import numpy as np
 
 from dct_tpu.config import DataConfig, RunConfig, TrainConfig
 from dct_tpu.tracking.client import LocalTracking
-from dct_tpu.train.trainer import Trainer
+from dct_tpu.train.trainer import Trainer, early_stop_update
 
 
 def test_early_stop_halts_before_target(processed_dir, tmp_path):
@@ -46,6 +46,23 @@ def test_resume_after_early_stop_extends(processed_dir, tmp_path):
     # The stopped run counts as COMPLETE: the resume extends by 2 epochs
     # from the stop point instead of resuming toward the abandoned 10.
     assert [h["epoch"] for h in r2.history] == [stopped_at, stopped_at + 1]
+
+
+def test_nan_first_epoch_does_not_seed_best():
+    """A NaN val_loss on the first monitored epoch must not become the
+    best: later finite improvements still reset the stale counter."""
+    best, stale, stop = early_stop_update(
+        float("nan"), None, 0, patience=3, min_delta=0.0
+    )
+    assert best is None and stale == 1 and not stop
+    best, stale, stop = early_stop_update(
+        0.5, best, stale, patience=3, min_delta=0.0
+    )
+    assert best == 0.5 and stale == 0 and not stop
+    best, stale, stop = early_stop_update(
+        0.4, best, stale, patience=3, min_delta=0.0
+    )
+    assert best == 0.4 and stale == 0 and not stop
 
 
 def test_early_stop_off_by_default(processed_dir, tmp_path):
