@@ -392,6 +392,20 @@ def bench_torch_reference(data) -> float:
     return steps * BATCH / dt
 
 
+def _section(name: str, fn, *args):
+    """Run one bench section with a wall-time line on stderr — the
+    on-chip runs go through a slow control-plane tunnel, and knowing
+    where the minutes went is the difference between tuning compute and
+    tuning dispatch."""
+    t0 = time.perf_counter()
+    out = fn(*args)
+    print(
+        f"[bench] {name}: {time.perf_counter() - t0:.1f}s",
+        file=sys.stderr, flush=True,
+    )
+    return out
+
+
 def main():
     import tempfile
 
@@ -406,12 +420,17 @@ def main():
     )
 
     with tempfile.TemporaryDirectory() as tmp:
-        data = _prepare_data(tmp)
-        baseline = bench_torch_reference(data)
-        ours, last_loss = bench_tpu(data)
-        trainer_loop = bench_trainer_loop(data, tmp)
-        scaled = None if skip_scaled else bench_scaled_transformer()
-        moe = None if skip_scaled else bench_scaled_moe()
+        data = _section("prepare_data", _prepare_data, tmp)
+        baseline = _section("torch_baseline", bench_torch_reference, data)
+        ours, last_loss = _section("parity_fused", bench_tpu, data)
+        trainer_loop = _section(
+            "trainer_loop", bench_trainer_loop, data, tmp
+        )
+        scaled = (
+            None if skip_scaled
+            else _section("scaled_transformer", bench_scaled_transformer)
+        )
+        moe = None if skip_scaled else _section("scaled_moe", bench_scaled_moe)
 
     import jax
 

@@ -355,22 +355,58 @@ class Trainer:
 
         es_best: float | None = None
         es_stale = 0
+
+        # Epoch-ahead input pipeline (scan path): epoch e+1's host batch
+        # assembly + H2D staging runs on a worker thread WHILE epoch e
+        # computes on device — shuffle/stack/device_put leave the step
+        # critical path (device_put is async; the transfer itself also
+        # overlaps compute). One epoch deep: bounded host memory, and the
+        # device queue never sees stale epochs after an early stop.
+        def _assemble_epoch(e: int):
+            # Annotated HERE so the profiler span follows the work onto
+            # the prefetch thread (the consumer side only joins a future).
+            with annotate("host_epoch_assembly"):
+                xs, ys, ws = self._stack_epoch(train_loader, e)
+                if accum > 1:
+                    # Whole accumulation groups only; the ragged tail
+                    # (< accum batches) is dropped, like drop_last on the
+                    # group granularity.
+                    s_eff = (xs.shape[0] // accum) * accum
+                    xs, ys, ws = xs[:s_eff], ys[:s_eff], ws[:s_eff]
+                return xs.shape[0], make_global_epoch(self.mesh, xs, ys, ws)
+
+        prefetch_pool = None
+        prefetched = None
+        if use_scan:
+            from concurrent.futures import ThreadPoolExecutor
+
+            prefetch_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="epoch-prefetch"
+            )
         try:
             for epoch in range(start_epoch, target_epochs):
                 profiler.maybe_start(epoch)
                 timer.start()
                 if use_scan:
-                    with annotate("host_epoch_assembly"):
-                        xs, ys, ws = self._stack_epoch(train_loader, epoch)
-                        if accum > 1:
-                            # Whole accumulation groups only; the ragged
-                            # tail (< accum batches) is dropped, like
-                            # drop_last on the group granularity.
-                            s_eff = (xs.shape[0] // accum) * accum
-                            xs, ys, ws = xs[:s_eff], ys[:s_eff], ws[:s_eff]
-                        gxs, gys, gws = make_global_epoch(self.mesh, xs, ys, ws)
-                    n_steps = xs.shape[0]
+                    if prefetched is not None:
+                        n_steps, (gxs, gys, gws) = prefetched.result()
+                    else:
+                        n_steps, (gxs, gys, gws) = _assemble_epoch(epoch)
                     state, losses = epoch_train(state, gxs, gys, gws)
+                    # Prefetch one epoch ahead UNLESS early stopping is
+                    # armed and already stale: the next epoch may never
+                    # run, and a speculative full-epoch H2D would sit in
+                    # HBM through checkpointing/upload for nothing.
+                    speculative_ok = not (
+                        cfg.train.early_stop_patience > 0
+                        and es_stale + 1 >= cfg.train.early_stop_patience
+                    )
+                    if epoch + 1 < target_epochs and speculative_ok:
+                        prefetched = prefetch_pool.submit(
+                            _assemble_epoch, epoch + 1
+                        )
+                    else:
+                        prefetched = None
                     jax.block_until_ready(state.params)
                     epoch_stats = timer.stop(epoch, n_steps * global_batch)
                     losses_host = jax.device_get(losses)
@@ -501,13 +537,18 @@ class Trainer:
                     break
 
         finally:
-            # Crash-path hygiene: never leave a jax.profiler session open
-            # or a resume-state write un-joined (each guarded so one
-            # cleanup failing cannot abandon the other).
+            # Crash-path hygiene: never leave a jax.profiler session open,
+            # a resume-state write un-joined, or the prefetch thread
+            # running (each guarded so one cleanup failing cannot abandon
+            # the others).
             try:
                 profiler.close()
             finally:
-                state_ckptr.wait()
+                try:
+                    state_ckptr.wait()
+                finally:
+                    if prefetch_pool is not None:
+                        prefetch_pool.shutdown(wait=True)
 
         # Rank-0 post-train artifact upload, mirroring
         # jobs/train_lightning_ddp.py:146-164 (best, else last.ckpt fallback).

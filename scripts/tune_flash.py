@@ -39,7 +39,20 @@ def timeit(fn, *args, n=20):
 
 
 def main():
-    interpret = jax.default_backend() != "tpu"
+    from dct_tpu.ops.attention import flash_interpret_mode
+
+    # Follow the product's DCT_FLASH policy. Off-TPU that resolves to
+    # None (interpret-mode Pallas is orders of magnitude slower than XLA
+    # blockwise — a sweep at T=8192 would take hours); opt in with
+    # DCT_FLASH=interpret to debug the harness itself on CPU.
+    mode = flash_interpret_mode()
+    if mode is None:
+        print(
+            "flash disabled by policy on this backend "
+            f"({jax.default_backend()}); set DCT_FLASH=interpret to force"
+        )
+        return
+    interpret = bool(mode)
     causal_modes = (False, True)
     shapes = [
         # (B, H, T, D)
