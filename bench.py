@@ -167,9 +167,17 @@ def bench_trainer_loop(data, tmp: str) -> float:
 
 
 # --- Scaled-config MFU ----------------------------------------------------
+# Env-overridable so on-chip tuning sweeps need no edits:
+#   DCT_SCALED_DMODEL/_DFF/_SEQ/_LAYERS/_HEADS/_BATCH
 
-SCALED = dict(d_model=512, n_heads=8, n_layers=2, d_ff=2048, seq_len=1024)
-SCALED_BATCH = 16
+SCALED = dict(
+    d_model=int(os.environ.get("DCT_SCALED_DMODEL", "512")),
+    n_heads=int(os.environ.get("DCT_SCALED_HEADS", "8")),
+    n_layers=int(os.environ.get("DCT_SCALED_LAYERS", "2")),
+    d_ff=int(os.environ.get("DCT_SCALED_DFF", "2048")),
+    seq_len=int(os.environ.get("DCT_SCALED_SEQ", "1024")),
+)
+SCALED_BATCH = int(os.environ.get("DCT_SCALED_BATCH", "16"))
 
 
 def _chip_peak_tflops() -> float | None:
@@ -348,6 +356,71 @@ def bench_scaled_moe() -> dict:
     }
 
 
+def bench_serving(tmp: str) -> dict:
+    """Inference latency of the deployed scoring path vs the reference's.
+
+    Our deploy package is framework-free numpy (serving/score_gen.py);
+    the reference's generated score.py runs a torch CPU forward inside
+    the Azure container (dags/azure_manual_deploy.py:116-124). Both are
+    measured here on the same host, same weights-shape model, single-row
+    (the endpoint request shape) and batch-64 payloads."""
+    import numpy as np
+    import torch
+
+    from dct_tpu.serving.runtime import score_payload
+    from dct_tpu.serving.score_gen import weights_from_checkpoint
+
+    ckpts = [
+        f for f in os.listdir(os.path.join(tmp, "bench_models"))
+        if f.endswith(".ckpt")
+    ]
+    weights, meta = weights_from_checkpoint(
+        os.path.join(tmp, "bench_models", sorted(ckpts)[0])
+    )
+
+    tmodel = torch.nn.Sequential(
+        torch.nn.Linear(int(meta["input_dim"]), int(meta["hidden_dim"])),
+        torch.nn.ReLU(),
+        torch.nn.Dropout(0.2),
+        torch.nn.Linear(int(meta["hidden_dim"]), int(meta["num_classes"])),
+    )
+    tmodel.eval()
+
+    rng = np.random.default_rng(0)
+    out = {}
+    for label, bsz in (("single_row", 1), ("batch64", 64)):
+        x = rng.standard_normal((bsz, int(meta["input_dim"])))
+        payload = {"data": x.tolist()}
+
+        # Both paths pay the per-request list->tensor conversion, exactly
+        # like the serving containers do (ours: score_payload's asarray;
+        # reference score.py: torch.tensor(data) per run() call).
+        def t_ours():
+            score_payload(weights, meta, payload["data"])
+
+        def t_torch():
+            with torch.no_grad():
+                xt = torch.tensor(payload["data"], dtype=torch.float32)
+                torch.softmax(tmodel(xt), dim=1).numpy()
+
+        times = {}
+        for name, fn in (("ours", t_ours), ("torch", t_torch)):
+            for _ in range(20):
+                fn()
+            samples = []
+            for _ in range(200):
+                t0 = time.perf_counter()
+                fn()
+                samples.append(time.perf_counter() - t0)
+            times[name] = float(np.median(samples) * 1e3)
+        out[label] = {
+            "numpy_p50_ms": round(times["ours"], 4),
+            "torch_p50_ms": round(times["torch"], 4),
+            "speedup": round(times["torch"] / times["ours"], 2),
+        }
+    return out
+
+
 def bench_torch_reference(data) -> float:
     """The reference's per-rank training loop, measured on this host's CPU."""
     import numpy as np
@@ -431,6 +504,7 @@ def main():
             else _section("scaled_transformer", bench_scaled_transformer)
         )
         moe = None if skip_scaled else _section("scaled_moe", bench_scaled_moe)
+        serving = _section("serving", bench_serving, tmp)
 
     import jax
 
@@ -452,6 +526,7 @@ def main():
         record["mfu"] = scaled.get("mfu")
     if moe is not None:
         record["moe"] = moe
+    record["serving"] = serving
     print(json.dumps(record))
 
 

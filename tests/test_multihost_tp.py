@@ -27,54 +27,73 @@ def test_process_data_block_single_process():
     assert process_data_block(mesh) == (1, 0)
 
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def launch_training(processed_dir, tmp_path, *, world_size: int, port: int,
+                    models_sub: str, runs_sub: str, env_overrides: dict):
+    """Launch ``world_size`` real jax.distributed CPU processes (one
+    device each) running jobs/train_tpu.py, and return the merged final
+    metrics of the newest tracking run. Shared by every
+    spanning-processes test; ``env_overrides`` carries the DCT_* config
+    that distinguishes the parallelism under test."""
+    env = {
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "DCT_PROCESSED_DIR": processed_dir,
+        "DCT_MODELS_DIR": str(tmp_path / models_sub),
+        "DCT_TRACKING_DIR": str(tmp_path / runs_sub),
+        "DCT_SEQ_LEN": "8",
+        "DCT_D_MODEL": "16",
+        "DCT_N_HEADS": "2",
+        "DCT_D_FF": "32",
+        "DCT_EPOCHS": "1",
+        "DCT_BATCH_SIZE": "16",
+        "DCT_BF16_COMPUTE": "0",
+        "DCT_MESH_DATA": "1",
+        "DCT_RESUME": "0",
+        **env_overrides,
+    }
+    launcher = LocalProcessLauncher(
+        coordinator_port=port, stagger_seconds=1.0, timeout=300
+    )
+    results = launcher.launch(
+        [sys.executable, os.path.join(_REPO, "jobs", "train_tpu.py")],
+        world_size=world_size,
+        env=env,
+    )
+    assert LocalProcessLauncher.all_succeeded(results), results
+    runs = sorted(
+        glob.glob(
+            str(tmp_path / runs_sub / "weather_forecasting" / "*" / "metrics.jsonl")
+        ),
+        key=os.path.getmtime,
+    )
+    assert runs, "no tracking run written"
+    last = {}
+    with open(runs[-1]) as f:
+        for line in f:
+            last.update(json.loads(line))
+    return last
+
+
 @pytest.mark.slow
 def test_tp_across_processes_trains_and_checkpoints(processed_dir, tmp_path):
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
     def run(world_size, mesh_model, models_sub, runs_sub, *, epochs=1,
             resume=False):
-        env = {
-            "PALLAS_AXON_POOL_IPS": "",
-            "JAX_PLATFORMS": "cpu",
-            # One device per process: the model axis must span PROCESSES.
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-            "DCT_PROCESSED_DIR": processed_dir,
-            "DCT_MODELS_DIR": str(tmp_path / models_sub),
-            "DCT_TRACKING_DIR": str(tmp_path / runs_sub),
-            "DCT_MODEL": "weather_transformer",
-            "DCT_SEQ_LEN": "8",
-            "DCT_D_MODEL": "16",
-            "DCT_N_HEADS": "2",
-            "DCT_N_LAYERS": "1",
-            "DCT_D_FF": "32",
-            "DCT_EPOCHS": str(epochs),
-            "DCT_BATCH_SIZE": "16",
-            "DCT_BF16_COMPUTE": "0",
-            "DCT_MESH_MODEL": str(mesh_model),
-            "DCT_MESH_DATA": "1",
-            "DCT_RESUME": "1" if resume else "0",
-        }
-        launcher = LocalProcessLauncher(
-            coordinator_port=29533, stagger_seconds=1.0, timeout=300
+        # One device per process: the model axis must span PROCESSES.
+        return launch_training(
+            processed_dir, tmp_path, world_size=world_size, port=29533,
+            models_sub=models_sub, runs_sub=runs_sub,
+            env_overrides={
+                "DCT_MODEL": "weather_transformer",
+                "DCT_N_LAYERS": "1",
+                "DCT_MESH_MODEL": str(mesh_model),
+                "DCT_EPOCHS": str(epochs),
+                "DCT_RESUME": "1" if resume else "0",
+            },
         )
-        results = launcher.launch(
-            [sys.executable, os.path.join(repo, "jobs", "train_tpu.py")],
-            world_size=world_size,
-            env=env,
-        )
-        assert LocalProcessLauncher.all_succeeded(results), results
-        runs = sorted(
-            glob.glob(
-                str(tmp_path / runs_sub / "weather_forecasting" / "*" / "metrics.jsonl")
-            ),
-            key=os.path.getmtime,
-        )
-        assert runs, "no tracking run written"
-        last = {}
-        with open(runs[-1]) as f:
-            for line in f:
-                last.update(json.loads(line))
-        return last
 
     m_tp = run(2, 2, "m_tp", "r_tp")
     m_ref = run(1, 1, "m_ref", "r_ref")
@@ -114,60 +133,49 @@ def test_ep_all_to_all_across_processes(processed_dir, tmp_path):
     procs, one device each, experts split over the model axis), and the
     loss trajectory matches the single-process sorted engine (ample
     capacity -> no drops -> parallelism is layout, not math)."""
-    import glob as _glob
-    import json as _json
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
     def run(world_size, mesh_model, models_sub, runs_sub):
-        env = {
-            "PALLAS_AXON_POOL_IPS": "",
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-            "DCT_PROCESSED_DIR": processed_dir,
-            "DCT_MODELS_DIR": str(tmp_path / models_sub),
-            "DCT_TRACKING_DIR": str(tmp_path / runs_sub),
-            "DCT_MODEL": "weather_moe",
-            "DCT_SEQ_LEN": "8",
-            "DCT_D_MODEL": "16",
-            "DCT_N_HEADS": "2",
-            "DCT_N_LAYERS": "1",
-            "DCT_D_FF": "32",
-            "DCT_N_EXPERTS": "4",
-            "DCT_MOE_DISPATCH": "sorted",
-            "DCT_CAPACITY_FACTOR": "8.0",
-            "DCT_EPOCHS": "1",
-            "DCT_BATCH_SIZE": "16",
-            "DCT_BF16_COMPUTE": "0",
-            "DCT_MESH_MODEL": str(mesh_model),
-            "DCT_MESH_DATA": "1",
-            "DCT_RESUME": "0",
-        }
-        launcher = LocalProcessLauncher(
-            coordinator_port=29534, stagger_seconds=1.0, timeout=300
+        return launch_training(
+            processed_dir, tmp_path, world_size=world_size, port=29534,
+            models_sub=models_sub, runs_sub=runs_sub,
+            env_overrides={
+                "DCT_MODEL": "weather_moe",
+                "DCT_N_LAYERS": "1",
+                "DCT_N_EXPERTS": "4",
+                "DCT_MOE_DISPATCH": "sorted",
+                "DCT_CAPACITY_FACTOR": "8.0",
+                "DCT_MESH_MODEL": str(mesh_model),
+            },
         )
-        results = launcher.launch(
-            [sys.executable, os.path.join(repo, "jobs", "train_tpu.py")],
-            world_size=world_size,
-            env=env,
-        )
-        assert LocalProcessLauncher.all_succeeded(results), results
-        runs = sorted(
-            _glob.glob(
-                str(tmp_path / runs_sub / "weather_forecasting" / "*" / "metrics.jsonl")
-            ),
-            key=os.path.getmtime,
-        )
-        assert runs
-        last = {}
-        with open(runs[-1]) as f:
-            for line in f:
-                last.update(_json.loads(line))
-        return last
 
     m_ep = run(2, 2, "m_ep", "r_ep")
     m_ref = run(1, 1, "m_ep_ref", "r_ep_ref")
     assert abs(m_ep["val_loss"] - m_ref["val_loss"]) < 1e-3, (m_ep, m_ref)
+
+
+@pytest.mark.slow
+def test_striped_causal_ring_across_processes(processed_dir, tmp_path):
+    """Striped (zigzag) causal ring attention SPANNING processes: 2
+    jax.distributed CPU procs (one device each), mesh seq=2, causal
+    family with DCT_FLASH=interpret — so the striped flash ring (static
+    sequence permutation, per-step lax.cond visibility cases, ppermute KV
+    hops) crosses a real process boundary. Loss must match the
+    single-process run (parallelism is layout, not math)."""
+    def run(world_size, seq_par, models_sub, runs_sub):
+        return launch_training(
+            processed_dir, tmp_path, world_size=world_size, port=29536,
+            models_sub=models_sub, runs_sub=runs_sub,
+            env_overrides={
+                "DCT_MODEL": "weather_transformer_causal",
+                "DCT_N_LAYERS": "1",
+                "DCT_FLASH": "interpret",
+                "DCT_MESH_SEQ": str(seq_par),
+                "DCT_MESH_MODEL": "1",
+            },
+        )
+
+    m_sp = run(2, 2, "m_sp", "r_sp")
+    m_ref = run(1, 1, "m_sp_ref", "r_sp_ref")
+    assert abs(m_sp["val_loss"] - m_ref["val_loss"]) < 1e-3, (m_sp, m_ref)
 
 
 @pytest.mark.slow
@@ -176,55 +184,18 @@ def test_pp_ppermute_across_processes(processed_dir, tmp_path):
     across 2 jax.distributed CPU procs (one device each); the GPipe
     ppermute hops cross a real process boundary and the loss trajectory
     matches the single-process sequential stack."""
-    import glob as _glob
-    import json as _json
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
     def run(world_size, pipe, models_sub, runs_sub):
-        env = {
-            "PALLAS_AXON_POOL_IPS": "",
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-            "DCT_PROCESSED_DIR": processed_dir,
-            "DCT_MODELS_DIR": str(tmp_path / models_sub),
-            "DCT_TRACKING_DIR": str(tmp_path / runs_sub),
-            "DCT_MODEL": "weather_transformer_pp",
-            "DCT_SEQ_LEN": "8",
-            "DCT_D_MODEL": "16",
-            "DCT_N_HEADS": "2",
-            "DCT_N_LAYERS": "2",
-            "DCT_D_FF": "32",
-            "DCT_N_STAGES": "2",
-            "DCT_EPOCHS": "1",
-            "DCT_BATCH_SIZE": "16",
-            "DCT_BF16_COMPUTE": "0",
-            "DCT_MESH_PIPE": str(pipe),
-            "DCT_MESH_DATA": "1",
-            "DCT_MESH_MODEL": "1",
-            "DCT_RESUME": "0",
-        }
-        launcher = LocalProcessLauncher(
-            coordinator_port=29535, stagger_seconds=1.0, timeout=300
+        return launch_training(
+            processed_dir, tmp_path, world_size=world_size, port=29535,
+            models_sub=models_sub, runs_sub=runs_sub,
+            env_overrides={
+                "DCT_MODEL": "weather_transformer_pp",
+                "DCT_N_LAYERS": "2",
+                "DCT_N_STAGES": "2",
+                "DCT_MESH_PIPE": str(pipe),
+                "DCT_MESH_MODEL": "1",
+            },
         )
-        results = launcher.launch(
-            [sys.executable, os.path.join(repo, "jobs", "train_tpu.py")],
-            world_size=world_size,
-            env=env,
-        )
-        assert LocalProcessLauncher.all_succeeded(results), results
-        runs = sorted(
-            _glob.glob(
-                str(tmp_path / runs_sub / "weather_forecasting" / "*" / "metrics.jsonl")
-            ),
-            key=os.path.getmtime,
-        )
-        assert runs
-        last = {}
-        with open(runs[-1]) as f:
-            for line in f:
-                last.update(_json.loads(line))
-        return last
 
     m_pp = run(2, 2, "m_pp", "r_pp")
     m_ref = run(1, 1, "m_pp_ref", "r_pp_ref")
