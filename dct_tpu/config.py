@@ -94,6 +94,11 @@ class ModelConfig:
     # the mesh's ``pipe`` axis; microbatches default to the stage count.
     n_stages: int = 2
     n_microbatches: int | None = None
+    # Causal family (weather_transformer_causal): forecast horizon. 1 =
+    # next-step (reference-style single label); H > 1 = DIRECT
+    # multi-horizon — every position predicts steps t+1..t+H at once
+    # (no autoregressive feedback), labels [B, S, H].
+    horizon: int = 1
 
     @classmethod
     def from_env(cls) -> "ModelConfig":
@@ -117,6 +122,7 @@ class ModelConfig:
         c.n_stages = _env("DCT_N_STAGES", c.n_stages, int)
         mb = os.environ.get("DCT_N_MICROBATCHES")
         c.n_microbatches = int(mb) if mb else c.n_microbatches
+        c.horizon = _env("DCT_HORIZON", c.horizon, int)
         return c
 
 

@@ -58,37 +58,62 @@ class WindowArrays:
 
 
 def make_windows(
-    data: WeatherArrays, seq_len: int, *, per_position_labels: bool = False
+    data: WeatherArrays, seq_len: int, *, per_position_labels: bool = False,
+    horizon: int = 1,
 ) -> WindowArrays:
-    """[N, F] rows -> [N-seq_len, seq_len, F] windows with next-step labels.
+    """[N, F] rows -> [N_w, seq_len, F] windows with next-step labels.
 
-    ``per_position_labels``: labels become [N, S] — position ``t`` of
+    ``per_position_labels``: labels become [N_w, S] — position ``t`` of
     window ``i`` is supervised with row ``i+t+1``'s label (causal
     next-step prediction at EVERY position, the causal transformer
     family's training signal); the final column equals the default
-    window-level label."""
+    window-level label.
+
+    ``horizon`` (per-position only): DIRECT multi-horizon supervision —
+    labels become [N_w, S, H] where entry (i, t, h) is row
+    ``i+t+1+h``'s label: every position forecasts steps t+1..t+H in one
+    forward pass, no autoregressive feedback. The window count shrinks
+    to ``N - seq_len - horizon + 1`` so every horizon slot exists.
+    """
     n = len(data)
     if seq_len < 1:
         raise ValueError(f"seq_len must be >= 1, got {seq_len}")
-    if n <= seq_len:
+    if horizon < 1:
+        raise ValueError(f"horizon must be >= 1, got {horizon}")
+    if horizon > 1 and not per_position_labels:
         raise ValueError(
-            f"Need more than seq_len={seq_len} rows to build windows; "
-            f"dataset has {n}."
+            "horizon > 1 requires per_position_labels=True (the causal "
+            "family's training signal)"
+        )
+    n_w = n - seq_len - horizon + 1
+    if n_w < 1:
+        raise ValueError(
+            f"Need more than seq_len+horizon-1={seq_len + horizon - 1} "
+            f"rows to build windows; dataset has {n}."
         )
     base = np.ascontiguousarray(data.features, dtype=np.float32)
     # sliding_window_view puts the window axis last: [N-S+1, F, S], zero-copy.
     windows = sliding_window_view(base, seq_len, axis=0)
     windows = np.moveaxis(windows, -1, 1)  # -> [N-S+1, S, F]
-    if per_position_labels:
+    if per_position_labels and horizon > 1:
+        lab = data.labels.astype(np.int32)
+        # Lh[j] = labels[j : j+H]; position t of window i needs Lh[i+t+1]
+        # -> a second sliding window of length S starting at i+1.
+        lh = sliding_window_view(lab, horizon)  # [N-H+1, H]
+        labels = np.ascontiguousarray(
+            sliding_window_view(lh, seq_len, axis=0)[1 : 1 + n_w]
+            .transpose(0, 2, 1)
+        )  # [N_w, S, H]; (i, t, h) = label of row i+t+1+h
+    elif per_position_labels:
         labels = np.ascontiguousarray(
             sliding_window_view(
                 data.labels[1:].astype(np.int32), seq_len, axis=0
-            )[: n - seq_len]
+            )[:n_w]
         )  # [N-S, S]; row i column t = label of row i+t+1
     else:
         labels = data.labels[seq_len:].astype(np.int32)
     return WindowArrays(
-        features=windows[: n - seq_len],
+        features=windows[:n_w],
         labels=labels,
         feature_names=list(data.feature_names),
         seq_len=int(seq_len),

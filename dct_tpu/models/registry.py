@@ -149,6 +149,7 @@ def _build_transformer_causal(
         dropout=cfg.dropout,
         attn_fn=make_attention_fn(mesh, causal=True),
         per_position=True,
+        horizon=cfg.horizon,
         compute_dtype=compute_dtype or jnp.float32,
     )
 

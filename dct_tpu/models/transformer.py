@@ -221,7 +221,9 @@ class WeatherTransformer(nn.Module):
     ``per_position``: decoder-style per-position head — [B, S, classes]
     logits, one next-step forecast per position (pair with a CAUSAL
     ``attn_fn`` so position t sees only rows <= t; the causal family in
-    the registry wires both)."""
+    the registry wires both). ``horizon`` > 1 widens that head to DIRECT
+    multi-horizon forecasting: [B, S, horizon, classes] logits, position
+    t predicting steps t+1..t+horizon in one pass."""
 
     input_dim: int
     seq_len: int
@@ -233,6 +235,7 @@ class WeatherTransformer(nn.Module):
     dropout: float = 0.1
     attn_fn: object = None  # default set in __call__ (dense/blockwise)
     per_position: bool = False
+    horizon: int = 1
     compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -261,7 +264,12 @@ class WeatherTransformer(nn.Module):
                 name=f"block_{i}",
             )(h, train=train)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)
-        if self.per_position:
+        if self.per_position and self.horizon > 1:
+            logits = TorchStyleDense(
+                self.num_classes * self.horizon, dtype=self.compute_dtype,
+                name="head",
+            )(h).reshape(*h.shape[:-1], self.horizon, self.num_classes)
+        elif self.per_position:
             logits = TorchStyleDense(
                 self.num_classes, dtype=self.compute_dtype, name="head"
             )(h)  # [B, S, classes]

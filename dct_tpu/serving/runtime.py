@@ -149,10 +149,15 @@ def _pre_ln_block(w: dict, pre: str, h: np.ndarray, n_heads: int, ffn,
 
 
 def _head_numpy(weights: dict, h: np.ndarray,
-                per_position: bool) -> np.ndarray:
+                per_position: bool, horizon: int = 1) -> np.ndarray:
     h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
     pooled = h[:, -1, :] if per_position else h.mean(axis=1)
-    return pooled @ weights["head/kernel"] + weights["head/bias"]
+    out = pooled @ weights["head/kernel"] + weights["head/bias"]
+    if per_position and horizon > 1:
+        # Multi-horizon causal head: [B, H*C] -> [B, H, C] — forecasts
+        # for steps t+1..t+H from the window's last position.
+        return out.reshape(out.shape[0], horizon, -1)
+    return out
 
 
 def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn, *,
@@ -173,7 +178,9 @@ def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn, *,
     h = h + _sincos_positions(s, d_model)
     for i in range(n_layers):
         h = _pre_ln_block(weights, f"block_{i}", h, n_heads, ffn, causal)
-    return _head_numpy(weights, h, per_position)
+    return _head_numpy(
+        weights, h, per_position, horizon=int(meta.get("horizon", 1))
+    )
 
 
 def transformer_forward_numpy(

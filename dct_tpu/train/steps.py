@@ -30,10 +30,13 @@ from dct_tpu.train.state import TrainState
 
 def _position_weight(logits, y, weight):
     """Per-position supervision support: [B, S, C] logits with [B, S]
-    labels broadcast the [B] row weight over positions (padded rows mask
-    every position; the mean stays per-position)."""
-    if logits.ndim == y.ndim + 1 and y.ndim == 2 and weight.ndim == 1:
-        return jnp.broadcast_to(weight[:, None], y.shape)
+    labels (or [B, S, H, C] with [B, S, H] — the multi-horizon causal
+    head) broadcast the [B] row weight over the label positions (padded
+    rows mask every position; the mean stays per-position)."""
+    if logits.ndim == y.ndim + 1 and y.ndim >= 2 and weight.ndim == 1:
+        return jnp.broadcast_to(
+            weight.reshape(-1, *([1] * (y.ndim - 1))), y.shape
+        )
     return weight
 
 
@@ -91,8 +94,11 @@ def _train_accum_body(state: TrainState, x, y, weight, accum_steps: int):
     xs = x.reshape(accum_steps, b // accum_steps, *x.shape[1:])
     ys = y.reshape(accum_steps, b // accum_steps, *y.shape[1:])
     ws = weight.reshape(accum_steps, b // accum_steps)
-    # Per-position supervision ([B, S] labels) counts every position.
-    positions = y.shape[1] if y.ndim == 2 else 1
+    # Per-position supervision ([B, S] or [B, S, H] labels) counts every
+    # supervised position.
+    positions = 1
+    for d in y.shape[1:]:
+        positions *= d
     total = jnp.maximum(weight.sum() * positions, 1.0)
 
     def chunk_loss(params, cx, cy, cw, rng):

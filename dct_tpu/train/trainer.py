@@ -124,17 +124,22 @@ class Trainer:
             from dct_tpu.data.windows import make_windows
             from dct_tpu.models.registry import is_causal_model
 
+            causal = is_causal_model(cfg.model.name)
             data = make_windows(
                 data, cfg.model.seq_len,
-                per_position_labels=is_causal_model(cfg.model.name),
+                per_position_labels=causal,
+                horizon=cfg.model.horizon if causal else 1,
             )
             # Overlapping windows leak under a random split; hold out the
-            # TAIL of the stream, gapped by seq_len so no val window shares
-            # rows with any train window.
+            # TAIL of the stream, gapped by seq_len (+ the extra horizon
+            # reach: train window i supervises label rows up to
+            # i+seq_len+horizon-1) so no val window shares rows — feature
+            # OR supervision — with any train window.
+            gap = cfg.model.seq_len + (cfg.model.horizon - 1 if causal else 0)
             train_idx, val_idx = contiguous_split(
                 len(data),
                 val_fraction=cfg.data.val_fraction,
-                gap=cfg.model.seq_len,
+                gap=gap,
             )
         else:
             train_idx, val_idx = train_val_split(

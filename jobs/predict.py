@@ -18,7 +18,10 @@ Env contract (DCT_* like every job):
 
 Sequence families score sliding windows (prediction i = forecast for the
 row after window i); row families score each row. Output columns:
-``prob_<class>`` per class and ``predicted`` (argmax).
+``prob_<class>`` per class and ``predicted`` (argmax). Multi-horizon
+causal checkpoints (meta horizon H > 1) instead emit per-horizon columns
+``prob_h<k>_<class>`` (k = 1..H) plus ``pred_h<k>`` for k >= 2;
+``predicted`` stays the next-step (h1) argmax.
 """
 
 from __future__ import annotations
@@ -104,11 +107,28 @@ def main() -> None:
         piece = np.ascontiguousarray(x[start:start + chunk], np.float32)
         probs_parts.append(softmax_numpy(forward_numpy(weights, meta, piece)))
     probs = np.concatenate(probs_parts, axis=0)
-    pred = np.argmax(probs, axis=-1)
 
-    frame = {"row": index, "predicted": pred.astype(np.int32)}
-    for c in range(probs.shape[-1]):
-        frame[f"prob_{c}"] = probs[:, c].astype(np.float32)
+    frame = {"row": index}
+    if probs.ndim == 3:
+        # Multi-horizon causal checkpoint: probs [N, H, C]. `predicted`
+        # stays the next-step (h=0) argmax so the column contract is
+        # unchanged; each further horizon adds pred_h<k>/prob_h<k>_<c>.
+        pred = np.argmax(probs[:, 0], axis=-1)
+        frame["predicted"] = pred.astype(np.int32)
+        for h in range(probs.shape[1]):
+            if h > 0:
+                frame[f"pred_h{h + 1}"] = np.argmax(
+                    probs[:, h], axis=-1
+                ).astype(np.int32)
+            for c in range(probs.shape[-1]):
+                frame[f"prob_h{h + 1}_{c}"] = probs[:, h, c].astype(
+                    np.float32
+                )
+    else:
+        pred = np.argmax(probs, axis=-1)
+        frame["predicted"] = pred.astype(np.int32)
+        for c in range(probs.shape[-1]):
+            frame[f"prob_{c}"] = probs[:, c].astype(np.float32)
     if truth is not None and np.asarray(truth).ndim == 1:
         frame["label"] = np.asarray(truth, np.int32)
         acc = float((pred == np.asarray(truth)).mean())

@@ -185,3 +185,120 @@ def test_epoch_scan_accum_with_per_position_labels(rng):
     )
     assert losses.shape == (2,)
     assert np.isfinite(np.asarray(losses)).all()
+
+
+# --- Direct multi-horizon forecasting (horizon > 1) -----------------------
+
+HCFG = dict(CFG, horizon=3)
+
+
+def test_multi_horizon_window_labels(rng):
+    rows = 20
+    feats = rng.standard_normal((rows, 3)).astype(np.float32)
+    labels = np.arange(rows, dtype=np.int32)  # label == row index
+    data = WeatherArrays(
+        features=feats, labels=labels, feature_names=["a", "b", "c"]
+    )
+    w = make_windows(data, 4, per_position_labels=True, horizon=3)
+    # N - S - H + 1 windows; [N_w, S, H] labels.
+    assert w.labels.shape == (14, 4, 3)
+    assert len(w) == 14
+    for i in (0, 7, 13):
+        for t in range(4):
+            # (i, t, h) = label of row i+t+1+h.
+            np.testing.assert_array_equal(
+                w.labels[i, t], np.arange(i + t + 1, i + t + 4)
+            )
+    # horizon=1 slice of the multi-horizon labels == the next-step labels.
+    w1 = make_windows(data, 4, per_position_labels=True)
+    np.testing.assert_array_equal(w.labels[:, :, 0], w1.labels[:14])
+
+
+def test_multi_horizon_requires_per_position():
+    data = WeatherArrays(
+        features=np.zeros((10, 2), np.float32),
+        labels=np.zeros(10, np.int32),
+        feature_names=["a", "b"],
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="per_position"):
+        make_windows(data, 4, horizon=2)
+
+
+def test_multi_horizon_model_shapes_and_causality(rng):
+    model = get_model(ModelConfig(**HCFG), input_dim=5)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
+    x = rng.standard_normal((2, 8, 5)).astype(np.float32)
+    out = np.asarray(model.apply(params, jnp.asarray(x)))
+    assert out.shape == (2, 8, 3, 2)
+    # Still causal: corrupting the future leaves earlier positions alone.
+    x2 = x.copy()
+    x2[:, 5:] += 100.0
+    pert = np.asarray(model.apply(params, jnp.asarray(x2)))
+    np.testing.assert_allclose(pert[:, :5], out[:, :5], atol=1e-5)
+
+
+def test_multi_horizon_train_step(rng):
+    model = get_model(ModelConfig(**HCFG), input_dim=5)
+    state = create_train_state(
+        model, input_dim=5, lr=1e-2, seed=0, example_shape=(1, 8, 5)
+    )
+    x = jnp.asarray(rng.standard_normal((4, 8, 5)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (4, 8, 3)), jnp.int32)
+    w = jnp.ones(4, jnp.float32).at[3].set(0.0)
+    step = make_train_step(donate=False)
+    _, m = step(state, x, y, w)
+    assert np.isfinite(float(jax.device_get(m["train_loss"])))
+    # Padded row masks every (position, horizon) cell.
+    x2 = x.at[3].add(100.0)
+    _, m2 = step(state, x2, y, w)
+    np.testing.assert_allclose(
+        float(m["train_loss"]), float(m2["train_loss"]), atol=1e-6
+    )
+
+
+def test_multi_horizon_trainer_e2e(processed_dir, tmp_path):
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp_path / "m")),
+        model=ModelConfig(**HCFG),
+        train=TrainConfig(epochs=1, batch_size=4, lr=1e-3, bf16_compute=False),
+    )
+    res = Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    assert np.isfinite(res.val_loss)
+    assert 0.0 <= res.val_acc <= 1.0
+    # The deploy checkpoint's meta carries the horizon for serving.
+    import glob
+
+    from dct_tpu.checkpoint.manager import load_checkpoint
+
+    best = glob.glob(str(tmp_path / "m" / "weather-best-*.ckpt"))
+    assert best
+    _, meta = load_checkpoint(best[0])
+    assert int(meta["horizon"]) == 3
+
+
+def test_multi_horizon_serving_parity(rng):
+    """numpy serving returns [B, H, C] probabilities for the window's last
+    position, matching the JAX model."""
+    from dct_tpu.serving.runtime import score_payload, softmax_numpy
+    from dct_tpu.serving.score_gen import _flatten_params
+
+    model = get_model(ModelConfig(**HCFG), input_dim=5)
+    variables = model.init(jax.random.PRNGKey(2), jnp.zeros((1, 8, 5)))
+    params = {"params": variables["params"]}
+    x = rng.standard_normal((3, 8, 5)).astype(np.float32)
+    jax_probs = softmax_numpy(
+        np.asarray(model.apply(params, jnp.asarray(x)))[:, -1]
+    )  # [B, H, C]
+    weights = _flatten_params(params["params"])
+    meta = {
+        "model": "weather_transformer_causal", "input_dim": 5,
+        "seq_len": 8, "d_model": 16, "n_heads": 2, "n_layers": 2,
+        "d_ff": 32, "num_classes": 2, "horizon": 3,
+    }
+    out = score_payload(weights, meta, x.tolist())
+    probs = np.asarray(out["probabilities"])
+    assert probs.shape == (3, 3, 2)
+    np.testing.assert_allclose(probs, jax_probs, atol=2e-5)
+    np.testing.assert_allclose(probs.sum(axis=-1), 1.0, atol=1e-5)

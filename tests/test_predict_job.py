@@ -63,6 +63,37 @@ def test_predict_job_end_to_end(processed_dir, tmp_path, model_env):
     assert acc > 0.6, acc
 
 
+@pytest.mark.slow
+def test_predict_job_multi_horizon(processed_dir, tmp_path):
+    """A horizon=3 causal checkpoint yields per-horizon prediction and
+    probability columns; next-step `predicted` keeps the base contract."""
+    env = _train(
+        processed_dir, tmp_path,
+        {"DCT_MODEL": "weather_transformer_causal", "DCT_SEQ_LEN": "8",
+         "DCT_D_MODEL": "16", "DCT_N_HEADS": "2", "DCT_N_LAYERS": "1",
+         "DCT_D_FF": "32", "DCT_HORIZON": "3"},
+    )
+    out = str(tmp_path / "pred" / "predictions.parquet")
+    env["DCT_PREDICTIONS"] = out
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "jobs", "predict.py")],
+        env=env, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    df = pd.read_parquet(out)
+    expect = {
+        "row", "predicted", "label",
+        "prob_h1_0", "prob_h1_1", "pred_h2", "prob_h2_0", "prob_h2_1",
+        "pred_h3", "prob_h3_0", "prob_h3_1",
+    }
+    assert expect <= set(df.columns), sorted(df.columns)
+    for h in (1, 2, 3):
+        np.testing.assert_allclose(
+            df[f"prob_h{h}_0"] + df[f"prob_h{h}_1"], np.ones(len(df)),
+            atol=1e-5,
+        )
+
+
 def test_predict_job_missing_checkpoint(tmp_path, processed_dir):
     env = {
         **os.environ,
