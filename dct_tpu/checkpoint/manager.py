@@ -354,7 +354,11 @@ class TrainStateCheckpointer:
                 f"Shard-saved leaf holds offsets {sorted(part_by_key)} but "
                 f"the current topology needs {sorted(want)}; resume "
                 "requires the same mesh/process topology that saved the "
-                "state"
+                "state. (If the topology is unchanged, this checkpoint "
+                "may predate declared-layout saves — written while the "
+                "step's output layout had drifted, e.g. ZeRO-1 sharded "
+                "output params; clear the train_state dir to restart "
+                "from the deploy checkpoint.)"
             )
         arrays = [
             jax.device_put(part_by_key[self._index_key(ix)], d)

@@ -45,7 +45,10 @@ from dct_tpu.parallel.mesh import (
     make_mesh,
     process_data_block,
 )
-from dct_tpu.parallel.sharding_rules import shard_state_with_rules
+from dct_tpu.parallel.sharding_rules import (
+    shard_state_with_rules,
+    state_shardings,
+)
 from dct_tpu.tracking.client import get_tracker
 from dct_tpu.train.state import create_train_state
 from dct_tpu.utils.profiling import EpochTimer, Profiler, annotate
@@ -235,6 +238,17 @@ class Trainer:
         # params with a cross-process allgather (checkpoint.manager.to_host),
         # called on EVERY rank before the coordinator-gated write.
         state = shard_state_with_rules(
+            state, self.mesh, shard_opt=cfg.train.shard_opt_state
+        )
+        # The DECLARED layout. The jitted step's OUTPUT shardings can
+        # drift from it — under ZeRO-1, XLA keeps the weight update (and
+        # therefore the output params) sharded over ``data`` instead of
+        # all-gathering — and the resume tier saves per-process local
+        # shards of whatever layout the state actually has. Checkpoints
+        # must be written in the declared layout, or a resumed process
+        # (whose fresh template is the declared layout) cannot match the
+        # saved shards to its topology.
+        declared_shardings = state_shardings(
             state, self.mesh, shard_opt=cfg.train.shard_opt_state
         )
 
@@ -529,8 +543,12 @@ class Trainer:
                 # (target_epochs = epochs_completed) so a resumed run
                 # EXTENDS (continuous semantics) instead of "finishing"
                 # the abandoned target.
+                # Re-pin to the declared layout before snapshotting (a
+                # no-op for leaves already there; a collective reshard —
+                # every rank calls it — for any the step's output layout
+                # drifted, e.g. ZeRO-1 output params).
                 state_ckptr.save_async(
-                    state,
+                    jax.device_put(state, declared_shardings),
                     meta={
                         "epochs_completed": epoch + 1,
                         "target_epochs": (
