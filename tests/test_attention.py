@@ -156,6 +156,16 @@ def test_flash_ring_unaligned_shard_falls_back(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_striped_flash_ring_composes_with_dp_tp(qkv):
+    """DP x TP x SP with the striped causal flash ring: batch over data,
+    heads over model, zigzag sequence layout over seq — all in one op."""
+    q, k, v = qkv
+    mesh = make_mesh(MeshConfig(data=2, model=2, seq=2))
+    ref = dense_attention(q, k, v, causal=True)
+    out = ring_attention(q, k, v, mesh=mesh, causal=True, use_flash=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_striped_rejects_non_causal(qkv):
     q, k, v = qkv
     mesh = make_mesh(MeshConfig(data=1, model=1, seq=2), allow_subset=True)

@@ -31,14 +31,12 @@ def test_process_data_block_single_process():
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def launch_training(processed_dir, tmp_path, *, world_size: int, port: int,
-                    models_sub: str, runs_sub: str, env_overrides: dict):
-    """Launch ``world_size`` real jax.distributed CPU processes (one
-    device each) running jobs/train_tpu.py, and return the merged final
-    metrics of the newest tracking run. Shared by every
-    spanning-processes test; ``env_overrides`` carries the DCT_* config
-    that distinguishes the parallelism under test."""
-    env = {
+def base_training_env(processed_dir, tmp_path, models_sub: str,
+                      runs_sub: str, env_overrides: dict) -> dict:
+    """The shared small-model CPU env for spanning-processes launches;
+    ``env_overrides`` carries the DCT_* config distinguishing the
+    parallelism under test."""
+    return {
         "PALLAS_AXON_POOL_IPS": "",
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
@@ -56,6 +54,17 @@ def launch_training(processed_dir, tmp_path, *, world_size: int, port: int,
         "DCT_RESUME": "0",
         **env_overrides,
     }
+
+
+def launch_training(processed_dir, tmp_path, *, world_size: int, port: int,
+                    models_sub: str, runs_sub: str, env_overrides: dict):
+    """Launch ``world_size`` real jax.distributed CPU processes (one
+    device each) running jobs/train_tpu.py, and return the merged final
+    metrics of the newest tracking run. Shared by every
+    spanning-processes test."""
+    env = base_training_env(
+        processed_dir, tmp_path, models_sub, runs_sub, env_overrides
+    )
     launcher = LocalProcessLauncher(
         coordinator_port=port, stagger_seconds=1.0, timeout=300
     )
@@ -217,6 +226,90 @@ def test_zero1_across_processes(processed_dir, tmp_path):
     # Continuing from a trained state must not be worse than the first
     # epoch's result by much (a wrong-moment restore diverges sharply).
     assert m_resume["val_loss"] < m_z["val_loss"] + 0.1, (m_resume, m_z)
+
+
+@pytest.mark.slow
+def test_sigkill_rank_then_resume(processed_dir, tmp_path):
+    """Crash recovery end to end: SIGKILL one rank MID-TRAINING (after at
+    least one epoch's resume state landed), assert the fail-fast launcher
+    reaps the survivor and reports failure, then a resume launch
+    continues from the rotated state instead of restarting from scratch."""
+    import json as _json
+    import signal
+    import subprocess
+    import threading
+    import time
+
+    env = base_training_env(
+        processed_dir, tmp_path, "m_kill", "r_kill",
+        {
+            # Long enough that the kill lands mid-run, short enough that
+            # the resume (which finishes to this interrupted target)
+            # stays fast.
+            "DCT_EPOCHS": "50",
+            "DCT_BATCH_SIZE": "8",
+            "DCT_MESH_DATA": "-1",
+            "DCT_RESUME": "1",
+        },
+    )
+    launcher = LocalProcessLauncher(
+        coordinator_port=29538, stagger_seconds=1.0, timeout=300
+    )
+    results = []
+    # train_tpu.py reads config from env only, so a marker argv scopes
+    # pgrep to THIS launch (never another test's or machine tenant's
+    # ranks). No leading dashes: pgrep would parse them as options.
+    marker = "sigkill_resume_test_marker"
+
+    def run():
+        results.extend(
+            launcher.launch(
+                [sys.executable, os.path.join(_REPO, "jobs", "train_tpu.py"),
+                 marker],
+                world_size=2,
+                env=env,
+            )
+        )
+
+    t = threading.Thread(target=run)
+    t.start()
+    # Wait until rank 0's first resume state is PUBLISHED (not just a
+    # .next in progress) so the kill lands mid-training with a
+    # restorable checkpoint on disk.
+    state_npz = (
+        tmp_path / "m_kill" / "train_state" / "p0" / "state" / "state.npz"
+    )
+    deadline = time.time() + 240
+    while time.time() < deadline and not state_npz.exists():
+        time.sleep(0.5)
+    assert state_npz.exists(), "no resume state appeared before deadline"
+    pids = subprocess.run(
+        ["pgrep", "-f", marker], capture_output=True, text=True
+    ).stdout.split()
+    assert pids, "no training rank processes found to kill"
+    os.kill(int(pids[0]), signal.SIGKILL)
+    t.join(timeout=240)
+    assert not t.is_alive(), "launcher did not return after rank kill"
+    assert not LocalProcessLauncher.all_succeeded(results), results
+    # Fail-fast must have reaped the survivor too.
+    leftover = subprocess.run(
+        ["pgrep", "-f", marker], capture_output=True, text=True
+    ).stdout.split()
+    assert not leftover, f"surviving ranks not reaped: {leftover}"
+
+    completed = _json.load(
+        open(tmp_path / "m_kill" / "train_state" / "p0" / "state" / "meta.json")
+    )["epochs_completed"]
+    assert completed >= 1
+
+    # Resume: finish a small extension from the rotated state.
+    m = launch_training(
+        processed_dir, tmp_path, world_size=2, port=29538,
+        models_sub="m_kill", runs_sub="r_kill",
+        env_overrides={"DCT_EPOCHS": "2", "DCT_RESUME": "1",
+                       "DCT_MESH_DATA": "-1", "DCT_BATCH_SIZE": "8"},
+    )
+    assert np.isfinite(m["val_loss"]), m
 
 
 @pytest.mark.slow
