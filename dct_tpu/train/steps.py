@@ -146,6 +146,38 @@ def make_train_step(donate: bool = True, accum_steps: int = 1):
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
 
 
+def _epoch_train_scan(state: TrainState, xs, ys, ws, accum_steps: int):
+    """Shared whole-epoch train scan body (see make_epoch_train_step)."""
+    if accum_steps > 1:
+        s, b = xs.shape[0], xs.shape[1]
+        xs = xs.reshape(s // accum_steps, accum_steps * b, *xs.shape[2:])
+        # Trailing label dims survive (per-position [S, B, seq] labels
+        # of the causal family).
+        ys = ys.reshape(s // accum_steps, accum_steps * b, *ys.shape[2:])
+        ws = ws.reshape(s // accum_steps, accum_steps * b)
+
+        def body(st, batch):
+            return _train_accum_body(st, *batch, accum_steps)
+    else:
+        def body(st, batch):
+            return _train_body(st, *batch)
+
+    return jax.lax.scan(body, state, (xs, ys, ws))
+
+
+def _epoch_eval_scan(state: TrainState, xs, ys, ws):
+    """Shared whole-valset eval scan body -> (loss_sum, acc_sum, count)."""
+
+    def body(carry, batch):
+        ls, accs, c = _eval_body(state, *batch)
+        l0, a0, c0 = carry
+        return (l0 + ls, a0 + accs, c0 + c), None
+
+    zeros = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
+    (loss_sum, acc_sum, count), _ = jax.lax.scan(body, zeros, (xs, ys, ws))
+    return loss_sum, acc_sum, count
+
+
 def make_epoch_train_step(donate: bool = True, accum_steps: int = 1):
     """Whole-epoch training as one XLA program: ``lax.scan`` of
     ``_train_body`` over the stacked batches [S, B, ...].
@@ -164,23 +196,28 @@ def make_epoch_train_step(donate: bool = True, accum_steps: int = 1):
     """
 
     def epoch_train(state: TrainState, xs, ys, ws):
-        if accum_steps > 1:
-            s, b = xs.shape[0], xs.shape[1]
-            xs = xs.reshape(s // accum_steps, accum_steps * b, *xs.shape[2:])
-            # Trailing label dims survive (per-position [S, B, seq] labels
-            # of the causal family).
-            ys = ys.reshape(s // accum_steps, accum_steps * b, *ys.shape[2:])
-            ws = ws.reshape(s // accum_steps, accum_steps * b)
-
-            def body(st, batch):
-                return _train_accum_body(st, *batch, accum_steps)
-        else:
-            def body(st, batch):
-                return _train_body(st, *batch)
-
-        return jax.lax.scan(body, state, (xs, ys, ws))
+        return _epoch_train_scan(state, xs, ys, ws, accum_steps)
 
     return jax.jit(epoch_train, donate_argnums=(0,) if donate else ())
+
+
+def make_epoch_train_eval_step(donate: bool = True, accum_steps: int = 1):
+    """Train epoch + full validation pass as ONE XLA program — one host
+    dispatch per epoch where train-then-eval would cost two. On a slow
+    control plane (tunneled TPU) the saved round trip is most of an
+    epoch's wall time at the parity batch size; the numerics are
+    identical to make_epoch_train_step followed by make_epoch_eval_step
+    (eval runs on the post-epoch state).
+
+    Returns (state, losses[S], (val_loss_sum, val_acc_sum, val_count)).
+    The validation stacks are NOT donated — they are reused every epoch.
+    """
+
+    def epoch_fused(state: TrainState, xs, ys, ws, vxs, vys, vws):
+        state, losses = _epoch_train_scan(state, xs, ys, ws, accum_steps)
+        return state, losses, _epoch_eval_scan(state, vxs, vys, vws)
+
+    return jax.jit(epoch_fused, donate_argnums=(0,) if donate else ())
 
 
 def make_eval_step():
@@ -191,15 +228,4 @@ def make_eval_step():
 def make_epoch_eval_step():
     """Whole-valset evaluation as one scan of ``_eval_body``; returns
     (loss_sum, acc_sum, count) global sums."""
-
-    def epoch_eval(state: TrainState, xs, ys, ws):
-        def body(carry, batch):
-            ls, accs, c = _eval_body(state, *batch)
-            l0, a0, c0 = carry
-            return (l0 + ls, a0 + accs, c0 + c), None
-
-        zeros = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
-        (loss_sum, acc_sum, count), _ = jax.lax.scan(body, zeros, (xs, ys, ws))
-        return loss_sum, acc_sum, count
-
-    return jax.jit(epoch_eval)
+    return jax.jit(_epoch_eval_scan)

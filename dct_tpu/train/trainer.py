@@ -53,8 +53,7 @@ from dct_tpu.tracking.client import get_tracker
 from dct_tpu.train.state import create_train_state
 from dct_tpu.utils.profiling import EpochTimer, Profiler, annotate
 from dct_tpu.train.steps import (
-    make_epoch_eval_step,
-    make_epoch_train_step,
+    make_epoch_train_eval_step,
     make_eval_step,
     make_train_step,
 )
@@ -312,8 +311,7 @@ class Trainer:
         use_scan = cfg.train.use_scan
         accum = max(1, cfg.train.grad_accum_steps)
         if use_scan:
-            epoch_train = make_epoch_train_step(accum_steps=accum)
-            epoch_eval = make_epoch_eval_step()
+            epoch_fused = make_epoch_train_eval_step(accum_steps=accum)
         else:
             train_step = make_train_step(accum_steps=accum)
             eval_step = make_eval_step()
@@ -411,7 +409,12 @@ class Trainer:
                         n_steps, (gxs, gys, gws) = prefetched.result()
                     else:
                         n_steps, (gxs, gys, gws) = _assemble_epoch(epoch)
-                    state, losses = epoch_train(state, gxs, gys, gws)
+                    # Train epoch + full eval in ONE dispatch (the saved
+                    # host round trip is most of an epoch's wall time on
+                    # a slow control plane at the parity batch size).
+                    state, losses, (ls, accs, c) = epoch_fused(
+                        state, gxs, gys, gws, *val_global
+                    )
                     # Prefetch one epoch ahead UNLESS early stopping is
                     # armed and already stale: the next epoch may never
                     # run, and a speculative full-epoch H2D would sit in
@@ -427,7 +430,12 @@ class Trainer:
                     else:
                         prefetched = None
                     jax.block_until_ready(state.params)
-                    epoch_stats = timer.stop(epoch, n_steps * global_batch)
+                    # The fused program runs the validation pass inside
+                    # the timed window; credit those forwards to MFU.
+                    epoch_stats = timer.stop(
+                        epoch, n_steps * global_batch,
+                        eval_samples=len(val_idx),
+                    )
                     losses_host = jax.device_get(losses)
                     n_updates = len(losses_host)
                     for i in range(n_updates):
@@ -483,7 +491,6 @@ class Trainer:
                     epoch_loss = loss_sum / n_updates if n_updates else None
 
                 if use_scan:
-                    ls, accs, c = epoch_eval(state, *val_global)
                     cnt = float(jax.device_get(c))
                     val_loss = float(jax.device_get(ls)) / cnt if cnt else float("nan")
                     val_acc = float(jax.device_get(accs)) / cnt if cnt else float("nan")

@@ -150,15 +150,24 @@ class EpochTimer:
     def start(self) -> None:
         self._t0 = time.perf_counter()
 
-    def stop(self, epoch: int, samples: int) -> EpochStats:
+    def stop(
+        self, epoch: int, samples: int, eval_samples: int = 0
+    ) -> EpochStats:
+        """``samples`` = TRAIN samples; ``eval_samples`` = validation
+        samples whose forward pass ran inside the timed window (the
+        fused train+eval epoch program). samples_per_sec stays
+        train-samples over the full epoch wall time — the reference's
+        per-epoch cadence also includes validation — while MFU credits
+        the eval forwards (1/3 of a train sample's FLOPs) so utilization
+        is not understated by work the denominator paid for."""
         dt = time.perf_counter() - self._t0
         sps = samples / dt if dt > 0 else 0.0
         mfu = None
-        if self.flops_per_sample and self.peak_flops:
-            mfu = (
-                sps / max(self.n_chips, 1) * self.flops_per_sample
-                / self.peak_flops
+        if self.flops_per_sample and self.peak_flops and dt > 0:
+            achieved = (
+                (samples + eval_samples / 3.0) * self.flops_per_sample / dt
             )
+            mfu = achieved / max(self.n_chips, 1) / self.peak_flops
         stats = EpochStats(
             epoch=epoch,
             seconds=dt,

@@ -11,6 +11,7 @@ from dct_tpu.tracking.client import LocalTracking
 from dct_tpu.train.state import create_train_state
 from dct_tpu.train.steps import (
     make_epoch_eval_step,
+    make_epoch_train_eval_step,
     make_epoch_train_step,
     make_eval_step,
     make_train_step,
@@ -44,6 +45,52 @@ def test_scan_equals_eager_steps(rng):
     sl, sp = scanned()
     np.testing.assert_allclose(el, sl, rtol=1e-6)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6), ep_, sp)
+
+
+def test_fused_train_eval_matches_separate(rng):
+    """The one-dispatch train+eval program == epoch train followed by
+    epoch eval (same losses, same params, same val sums)."""
+    x = rng.standard_normal((4, 8, 5)).astype(np.float32)
+    y = rng.integers(0, 2, (4, 8)).astype(np.int32)
+    w = np.ones((4, 8), np.float32)
+    vx = rng.standard_normal((2, 8, 5)).astype(np.float32)
+    vy = rng.integers(0, 2, (2, 8)).astype(np.int32)
+    vw = np.ones((2, 8), np.float32)
+    model = get_model(ModelConfig(), input_dim=5)  # dropout ACTIVE
+
+    def separate():
+        state = create_train_state(model, input_dim=5, lr=0.01, seed=42)
+        state, losses = make_epoch_train_step(donate=False)(
+            state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
+        )
+        ls, accs, c = make_epoch_eval_step()(
+            state, jnp.asarray(vx), jnp.asarray(vy), jnp.asarray(vw)
+        )
+        return (
+            jax.device_get(losses), jax.device_get(state.params),
+            (float(ls), float(accs), float(c)),
+        )
+
+    def fused():
+        state = create_train_state(model, input_dim=5, lr=0.01, seed=42)
+        state, losses, (ls, accs, c) = make_epoch_train_eval_step(
+            donate=False
+        )(
+            state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(vx), jnp.asarray(vy), jnp.asarray(vw),
+        )
+        return (
+            jax.device_get(losses), jax.device_get(state.params),
+            (float(ls), float(accs), float(c)),
+        )
+
+    sl, sp, sv = separate()
+    fl, fp, fv = fused()
+    np.testing.assert_allclose(sl, fl, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6), sp, fp
+    )
+    np.testing.assert_allclose(sv, fv, rtol=1e-6)
 
 
 def test_epoch_eval_matches_eager(rng):
