@@ -38,5 +38,35 @@ def masked_accuracy(logits, labels, weight):
     return jnp.sum(correct * w), jnp.sum(w)
 
 
+def masked_binary_counts(logits, labels, weight, *, positive: int = 1):
+    """Weighted (tp, fp, fn) sums for the ``positive`` class.
+
+    The building blocks of precision/recall/F1 as GLOBAL sums — exact
+    under any sharding/padding, same design as the (sum, count) metric
+    pairs (module docstring). Works for per-position/multi-horizon label
+    shapes: argmax is over the trailing class axis and ``weight`` must
+    already broadcast to the label shape."""
+    preds = jnp.argmax(jnp.asarray(logits, jnp.float32), axis=-1)
+    w = jnp.asarray(weight, jnp.float32)
+    is_pos_pred = (preds == positive).astype(jnp.float32)
+    is_pos_label = (labels == positive).astype(jnp.float32)
+    tp = jnp.sum(is_pos_pred * is_pos_label * w)
+    fp = jnp.sum(is_pos_pred * (1.0 - is_pos_label) * w)
+    fn = jnp.sum((1.0 - is_pos_pred) * is_pos_label * w)
+    return tp, fp, fn
+
+
+def precision_recall_f1(tp: float, fp: float, fn: float):
+    """Host-side finalization of the global count sums."""
+    precision = tp / (tp + fp) if (tp + fp) > 0 else 0.0
+    recall = tp / (tp + fn) if (tp + fn) > 0 else 0.0
+    f1 = (
+        2.0 * precision * recall / (precision + recall)
+        if (precision + recall) > 0
+        else 0.0
+    )
+    return precision, recall, f1
+
+
 def softmax_probs(logits):
     return jax.nn.softmax(jnp.asarray(logits, jnp.float32), axis=-1)

@@ -24,7 +24,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from dct_tpu.ops.losses import masked_accuracy, masked_cross_entropy
+from dct_tpu.ops.losses import (
+    masked_accuracy,
+    masked_binary_counts,
+    masked_cross_entropy,
+)
 from dct_tpu.train.state import TrainState
 
 
@@ -69,17 +73,20 @@ def _train_body(state: TrainState, x, y, weight):
 
 
 def _eval_body(state: TrainState, x, y, weight):
-    """One eval step -> (loss_sum, acc_sum, count) running-sum triple
-    (the reference's ``val_loss``/``val_acc``,
-    jobs/train_lightning_ddp.py:73-85). Sown aux losses are training
-    regularizers only; val_loss stays pure CE."""
+    """One eval step -> (loss_sum, acc_sum, count, tp, fp, fn) running
+    sums (the reference's ``val_loss``/``val_acc``,
+    jobs/train_lightning_ddp.py:73-85, plus the positive-class counts
+    behind precision/recall/F1 — a metric surface the reference's rain
+    classifier lacks). Sown aux losses are training regularizers only;
+    val_loss stays pure CE."""
     logits, _ = state.apply_fn(
         state.params, x, train=False, mutable=["aux_loss"]
     )
     w = _position_weight(logits, y, weight)
     loss_sum, count = masked_cross_entropy(logits, y, w)
     acc_sum, _ = masked_accuracy(logits, y, w)
-    return loss_sum, acc_sum, count
+    tp, fp, fn = masked_binary_counts(logits, y, w)
+    return loss_sum, acc_sum, count, tp, fp, fn
 
 
 def _train_accum_body(state: TrainState, x, y, weight, accum_steps: int):
@@ -166,16 +173,16 @@ def _epoch_train_scan(state: TrainState, xs, ys, ws, accum_steps: int):
 
 
 def _epoch_eval_scan(state: TrainState, xs, ys, ws):
-    """Shared whole-valset eval scan body -> (loss_sum, acc_sum, count)."""
+    """Shared whole-valset eval scan body -> the 6 global metric sums
+    (loss_sum, acc_sum, count, tp, fp, fn)."""
 
     def body(carry, batch):
-        ls, accs, c = _eval_body(state, *batch)
-        l0, a0, c0 = carry
-        return (l0 + ls, a0 + accs, c0 + c), None
+        sums = _eval_body(state, *batch)
+        return tuple(a + b for a, b in zip(carry, sums)), None
 
-    zeros = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
-    (loss_sum, acc_sum, count), _ = jax.lax.scan(body, zeros, (xs, ys, ws))
-    return loss_sum, acc_sum, count
+    zeros = tuple(jnp.zeros(()) for _ in range(6))
+    sums, _ = jax.lax.scan(body, zeros, (xs, ys, ws))
+    return sums
 
 
 def make_epoch_train_step(donate: bool = True, accum_steps: int = 1):
@@ -209,8 +216,9 @@ def make_epoch_train_eval_step(donate: bool = True, accum_steps: int = 1):
     identical to make_epoch_train_step followed by make_epoch_eval_step
     (eval runs on the post-epoch state).
 
-    Returns (state, losses[S], (val_loss_sum, val_acc_sum, val_count)).
-    The validation stacks are NOT donated — they are reused every epoch.
+    Returns (state, losses[S], the 6 eval sums (val_loss_sum,
+    val_acc_sum, val_count, tp, fp, fn)). The validation stacks are NOT
+    donated — they are reused every epoch.
     """
 
     def epoch_fused(state: TrainState, xs, ys, ws, vxs, vys, vws):
@@ -227,5 +235,5 @@ def make_eval_step():
 
 def make_epoch_eval_step():
     """Whole-valset evaluation as one scan of ``_eval_body``; returns
-    (loss_sum, acc_sum, count) global sums."""
+    the 6 global sums (loss_sum, acc_sum, count, tp, fp, fn)."""
     return jax.jit(_epoch_eval_scan)

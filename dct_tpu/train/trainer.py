@@ -38,6 +38,7 @@ from dct_tpu.config import RunConfig
 from dct_tpu.data.dataset import WeatherArrays, load_processed_dataset
 from dct_tpu.data.pipeline import BatchLoader, contiguous_split, train_val_split
 from dct_tpu.models.registry import get_model, is_sequence_model
+from dct_tpu.ops.losses import precision_recall_f1
 from dct_tpu.parallel.distributed import is_coordinator
 from dct_tpu.parallel.mesh import (
     make_global_batch,
@@ -412,7 +413,7 @@ class Trainer:
                     # Train epoch + full eval in ONE dispatch (the saved
                     # host round trip is most of an epoch's wall time on
                     # a slow control plane at the parity batch size).
-                    state, losses, (ls, accs, c) = epoch_fused(
+                    state, losses, val_sums = epoch_fused(
                         state, gxs, gys, gws, *val_global
                     )
                     # Prefetch one epoch ahead UNLESS early stopping is
@@ -491,18 +492,21 @@ class Trainer:
                     epoch_loss = loss_sum / n_updates if n_updates else None
 
                 if use_scan:
-                    cnt = float(jax.device_get(c))
-                    val_loss = float(jax.device_get(ls)) / cnt if cnt else float("nan")
-                    val_acc = float(jax.device_get(accs)) / cnt if cnt else float("nan")
+                    ls, accs, c, tp, fp, fn = (
+                        float(v) for v in jax.device_get(val_sums)
+                    )
+                    val_loss = ls / c if c else float("nan")
+                    val_acc = accs / c if c else float("nan")
                 else:
-                    val_loss, val_acc = self._evaluate(state, eval_step, val_loader)
+                    val_loss, val_acc, (tp, fp, fn) = self._evaluate(
+                        state, eval_step, val_loader
+                    )
                 epoch_rec = {
                     "epoch": epoch,
                     "train_loss": epoch_loss if epoch_loss is not None else float("nan"),
                     "val_loss": val_loss,
                     "val_acc": val_acc,
                 }
-                history.append(epoch_rec)
                 epoch_metrics = {
                     "train_loss_epoch": epoch_rec["train_loss"],
                     "val_loss": val_loss,
@@ -511,6 +515,21 @@ class Trainer:
                     "samples_per_sec": epoch_stats.samples_per_sec,
                     "samples_per_sec_per_chip": epoch_stats.samples_per_sec_per_chip,
                 }
+                if cfg.model.num_classes == 2:
+                    # Positive class 1 = "rain" (the reference's label
+                    # encoding, jobs/preprocess.py:23-25). One-vs-rest
+                    # counts would mislead for num_classes > 2, so the
+                    # P/R/F1 surface is binary-only.
+                    val_precision, val_recall, val_f1 = precision_recall_f1(
+                        tp, fp, fn
+                    )
+                    epoch_rec["val_f1"] = val_f1
+                    epoch_metrics.update(
+                        val_precision=val_precision,
+                        val_recall=val_recall,
+                        val_f1=val_f1,
+                    )
+                history.append(epoch_rec)
                 if epoch_stats.mfu is not None:
                     epoch_metrics["mfu"] = epoch_stats.mfu
                 self.tracker.log_metrics(epoch_metrics, step=global_step)
@@ -639,20 +658,14 @@ class Trainer:
         return loader.epoch_stacked(epoch)
 
     # ------------------------------------------------------------------
-    def _evaluate(self, state, eval_step, val_loader) -> tuple[float, float]:
-        loss_sum = jnp.zeros(())
-        acc_sum = jnp.zeros(())
-        count = jnp.zeros(())
+    def _evaluate(self, state, eval_step, val_loader):
+        """-> (val_loss, val_acc, (tp, fp, fn)) from the global sums."""
+        sums = [jnp.zeros(()) for _ in range(6)]
         for batch in val_loader.epoch(0):
             x, y, w = make_global_batch(self.mesh, batch.x, batch.y, batch.weight)
-            ls, accs, c = eval_step(state, x, y, w)
-            loss_sum += ls
-            acc_sum += accs
-            count += c
-        c = float(jax.device_get(count))
+            for i, v in enumerate(eval_step(state, x, y, w)):
+                sums[i] = sums[i] + v
+        ls, accs, c, tp, fp, fn = (float(v) for v in jax.device_get(sums))
         if c == 0:
-            return float("nan"), float("nan")
-        return (
-            float(jax.device_get(loss_sum)) / c,
-            float(jax.device_get(acc_sum)) / c,
-        )
+            return float("nan"), float("nan"), (0.0, 0.0, 0.0)
+        return ls / c, accs / c, (tp, fp, fn)

@@ -63,17 +63,17 @@ def test_fused_train_eval_matches_separate(rng):
         state, losses = make_epoch_train_step(donate=False)(
             state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
         )
-        ls, accs, c = make_epoch_eval_step()(
+        sums = make_epoch_eval_step()(
             state, jnp.asarray(vx), jnp.asarray(vy), jnp.asarray(vw)
         )
         return (
             jax.device_get(losses), jax.device_get(state.params),
-            (float(ls), float(accs), float(c)),
+            tuple(float(v) for v in sums),
         )
 
     def fused():
         state = create_train_state(model, input_dim=5, lr=0.01, seed=42)
-        state, losses, (ls, accs, c) = make_epoch_train_eval_step(
+        state, losses, sums = make_epoch_train_eval_step(
             donate=False
         )(
             state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w),
@@ -81,7 +81,7 @@ def test_fused_train_eval_matches_separate(rng):
         )
         return (
             jax.device_get(losses), jax.device_get(state.params),
-            (float(ls), float(accs), float(c)),
+            tuple(float(v) for v in sums),
         )
 
     sl, sp, sv = separate()
@@ -102,17 +102,22 @@ def test_epoch_eval_matches_eager(rng):
     w[2, 5:] = 0.0  # padded tail
 
     ev = make_eval_step()
-    tot = [0.0, 0.0, 0.0]
+    tot = [0.0] * 6
     for i in range(3):
-        ls, accs, c = ev(state, jnp.asarray(x[i]), jnp.asarray(y[i]), jnp.asarray(w[i]))
-        tot[0] += float(ls); tot[1] += float(accs); tot[2] += float(c)
+        for j, v in enumerate(
+            ev(state, jnp.asarray(x[i]), jnp.asarray(y[i]), jnp.asarray(w[i]))
+        ):
+            tot[j] += float(v)
 
     ep = make_epoch_eval_step()
-    ls, accs, c = ep(state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))
-    np.testing.assert_allclose(
-        [float(ls), float(accs), float(c)], tot, rtol=1e-6
-    )
-    assert float(c) == 21.0
+    sums = ep(state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))
+    np.testing.assert_allclose([float(v) for v in sums], tot, rtol=1e-6)
+    ls, accs, c, tp, fp, fn = (float(v) for v in sums)
+    assert c == 21.0
+    # Positive-class counts partition the real rows: tp+fp+fn <= count,
+    # and accuracy equals 1 - (fp+fn)/count for binary labels.
+    assert tp + fp + fn <= c
+    np.testing.assert_allclose(accs, c - fp - fn, rtol=1e-6)
 
 
 def test_trainer_scan_vs_eager_same_result(processed_dir, tmp_path):

@@ -85,7 +85,26 @@ def test_eval_step_sums(rng):
     x = rng.standard_normal((12, 5)).astype(np.float32)
     y = rng.integers(0, 2, 12).astype(np.int32)
     ev = make_eval_step()
-    ls, accs, c = ev(state, jnp.asarray(x), jnp.asarray(y), jnp.ones(12))
+    ls, accs, c, tp, fp, fn = ev(state, jnp.asarray(x), jnp.asarray(y), jnp.ones(12))
     assert float(c) == 12.0
+    assert float(tp) + float(fp) + float(fn) <= 12.0
     assert 0.0 <= float(accs) <= 12.0
     assert float(ls) > 0.0
+
+
+def test_binary_counts_and_f1(rng):
+    from dct_tpu.ops.losses import masked_binary_counts, precision_recall_f1
+
+    logits = jnp.asarray(
+        [[2.0, -1.0], [-1.0, 2.0], [-1.0, 2.0], [2.0, -1.0]], jnp.float32
+    )  # preds: 0, 1, 1, 0
+    labels = jnp.asarray([0, 1, 0, 1], jnp.int32)
+    w = jnp.asarray([1.0, 1.0, 1.0, 0.0], jnp.float32)  # last row padded
+    tp, fp, fn = masked_binary_counts(logits, labels, w)
+    # Real rows: pred/label pairs (0,0) (1,1) (1,0) -> tp=1 fp=1 fn=0.
+    assert (float(tp), float(fp), float(fn)) == (1.0, 1.0, 0.0)
+    p, r, f1 = precision_recall_f1(float(tp), float(fp), float(fn))
+    assert p == 0.5 and r == 1.0
+    np.testing.assert_allclose(f1, 2 * 0.5 * 1.0 / 1.5)
+    # Degenerate: no positives anywhere -> all zeros, no division error.
+    assert precision_recall_f1(0.0, 0.0, 0.0) == (0.0, 0.0, 0.0)
