@@ -264,6 +264,7 @@ def bench_scaled_transformer() -> dict:
     t_blockwise = _time_step(step, state, (gx, gy, gw))
 
     t_flash = None
+    causal = {}
     if flash_interpret_mode() is False:  # real Mosaic kernel available
         from dct_tpu.ops.pallas_attention import flash_attention
 
@@ -272,6 +273,25 @@ def bench_scaled_transformer() -> dict:
 
         state_fl = state.replace(apply_fn=build(flash_fn).apply)
         t_flash = _time_step(step, state_fl, (gx, gy, gw))
+
+        # CAUSAL variants: the flash kernel skips above-diagonal tiles
+        # (and elides their KV DMA) — roughly half the attention work —
+        # while the XLA blockwise path computes every block and masks.
+        def flash_causal(q, k, v):
+            return flash_attention(q, k, v, 128, 128, True)
+
+        def blockwise_causal(q, k, v):
+            return blockwise_attention(
+                q, k, v, block_size=min(512, q.shape[-2]), causal=True
+            )
+
+        for name, fn in (
+            ("flash", flash_causal), ("blockwise", blockwise_causal),
+        ):
+            st = state.replace(apply_fn=build(fn).apply)
+            causal[f"attn_causal_{name}_ms"] = round(
+                _time_step(step, st, (gx, gy, gw)) * 1e3, 2
+            )
 
     from dct_tpu.utils.profiling import transformer_train_flops
 
@@ -288,6 +308,7 @@ def bench_scaled_transformer() -> dict:
         "attn_blockwise_ms": round(t_blockwise * 1e3, 2),
         "attn_flash_ms": round(t_flash * 1e3, 2) if t_flash else None,
         "samples_per_sec_per_chip": round(batch / t_best / mesh.size, 1),
+        **causal,
     }
     if peak:
         out["chip_peak_bf16_tflops"] = peak

@@ -541,9 +541,12 @@ class Trainer:
                 if params_cross_process or self.coordinator:
                     host_params = to_host(state.params)
                 if self.coordinator:
+                    ckpt_metrics = {"val_loss": val_loss, "val_acc": val_acc}
+                    if "val_f1" in epoch_rec:
+                        ckpt_metrics["val_f1"] = epoch_rec["val_f1"]
                     ckptr.update(
                         epoch=epoch,
-                        metrics={"val_loss": val_loss, "val_acc": val_acc},
+                        metrics=ckpt_metrics,
                         params=host_params,
                         meta=meta,
                     )
