@@ -1,0 +1,114 @@
+"""Local HTTP inference server: the Azure Managed Online Endpoint
+contract, runnable anywhere, dependency-free.
+
+The reference serves ONLY through an Azure endpoint (its generated
+score.py runs inside azureml-inference-server,
+dags/azure_manual_deploy.py:54-125) — there is no way to exercise the
+request/response contract without a cloud deployment. This server wraps
+the same :func:`dct_tpu.serving.runtime.score_payload` body behind the
+same wire contract on stdlib ``http.server``:
+
+- ``POST /score``   — ``{"data": ...}`` -> ``{"probabilities": ...}``
+  (exactly the reference's run() contract; multi-horizon causal
+  checkpoints return per-horizon probability lists)
+- ``GET /healthz``  — 200 ``{"status": "ok", "model": ..., "horizon": ...}``
+  once the model is loaded (the endpoint analog of the compose
+  healthchecks, docker-compose.yml:48-52)
+
+Errors mirror the score.py behavior: a malformed payload returns 400
+with the validation message rather than a 500.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from dct_tpu.serving.score_gen import weights_from_checkpoint
+from dct_tpu.serving.runtime import score_payload
+
+
+class ScoreHandler(BaseHTTPRequestHandler):
+    """Per-request handler; the loaded model rides on the server object
+    (ThreadingHTTPServer => score_payload must be thread-safe: it is —
+    pure numpy on read-only weights)."""
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # quiet by default; DCT_SERVE_LOG=1
+        if os.environ.get("DCT_SERVE_LOG"):
+            super().log_message(fmt, *args)
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path != "/healthz":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        meta = self.server.model_meta
+        self._reply(
+            200,
+            {
+                "status": "ok",
+                "model": meta.get("model", "weather_mlp"),
+                "input_dim": int(meta.get("input_dim", 0)),
+                "horizon": int(meta.get("horizon", 1)),
+            },
+        )
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        if self.path != "/score":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict) or "data" not in payload:
+                raise ValueError('payload must be {"data": [...]}')
+        except (ValueError, TypeError) as e:  # malformed JSON / envelope
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            result = score_payload(
+                self.server.model_weights, self.server.model_meta,
+                payload["data"],
+            )
+        except (ValueError, TypeError) as e:
+            # score_payload validation (wrong shape, ragged/non-numeric
+            # rows): the client's fault.
+            self._reply(400, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — a broken checkpoint or
+            # export defect is a SERVER error; blaming the request would
+            # send operators debugging the wrong side.
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(200, result)
+
+
+def make_server(ckpt_path: str, *, host: str = "127.0.0.1", port: int = 0):
+    """Load the checkpoint and return a ready (unstarted)
+    ThreadingHTTPServer; ``port=0`` binds an ephemeral port
+    (``server.server_address[1]`` after construction)."""
+    weights, meta = weights_from_checkpoint(ckpt_path)
+    server = ThreadingHTTPServer((host, port), ScoreHandler)
+    server.model_weights = weights
+    server.model_meta = meta
+    return server
+
+
+def serve_forever(ckpt_path: str, *, host: str = "0.0.0.0",
+                  port: int = 8901) -> None:
+    server = make_server(ckpt_path, host=host, port=port)
+    meta = server.model_meta
+    print(
+        f"serving {meta.get('model', 'weather_mlp')} from {ckpt_path} on "
+        f"http://{host}:{port} (POST /score, GET /healthz)",
+        flush=True,
+    )
+    server.serve_forever()

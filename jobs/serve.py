@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Local inference server CLI — the Azure endpoint contract without Azure.
+
+Env contract (matching the other job CLIs):
+  DCT_MODELS_DIR  — where checkpoints live (default data/models);
+                    newest best ckpt is served, else last.ckpt
+  DCT_CKPT        — serve a specific checkpoint file instead
+  DCT_SERVE_HOST  — bind host (default 0.0.0.0)
+  DCT_SERVE_PORT  — bind port (default 8901)
+
+POST /score {"data": ...} -> {"probabilities": ...}; GET /healthz.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main() -> int:
+    from jobs.predict import _find_checkpoint
+    from dct_tpu.serving.server import serve_forever
+
+    models_dir = os.environ.get("DCT_MODELS_DIR", "data/models")
+    ckpt = _find_checkpoint(models_dir)
+    serve_forever(
+        ckpt,
+        host=os.environ.get("DCT_SERVE_HOST", "0.0.0.0"),
+        port=int(os.environ.get("DCT_SERVE_PORT", "8901")),
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

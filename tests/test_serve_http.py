@@ -1,0 +1,137 @@
+"""Local HTTP inference server: the Azure endpoint request/response
+contract (POST /score, GET /healthz) served in-process."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dct_tpu.checkpoint.manager import save_checkpoint
+from dct_tpu.config import DataConfig, ModelConfig, RunConfig, TrainConfig
+from dct_tpu.models.registry import get_model
+from dct_tpu.serving.server import make_server
+from dct_tpu.tracking.client import LocalTracking
+from dct_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def served_mlp(processed_dir, tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serve")
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp / "m")),
+        train=TrainConfig(epochs=1, batch_size=8, bf16_compute=False),
+    )
+    res = Trainer(cfg, tracker=LocalTracking(root=str(tmp / "r"))).fit()
+    server = make_server(res.best_model_path)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+    server.server_close()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url + "/score",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_healthz(served_mlp):
+    with urllib.request.urlopen(served_mlp + "/healthz") as r:
+        body = json.loads(r.read())
+    assert body["status"] == "ok"
+    assert body["model"] == "weather_mlp"
+    assert body["input_dim"] == 5
+
+
+def test_score_contract(served_mlp):
+    out = _post(served_mlp, {"data": [[0.1, -0.2, 0.3, 0.0, 1.0]]})
+    probs = np.asarray(out["probabilities"])
+    assert probs.shape == (1, 2)
+    np.testing.assert_allclose(probs.sum(axis=-1), 1.0, atol=1e-5)
+    # Batch of 3 rows.
+    out = _post(served_mlp, {"data": np.zeros((3, 5)).tolist()})
+    assert np.asarray(out["probabilities"]).shape == (3, 2)
+
+
+def test_bad_payload_is_400_not_500(served_mlp):
+    for payload in (
+        {"data": [[1.0, 2.0]]},  # wrong feature count
+        {"rows": [[0.0] * 5]},  # missing "data" key
+    ):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(served_mlp, payload)
+        assert e.value.code == 400
+        assert "error" in json.loads(e.value.read())
+
+
+def test_broken_checkpoint_is_500_not_400(processed_dir, tmp_path):
+    """A server-side defect (missing weight key) must surface as 500 —
+    blaming the request would send operators debugging the wrong side."""
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp_path / "m")),
+        train=TrainConfig(epochs=1, batch_size=8, bf16_compute=False),
+    )
+    res = Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    server = make_server(res.best_model_path)
+    server.model_weights = {
+        k: v for k, v in server.model_weights.items() if k != "w0"
+    }
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, {"data": [[0.0] * 5]})
+        assert e.value.code == 500
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_unknown_route_404(served_mlp):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(served_mlp + "/nope")
+    assert e.value.code == 404
+
+
+def test_multi_horizon_server(tmp_path):
+    """A horizon=3 causal checkpoint serves [B, H, C] probabilities and
+    reports its horizon in /healthz."""
+    cfg = ModelConfig(
+        name="weather_transformer_causal", seq_len=8, d_model=16,
+        n_heads=2, n_layers=1, d_ff=32, horizon=3,
+    )
+    model = get_model(cfg, input_dim=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
+    meta = {
+        "model": cfg.name, "input_dim": 5, "seq_len": 8, "d_model": 16,
+        "n_heads": 2, "n_layers": 1, "d_ff": 32, "num_classes": 2,
+        "horizon": 3, "hidden_dim": 64,
+    }
+    ckpt = str(tmp_path / "causal.ckpt")
+    save_checkpoint(ckpt, {"params": variables["params"]}, meta)
+
+    server = make_server(ckpt)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        with urllib.request.urlopen(url + "/healthz") as r:
+            assert json.loads(r.read())["horizon"] == 3
+        out = _post(url, {"data": np.zeros((2, 8, 5)).tolist()})
+        probs = np.asarray(out["probabilities"])
+        assert probs.shape == (2, 3, 2)
+        np.testing.assert_allclose(probs.sum(axis=-1), 1.0, atol=1e-5)
+    finally:
+        server.shutdown()
+        server.server_close()
