@@ -381,6 +381,46 @@ def bench_scaled_moe() -> dict:
     }
 
 
+def bench_host_dataplane() -> dict | None:
+    """Native C++ data plane vs pure-numpy host gathers — the input
+    pipeline work that runs on the prefetch thread (CPU-side regardless
+    of accelerator). Returns None when the native library is absent
+    (the numpy fallback is then the product path)."""
+    import numpy as np
+
+    from dct_tpu import native
+
+    if not native.available():
+        return None
+
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal((200_000, 5)).astype(np.float32)
+    idx = rng.integers(0, len(base), 65_536)
+    starts = rng.integers(0, len(base) - 64, 8_192)
+
+    def timeit(fn, n=20):
+        fn()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n
+
+    t_rows_native = timeit(lambda: native.gather_rows(base, idx))
+    t_rows_numpy = timeit(lambda: base[idx])
+    t_win_native = timeit(lambda: native.gather_windows(base, starts, 64))
+    t_win_numpy = timeit(
+        lambda: np.stack([base[s : s + 64] for s in starts])
+    )
+    return {
+        "rows_native_ms": round(t_rows_native * 1e3, 3),
+        "rows_numpy_ms": round(t_rows_numpy * 1e3, 3),
+        "rows_speedup": round(t_rows_numpy / t_rows_native, 2),
+        "windows_native_ms": round(t_win_native * 1e3, 3),
+        "windows_numpy_ms": round(t_win_numpy * 1e3, 3),
+        "windows_speedup": round(t_win_numpy / t_win_native, 2),
+    }
+
+
 def bench_serving(tmp: str) -> dict:
     """Inference latency of the deployed scoring path vs the reference's.
 
@@ -554,6 +594,7 @@ def main():
             else _section("scaled_moe", bench_scaled_moe)
         )
         serving = _section("serving", bench_serving, tmp)
+        dataplane = _section("host_dataplane", bench_host_dataplane)
 
     import jax
 
@@ -577,6 +618,8 @@ def main():
     if moe is not None:
         record["moe"] = moe
     record["serving"] = serving
+    if dataplane is not None:
+        record["host_dataplane"] = dataplane
     print(json.dumps(record))
 
 

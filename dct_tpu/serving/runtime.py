@@ -300,15 +300,13 @@ _SEQUENCE_FAMILIES = (
 )
 
 
-def score_payload(weights: dict, meta: dict, data) -> dict:
-    """The run()-body: validate + forward + softmax.
+def validate_payload(meta: dict, data) -> np.ndarray:
+    """Client-input validation: payload -> float32 batch array.
 
-    Mirrors the reference's response contract
-    (dags/azure_manual_deploy.py:116-124): {"probabilities": [[...], ...]}.
-    Row families take {"data": [[feature vector], ...]}; sequence families
-    take {"data": [[[row x seq_len] window], ...]} (one window may be passed
-    un-batched).
-    """
+    Raises ValueError for anything that is the REQUEST's fault (ragged or
+    non-numeric rows, wrong shape, non-finite values after float32
+    conversion) — callers can map exactly this to an HTTP 400 while
+    treating any later forward-pass failure as a server defect."""
     x = np.asarray(data, dtype=np.float32)
     expected = int(meta["input_dim"])
     family = meta.get("model", "weather_mlp")
@@ -330,5 +328,24 @@ def score_payload(weights: dict, meta: dict, data) -> dict:
                 f"Expected shape [N, {expected}] (features: "
                 f"{meta.get('feature_names', '?')}), got {list(x.shape)}"
             )
+    if not np.isfinite(x).all():
+        # Includes float32 overflow of huge JSON numbers: softmax of an
+        # inf logit is NaN, which is not valid strict JSON.
+        raise ValueError(
+            "features must be finite after float32 conversion"
+        )
+    return x
+
+
+def score_payload(weights: dict, meta: dict, data) -> dict:
+    """The run()-body: validate + forward + softmax.
+
+    Mirrors the reference's response contract
+    (dags/azure_manual_deploy.py:116-124): {"probabilities": [[...], ...]}.
+    Row families take {"data": [[feature vector], ...]}; sequence families
+    take {"data": [[[row x seq_len] window], ...]} (one window may be passed
+    un-batched).
+    """
+    x = validate_payload(meta, data)
     probs = softmax_numpy(forward_numpy(weights, meta, x))
     return {"probabilities": probs.tolist()}

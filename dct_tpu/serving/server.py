@@ -26,7 +26,11 @@ import os
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dct_tpu.serving.score_gen import weights_from_checkpoint
-from dct_tpu.serving.runtime import score_payload
+from dct_tpu.serving.runtime import (
+    forward_numpy,
+    softmax_numpy,
+    validate_payload,
+)
 
 
 class ScoreHandler(BaseHTTPRequestHandler):
@@ -35,7 +39,13 @@ class ScoreHandler(BaseHTTPRequestHandler):
     pure numpy on read-only weights)."""
 
     def _reply(self, code: int, payload: dict) -> None:
-        body = json.dumps(payload).encode()
+        try:
+            # Strict JSON: a bare NaN/Infinity token in a 200 body would
+            # be unparsable by spec-compliant clients.
+            body = json.dumps(payload, allow_nan=False).encode()
+        except ValueError:
+            code = 500
+            body = b'{"error": "non-finite values in response"}'
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -73,22 +83,26 @@ class ScoreHandler(BaseHTTPRequestHandler):
         except (ValueError, TypeError) as e:  # malformed JSON / envelope
             self._reply(400, {"error": str(e)})
             return
+        meta = self.server.model_meta
         try:
-            result = score_payload(
-                self.server.model_weights, self.server.model_meta,
-                payload["data"],
-            )
+            # Wrong shape, ragged/non-numeric rows, non-finite features:
+            # the client's fault.
+            x = validate_payload(meta, payload["data"])
         except (ValueError, TypeError) as e:
-            # score_payload validation (wrong shape, ragged/non-numeric
-            # rows): the client's fault.
             self._reply(400, {"error": str(e)})
             return
-        except Exception as e:  # noqa: BLE001 — a broken checkpoint or
-            # export defect is a SERVER error; blaming the request would
-            # send operators debugging the wrong side.
+        try:
+            probs = softmax_numpy(
+                forward_numpy(self.server.model_weights, meta, x)
+            )
+        except Exception as e:  # noqa: BLE001 — past validation, ANY
+            # failure (incl. a shape-mismatched weight raising ValueError
+            # in a matmul) is a broken checkpoint/export: a SERVER error.
+            # Blaming the request would send operators debugging the
+            # wrong side.
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
             return
-        self._reply(200, result)
+        self._reply(200, {"probabilities": probs.tolist()})
 
 
 def make_server(ckpt_path: str, *, host: str = "127.0.0.1", port: int = 0):

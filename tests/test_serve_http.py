@@ -67,6 +67,7 @@ def test_bad_payload_is_400_not_500(served_mlp):
     for payload in (
         {"data": [[1.0, 2.0]]},  # wrong feature count
         {"rows": [[0.0] * 5]},  # missing "data" key
+        {"data": [[1e39, 0.0, 0.0, 0.0, 0.0]]},  # f32-overflow -> inf
     ):
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(served_mlp, payload)
@@ -74,18 +75,30 @@ def test_bad_payload_is_400_not_500(served_mlp):
         assert "error" in json.loads(e.value.read())
 
 
-def test_broken_checkpoint_is_500_not_400(processed_dir, tmp_path):
-    """A server-side defect (missing weight key) must surface as 500 —
-    blaming the request would send operators debugging the wrong side."""
+@pytest.mark.parametrize("defect", ["missing_key", "wrong_shape"])
+def test_broken_checkpoint_is_500_not_400(processed_dir, tmp_path, defect):
+    """Server-side defects (missing weight key; a shape-mismatched weight
+    whose matmul raises ValueError) must surface as 500 — blaming the
+    request would send operators debugging the wrong side."""
     cfg = RunConfig(
-        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp_path / "m")),
+        data=DataConfig(
+            processed_dir=processed_dir,
+            models_dir=str(tmp_path / f"m_{defect}"),
+        ),
         train=TrainConfig(epochs=1, batch_size=8, bf16_compute=False),
     )
-    res = Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    res = Trainer(
+        cfg, tracker=LocalTracking(root=str(tmp_path / f"r_{defect}"))
+    ).fit()
     server = make_server(res.best_model_path)
-    server.model_weights = {
-        k: v for k, v in server.model_weights.items() if k != "w0"
-    }
+    if defect == "missing_key":
+        server.model_weights = {
+            k: v for k, v in server.model_weights.items() if k != "w0"
+        }
+    else:
+        server.model_weights = dict(
+            server.model_weights, w0=np.zeros((6, 64), np.float32)
+        )
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     try:
