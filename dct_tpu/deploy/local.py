@@ -145,6 +145,11 @@ class LocalEndpointClient:
         return list(self.endpoints[endpoint].deployments)
 
     # -- data plane (what Azure's scoring URI does) --------------------
+    def load_slot(self, endpoint: str, slot: str) -> tuple[dict, dict]:
+        """(weights, meta) of a deployed slot; KeyError for an unknown
+        endpoint/slot (callers map that to a client-facing 404)."""
+        return self.endpoints[endpoint].deployments[slot].load()
+
     def score(self, endpoint: str, payload: dict, *, slot: str | None = None) -> dict:
         """Route a request like the live endpoint would: to the given slot,
         or to the max-live-traffic slot."""

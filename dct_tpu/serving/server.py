@@ -5,18 +5,35 @@ The reference serves ONLY through an Azure endpoint (its generated
 score.py runs inside azureml-inference-server,
 dags/azure_manual_deploy.py:54-125) — there is no way to exercise the
 request/response contract without a cloud deployment. This server wraps
-the same :func:`dct_tpu.serving.runtime.score_payload` body behind the
-same wire contract on stdlib ``http.server``:
+the same :mod:`dct_tpu.serving.runtime` scoring body behind the same
+wire contract on stdlib ``http.server``:
 
 - ``POST /score``   — ``{"data": ...}`` -> ``{"probabilities": ...}``
   (exactly the reference's run() contract; multi-horizon causal
   checkpoints return per-horizon probability lists)
-- ``GET /healthz``  — 200 ``{"status": "ok", "model": ..., "horizon": ...}``
-  once the model is loaded (the endpoint analog of the compose
-  healthchecks, docker-compose.yml:48-52)
+- ``GET /healthz``  — 200 once the model is loaded (the endpoint analog
+  of the compose healthchecks, docker-compose.yml:48-52)
 
-Errors mirror the score.py behavior: a malformed payload returns 400
-with the validation message rather than a 500.
+Status-code policy, shared by both server modes: anything that is the
+REQUEST's fault (malformed JSON/envelope, validate_payload failures,
+a pinned slot that does not exist) is 4xx; anything past validation
+(broken checkpoint/package, shape-mismatched weights) is 500 — blaming
+the request for a server defect sends operators debugging the wrong
+side. Responses are strict JSON (``allow_nan=False``).
+
+Two modes:
+
+- :func:`make_server` — serve one checkpoint (weights load once).
+- :func:`make_endpoint_server` — serve a LOCAL rollout endpoint
+  (:class:`dct_tpu.deploy.local.LocalEndpointClient`): requests route by
+  the live traffic map (weighted random, like the Azure scoring URI
+  during a canary), ``?slot=`` pins a slot (the
+  ``azureml-model-deployment`` header analog), mirror traffic shadows a
+  copy to the shadow slot AFTER the live response is sent, and the
+  persisted control-plane state is re-read per request so the deploy
+  DAG's stage transitions apply live, mid-serve. Weights cache by
+  package dir (immutable once written); only the small state JSON is
+  re-read per request.
 """
 
 from __future__ import annotations
@@ -33,10 +50,8 @@ from dct_tpu.serving.runtime import (
 )
 
 
-class ScoreHandler(BaseHTTPRequestHandler):
-    """Per-request handler; the loaded model rides on the server object
-    (ThreadingHTTPServer => score_payload must be thread-safe: it is —
-    pure numpy on read-only weights)."""
+class _JsonHandler(BaseHTTPRequestHandler):
+    """Shared JSON plumbing: strict replies, quiet logs, envelope parse."""
 
     def _reply(self, code: int, payload: dict) -> None:
         try:
@@ -55,6 +70,41 @@ class ScoreHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet by default; DCT_SERVE_LOG=1
         if os.environ.get("DCT_SERVE_LOG"):
             super().log_message(fmt, *args)
+
+    def _read_data_envelope(self):
+        """Parse the request body as ``{"data": ...}``; replies 400 and
+        returns None on anything malformed."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict) or "data" not in payload:
+                raise ValueError('payload must be {"data": [...]}')
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": str(e)})
+            return None
+        return payload["data"]
+
+    def _score(self, weights: dict, meta: dict, data) -> dict | None:
+        """validate (400) -> forward (500) -> probabilities dict."""
+        try:
+            x = validate_payload(meta, data)
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": str(e)})
+            return None
+        try:
+            probs = softmax_numpy(forward_numpy(weights, meta, x))
+        except Exception as e:  # noqa: BLE001 — past validation, ANY
+            # failure (incl. a shape-mismatched weight raising ValueError
+            # in a matmul) is a broken checkpoint/export: a SERVER error.
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return None
+        return {"probabilities": probs.tolist()}
+
+
+class ScoreHandler(_JsonHandler):
+    """Single-checkpoint mode; the loaded model rides on the server
+    object (ThreadingHTTPServer => scoring must be thread-safe: it is —
+    pure numpy on read-only weights)."""
 
     def do_GET(self):  # noqa: N802 (http.server API)
         if self.path != "/healthz":
@@ -75,34 +125,14 @@ class ScoreHandler(BaseHTTPRequestHandler):
         if self.path != "/score":
             self._reply(404, {"error": f"no route {self.path}"})
             return
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length) or b"{}")
-            if not isinstance(payload, dict) or "data" not in payload:
-                raise ValueError('payload must be {"data": [...]}')
-        except (ValueError, TypeError) as e:  # malformed JSON / envelope
-            self._reply(400, {"error": str(e)})
+        data = self._read_data_envelope()
+        if data is None:
             return
-        meta = self.server.model_meta
-        try:
-            # Wrong shape, ragged/non-numeric rows, non-finite features:
-            # the client's fault.
-            x = validate_payload(meta, payload["data"])
-        except (ValueError, TypeError) as e:
-            self._reply(400, {"error": str(e)})
-            return
-        try:
-            probs = softmax_numpy(
-                forward_numpy(self.server.model_weights, meta, x)
-            )
-        except Exception as e:  # noqa: BLE001 — past validation, ANY
-            # failure (incl. a shape-mismatched weight raising ValueError
-            # in a matmul) is a broken checkpoint/export: a SERVER error.
-            # Blaming the request would send operators debugging the
-            # wrong side.
-            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
-            return
-        self._reply(200, {"probabilities": probs.tolist()})
+        result = self._score(
+            self.server.model_weights, self.server.model_meta, data
+        )
+        if result is not None:
+            self._reply(200, result)
 
 
 def make_server(ckpt_path: str, *, host: str = "127.0.0.1", port: int = 0):
@@ -113,6 +143,126 @@ def make_server(ckpt_path: str, *, host: str = "127.0.0.1", port: int = 0):
     server = ThreadingHTTPServer((host, port), ScoreHandler)
     server.model_weights = weights
     server.model_meta = meta
+    return server
+
+
+class EndpointScoreHandler(_JsonHandler):
+    """Rollout-endpoint mode (see module docstring)."""
+
+    def _client(self):
+        from dct_tpu.deploy.local import LocalEndpointClient
+
+        # Fresh read of the persisted state: rollout stages run in other
+        # processes and must take effect without a server restart.
+        return LocalEndpointClient(state_path=self.server.state_path)
+
+    def _load_slot(self, client, slot: str):
+        """(weights, meta) via the server-lifetime package cache —
+        packages are immutable once written, so only the state JSON
+        needs the per-request re-read."""
+        pkg = client.endpoints[self.server.endpoint_name] \
+            .deployments[slot].package_dir
+        cached = self.server.package_cache.get(pkg)
+        if cached is None:
+            cached = client.load_slot(self.server.endpoint_name, slot)
+            self.server.package_cache[pkg] = cached
+        return cached
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        import urllib.parse
+
+        if urllib.parse.urlparse(self.path).path != "/healthz":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        client = self._client()
+        name = self.server.endpoint_name
+        if not client.endpoint_exists(name):
+            self._reply(503, {"error": f"endpoint {name} not provisioned"})
+            return
+        self._reply(
+            200,
+            {
+                "status": "ok",
+                "endpoint": name,
+                "traffic": client.get_traffic(name),
+                "mirror_traffic": client.get_mirror_traffic(name),
+                "deployments": client.list_deployments(name),
+            },
+        )
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        import random
+        import urllib.parse
+
+        parsed = urllib.parse.urlparse(self.path)
+        if parsed.path != "/score":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        data = self._read_data_envelope()
+        if data is None:
+            return
+        client = self._client()
+        name = self.server.endpoint_name
+        live = {
+            k: v for k, v in client.get_traffic(name).items() if v > 0
+        }
+        pinned = urllib.parse.parse_qs(parsed.query).get("slot")
+        if pinned:
+            slot = pinned[0]
+        elif live:
+            # Weighted random routing — the canary's 10% is a real 10%.
+            slot = random.choices(
+                list(live), weights=list(live.values())
+            )[0]
+        else:
+            self._reply(503, {"error": f"endpoint {name} has no live traffic"})
+            return
+        if slot not in client.list_deployments(name):
+            # A request naming a nonexistent slot is the CLIENT's fault
+            # (Azure's model-deployment header behaves the same).
+            self._reply(404, {"error": f"no deployment {slot!r} on {name}"})
+            return
+        try:
+            weights, meta = self._load_slot(client, slot)
+        except Exception as e:  # noqa: BLE001 — unreadable package:
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        result = self._score(weights, meta, data)
+        if result is None:
+            return
+        self._reply(200, {**result, "slot": slot})
+        # Mirror (shadow) traffic AFTER the live response is flushed —
+        # a slow or broken shadow must never touch live latency (exactly
+        # Azure's mirror semantics: the caller never sees it).
+        for shadow, pct in client.get_mirror_traffic(name).items():
+            if (
+                pct > 0
+                and shadow != slot
+                and shadow in client.list_deployments(name)
+                and random.random() * 100 < pct
+            ):
+                try:
+                    w_s, m_s = self._load_slot(client, shadow)
+                    softmax_numpy(
+                        forward_numpy(w_s, m_s, validate_payload(m_s, data))
+                    )
+                except Exception:  # noqa: BLE001 — shadow failures are
+                    pass  # invisible by design
+
+
+def make_endpoint_server(
+    endpoint: str, *, state_path: str | None = None,
+    host: str = "127.0.0.1", port: int = 0,
+):
+    """HTTP server over the local rollout endpoint ``endpoint`` whose
+    control-plane state lives at ``state_path`` (default: the
+    DCT_LOCAL_ENDPOINT_STATE env the rollout DAG uses)."""
+    server = ThreadingHTTPServer((host, port), EndpointScoreHandler)
+    server.endpoint_name = endpoint
+    server.state_path = state_path or os.environ.get(
+        "DCT_LOCAL_ENDPOINT_STATE"
+    )
+    server.package_cache = {}
     return server
 
 

@@ -8,6 +8,13 @@ Env contract (matching the other job CLIs):
   DCT_SERVE_HOST  — bind host (default 0.0.0.0)
   DCT_SERVE_PORT  — bind port (default 8901)
 
+Endpoint mode — serve the LOCAL rollout endpoint instead of a raw
+checkpoint (traffic-weighted blue/green routing + mirror shadowing over
+the deploy DAG's persisted state):
+  DCT_ENDPOINT_NAME         — endpoint to serve (enables this mode)
+  DCT_LOCAL_ENDPOINT_STATE  — the rollout state JSON (same env the DAG
+                              uses); stage transitions apply live
+
 POST /score {"data": ...} -> {"probabilities": ...}; GET /healthz.
 """
 
@@ -22,16 +29,29 @@ if _REPO_ROOT not in sys.path:
 
 
 def main() -> int:
+    host = os.environ.get("DCT_SERVE_HOST", "0.0.0.0")
+    port = int(os.environ.get("DCT_SERVE_PORT", "8901"))
+
+    endpoint = os.environ.get("DCT_ENDPOINT_NAME")
+    if endpoint:
+        from dct_tpu.serving.server import make_endpoint_server
+
+        server = make_endpoint_server(endpoint, host=host, port=port)
+        print(
+            f"serving rollout endpoint {endpoint!r} (state: "
+            f"{server.state_path}) on http://{host}:{port} "
+            "(POST /score, GET /healthz)",
+            flush=True,
+        )
+        server.serve_forever()
+        return 0
+
     from jobs.predict import _find_checkpoint
     from dct_tpu.serving.server import serve_forever
 
     models_dir = os.environ.get("DCT_MODELS_DIR", "data/models")
     ckpt = _find_checkpoint(models_dir)
-    serve_forever(
-        ckpt,
-        host=os.environ.get("DCT_SERVE_HOST", "0.0.0.0"),
-        port=int(os.environ.get("DCT_SERVE_PORT", "8901")),
-    )
+    serve_forever(ckpt, host=host, port=port)
     return 0
 
 

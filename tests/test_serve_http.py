@@ -148,3 +148,84 @@ def test_multi_horizon_server(tmp_path):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_endpoint_server_rollout_routing(processed_dir, tmp_path):
+    """HTTP surface over the LOCAL rollout endpoint: traffic-weighted
+    blue/green routing, live stage transitions from the persisted state,
+    slot pinning, mirror shadowing, and 503 when nothing is live."""
+    from dct_tpu.deploy.local import LocalEndpointClient
+    from dct_tpu.serving.score_gen import generate_score_package
+    from dct_tpu.serving.server import make_endpoint_server
+
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp_path / "m")),
+        train=TrainConfig(epochs=1, batch_size=8, bf16_compute=False),
+    )
+    res = Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    pkg = str(tmp_path / "pkg")
+    generate_score_package(res.best_model_path, pkg)
+
+    state = str(tmp_path / "endpoint_state.json")
+    c = LocalEndpointClient(state_path=state)
+    c.create_endpoint("weather-ep")
+    c.deploy("weather-ep", "blue", pkg)
+    c.set_traffic("weather-ep", {"blue": 100})
+
+    server = make_endpoint_server("weather-ep", state_path=state)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    row = {"data": [[0.1, -0.2, 0.3, 0.0, 1.0]]}
+    try:
+        with urllib.request.urlopen(url + "/healthz") as r:
+            body = json.loads(r.read())
+        assert body["traffic"] == {"blue": 100}
+
+        out = _post(url, row)
+        assert out["slot"] == "blue"
+        assert np.asarray(out["probabilities"]).shape == (1, 2)
+
+        # Stage transition from ANOTHER client (the DAG's fresh-process
+        # pattern): deploy green, start a canary with mirror shadowing.
+        c2 = LocalEndpointClient(state_path=state)
+        c2.deploy("weather-ep", "green", pkg)
+        c2.set_traffic("weather-ep", {"blue": 90, "green": 10})
+        c2.set_mirror_traffic("weather-ep", {"green": 20})
+        slots = [_post(url, row)["slot"] for _ in range(120)]
+        assert set(slots) == {"blue", "green"}, set(slots)
+        assert slots.count("blue") > slots.count("green")
+
+        # Slot pinning (the azureml-model-deployment header analog).
+        req = urllib.request.Request(
+            url + "/score?slot=green",
+            data=json.dumps(row).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["slot"] == "green"
+
+        # Full rollout: 100% green, blue deleted — applies live.
+        c2.set_mirror_traffic("weather-ep", {})
+        c2.set_traffic("weather-ep", {"green": 100})
+        c2.delete_deployment("weather-ep", "blue")
+        assert _post(url, row)["slot"] == "green"
+
+        # Pinning a slot that no longer exists is the CLIENT's fault.
+        req_gone = urllib.request.Request(
+            url + "/score?slot=blue",
+            data=json.dumps(row).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req_gone)
+        assert e.value.code == 404
+
+        # No live traffic -> 503, not a crash.
+        c2.set_traffic("weather-ep", {})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, row)
+        assert e.value.code == 503
+    finally:
+        server.shutdown()
+        server.server_close()
