@@ -419,11 +419,14 @@ def ring_attention(
     fallback when flash is off or the local shard is not block-aligned.
 
     ``striped``: causal-only. True runs the :func:`striped_layout` ring
-    (perfect per-step load balance — see module docstring); None enables
-    it automatically whenever the flash path is on and the half-chunk is
-    kernel-aligned (that is where balance pays: the flash causal ring
-    skips invisible shards, so the contiguous layout runs at the tail
-    device's pace); False keeps the contiguous layout.
+    (perfect per-step load balance — see module docstring); None follows
+    the ``DCT_RING_STRIPED`` env policy — ``auto`` (default) enables it
+    whenever the flash path is on and the half-chunk is kernel-aligned
+    (that is where balance pays: the flash causal ring skips invisible
+    shards, so the contiguous layout runs at the tail device's pace),
+    ``on`` forces it for causal rings (like ``striped=True``), ``off``
+    keeps the contiguous layout (the A/B baseline); False keeps the
+    contiguous layout.
     """
     ring_size = mesh.shape[seq_axis]
     b, h, t, _ = q.shape
@@ -474,13 +477,27 @@ def ring_attention(
         divisible = lambda e: e >= 1 and e % min(128, e) == 0
         return divisible(n) and divisible(t_local)
     if striped is None:
-        striped = bool(
-            causal
-            and ring_size > 1
-            and t_local % 2 == 0
-            and flash_on
-            and flash_aligned(half)
-        )
+        # DCT_RING_STRIPED: "auto" (default — striped whenever the causal
+        # flash ring is kernel-aligned), "0"/"off" (force contiguous,
+        # the on-chip A/B baseline), "1"/"on" (striped even for the
+        # JAX-level body).
+        mode = os.environ.get("DCT_RING_STRIPED", "auto").strip().lower()
+        if mode in ("0", "off", "false", "no"):
+            striped = False
+        elif mode in ("1", "on", "true", "yes"):
+            # Forced on behaves like striped=True for causal rings
+            # (below it raises on an odd t_local rather than silently
+            # measuring the contiguous layout); non-causal rings have no
+            # striped concept and are unaffected.
+            striped = bool(causal and ring_size > 1)
+        else:
+            striped = bool(
+                causal
+                and ring_size > 1
+                and t_local % 2 == 0
+                and flash_on
+                and flash_aligned(half)
+            )
     elif striped:
         if t_local % 2:
             raise ValueError(

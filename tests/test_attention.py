@@ -166,6 +166,22 @@ def test_striped_flash_ring_composes_with_dp_tp(qkv):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_ring_striped_env_override(qkv, monkeypatch):
+    """DCT_RING_STRIPED forces the layout either way; numerics are the
+    oracle's in both."""
+    q, k, v = qkv
+    mesh = make_mesh(MeshConfig(data=1, model=1, seq=2), allow_subset=True)
+    ref = dense_attention(q, k, v, causal=True)
+    monkeypatch.setenv("DCT_RING_STRIPED", "on")  # striped JAX-level body
+    out_on = ring_attention(q, k, v, mesh=mesh, causal=True, use_flash=False)
+    # "off" forces the contiguous layout; at t_local=32 (< 128) the
+    # flash request then degrades to the JAX-level contiguous ring.
+    monkeypatch.setenv("DCT_RING_STRIPED", "off")
+    out_off = ring_attention(q, k, v, mesh=mesh, causal=True, use_flash=True)
+    np.testing.assert_allclose(np.asarray(out_on), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_off), np.asarray(ref), atol=1e-5)
+
+
 def test_striped_rejects_non_causal(qkv):
     q, k, v = qkv
     mesh = make_mesh(MeshConfig(data=1, model=1, seq=2), allow_subset=True)
