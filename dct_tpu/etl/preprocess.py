@@ -17,16 +17,94 @@ The north star keeps the real Spark cluster for production ETL (see
 ``dct_tpu/etl/spark_job.py``); this native path is the same transform without
 a JVM for single-host runs, tests, and benches. It is vectorized numpy/arrow
 on the host — ETL is IO-bound, not a TPU problem.
+
+Continuous-training hygiene the reference lacks entirely: each run
+persists the raw per-feature statistics beside the parquet
+(``stats.json``) and compares them against the PREVIOUS run's
+(:func:`detect_drift`), writing ``drift_report.json`` — so a daily
+re-train on silently-shifted data is a visible event instead of a
+mystery regression in val_loss. Thresholded on the standardized mean
+shift (|Δmean|/σ_prev), the std ratio, and the label-rate shift;
+``DCT_DRIFT_THRESHOLD`` tunes it.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 
 import numpy as np
 
 DEFAULT_FEATURES = ["Temperature", "Humidity", "Wind_Speed", "Cloud_Cover", "Pressure"]
+
+
+def detect_drift(
+    prev: dict, new: dict, *, threshold: float | None = None
+) -> dict:
+    """Compare two runs' raw-data statistics.
+
+    Per feature: ``mean_shift`` = |mean_new - mean_prev| / max(σ_prev,
+    1e-12) (standardized, so 'moved by half a previous-σ' means the same
+    for every feature) and ``std_ratio`` = σ_new/σ_prev; plus the label
+    positive-rate shift. A feature drifts when mean_shift > threshold or
+    std_ratio is outside [1/(1+t), 1+t]; the label drifts when its rate
+    moves by more than t/2 absolute. Returns a JSON-able report with
+    ``any_drift`` for pipeline gates."""
+    if threshold is None:
+        threshold = float(os.environ.get("DCT_DRIFT_THRESHOLD", "0.5"))
+    feats = {}
+    any_drift = False
+    prev_feats = prev.get("features", {})
+    new_feats = new.get("features", {})
+    for name in sorted(set(prev_feats) | set(new_feats)):
+        p, n = prev_feats.get(name), new_feats.get(name)
+        if p is None or n is None:
+            # Schema drift (column added/renamed/dropped) IS drift — the
+            # exact silently-shifted-data event this detector exists for.
+            any_drift = True
+            feats[name] = {
+                "drifted": True,
+                "missing_in": "previous" if p is None else "current",
+            }
+            continue
+        values = (p["mean"], p["std"], n["mean"], n["std"])
+        if not all(np.isfinite(v) for v in values):
+            # NaN stats (nulls in the raw CSV) would make every
+            # comparison False; broken data must read as drifted.
+            any_drift = True
+            feats[name] = {"drifted": True, "non_finite_stats": True}
+            continue
+        sigma = max(abs(p["std"]), 1e-12)
+        mean_shift = abs(n["mean"] - p["mean"]) / sigma
+        std_ratio = (abs(n["std"]) + 1e-12) / sigma
+        drifted = bool(
+            mean_shift > threshold
+            or std_ratio > 1.0 + threshold
+            or std_ratio < 1.0 / (1.0 + threshold)
+        )
+        any_drift |= drifted
+        feats[name] = {
+            "mean_shift": round(mean_shift, 4),
+            "std_ratio": round(std_ratio, 4),
+            "drifted": drifted,
+        }
+    label_shift = abs(
+        new.get("label_rate", 0.0) - prev.get("label_rate", 0.0)
+    )
+    # Label rates live in [0, 1]: clamp the derived threshold so a large
+    # sigma-unit knob cannot silently disable label-drift detection.
+    label_drifted = bool(label_shift > min(threshold / 2, 0.25))
+    any_drift |= label_drifted
+    return {
+        "threshold": threshold,
+        "features": feats,
+        "label_rate_shift": round(label_shift, 4),
+        "label_drifted": label_drifted,
+        "rows_prev": int(prev.get("rows", 0)),
+        "rows_new": int(new.get("rows", 0)),
+        "any_drift": any_drift,
+    }
 
 
 def preprocess_csv_to_parquet(
@@ -53,16 +131,35 @@ def preprocess_csv_to_parquet(
     label_encoded = (labels_raw == positive_label).astype(np.int64)
 
     out_cols: dict[str, np.ndarray] = {}
+    stats = {"rows": int(len(label_encoded)), "features": {}}
     for name in feature_cols:
         col = table.column(name).to_numpy(zero_copy_only=False).astype(np.float64)
         mean = float(np.mean(col))
         # Spark's stddev is the sample stddev (ddof=1), jobs/preprocess.py:33.
         std = float(np.std(col, ddof=1)) if len(col) > 1 else 0.0
+        stats["features"][name] = {"mean": mean, "std": std}
         std = std if std != 0.0 else 1.0
         out_cols[f"{name}_norm"] = (col - mean) / std
     out_cols["label_encoded"] = label_encoded
+    stats["label_rate"] = float(np.mean(label_encoded)) if len(
+        label_encoded
+    ) else 0.0
 
     out_table = pa.table(out_cols)
+
+    # Previous run's raw stats (read BEFORE anything is overwritten):
+    # the drift baseline for continuous training's daily re-run.
+    stats_path = os.path.join(output_dir, "stats.json")
+    prev_stats = None
+    if os.path.exists(stats_path):
+        try:
+            with open(stats_path) as f:
+                prev_stats = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            # A torn baseline (killed mid-write before atomic writes, or
+            # hand-edited) must not brick the daily ETL over an
+            # observability feature: treat as "no previous run".
+            prev_stats = None
 
     parquet_dir = os.path.join(output_dir, parquet_name)
     # mode("overwrite") semantics: wipe the previous output directory.
@@ -72,4 +169,29 @@ def preprocess_csv_to_parquet(
     pq.write_table(out_table, os.path.join(parquet_dir, "part-00000.parquet"))
     # Spark writes a _SUCCESS marker on commit; downstream checks may rely on it.
     open(os.path.join(parquet_dir, "_SUCCESS"), "w").close()
+
+    # Atomic: a run killed mid-write must not leave a torn baseline.
+    tmp_stats = stats_path + ".tmp"
+    with open(tmp_stats, "w") as f:
+        json.dump(stats, f, indent=2)
+    os.replace(tmp_stats, stats_path)
+    if prev_stats is not None:
+        report = detect_drift(prev_stats, stats)
+        report_path = os.path.join(output_dir, "drift_report.json")
+        tmp_report = report_path + ".tmp"
+        with open(tmp_report, "w") as f:
+            json.dump(report, f, indent=2)
+        os.replace(tmp_report, report_path)
+        if report["any_drift"]:
+            drifted = [
+                k for k, v in report["features"].items() if v["drifted"]
+            ]
+            if report["label_drifted"]:
+                drifted.append("label_rate")
+            print(
+                f"⚠ DATA DRIFT vs previous run (threshold "
+                f"{report['threshold']}): {', '.join(drifted)} — see "
+                f"{os.path.join(output_dir, 'drift_report.json')}",
+                flush=True,
+            )
     return parquet_dir

@@ -60,3 +60,117 @@ def test_overwrite_mode(weather_csv, tmp_path):
 def test_missing_input_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         preprocess_csv_to_parquet(str(tmp_path / "nope.csv"), str(tmp_path / "o"))
+
+
+def test_drift_report_between_runs(tmp_path):
+    """Second ETL run over shifted raw data writes a drift report naming
+    the shifted features; an identical re-run reports no drift."""
+    import json
+
+    import numpy as np
+
+    from dct_tpu.data.synthetic import generate_weather_csv
+    from dct_tpu.etl.preprocess import preprocess_csv_to_parquet
+
+    csv1 = str(tmp_path / "raw1.csv")
+    generate_weather_csv(csv1, rows=600, seed=1)
+    out = str(tmp_path / "proc")
+    preprocess_csv_to_parquet(csv1, out)
+    assert (tmp_path / "proc" / "stats.json").exists()
+    assert not (tmp_path / "proc" / "drift_report.json").exists()
+
+    # Identical data -> no drift.
+    preprocess_csv_to_parquet(csv1, out)
+    rep = json.load(open(tmp_path / "proc" / "drift_report.json"))
+    assert not rep["any_drift"], rep
+
+    # Shift Temperature by several sigma in the raw CSV.
+    import pandas as pd
+
+    df = pd.read_csv(csv1)
+    sigma = float(df["Temperature"].std())
+    df["Temperature"] += 5 * sigma
+    csv2 = str(tmp_path / "raw2.csv")
+    df.to_csv(csv2, index=False)
+    preprocess_csv_to_parquet(csv2, out)
+    rep = json.load(open(tmp_path / "proc" / "drift_report.json"))
+    assert rep["any_drift"]
+    assert rep["features"]["Temperature"]["drifted"]
+    assert rep["features"]["Temperature"]["mean_shift"] > 3
+    assert not rep["features"]["Humidity"]["drifted"]
+
+
+def test_detect_drift_std_and_label():
+    from dct_tpu.etl.preprocess import detect_drift
+
+    prev = {
+        "rows": 100,
+        "label_rate": 0.3,
+        "features": {"a": {"mean": 0.0, "std": 1.0}},
+    }
+    # Variance doubled -> std_ratio 2.0 > 1.5 at threshold 0.5.
+    rep = detect_drift(
+        prev,
+        {"rows": 100, "label_rate": 0.3,
+         "features": {"a": {"mean": 0.0, "std": 2.0}}},
+        threshold=0.5,
+    )
+    assert rep["features"]["a"]["drifted"] and rep["any_drift"]
+    # Label rate jump 0.3 -> 0.6 > threshold/2.
+    rep = detect_drift(
+        prev,
+        {"rows": 100, "label_rate": 0.6,
+         "features": {"a": {"mean": 0.0, "std": 1.0}}},
+        threshold=0.5,
+    )
+    assert rep["label_drifted"] and rep["any_drift"]
+    assert not rep["features"]["a"]["drifted"]
+
+
+def test_drift_edge_cases(tmp_path):
+    import json
+
+    from dct_tpu.data.synthetic import generate_weather_csv
+    from dct_tpu.etl.preprocess import detect_drift, preprocess_csv_to_parquet
+
+    # Torn baseline must not brick the ETL: treated as "no previous run".
+    csv = str(tmp_path / "raw.csv")
+    generate_weather_csv(csv, rows=300, seed=2)
+    out = str(tmp_path / "proc")
+    preprocess_csv_to_parquet(csv, out)
+    (tmp_path / "proc" / "stats.json").write_text('{"rows": 3')  # truncated
+    preprocess_csv_to_parquet(csv, out)  # must not raise
+    assert json.load(open(tmp_path / "proc" / "stats.json"))["rows"] == 300
+
+    prev = {
+        "rows": 10, "label_rate": 0.3,
+        "features": {"a": {"mean": 0.0, "std": 1.0}},
+    }
+    # Schema drift: feature present on only one side is drift.
+    rep = detect_drift(
+        prev,
+        {"rows": 10, "label_rate": 0.3,
+         "features": {"b": {"mean": 0.0, "std": 1.0}}},
+        threshold=0.5,
+    )
+    assert rep["any_drift"]
+    assert rep["features"]["a"]["missing_in"] == "current"
+    assert rep["features"]["b"]["missing_in"] == "previous"
+
+    # Non-finite stats (nulls upstream) read as drifted, never as clean.
+    rep = detect_drift(
+        prev,
+        {"rows": 10, "label_rate": 0.3,
+         "features": {"a": {"mean": float("nan"), "std": 1.0}}},
+        threshold=0.5,
+    )
+    assert rep["any_drift"] and rep["features"]["a"]["non_finite_stats"]
+
+    # A huge sigma-unit threshold cannot disable label-drift detection.
+    rep = detect_drift(
+        prev,
+        {"rows": 10, "label_rate": 0.9,
+         "features": {"a": {"mean": 0.0, "std": 1.0}}},
+        threshold=10.0,
+    )
+    assert rep["label_drifted"]
