@@ -149,17 +149,7 @@ def preprocess_csv_to_parquet(
 
     # Previous run's raw stats (read BEFORE anything is overwritten):
     # the drift baseline for continuous training's daily re-run.
-    stats_path = os.path.join(output_dir, "stats.json")
-    prev_stats = None
-    if os.path.exists(stats_path):
-        try:
-            with open(stats_path) as f:
-                prev_stats = json.load(f)
-        except (json.JSONDecodeError, OSError):
-            # A torn baseline (killed mid-write before atomic writes, or
-            # hand-edited) must not brick the daily ETL over an
-            # observability feature: treat as "no previous run".
-            prev_stats = None
+    prev_stats = read_previous_stats(output_dir)
 
     parquet_dir = os.path.join(output_dir, parquet_name)
     # mode("overwrite") semantics: wipe the previous output directory.
@@ -170,28 +160,53 @@ def preprocess_csv_to_parquet(
     # Spark writes a _SUCCESS marker on commit; downstream checks may rely on it.
     open(os.path.join(parquet_dir, "_SUCCESS"), "w").close()
 
+    persist_stats_and_drift(output_dir, stats, prev_stats)
+    return parquet_dir
+
+
+def read_previous_stats(output_dir: str) -> dict | None:
+    """The previous run's stats.json, or None when absent/torn — a torn
+    baseline (killed mid-write before atomic writes, or hand-edited)
+    must not brick the daily ETL over an observability feature."""
+    stats_path = os.path.join(output_dir, "stats.json")
+    if not os.path.exists(stats_path):
+        return None
+    try:
+        with open(stats_path) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None
+
+
+def persist_stats_and_drift(
+    output_dir: str, stats: dict, prev_stats: dict | None
+) -> dict | None:
+    """Atomically write stats.json and (when a baseline exists) the
+    drift_report.json + console warning. Shared by the native and Spark
+    ETL paths — both engines compute the same per-feature mean/std, so
+    the drift logic lives once. Returns the report (or None)."""
+    stats_path = os.path.join(output_dir, "stats.json")
     # Atomic: a run killed mid-write must not leave a torn baseline.
     tmp_stats = stats_path + ".tmp"
     with open(tmp_stats, "w") as f:
         json.dump(stats, f, indent=2)
     os.replace(tmp_stats, stats_path)
-    if prev_stats is not None:
-        report = detect_drift(prev_stats, stats)
-        report_path = os.path.join(output_dir, "drift_report.json")
-        tmp_report = report_path + ".tmp"
-        with open(tmp_report, "w") as f:
-            json.dump(report, f, indent=2)
-        os.replace(tmp_report, report_path)
-        if report["any_drift"]:
-            drifted = [
-                k for k, v in report["features"].items() if v["drifted"]
-            ]
-            if report["label_drifted"]:
-                drifted.append("label_rate")
-            print(
-                f"⚠ DATA DRIFT vs previous run (threshold "
-                f"{report['threshold']}): {', '.join(drifted)} — see "
-                f"{os.path.join(output_dir, 'drift_report.json')}",
-                flush=True,
-            )
-    return parquet_dir
+    if prev_stats is None:
+        return None
+    report = detect_drift(prev_stats, stats)
+    report_path = os.path.join(output_dir, "drift_report.json")
+    tmp_report = report_path + ".tmp"
+    with open(tmp_report, "w") as f:
+        json.dump(report, f, indent=2)
+    os.replace(tmp_report, report_path)
+    if report["any_drift"]:
+        drifted = [k for k, v in report["features"].items() if v["drifted"]]
+        if report["label_drifted"]:
+            drifted.append("label_rate")
+        print(
+            f"⚠ DATA DRIFT vs previous run (threshold "
+            f"{report['threshold']}): {', '.join(drifted)} — see "
+            f"{report_path}",
+            flush=True,
+        )
+    return report

@@ -13,7 +13,11 @@ from __future__ import annotations
 
 import os
 
-from dct_tpu.etl.preprocess import DEFAULT_FEATURES
+from dct_tpu.etl.preprocess import (
+    DEFAULT_FEATURES,
+    persist_stats_and_drift,
+    read_previous_stats,
+)
 
 
 def preprocess_with_spark(
@@ -26,7 +30,7 @@ def preprocess_with_spark(
     parquet_name: str = "data.parquet",
 ) -> str:
     from pyspark.sql import SparkSession
-    from pyspark.sql.functions import col, mean, stddev, when
+    from pyspark.sql.functions import col, count, mean, stddev, when
 
     feature_cols = feature_cols or DEFAULT_FEATURES
     spark = SparkSession.builder.appName("WeatherPreprocessingTPU").getOrCreate()
@@ -35,15 +39,41 @@ def preprocess_with_spark(
         df = df.withColumn(
             "label_encoded", when(col(label_col) == positive_label, 1).otherwise(0)
         )
+        # ONE aggregation pass for every statistic (row count, label
+        # rate, per-feature mean/stddev) instead of 2 + N actions over
+        # the un-cached DataFrame.
+        aggs = [count("*").alias("__rows"), mean(col("label_encoded")).alias("__rate")]
         for name in feature_cols:
-            stats = df.select(
-                mean(col(name)).alias("mean"), stddev(col(name)).alias("std")
-            ).first()
-            std_val = stats["std"] if stats["std"] else 1.0
-            df = df.withColumn(f"{name}_norm", (col(name) - stats["mean"]) / std_val)
+            aggs.append(mean(col(name)).alias(f"__m_{name}"))
+            aggs.append(stddev(col(name)).alias(f"__s_{name}"))
+        row = df.select(*aggs).first()
+
+        def _stat(v):
+            # Spark returns None for all-null columns: record NaN (like
+            # the native path) so detect_drift's non-finite branch flags
+            # the broken data instead of seeing a fabricated clean 0.0.
+            return float(v) if v is not None else float("nan")
+
+        run_stats: dict = {
+            "rows": int(row["__rows"]),
+            "label_rate": _stat(row["__rate"]),
+            "features": {},
+        }
+        for name in feature_cols:
+            m, s = row[f"__m_{name}"], row[f"__s_{name}"]
+            run_stats["features"][name] = {"mean": _stat(m), "std": _stat(s)}
+            std_val = s if s else 1.0
+            df = df.withColumn(
+                f"{name}_norm", (col(name) - (m or 0.0)) / std_val
+            )
         final_cols = [f"{c}_norm" for c in feature_cols] + ["label_encoded"]
+        # Baseline read BEFORE the overwrite, like the native path.
+        prev_stats = read_previous_stats(output_dir)
         out_path = os.path.join(output_dir, parquet_name)
         df.select(final_cols).write.mode("overwrite").parquet(out_path)
+        # Same drift machinery as the native engine (driver-side write:
+        # output_dir is the shared ./data volume in the compose topology).
+        persist_stats_and_drift(output_dir, run_stats, prev_stats)
         return out_path
     finally:
         spark.stop()

@@ -124,11 +124,15 @@ class WeatherTransformerPP(nn.Module):
 
     Stage params live in ONE stacked pytree param named ``pp_stages``
     (leading dim = stage), which the sharding rules place
-    ``P("pipe", ...)`` — each pipeline device holds one stage. Composes
-    with DP (microbatch rows shard over ``data``); TP/SP inside stages
-    are deliberately not composed — attention is the single-shard
-    dense/blockwise/flash path. Embedding, dropout, final LN and the
-    classifier head run outside the pipelined region (replicated).
+    ``P("pipe", <TP name-rule spec>)`` — each pipeline device holds one
+    stage, and the stage's projection kernels keep their megatron-style
+    ``model``-axis split. Composes with DP (microbatch rows shard over
+    ``data``) AND TP: pipeline_apply's shard_map is manual only over
+    pipe/data, so the model axis stays auto and the compiler inserts the
+    per-block TP collectives inside each stage. Attention is the
+    single-shard dense/blockwise/flash path (no seq axis). Embedding,
+    dropout, final LN and the classifier head run outside the pipelined
+    region (replicated).
 
     Without a mesh (or ``pipe`` = 1, or the batch-1 flax init trace) the
     stages apply sequentially — the same function, used by tests as the

@@ -138,10 +138,17 @@ def pipeline_apply(
         lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params
     )
     xs_spec = P(None, data_axis, *([None] * (x.ndim - 1)))
+    # PARTIAL-manual shard_map: only the pipe (and data) axes are manual;
+    # every other mesh axis (model/seq) stays AUTO, so tensor-parallel
+    # shardings on the stage params' inner dims survive into the body and
+    # the compiler inserts the TP collectives inside each stage — PP x TP
+    # compose without hand-written stage communication.
+    manual = {axis} | ({data_axis} if data_axis is not None else set())
     ys = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(param_specs, xs_spec),
         out_specs=xs_spec,
+        axis_names=frozenset(manual),
     )(stacked_params, xs)
     return ys.reshape(b, *x.shape[1:])

@@ -43,11 +43,22 @@ def spec_for_path(path, ndim: int | None = None) -> P:
     leaf = names[-1] if names else ""
     if "pp_stages" in names:
         # Pipeline stages: stacked [n_stages, ...] leaves, stage dim on
-        # ``pipe`` — one stage per pipeline device. Takes precedence over
-        # the TP name patterns that also occur INSIDE a stage (PP does
-        # not compose with TP; parallel/pipeline.py module docstring).
+        # ``pipe`` — one stage per pipeline device. The INNER dims keep
+        # their tensor-parallel name-rule placement (PP x TP compose:
+        # pipeline_apply's shard_map is manual only over pipe/data, so
+        # the model-axis sharding survives into the stage compute).
+        inner_names = names[names.index("pp_stages") + 1:]
+        inner = P()
+        for pattern, kernel_spec, bias_spec in _RULES:
+            if any(pattern in n for n in inner_names):
+                if leaf == "kernel":
+                    inner = kernel_spec
+                elif leaf == "bias":
+                    inner = bias_spec
+                break
         n = ndim if ndim is not None else 2
-        return P("pipe", *([None] * (n - 1)))
+        pad = n - 1 - len(inner)
+        return P("pipe", *inner, *([None] * max(pad, 0)))
     if leaf in _EXPERT_RULES:
         return _EXPERT_RULES[leaf]
     for pattern, kernel_spec, bias_spec in _RULES:

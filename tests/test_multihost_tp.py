@@ -229,6 +229,35 @@ def test_zero1_across_processes(processed_dir, tmp_path):
 
 
 @pytest.mark.slow
+def test_tp_zero1_composed_across_processes(processed_dir, tmp_path):
+    """TP x ZeRO-1 composed over 4 real processes (mesh data=2 x
+    model=2): transformer params shard over ``model`` ACROSS hosts while
+    the replicated leaves' Adam moments shard over ``data`` across the
+    other host pair — both rules at once, trajectory matching the
+    unsharded single-process run."""
+
+    def run(world_size, mesh_data, mesh_model, shard_opt, models_sub,
+            runs_sub):
+        return launch_training(
+            processed_dir, tmp_path, world_size=world_size, port=29539,
+            models_sub=models_sub, runs_sub=runs_sub,
+            env_overrides={
+                "DCT_MODEL": "weather_transformer",
+                "DCT_N_LAYERS": "1",
+                "DCT_MESH_DATA": str(mesh_data),
+                "DCT_MESH_MODEL": str(mesh_model),
+                "DCT_SHARD_OPT_STATE": "1" if shard_opt else "0",
+                # Same GLOBAL batch (16) at any data-axis width.
+                "DCT_BATCH_SIZE": str(16 // mesh_data),
+            },
+        )
+
+    m_tz = run(4, 2, 2, True, "m_tz", "r_tz")
+    m_ref = run(1, 1, 1, False, "m_tz_ref", "r_tz_ref")
+    assert abs(m_tz["val_loss"] - m_ref["val_loss"]) < 1e-3, (m_tz, m_ref)
+
+
+@pytest.mark.slow
 def test_sigkill_rank_then_resume(processed_dir, tmp_path):
     """Crash recovery end to end: SIGKILL one rank MID-TRAINING (after at
     least one epoch's resume state landed), assert the fail-fast launcher

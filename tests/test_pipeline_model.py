@@ -136,3 +136,122 @@ def test_pp_untileable_real_batch_raises(rng):
     x = jnp.asarray(rng.standard_normal((10, 8, 5)), jnp.float32)  # 10 % 4
     with pytest.raises(ValueError, match="does not tile"):
         model.apply(params, x)
+
+
+def test_pp_tp_composed_matches_sequential(rng):
+    """PP x TP: stages streamed over `pipe` with their projection kernels
+    sharded over `model` — output equals the meshless sequential stack
+    (parallelism is layout, not math)."""
+    from dct_tpu.config import MeshConfig, ModelConfig
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.parallel.mesh import make_mesh
+    from dct_tpu.parallel.sharding_rules import state_shardings
+    from dct_tpu.train.state import create_train_state
+
+    cfg = ModelConfig(
+        name="weather_transformer_pp", seq_len=8, d_model=16, n_heads=2,
+        n_layers=2, d_ff=32, n_stages=2,
+    )
+    mesh = make_mesh(MeshConfig(data=2, model=2, pipe=2))
+    m_seq = get_model(cfg, input_dim=5)  # meshless sequential oracle
+    params = m_seq.init(jax.random.PRNGKey(3), jnp.zeros((1, 8, 5)))
+    x = rng.standard_normal((8, 8, 5)).astype(np.float32)
+    ref = np.asarray(m_seq.apply(params, jnp.asarray(x)))
+
+    m_pp = get_model(cfg, input_dim=5, mesh=mesh)
+    state = create_train_state(
+        m_pp, input_dim=5, lr=1e-3, seed=3, example_shape=(1, 8, 5)
+    )
+    shardings = state_shardings(state, mesh)
+    # The qkv kernel inside the stacked stages must be model-sharded —
+    # TP composed, not just replicated under the pipe split.
+    qkv_spec = jax.tree_util.tree_map_with_path(
+        lambda p, s: s.spec
+        if "qkv_proj" in jax.tree_util.keystr(p) and "kernel" in jax.tree_util.keystr(p)
+        else None,
+        shardings,
+    )
+    specs = [s for s in jax.tree.leaves(qkv_spec, is_leaf=lambda v: v is not None) if s]
+    assert any("model" in str(s) for s in specs), specs
+
+    sharded_params = jax.device_put(params, shardings.params)
+    out = np.asarray(m_pp.apply(sharded_params, jnp.asarray(x)))
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_pp_tp_train_step_runs(rng):
+    """Full train step over the data x model x pipe mesh with composed
+    PP x TP shardings: finite loss, params update."""
+    from dct_tpu.config import MeshConfig, ModelConfig
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.parallel.mesh import make_global_batch, make_mesh
+    from dct_tpu.parallel.sharding_rules import shard_state_with_rules
+    from dct_tpu.train.state import create_train_state
+    from dct_tpu.train.steps import make_train_step
+
+    cfg = ModelConfig(
+        name="weather_transformer_pp", seq_len=8, d_model=16, n_heads=2,
+        n_layers=2, d_ff=32, n_stages=2,
+    )
+    mesh = make_mesh(MeshConfig(data=2, model=2, pipe=2))
+    model = get_model(cfg, input_dim=5, mesh=mesh)
+    state = create_train_state(
+        model, input_dim=5, lr=1e-3, seed=0, example_shape=(1, 8, 5)
+    )
+    state = shard_state_with_rules(state, mesh)
+    x = rng.standard_normal((8, 8, 5)).astype(np.float32)
+    y = rng.integers(0, 2, 8).astype(np.int32)
+    w = np.ones(8, np.float32)
+    gx, gy, gw = make_global_batch(mesh, x, y, w)
+    before = jax.device_get(
+        jax.tree.leaves(state.params["params"]["pp_stages"])[0]
+    )
+    state2, m = make_train_step(donate=False)(state, gx, gy, gw)
+    assert np.isfinite(float(jax.device_get(m["train_loss"])))
+    after = jax.device_get(
+        jax.tree.leaves(state2.params["params"]["pp_stages"])[0]
+    )
+    assert np.abs(after - before).max() > 0  # grads flowed through PPxTP
+
+
+def test_pp_tp_collective_in_hlo(rng):
+    """The compiled PP x TP body contains a model-axis all-reduce INSIDE
+    the pipeline (the row-parallel psum) — TP compute is real, not an
+    all-gather of the stage weights."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dct_tpu.config import MeshConfig
+    from dct_tpu.parallel.mesh import make_mesh
+    from dct_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = make_mesh(MeshConfig(data=2, model=2, pipe=2))
+    d = 8
+    w = jnp.asarray(rng.standard_normal((2, d, d)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((8, 4, d)), jnp.float32)
+    w_s = jax.device_put(w, NamedSharding(mesh, P("pipe", None, "model")))
+    x_s = jax.device_put(x, NamedSharding(mesh, P("data", None, None)))
+
+    def stage_fn(p, a):  # col-parallel then row-parallel matmul pair
+        return jnp.tanh(a @ p @ p.T)
+
+    def run(params, xx):
+        return pipeline_apply(
+            stage_fn, params, xx, mesh=mesh, n_microbatches=2,
+            data_axis="data",
+        )
+
+    hlo_tp = jax.jit(run).lower(w_s, x_s).compile().as_text()
+    # Baseline with TP disabled (weights replicated over model): the
+    # pipe-axis psum broadcast alone contributes all-reduces, so the
+    # assertion must be RELATIVE — the TP compile has strictly more
+    # (the in-stage row-parallel psum).
+    w_rep = jax.device_put(w, NamedSharding(mesh, P("pipe", None, None)))
+    hlo_rep = jax.jit(run).lower(w_rep, x_s).compile().as_text()
+    n_tp = hlo_tp.count("all-reduce")
+    n_rep = hlo_rep.count("all-reduce")
+    assert n_tp > n_rep, (n_tp, n_rep)
+    out = jax.jit(run)(w_s, x_s)
+    h = x
+    for i in range(2):
+        h = stage_fn(w[i], h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(h), atol=1e-4)
