@@ -258,6 +258,31 @@ def test_tp_zero1_composed_across_processes(processed_dir, tmp_path):
 
 
 @pytest.mark.slow
+def test_pp_tp_composed_across_processes(processed_dir, tmp_path):
+    """PP x TP composed over 4 real processes (mesh pipe=2 x model=2):
+    GPipe ppermute hops cross one process boundary while the stages'
+    megatron-split kernels all-reduce across the other — trajectory
+    matching the single-process sequential stack."""
+
+    def run(world_size, pipe, model, models_sub, runs_sub):
+        return launch_training(
+            processed_dir, tmp_path, world_size=world_size, port=29540,
+            models_sub=models_sub, runs_sub=runs_sub,
+            env_overrides={
+                "DCT_MODEL": "weather_transformer_pp",
+                "DCT_N_LAYERS": "2",
+                "DCT_N_STAGES": "2",
+                "DCT_MESH_PIPE": str(pipe),
+                "DCT_MESH_MODEL": str(model),
+            },
+        )
+
+    m_pt = run(4, 2, 2, "m_pt", "r_pt")
+    m_ref = run(1, 1, 1, "m_pt_ref", "r_pt_ref")
+    assert abs(m_pt["val_loss"] - m_ref["val_loss"]) < 1e-3, (m_pt, m_ref)
+
+
+@pytest.mark.slow
 def test_sigkill_rank_then_resume(processed_dir, tmp_path):
     """Crash recovery end to end: SIGKILL one rank MID-TRAINING (after at
     least one epoch's resume state landed), assert the fail-fast launcher
