@@ -84,21 +84,34 @@ class _JsonHandler(BaseHTTPRequestHandler):
             return None
         return payload["data"]
 
-    def _score(self, weights: dict, meta: dict, data) -> dict | None:
-        """validate (400) -> forward (500) -> probabilities dict."""
+    def _score(self, weights: dict, meta: dict, data):
+        """validate (400) -> forward (500) -> probabilities dict.
+
+        Returns (result_or_None, server_fault): a None result with
+        server_fault=False was the request's fault (400 already sent);
+        with server_fault=True a 500 was sent — callers tracking
+        per-slot health must count only the latter as slot errors."""
         try:
             x = validate_payload(meta, data)
         except (ValueError, TypeError) as e:
             self._reply(400, {"error": str(e)})
-            return None
+            return None, False
         try:
             probs = softmax_numpy(forward_numpy(weights, meta, x))
+            import numpy as _np
+
+            if not _np.isfinite(probs).all():
+                # Finite validated input producing NaN probabilities is
+                # a broken checkpoint; surface it as the 500 it is
+                # rather than letting the strict-JSON backstop downgrade
+                # the reply after the fact.
+                raise ArithmeticError("non-finite probabilities")
         except Exception as e:  # noqa: BLE001 — past validation, ANY
             # failure (incl. a shape-mismatched weight raising ValueError
             # in a matmul) is a broken checkpoint/export: a SERVER error.
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
-            return None
-        return {"probabilities": probs.tolist()}
+            return None, True
+        return {"probabilities": probs.tolist()}, False
 
 
 class ScoreHandler(_JsonHandler):
@@ -128,7 +141,7 @@ class ScoreHandler(_JsonHandler):
         data = self._read_data_envelope()
         if data is None:
             return
-        result = self._score(
+        result, _server_fault = self._score(
             self.server.model_weights, self.server.model_meta, data
         )
         if result is not None:
@@ -144,6 +157,52 @@ def make_server(ckpt_path: str, *, host: str = "127.0.0.1", port: int = 0):
     server.model_weights = weights
     server.model_meta = meta
     return server
+
+
+class _SlotMetrics:
+    """Thread-safe per-slot request metrics: what an operator watches
+    during a canary (the Azure endpoint surfaces the same per-deployment
+    request/latency series). Bounded memory: a sliding window of the
+    last 1024 latencies per slot — p50/p99 reflect recent traffic, not
+    all-time history."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._by_slot: dict = {}
+
+    def record(self, slot: str, seconds: float, ok: bool) -> None:
+        with self._lock:
+            m = self._by_slot.setdefault(
+                slot, {"requests": 0, "errors": 0, "lat": []}
+            )
+            m["requests"] += 1
+            if not ok:
+                m["errors"] += 1
+            lat = m["lat"]
+            lat.append(seconds)
+            if len(lat) > 1024:
+                del lat[: len(lat) - 1024]
+
+    def snapshot(self) -> dict:
+        import statistics
+
+        with self._lock:
+            out = {}
+            for slot, m in self._by_slot.items():
+                lat = sorted(m["lat"])
+                entry = {"requests": m["requests"], "errors": m["errors"]}
+                if lat:
+                    entry["p50_ms"] = round(
+                        statistics.median(lat) * 1e3, 3
+                    )
+                    entry["p99_ms"] = round(
+                        lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3,
+                        3,
+                    )
+                out[slot] = entry
+            return out
 
 
 class EndpointScoreHandler(_JsonHandler):
@@ -187,11 +246,13 @@ class EndpointScoreHandler(_JsonHandler):
                 "traffic": client.get_traffic(name),
                 "mirror_traffic": client.get_mirror_traffic(name),
                 "deployments": client.list_deployments(name),
+                "metrics": self.server.slot_metrics.snapshot(),
             },
         )
 
     def do_POST(self):  # noqa: N802 (http.server API)
         import random
+        import time
         import urllib.parse
 
         parsed = urllib.parse.urlparse(self.path)
@@ -222,18 +283,30 @@ class EndpointScoreHandler(_JsonHandler):
             # (Azure's model-deployment header behaves the same).
             self._reply(404, {"error": f"no deployment {slot!r} on {name}"})
             return
+        t0 = time.perf_counter()
         try:
             weights, meta = self._load_slot(client, slot)
         except Exception as e:  # noqa: BLE001 — unreadable package:
+            self.server.slot_metrics.record(
+                slot, time.perf_counter() - t0, ok=False
+            )
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
             return
-        result = self._score(weights, meta, data)
+        result, server_fault = self._score(weights, meta, data)
+        # Only SERVER faults count against the slot: a client's bad
+        # payload (400) must not spike the canary's error series and
+        # trigger a rollback of a healthy deployment.
+        self.server.slot_metrics.record(
+            slot, time.perf_counter() - t0, ok=not server_fault
+        )
         if result is None:
             return
         self._reply(200, {**result, "slot": slot})
         # Mirror (shadow) traffic AFTER the live response is flushed —
         # a slow or broken shadow must never touch live latency (exactly
-        # Azure's mirror semantics: the caller never sees it).
+        # Azure's mirror semantics: the caller never sees it). Outcomes
+        # ARE recorded under the shadow slot: evaluating the shadow is
+        # what mirror traffic exists for.
         for shadow, pct in client.get_mirror_traffic(name).items():
             if (
                 pct > 0
@@ -241,13 +314,20 @@ class EndpointScoreHandler(_JsonHandler):
                 and shadow in client.list_deployments(name)
                 and random.random() * 100 < pct
             ):
+                ts = time.perf_counter()
                 try:
+                    import numpy as _np
+
                     w_s, m_s = self._load_slot(client, shadow)
-                    softmax_numpy(
+                    p_s = softmax_numpy(
                         forward_numpy(w_s, m_s, validate_payload(m_s, data))
                     )
+                    shadow_ok = bool(_np.isfinite(p_s).all())
                 except Exception:  # noqa: BLE001 — shadow failures are
-                    pass  # invisible by design
+                    shadow_ok = False  # invisible to the caller by design
+                self.server.slot_metrics.record(
+                    shadow, time.perf_counter() - ts, ok=shadow_ok
+                )
 
 
 def make_endpoint_server(
@@ -263,6 +343,7 @@ def make_endpoint_server(
         "DCT_LOCAL_ENDPOINT_STATE"
     )
     server.package_cache = {}
+    server.slot_metrics = _SlotMetrics()
     return server
 
 

@@ -221,6 +221,16 @@ def test_endpoint_server_rollout_routing(processed_dir, tmp_path):
             urllib.request.urlopen(req_gone)
         assert e.value.code == 404
 
+        # Per-slot request metrics surface on /healthz (the canary
+        # operator's dashboard): both slots saw traffic, latencies
+        # recorded, no errors.
+        with urllib.request.urlopen(url + "/healthz") as r:
+            metrics = json.loads(r.read())["metrics"]
+        assert metrics["blue"]["requests"] > 0
+        assert metrics["green"]["requests"] > 0
+        assert metrics["green"]["errors"] == 0
+        assert metrics["green"]["p50_ms"] > 0
+
         # No live traffic -> 503, not a crash.
         c2.set_traffic("weather-ep", {})
         with pytest.raises(urllib.error.HTTPError) as e:
