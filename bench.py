@@ -563,6 +563,31 @@ def _section(name: str, fn, *args):
     return out
 
 
+# Partial-record checkpointing: every completed section is flushed to this
+# file (and echoed on stderr), so a mid-run wedge/timeout-kill still leaves
+# all on-chip numbers measured so far on disk (VERDICT r2 item 1 — round 2
+# lost its only on-chip record exactly this way).
+_PARTIAL_PATH = os.environ.get(
+    "DCT_BENCH_PARTIAL", os.path.join(_REPO_ROOT, "BENCH_PARTIAL.json")
+)
+
+
+def _flush_partial(record: dict) -> None:
+    # Atomic replace: a SIGKILL mid-write must not corrupt the previous
+    # flush — that is the record this file exists to preserve.
+    try:
+        tmp_path = _PARTIAL_PATH + ".tmp"
+        with open(tmp_path, "w") as f:
+            json.dump(record, f)
+            f.write("\n")
+        os.replace(tmp_path, _PARTIAL_PATH)
+    except OSError as e:  # read-only rigs: stderr echo still lands
+        print(f"[bench] partial write failed: {e}", file=sys.stderr)
+    print(
+        f"[bench] partial: {json.dumps(record)}", file=sys.stderr, flush=True
+    )
+
+
 def main():
     import tempfile
 
@@ -576,50 +601,78 @@ def main():
         "0", "false", "no"
     )
 
+    record = {
+        "metric": "weather_parity_train_samples_per_sec_per_chip",
+        "unit": "samples/sec/chip",
+        "mfu": None,
+    }
+    # Overwrite any stale partial from a previous run BEFORE the first
+    # section: an early crash must leave this run's (empty) record, not a
+    # prior run's numbers masquerading as this run's partials.
+    _flush_partial(record)
+
     with tempfile.TemporaryDirectory() as tmp:
         data = _section("prepare_data", _prepare_data, tmp)
         baseline = _section("torch_baseline", bench_torch_reference, data)
+        record["baseline_torch_cpu_samples_per_sec"] = round(baseline, 1)
+        _flush_partial(record)
+
         ours, last_loss = _section("parity_fused", bench_tpu, data)
+        import jax
+
+        record.update(
+            value=round(ours, 1),
+            vs_baseline=round(ours / baseline, 2),
+            final_train_loss=round(last_loss, 4),
+            platform=jax.default_backend(),
+        )
+        _flush_partial(record)
+
         trainer_loop = _section(
             "trainer_loop", bench_trainer_loop, data, tmp
         )
-        scaled = (
-            None
-            if skip_scaled or _over_deadline("scaled_transformer")
-            else _section("scaled_transformer", bench_scaled_transformer)
+        record["trainer_loop_samples_per_sec_per_chip"] = round(
+            trainer_loop, 1
         )
-        moe = (
-            None
-            if skip_scaled or _over_deadline("scaled_moe")
-            else _section("scaled_moe", bench_scaled_moe)
-        )
-        serving = _section("serving", bench_serving, tmp)
-        dataplane = _section("host_dataplane", bench_host_dataplane)
+        record["trainer_loop_vs_baseline"] = round(trainer_loop / baseline, 2)
+        _flush_partial(record)
 
-    import jax
+        if not (skip_scaled or _over_deadline("scaled_transformer")):
+            scaled = _section(
+                "scaled_transformer", bench_scaled_transformer
+            )
+            record["scaled"] = scaled
+            # null mfu = peak unknown (CPU fallback rig) or the section
+            # deadline-skipped, so absence can't read as "not measured".
+            record["mfu"] = scaled.get("mfu")
+            _flush_partial(record)
 
-    record = {
-        "metric": "weather_parity_train_samples_per_sec_per_chip",
-        "value": round(ours, 1),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(ours / baseline, 2),
-        "baseline_torch_cpu_samples_per_sec": round(baseline, 1),
-        "trainer_loop_samples_per_sec_per_chip": round(trainer_loop, 1),
-        "trainer_loop_vs_baseline": round(trainer_loop / baseline, 2),
-        "final_train_loss": round(last_loss, 4),
-        "platform": jax.default_backend(),
-    }
-    if scaled is not None:
-        record["scaled"] = scaled
-    # Always present: null = peak unknown (CPU fallback rig) or the
-    # scaled section deadline-skipped, so the field's absence can never
-    # be mistaken for "not measured".
-    record["mfu"] = scaled.get("mfu") if scaled is not None else None
-    if moe is not None:
-        record["moe"] = moe
-    record["serving"] = serving
-    if dataplane is not None:
-        record["host_dataplane"] = dataplane
+        if not (skip_scaled or _over_deadline("scaled_moe")):
+            record["moe"] = _section("scaled_moe", bench_scaled_moe)
+            _flush_partial(record)
+
+        if not _over_deadline("serving"):
+            record["serving"] = _section("serving", bench_serving, tmp)
+            _flush_partial(record)
+
+        if not _over_deadline("host_dataplane"):
+            dataplane = _section("host_dataplane", bench_host_dataplane)
+            # Distinguish "ran, native lib absent" from the deadline-skip
+            # null: the former means the numpy fallback IS the product
+            # path, not that a bigger budget would produce numbers.
+            record["host_dataplane"] = (
+                dataplane
+                if dataplane is not None
+                else {"native": "unavailable"}
+            )
+            _flush_partial(record)
+
+    # One null-marker pass for every skippable section: null means
+    # "skipped this run" (deadline or DCT_BENCH_SCALED=0), never "not part
+    # of this bench" — and the partial file must match the printed record.
+    for skippable in ("scaled", "moe", "serving", "host_dataplane"):
+        record.setdefault(skippable, None)
+    _flush_partial(record)
     print(json.dumps(record))
 
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 
 # The child honors JAX_PLATFORMS env over any sitecustomize config clobber
 # (mirroring ensure_live_backend's own policy) so it initializes exactly the
@@ -49,7 +50,9 @@ def probe_default_backend(timeout: float = 150.0) -> str | None:
     return out[-1] if out else None
 
 
-def ensure_live_backend(timeout: float | None = None) -> str:
+def ensure_live_backend(
+    timeout: float | None = None, retries: int | None = None
+) -> str:
     """Make sure this process's first backend init cannot hang.
 
     - An explicit ``JAX_PLATFORMS`` env var wins over any sitecustomize
@@ -59,15 +62,27 @@ def ensure_live_backend(timeout: float | None = None) -> str:
       an accelerator — is probed in a subprocess; on failure this process
       (and children, via env) is pinned to CPU.
 
+    A transiently wedged control plane (relay recovering from a killed
+    client) often comes back within seconds, so a probe child that FAILS
+    FAST (crash, connection refused) is retried up to ``retries`` times
+    (``DCT_BACKEND_PROBE_RETRIES``, default 3) with exponential backoff.
+    Every attempt gets the FULL remaining ``timeout`` budget
+    (``DCT_BACKEND_PROBE_TIMEOUT`` seconds, 150 if unset) — splitting it
+    would shrink the tolerated init latency, and a child killed at its
+    timeout restarts init from scratch on retry, so a smaller window can
+    never succeed where the bigger one didn't. Net: slow-but-healthy init
+    keeps the old single-probe tolerance; fast failures get retries the
+    old code lacked (VERDICT r2 item 1).
+
     Must be called before any jax backend initializes. Returns the platform
     that will be used ("cpu" or the probed default, e.g. "tpu").
-    ``timeout`` defaults to the ``DCT_BACKEND_PROBE_TIMEOUT`` env var
-    (seconds, 150 if unset) so every caller honors the knob.
     """
     import jax
 
     if timeout is None:
         timeout = float(os.environ.get("DCT_BACKEND_PROBE_TIMEOUT", "150"))
+    if retries is None:
+        retries = max(1, int(os.environ.get("DCT_BACKEND_PROBE_RETRIES", "3")))
 
     want = os.environ.get("JAX_PLATFORMS")
     if want and jax.config.jax_platforms != want:
@@ -76,13 +91,41 @@ def ensure_live_backend(timeout: float | None = None) -> str:
     if platforms == "cpu":
         return "cpu"
 
-    backend = probe_default_backend(timeout=timeout)
-    if backend is not None:
-        return backend
+    backoff = 2.0
+    deadline = time.monotonic() + timeout
+    attempts = 0
+    for attempt in range(retries):
+        remaining = timeout if attempt == 0 else deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        attempts += 1
+        backend = probe_default_backend(timeout=remaining)
+        if backend is not None:
+            if attempt:
+                sys.stderr.write(
+                    f"[dct_tpu] backend probe succeeded on attempt "
+                    f"{attempt + 1}/{retries}\n"
+                )
+            return backend
+        if attempt == retries - 1:
+            break
+        if time.monotonic() + backoff >= deadline:
+            # No room to wait out a recovering relay — an immediate
+            # re-probe against the same wedged control plane is doomed,
+            # so stop rather than burn subprocess spawns.
+            break
+        sys.stderr.write(
+            f"[dct_tpu] backend probe attempt {attempt + 1}/{retries} "
+            f"failed — retrying in {backoff:.0f}s\n"
+        )
+        time.sleep(backoff)
+        backoff *= 2
 
+    elapsed = time.monotonic() - (deadline - timeout)
     sys.stderr.write(
         f"[dct_tpu] default backend ({(platforms or 'auto')!r}) failed to "
-        f"initialize within {timeout:.0f}s — falling back to CPU\n"
+        f"initialize: {attempts} attempt(s) over {elapsed:.0f}s "
+        f"(budget {timeout:.0f}s) — falling back to CPU\n"
     )
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
