@@ -216,9 +216,13 @@ class MlflowTracking:
         )
 
     def download_artifacts(self, run_id: str, artifact_path: str, dst: str) -> str:
-        from mlflow.tracking import MlflowClient
+        # MlflowClient.download_artifacts was removed in MLflow 2.0; the
+        # 2.x API is mlflow.artifacts.download_artifacts (keyword-only).
+        from mlflow import artifacts
 
-        return MlflowClient().download_artifacts(run_id, artifact_path, dst)
+        return artifacts.download_artifacts(
+            run_id=run_id, artifact_path=artifact_path, dst_path=dst
+        )
 
 
 class NullTracking:

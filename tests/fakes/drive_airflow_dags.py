@@ -1,0 +1,62 @@
+"""Subprocess driver: install the fake ``airflow`` package, then import
+every DAG file through the REAL-import branch of
+``dct_tpu.orchestration.compat`` and print the resulting registry as JSON.
+
+Runs in a child process because the parent pytest process has already
+imported ``compat`` without airflow (the ImportError branch) — module
+caching would otherwise keep the stand-ins bound.
+"""
+
+import importlib
+import json
+import os
+import sys
+
+
+def main() -> None:
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    sys.path.insert(0, repo)
+
+    from tests.fakes import fake_airflow
+
+    fake_airflow.install()
+
+    from dct_tpu.orchestration import compat
+
+    assert compat.AIRFLOW_AVAILABLE, "fake airflow not picked up"
+    assert compat.DAG is fake_airflow.DAG, "compat did not re-export real DAG"
+
+    sys.path.insert(0, os.path.join(repo, "dags"))
+    for mod in (
+        "spark_etl_dag",
+        "training_dag",
+        "pipeline_dag",
+        "azure_manual_deploy_dag",
+        "azure_auto_deploy_dag",
+    ):
+        importlib.import_module(mod)
+
+    print(
+        json.dumps(
+            {
+                dag_id: {
+                    "tasks": sorted(dag.tasks),
+                    "schedule": dag.schedule,
+                    "downstream": {
+                        t.task_id: sorted(d.task_id for d in t.downstream)
+                        for t in dag.tasks.values()
+                    },
+                }
+                for dag_id, dag in fake_airflow.REGISTRY.items()
+            }
+        )
+    )
+
+
+# Module-level side effects (sys.modules mutation, DAG imports) must stay
+# subprocess-only — importing this module from the pytest process would
+# permanently shadow the compat fallback branch for the whole suite.
+if __name__ == "__main__":
+    main()
