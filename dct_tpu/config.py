@@ -252,7 +252,9 @@ class DistributedConfig:
         # Native names win; reference-compat names are the fallback.
         world = os.environ.get("DCT_NUM_PROCESSES") or os.environ.get("WORLD_SIZE")
         rank = os.environ.get("DCT_PROCESS_ID") or os.environ.get("NODE_RANK")
-        coord = os.environ.get("DCT_COORDINATOR_ADDRESS")
+        # "" means unset, consistently with world/rank above — launchers
+        # blank these vars to neutralize inherited overrides.
+        coord = os.environ.get("DCT_COORDINATOR_ADDRESS") or None
         if coord is None:
             master_addr = os.environ.get("MASTER_ADDR")
             master_port = os.environ.get("MASTER_PORT", "29500")
