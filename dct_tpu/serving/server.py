@@ -159,6 +159,51 @@ def make_server(ckpt_path: str, *, host: str = "127.0.0.1", port: int = 0):
     return server
 
 
+class _PackageCache:
+    """Thread-safe weights cache keyed by package dir.
+
+    ThreadingHTTPServer handles each request on its own thread, so the
+    cache needs a lock; and deployments retire as rollouts proceed, so
+    entries whose package dir no longer backs ANY current deployment are
+    evicted on the next load — a long-lived endpoint server must not
+    accumulate a full weight set for every package ever served.
+
+    Concurrent first requests for the same package may both run the
+    loader (load happens outside the lock — package IO must not stall
+    other slots' cache hits); the first store wins and the duplicate is
+    dropped, which is benign for immutable read-only packages.
+
+    Eviction is GENERATION-GATED: each request carries the state file's
+    mtime from before its snapshot read, and only the newest generation
+    observed may evict. A straggler request holding a pre-transition
+    snapshot can therefore never evict a package a newer deployment just
+    made live (which would force a full reload — a latency spike on
+    exactly the canary slot mid-rollout).
+    """
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._entries: dict = {}
+        self._generation = -1
+
+    def get_or_load(
+        self, pkg: str, loader, live_pkgs, generation: int = 0
+    ) -> tuple:
+        with self._lock:
+            if generation >= self._generation:
+                self._generation = generation
+                for stale in set(self._entries) - set(live_pkgs):
+                    del self._entries[stale]
+            cached = self._entries.get(pkg)
+        if cached is None:
+            loaded = loader()
+            with self._lock:
+                cached = self._entries.setdefault(pkg, loaded)
+        return cached
+
+
 class _SlotMetrics:
     """Thread-safe per-slot request metrics: what an operator watches
     during a canary (the Azure endpoint surfaces the same per-deployment
@@ -212,20 +257,30 @@ class EndpointScoreHandler(_JsonHandler):
         from dct_tpu.deploy.local import LocalEndpointClient
 
         # Fresh read of the persisted state: rollout stages run in other
-        # processes and must take effect without a server restart.
-        return LocalEndpointClient(state_path=self.server.state_path)
+        # processes and must take effect without a server restart. The
+        # mtime is taken BEFORE the read, so the generation can only
+        # under-state the snapshot's age — stale cache evictions are
+        # skipped, never wrongly applied (_PackageCache docstring).
+        state_path = self.server.state_path
+        try:
+            generation = os.stat(state_path).st_mtime_ns
+        except OSError:
+            generation = 0
+        self._state_generation = generation
+        return LocalEndpointClient(state_path=state_path)
 
     def _load_slot(self, client, slot: str):
         """(weights, meta) via the server-lifetime package cache —
         packages are immutable once written, so only the state JSON
-        needs the per-request re-read."""
-        pkg = client.endpoints[self.server.endpoint_name] \
-            .deployments[slot].package_dir
-        cached = self.server.package_cache.get(pkg)
-        if cached is None:
-            cached = client.load_slot(self.server.endpoint_name, slot)
-            self.server.package_cache[pkg] = cached
-        return cached
+        needs the per-request re-read. Retired packages evict."""
+        name = self.server.endpoint_name
+        deployments = client.endpoints[name].deployments
+        return self.server.package_cache.get_or_load(
+            deployments[slot].package_dir,
+            lambda: client.load_slot(name, slot),
+            [d.package_dir for d in deployments.values()],
+            generation=getattr(self, "_state_generation", 0),
+        )
 
     def do_GET(self):  # noqa: N802 (http.server API)
         import urllib.parse
@@ -342,7 +397,7 @@ def make_endpoint_server(
     server.state_path = state_path or os.environ.get(
         "DCT_LOCAL_ENDPOINT_STATE"
     )
-    server.package_cache = {}
+    server.package_cache = _PackageCache()
     server.slot_metrics = _SlotMetrics()
     return server
 

@@ -239,3 +239,125 @@ def test_endpoint_server_rollout_routing(processed_dir, tmp_path):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_endpoint_server_concurrent_load_during_transitions(
+    processed_dir, tmp_path
+):
+    """Parallel /score load while the deploy DAG's stage transitions
+    mutate the endpoint state mid-serve (the server's designed-for mode,
+    server.py module docstring): no torn reads — every response must be
+    a well-formed JSON with a consistent slot/probabilities pair, a 404
+    (pinned slot momentarily gone), or a 503 (no-traffic moment); never
+    a 500, never a connection drop, never invalid JSON. Also proves the
+    package cache's lock + eviction under ThreadingHTTPServer
+    concurrency (ADVICE r2)."""
+    from dct_tpu.deploy.local import LocalEndpointClient
+    from dct_tpu.serving.score_gen import generate_score_package
+    from dct_tpu.serving.server import make_endpoint_server
+
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=processed_dir, models_dir=str(tmp_path / "m")),
+        train=TrainConfig(epochs=1, batch_size=8, bf16_compute=False),
+    )
+    res = Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    # Two DISTINCT package dirs so blue's retirement exercises eviction.
+    pkg_blue = str(tmp_path / "pkg_blue")
+    pkg_green = str(tmp_path / "pkg_green")
+    generate_score_package(res.best_model_path, pkg_blue)
+    generate_score_package(res.best_model_path, pkg_green)
+
+    state = str(tmp_path / "endpoint_state.json")
+    c = LocalEndpointClient(state_path=state)
+    c.create_endpoint("weather-ep")
+    c.deploy("weather-ep", "blue", pkg_blue)
+    c.set_traffic("weather-ep", {"blue": 100})
+
+    server = make_endpoint_server("weather-ep", state_path=state)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    row = {"data": [[0.1, -0.2, 0.3, 0.0, 1.0]]}
+
+    stop = threading.Event()
+    failures: list[str] = []
+    successes: list[str] = []  # list.append is atomic under the GIL
+
+    def worker(idx: int):
+        payload = json.dumps(row).encode()
+        n = 0
+        while not stop.is_set() and n < 200:
+            n += 1
+            path = "/score?slot=green" if idx == 0 and n % 3 == 0 else "/score"
+            req = urllib.request.Request(
+                url + path, data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    body = json.loads(r.read())
+                if body["slot"] not in ("blue", "green"):
+                    failures.append(f"unknown slot {body['slot']!r}")
+                probs = np.asarray(body["probabilities"])
+                if probs.shape != (1, 2) or not np.allclose(
+                    probs.sum(), 1.0, atol=1e-4
+                ):
+                    failures.append(f"bad probabilities {probs!r}")
+                else:
+                    successes.append(body["slot"])
+            except urllib.error.HTTPError as e:
+                if e.code not in (404, 503):
+                    failures.append(
+                        f"status {e.code}: {e.read()[:200]!r}"
+                    )
+            except Exception as e:  # noqa: BLE001 — any transport tear
+                failures.append(f"{type(e).__name__}: {e}")
+
+    workers = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(6)
+    ]
+    for w in workers:
+        w.start()
+    try:
+        # The deploy DAG's transition sequence, looped under load from a
+        # fresh client each stage (the DAG's own fresh-process pattern).
+        import time as _time
+
+        for _ in range(6):
+            c2 = LocalEndpointClient(state_path=state)
+            c2.deploy("weather-ep", "green", pkg_green)
+            c2.set_mirror_traffic("weather-ep", {"green": 20})
+            _time.sleep(0.05)
+            c2.set_traffic("weather-ep", {"blue": 90, "green": 10})
+            _time.sleep(0.05)
+            c2.set_mirror_traffic("weather-ep", {})
+            c2.set_traffic("weather-ep", {"green": 100})
+            _time.sleep(0.05)
+            c2.delete_deployment("weather-ep", "blue")
+            _time.sleep(0.05)
+            # Roll back to blue for the next loop iteration.
+            c2.deploy("weather-ep", "blue", pkg_blue)
+            c2.set_traffic("weather-ep", {"blue": 100})
+            c2.delete_deployment("weather-ep", "green")
+            _time.sleep(0.05)
+    finally:
+        stop.set()
+        for w in workers:
+            w.join(timeout=60)
+        try:
+            # Eviction is lazy (runs on the next load after retirement):
+            # one post-churn request makes green's retirement observable.
+            _post(url, row)
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    assert not failures, failures[:10]
+    # The server actually SERVED through the transitions (a server
+    # 404/503-ing everything would otherwise pass vacuously).
+    assert len(successes) > 50, len(successes)
+    # Eviction: green is retired, so after the final successful score
+    # exactly blue's package is cached.
+    cached = set(server.package_cache._entries)
+    assert cached == {pkg_blue}, cached
