@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Run ONLY the scaled-transformer (and optionally MoE) bench sections —
+the on-chip MFU tuning loop. The full bench.py pays the torch baseline,
+parity, trainer-loop, and serving sections every run (~10 min over the
+tunnel); a DCT_SCALED_* sweep needs just these.
+
+  DCT_SCALED_DMODEL=1024 DCT_SCALED_LAYERS=8 python scripts/onchip_scaled.py
+  DCT_ONCHIP_MOE=1 python scripts/onchip_scaled.py   # also the MoE section
+
+Prints one JSON line per section, same schema as bench.py's fields.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from dct_tpu.utils.platform import ensure_live_backend  # noqa: E402
+
+ensure_live_backend()
+
+import bench  # noqa: E402
+
+
+def main() -> None:
+    scaled = bench._section("scaled_transformer", bench.bench_scaled_transformer)
+    print(json.dumps({"scaled": scaled}), flush=True)
+    if os.environ.get("DCT_ONCHIP_MOE", "").strip().lower() in ("1", "true", "yes"):
+        moe = bench._section("scaled_moe", bench.bench_scaled_moe)
+        print(json.dumps({"moe": moe}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
