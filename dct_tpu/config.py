@@ -164,6 +164,10 @@ class TrainConfig:
     # dim over the data axis; XLA reduce-scatters grads into the shards
     # and all-gathers updates. Memory win at scale; off for parity.
     shard_opt_state: bool = False
+    # FSDP/ZeRO-3: shard the PARAMS (and their Adam moments) over the
+    # data axis too; each rank stores 1/N of every weight and XLA
+    # all-gathers on use. Layout-only — the trajectory is unchanged.
+    shard_params: bool = False
     # Gradient accumulation: microbatches summed per optimizer update
     # (effective batch = batch_size * data_parallel * this) — capability
     # the reference lacks; 1 = parity behavior.
@@ -193,6 +197,7 @@ class TrainConfig:
         c.bf16_compute = _env("DCT_BF16_COMPUTE", c.bf16_compute, bool)
         c.use_scan = _env("DCT_USE_SCAN", c.use_scan, bool)
         c.shard_opt_state = _env("DCT_SHARD_OPT_STATE", c.shard_opt_state, bool)
+        c.shard_params = _env("DCT_SHARD_PARAMS", c.shard_params, bool)
         c.grad_accum_steps = _env("DCT_GRAD_ACCUM_STEPS", c.grad_accum_steps, int)
         c.early_stop_patience = _env(
             "DCT_EARLY_STOP_PATIENCE", c.early_stop_patience, int

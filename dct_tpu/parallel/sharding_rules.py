@@ -70,13 +70,15 @@ def spec_for_path(path, ndim: int | None = None) -> P:
     return P()
 
 
-def _opt_shard_spec(leaf, mesh: Mesh) -> P | None:
-    """Weight-update (ZeRO-1 style) sharding for an optimizer-state leaf:
-    split the leading dim over ``data`` when it divides evenly. XLA then
-    reduce-scatters gradients into the sharded Adam moments and
-    all-gathers the updates back onto the replicated params — the
-    cross-replica weight-update sharding recipe, expressed purely as a
-    layout annotation."""
+def _data_shard_spec(leaf, mesh: Mesh) -> P | None:
+    """Data-axis leading-dim sharding for a leaf that divides evenly.
+
+    Applied to optimizer-state leaves this is ZeRO-1 weight-update
+    sharding (XLA reduce-scatters gradients into the sharded Adam
+    moments and all-gathers the updates back); applied to param leaves
+    too it is FSDP/ZeRO-3 — each data rank stores 1/N of every weight,
+    and XLA inserts the all-gather-on-use in forward/backward. Both are
+    pure layout annotations: no imperative communication."""
     shape = getattr(leaf, "shape", ())
     data = mesh.shape["data"]
     if data > 1 and len(shape) >= 1 and shape[0] % data == 0 and shape[0] >= data:
@@ -84,37 +86,53 @@ def _opt_shard_spec(leaf, mesh: Mesh) -> P | None:
     return None
 
 
-def state_shardings(state, mesh: Mesh, *, shard_opt: bool = False):
+def state_shardings(
+    state, mesh: Mesh, *, shard_opt: bool = False, shard_params: bool = False
+):
     """NamedSharding tree for a TrainState under the name-pattern rules.
     Scalars/rngs/unmatched params replicate; matched params (and their
     mirrored Adam moments) shard over ``model``. With ``shard_opt``,
     otherwise-replicated optimizer-state leaves additionally shard their
-    leading dim over ``data`` (see :func:`_opt_shard_spec`)."""
+    leading dim over ``data`` (ZeRO-1); with ``shard_params``, the params
+    themselves (and their moment mirrors) do too — FSDP/ZeRO-3, where
+    params, gradients, and optimizer state all live 1/N-sharded and XLA
+    all-gathers weights on use (see :func:`_data_shard_spec`).
+    Tensor-parallel matches keep their ``model``-axis placement — TP and
+    FSDP compose axis-wise, the scaling-book combined recipe."""
 
     def one(path, leaf):
         if getattr(leaf, "ndim", 0) == 0:
             return NamedSharding(mesh, P())
         spec = spec_for_path(path, ndim=getattr(leaf, "ndim", None))
-        if (
-            shard_opt
-            and spec == P()
-            and any(
-                str(getattr(k, "key", getattr(k, "name", k))) == "opt_state"
-                for k in path
+        if spec == P():
+            names = {
+                str(getattr(k, "key", getattr(k, "name", k))) for k in path
+            }
+            eligible = (
+                (shard_opt and "opt_state" in names)
+                or (shard_params and ("opt_state" in names or "params" in names))
             )
-        ):
-            opt_spec = _opt_shard_spec(leaf, mesh)
-            if opt_spec is not None:
-                spec = opt_spec
+            if eligible:
+                data_spec = _data_shard_spec(leaf, mesh)
+                if data_spec is not None:
+                    spec = data_spec
         return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(one, state)
 
 
-def shard_state_with_rules(state, mesh: Mesh, *, shard_opt: bool = False):
+def shard_state_with_rules(
+    state, mesh: Mesh, *, shard_opt: bool = False, shard_params: bool = False
+):
     """Place a TrainState: tensor-parallel where rules match, replicated
     elsewhere (the pure-DP MLP matches nothing and fully replicates,
     keeping :func:`dct_tpu.parallel.mesh.shard_state` semantics).
     ``shard_opt`` opts optimizer state into data-axis weight-update
-    sharding."""
-    return jax.device_put(state, state_shardings(state, mesh, shard_opt=shard_opt))
+    sharding (ZeRO-1); ``shard_params`` additionally shards the params
+    (FSDP/ZeRO-3)."""
+    return jax.device_put(
+        state,
+        state_shardings(
+            state, mesh, shard_opt=shard_opt, shard_params=shard_params
+        ),
+    )

@@ -238,7 +238,8 @@ class Trainer:
         # params with a cross-process allgather (checkpoint.manager.to_host),
         # called on EVERY rank before the coordinator-gated write.
         state = shard_state_with_rules(
-            state, self.mesh, shard_opt=cfg.train.shard_opt_state
+            state, self.mesh, shard_opt=cfg.train.shard_opt_state,
+            shard_params=cfg.train.shard_params,
         )
         # The DECLARED layout. The jitted step's OUTPUT shardings can
         # drift from it — under ZeRO-1, XLA keeps the weight update (and
@@ -249,7 +250,8 @@ class Trainer:
         # (whose fresh template is the declared layout) cannot match the
         # saved shards to its topology.
         declared_shardings = state_shardings(
-            state, self.mesh, shard_opt=cfg.train.shard_opt_state
+            state, self.mesh, shard_opt=cfg.train.shard_opt_state,
+            shard_params=cfg.train.shard_params,
         )
 
         # Continuous-training semantics (the reference re-trains from
@@ -267,6 +269,7 @@ class Trainer:
             state = shard_state_with_rules(
                 state_ckptr.restore(state), self.mesh,
                 shard_opt=cfg.train.shard_opt_state,
+                shard_params=cfg.train.shard_params,
             )
             saved = state_ckptr.load_meta()
             if "epochs_completed" in saved:
