@@ -88,6 +88,10 @@ class ModelConfig:
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.01
     moe_dispatch: str = "auto"
+    # 'auto' dispatch crossover: elements of the one-hot [kN, E, C]
+    # tensor past which the sorted engine is picked. Calibrate on the
+    # target chip with bench.py's scaled_moe section.
+    moe_auto_threshold: int = 1 << 21
     # 1 = switch (top-1); 2+ = GShard-style top-k with normalized gates.
     router_top_k: int = 1
     # Pipeline-parallel family (weather_transformer_pp): stage count over
@@ -99,6 +103,10 @@ class ModelConfig:
     # multi-horizon — every position predicts steps t+1..t+H at once
     # (no autoregressive feedback), labels [B, S, H].
     horizon: int = 1
+    # Activation rematerialization for the transformer families: store
+    # only block boundaries forward, recompute internals backward — the
+    # HBM-for-FLOPs trade (jax.checkpoint) that unlocks long sequences.
+    remat: bool = False
 
     @classmethod
     def from_env(cls) -> "ModelConfig":
@@ -118,11 +126,15 @@ class ModelConfig:
             "DCT_ROUTER_AUX_WEIGHT", c.router_aux_weight, float
         )
         c.moe_dispatch = _env("DCT_MOE_DISPATCH", c.moe_dispatch, str)
+        c.moe_auto_threshold = _env(
+            "DCT_MOE_AUTO_THRESHOLD", c.moe_auto_threshold, int
+        )
         c.router_top_k = _env("DCT_ROUTER_TOP_K", c.router_top_k, int)
         c.n_stages = _env("DCT_N_STAGES", c.n_stages, int)
         mb = os.environ.get("DCT_N_MICROBATCHES")
         c.n_microbatches = int(mb) if mb else c.n_microbatches
         c.horizon = _env("DCT_HORIZON", c.horizon, int)
+        c.remat = _env("DCT_REMAT", c.remat, bool)
         return c
 
 

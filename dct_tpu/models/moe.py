@@ -137,6 +137,7 @@ class MoEFFN(nn.Module):
     dispatch: str = "auto"
     mesh: object = None
     top_k: int = 1
+    auto_threshold: int = 1 << 21
 
     @nn.compact
     def __call__(self, x):  # [B, S, D] -> [B, S, D]
@@ -224,9 +225,17 @@ class MoEFFN(nn.Module):
 
         engine = self.dispatch
         if engine == "auto":
-            # One-hot dispatch materializes [kN, E, C] twice; past ~2^21
-            # elements the sort-based engine wins on both memory and time.
-            engine = "sorted" if k * n * e * capacity >= (1 << 21) else "einsum"
+            # One-hot dispatch materializes [kN, E, C] twice; past
+            # ``auto_threshold`` (elements of that tensor) the sort-based
+            # engine wins on both memory and time. Default ~2^21; set
+            # DCT_MOE_AUTO_THRESHOLD (-> ModelConfig.moe_auto_threshold)
+            # once measured on the target chip (bench.py's scaled_moe
+            # section gives the crossover data).
+            engine = (
+                "sorted"
+                if k * n * e * capacity >= self.auto_threshold
+                else "einsum"
+            )
         mesh = self.mesh
         if engine == "sorted" and mesh is not None:
             dp = mesh.shape.get("data", 1)
@@ -362,6 +371,7 @@ class MoEBlock(nn.Module):
     dispatch: str = "auto"
     mesh: object = None
     top_k: int = 1
+    auto_threshold: int = 1 << 21
 
     @nn.compact
     def __call__(self, x, *, train: bool):
@@ -377,6 +387,7 @@ class MoEBlock(nn.Module):
             self.d_model, self.d_ff, self.n_experts, self.capacity_factor,
             aux_weight=self.aux_weight, dtype=self.dtype,
             dispatch=self.dispatch, mesh=self.mesh, top_k=self.top_k,
+            auto_threshold=self.auto_threshold,
             name="moe",
         )(h)
         h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
@@ -402,6 +413,7 @@ class WeatherMoE(nn.Module):
     dispatch: str = "auto"
     mesh: object = None
     top_k: int = 1
+    auto_threshold: int = 1 << 21
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -427,6 +439,7 @@ class WeatherMoE(nn.Module):
                 dispatch=self.dispatch,
                 mesh=self.mesh,
                 top_k=self.top_k,
+                auto_threshold=self.auto_threshold,
                 name=f"block_{i}",
             )(h, train=train)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)

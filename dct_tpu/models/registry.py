@@ -119,6 +119,7 @@ def _build_moe(
         dispatch=cfg.moe_dispatch,
         mesh=mesh,
         top_k=cfg.router_top_k,
+        auto_threshold=cfg.moe_auto_threshold,
     )
 
 
@@ -150,6 +151,7 @@ def _build_transformer_causal(
         attn_fn=make_attention_fn(mesh, causal=True),
         per_position=True,
         horizon=cfg.horizon,
+        remat=cfg.remat,
         compute_dtype=compute_dtype or jnp.float32,
     )
 
@@ -181,6 +183,7 @@ def _build_transformer_pp(
         n_microbatches=cfg.n_microbatches,
         attn_fn=make_attention_fn(None),
         mesh=mesh,
+        remat=cfg.remat,
         compute_dtype=compute_dtype or jnp.float32,
     )
 
@@ -205,5 +208,6 @@ def _build_transformer(
         num_classes=cfg.num_classes,
         dropout=cfg.dropout,
         attn_fn=attn_fn,
+        remat=cfg.remat,
         compute_dtype=compute_dtype or jnp.float32,
     )

@@ -77,7 +77,9 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool = False):
+        # ``train`` is positional-or-keyword (not kw-only) so nn.remat's
+        # static_argnums can reach it (WeatherTransformer's remat path).
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
         h = MultiHeadAttention(
             self.d_model, self.n_heads, self.attn_fn, dtype=self.dtype,
@@ -106,14 +108,20 @@ class _StageBlocks(nn.Module):
     layers_per_stage: int
     attn_fn: object
     dtype: jnp.dtype = jnp.float32
+    remat: bool = False
 
     @nn.compact
     def __call__(self, h):
+        block_cls = (
+            nn.remat(TransformerBlock, static_argnums=(2,))
+            if self.remat
+            else TransformerBlock
+        )
         for i in range(self.layers_per_stage):
-            h = TransformerBlock(
+            h = block_cls(
                 self.d_model, self.n_heads, self.d_ff, 0.0, self.attn_fn,
                 dtype=self.dtype, name=f"block_{i}",
-            )(h, train=False)
+            )(h, False)
         return h
 
 
@@ -151,6 +159,7 @@ class WeatherTransformerPP(nn.Module):
     n_microbatches: int | None = None
     attn_fn: object = None
     mesh: object = None
+    remat: bool = False
     compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -168,6 +177,7 @@ class WeatherTransformerPP(nn.Module):
         stage_mod = _StageBlocks(
             self.d_model, self.n_heads, self.d_ff,
             self.n_layers // self.n_stages, attn_fn, dtype=ct,
+            remat=self.remat,
         )
 
         def init_stages(rng):
@@ -240,6 +250,7 @@ class WeatherTransformer(nn.Module):
     attn_fn: object = None  # default set in __call__ (dense/blockwise)
     per_position: bool = False
     horizon: int = 1
+    remat: bool = False
     compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -257,8 +268,19 @@ class WeatherTransformer(nn.Module):
         h = h + jnp.asarray(
             sincos_positions(self.seq_len, self.d_model), self.compute_dtype
         )
+        # Activation rematerialization: store only block BOUNDARIES on the
+        # forward pass and recompute block internals in backward — the
+        # HBM-for-FLOPs trade that unlocks long sequences (activation
+        # memory drops from O(layers * seq * d_ff) to O(layers * seq *
+        # d_model)). Param tree and math are identical (static_argnums=2
+        # is ``train``; self counts as 0 in flax's indexing).
+        block_cls = (
+            nn.remat(TransformerBlock, static_argnums=(2,))
+            if self.remat
+            else TransformerBlock
+        )
         for i in range(self.n_layers):
-            h = TransformerBlock(
+            h = block_cls(
                 self.d_model,
                 self.n_heads,
                 self.d_ff,
@@ -266,7 +288,7 @@ class WeatherTransformer(nn.Module):
                 attn_fn,
                 dtype=self.compute_dtype,
                 name=f"block_{i}",
-            )(h, train=train)
+            )(h, train)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)
         if self.per_position and self.horizon > 1:
             logits = TorchStyleDense(

@@ -235,3 +235,26 @@ def test_dispatch_typo_rejected():
     f = MoEFFN(d_model=16, d_ff=32, n_experts=4, dispatch="sort")
     with pytest.raises(ValueError, match="moe_dispatch"):
         f.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 16), jnp.float32))
+
+
+def test_auto_threshold_picks_engine(rng):
+    """ModelConfig.moe_auto_threshold (DCT_MOE_AUTO_THRESHOLD) moves the
+    auto crossover: threshold 1 forces the sorted engine (argsort in the
+    program), a huge threshold forces einsum (no argsort) — the knob the
+    on-chip crossover measurement calibrates."""
+    x = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+
+    def jaxpr_for(threshold):
+        f = MoEFFN(
+            d_model=16, d_ff=32, n_experts=4, dispatch="auto",
+            auto_threshold=threshold,
+        )
+        params = f.init(jax.random.PRNGKey(0), x)
+        return str(
+            jax.make_jaxpr(
+                lambda p: f.apply(p, x, mutable=["aux_loss"])[0]
+            )(params)
+        )
+
+    assert "argsort" in jaxpr_for(1) or "sort" in jaxpr_for(1)
+    assert "sort" not in jaxpr_for(1 << 40)

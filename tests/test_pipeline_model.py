@@ -255,3 +255,30 @@ def test_pp_tp_collective_in_hlo(rng):
     for i in range(2):
         h = stage_fn(w[i], h)
     np.testing.assert_allclose(np.asarray(out), np.asarray(h), atol=1e-4)
+
+
+def test_pp_remat_is_layout_not_math(rng):
+    """DCT_REMAT through the PP family: same param tree, same outputs and
+    gradients as the non-remat pipeline (remat only reschedules the
+    backward's memory inside each stage)."""
+    mesh = make_mesh(MeshConfig(data=4, pipe=2))
+    x = jnp.asarray(rng.standard_normal((8, 8, 5)), jnp.float32)
+    m = _model(mesh=mesh, n_stages=2)
+    m_r = _model(mesh=mesh, n_stages=2, remat=True)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
+    params_r = m_r.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(
+        params_r
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_r.apply(params, x)), np.asarray(m.apply(params, x)),
+        atol=1e-6,
+    )
+    g = jax.grad(lambda p: m.apply(p, x).astype(jnp.float32).sum())(params)
+    g_r = jax.grad(lambda p: m_r.apply(p, x).astype(jnp.float32).sum())(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        g, g_r,
+    )

@@ -62,6 +62,50 @@ def test_forward_shape_and_dtype(rng):
     assert logits.dtype == jnp.float32
 
 
+def test_remat_is_layout_not_math(rng):
+    """DCT_REMAT (activation rematerialization) must change ONLY the
+    backward's memory schedule: identical param tree, identical loss,
+    identical gradients, and the remat primitive actually present in the
+    grad program (i.e. the flag is not silently ignored)."""
+    import dataclasses
+
+    cfg_remat = dataclasses.replace(CFG, remat=True)
+    model = get_model(CFG, input_dim=F)
+    model_r = get_model(cfg_remat, input_dim=F)
+    state = create_train_state(
+        model, input_dim=F, lr=1e-3, seed=42, example_shape=(1, SEQ, F)
+    )
+    state_r = create_train_state(
+        model_r, input_dim=F, lr=1e-3, seed=42, example_shape=(1, SEQ, F)
+    )
+    assert jax.tree_util.tree_structure(
+        state.params
+    ) == jax.tree_util.tree_structure(state_r.params)
+
+    x, y, w = _batch(rng, b=8)
+    step = make_train_step(donate=False)
+    s1, m1 = step(state, x, y, w)
+    s2, m2 = step(state_r, x, y, w)
+    assert float(m1["train_loss"]) == pytest.approx(
+        float(m2["train_loss"]), rel=1e-6
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        ),
+        jax.device_get(s1.params),
+        jax.device_get(s2.params),
+    )
+
+    def loss_r(params):
+        return state_r.apply_fn(params, x, train=False).sum()
+
+    jaxpr_text = str(jax.make_jaxpr(jax.grad(loss_r))(state_r.params))
+    assert "remat" in jaxpr_text or "checkpoint" in jaxpr_text, (
+        "remat flag did not reach the grad program"
+    )
+
+
 def test_sharding_rules_specs():
     state = _state()
     shardings = state_shardings(state, make_mesh(MeshConfig(data=2, model=2, seq=2)))
