@@ -173,15 +173,40 @@ def flash_interpret_mode() -> bool | None:
     return False if on_tpu else None
 
 
+def _resolve_flash(use_flash: bool | None) -> tuple[bool, bool | None]:
+    """Shared tri-state resolution for the SP engines: returns
+    (flash_on, interpret). ``use_flash`` None follows the
+    :func:`flash_interpret_mode` policy; True forces flash (interpret
+    everywhere except a real TPU backend); False disables it."""
+    interpret = flash_interpret_mode()
+    if use_flash is None:
+        return interpret is not None, interpret
+    if use_flash:
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        return True, interpret
+    return False, interpret
+
+
+def sp_engine() -> str:
+    """The sequence-parallel engine policy (``DCT_SP_ENGINE``):
+    'ring' (default — KV shards rotate with ppermute, O(T/sp) memory) or
+    'a2a' (Ulysses-style head<->seq all_to_all exchange)."""
+    engine = os.environ.get("DCT_SP_ENGINE", "ring").strip().lower()
+    if engine not in ("ring", "a2a"):
+        raise ValueError(f"DCT_SP_ENGINE={engine!r} must be 'ring' or 'a2a'")
+    return engine
+
+
 def select_attention_path(
     t: int, *, mesh: Mesh | None = None, block_size: int = 512,
     flash_block: int = 128, flash_min_len: int = 256,
 ) -> str:
     """The attention-path policy, exposed for tests and the bench:
-    'ring' | 'flash' | 'blockwise' | 'dense'. ``t`` is the (single-shard)
-    sequence length."""
+    'ring' | 'a2a' | 'flash' | 'blockwise' | 'dense'. ``t`` is the
+    (single-shard) sequence length."""
     if mesh is not None and mesh.shape.get("seq", 1) > 1:
-        return "ring"
+        return sp_engine()
     if (
         flash_interpret_mode() is not None
         and t >= flash_min_len
@@ -455,16 +480,7 @@ def ring_attention(
             "batch/heads/seq_len or the mesh"
         )
     spec = P(data_axis, model_axis, seq_axis, None)
-    interpret = flash_interpret_mode()
-    if use_flash is None:
-        flash_on = interpret is not None
-    elif use_flash:
-        # Forced on: interpret everywhere except a real TPU backend.
-        flash_on = True
-        if interpret is None:
-            interpret = jax.default_backend() != "tpu"
-    else:
-        flash_on = False
+    flash_on, interpret = _resolve_flash(use_flash)
     t_local = t // ring_size
     half = t_local // 2
 
@@ -561,13 +577,102 @@ def ring_attention(
     )(q, k, v)
 
 
+def a2a_attention(
+    q, k, v, *, mesh: Mesh, causal: bool = False, scale: float | None = None,
+    seq_axis: str = "seq", data_axis: str = "data", model_axis: str = "model",
+    use_flash: bool | None = None, block_size: int = 512,
+):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism over
+    ``mesh[seq_axis]`` — the second SP engine beside :func:`ring_attention`.
+
+    One ``lax.all_to_all`` trades each device's sequence shard for a HEAD
+    shard: [B, H/tp, T/sp, D] -> [B, H/(tp*sp), T, D]. Every device then
+    holds the FULL sequence for its head subset and runs the best
+    single-shard kernel (Pallas flash / blockwise / dense) with exact
+    causal semantics — no per-step visibility bookkeeping, no striping
+    needed for balance (causal work is identical per head). A second
+    all_to_all restores the sequence layout.
+
+    Trade-off vs the ring: two collectives total instead of sp-1 ppermute
+    hops (latency win, and the a2a rides ICI), but the full [T] sequence
+    must fit one device's memory for H/(tp*sp) heads, and heads must tile
+    ``tp*sp``. Select per workload with ``DCT_SP_ENGINE`` (ring | a2a) —
+    ring for the longest sequences (O(T/sp) memory), a2a when heads are
+    plentiful and T fits.
+
+    q,k,v: GLOBAL [B, H, T, D] arrays (jit-sharded); batch rides
+    ``data_axis``, heads ``model_axis`` — DP x TP x SP compose in one op.
+    """
+    sp = mesh.shape[seq_axis]
+    b, h, t, _ = q.shape
+    if b < mesh.shape[data_axis]:
+        # The batch-1 flax init trace cannot tile the data axis (same
+        # escape as ring_attention); dense is numerically identical.
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    tp = mesh.shape[model_axis]
+    h_local = h // tp if h % tp == 0 else 0
+    if (
+        b % mesh.shape[data_axis]
+        or h % tp
+        or t % sp
+        or h_local % sp
+    ):
+        raise ValueError(
+            f"a2a_attention shapes B={b}, H={h}, T={t} do not tile mesh "
+            f"axes data={mesh.shape[data_axis]}, model={tp}, seq={sp} "
+            f"(the seq axis must divide the heads per TP shard: "
+            f"H/tp={h_local}, sp={sp}); adjust heads/seq_len or the mesh, "
+            "or use DCT_SP_ENGINE=ring"
+        )
+    spec = P(data_axis, model_axis, seq_axis, None)
+    flash_on, interpret = _resolve_flash(use_flash)
+
+    def _kernel(ql, kl, vl):
+        # Full-sequence single-shard compute on [B_l, H_l/sp, T, D].
+        if flash_on and t % 128 == 0 and t >= 128:
+            from dct_tpu.ops.pallas_attention import flash_attention
+
+            return flash_attention(
+                ql, kl, vl, causal=causal, scale=scale,
+                interpret=bool(interpret),
+            )
+        if t > block_size and t % block_size == 0:
+            return blockwise_attention(
+                ql, kl, vl, block_size=block_size, causal=causal,
+                scale=scale,
+            )
+        return dense_attention(ql, kl, vl, causal=causal, scale=scale)
+
+    def body(ql, kl, vl):
+        # seq shard -> head shard: [B_l, H_l, T_l, D] -> [B_l, H_l/sp, T, D]
+        ql, kl, vl = (
+            lax.all_to_all(a, seq_axis, split_axis=1, concat_axis=2,
+                           tiled=True)
+            for a in (ql, kl, vl)
+        )
+        out = _kernel(ql, kl, vl)
+        # head shard -> seq shard (the inverse exchange).
+        return lax.all_to_all(
+            out, seq_axis, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    # check_vma=False for the same reason as the flash ring: interpret-
+    # mode pallas internals trip the varying-axes checker spuriously.
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
 def make_attention_fn(mesh: Mesh | None = None, *, causal: bool = False,
                       block_size: int = 512):
-    """Pick the attention path per :func:`select_attention_path`: ring when
-    the ``seq`` axis is populated (itself flash-per-shard when available),
-    the Pallas flash kernel for long single-shard sequences on TPU,
-    blockwise/dense otherwise."""
+    """Pick the attention path per :func:`select_attention_path`: ring (or
+    the all-to-all engine, ``DCT_SP_ENGINE=a2a``) when the ``seq`` axis is
+    populated, the Pallas flash kernel for long single-shard sequences on
+    TPU, blockwise/dense otherwise."""
     if mesh is not None and mesh.shape.get("seq", 1) > 1:
+        if sp_engine() == "a2a":
+            return functools.partial(a2a_attention, mesh=mesh, causal=causal)
         return functools.partial(ring_attention, mesh=mesh, causal=causal)
 
     def attn(q, k, v):

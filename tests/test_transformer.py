@@ -135,16 +135,19 @@ def test_sharding_rules_specs():
 
 
 @pytest.mark.slow
-def test_dp_tp_sp_training_matches_single_device(rng):
+@pytest.mark.parametrize("sp_engine", ["ring", "a2a"])
+def test_dp_tp_sp_training_matches_single_device(rng, monkeypatch, sp_engine):
     """3 train steps on a (data=2, model=2, seq=2) mesh == 3 single-device
-    steps: same losses, same final params (fp tolerance)."""
+    steps: same losses, same final params (fp tolerance). Runs once per
+    SP engine (ring ppermute / Ulysses all-to-all, DCT_SP_ENGINE)."""
+    monkeypatch.setenv("DCT_SP_ENGINE", sp_engine)
     mesh = make_mesh(MeshConfig(data=2, model=2, seq=2))
 
     # Single-device oracle (dense attention).
     s_ref = _state()
     step_ref = make_train_step(donate=False)
 
-    # Sharded run: ring attention over seq, params TP over model, batch DP.
+    # Sharded run: SP attention over seq, params TP over model, batch DP.
     s_tpu = _state(attn_fn=make_attention_fn(mesh))
     s_tpu = shard_state_with_rules(s_tpu, mesh)
     step_tpu = make_train_step(donate=False)
@@ -163,8 +166,12 @@ def test_dp_tp_sp_training_matches_single_device(rng):
     np.testing.assert_allclose(losses_tpu, losses_ref, rtol=1e-4)
     p_ref = jax.tree.map(np.asarray, jax.device_get(s_ref.params))
     p_tpu = jax.tree.map(np.asarray, jax.device_get(s_tpu.params))
+    # a2a's reduction order perturbs Adam's qkv-bias update by ~1e-4
+    # after 3 steps (losses are bit-identical); ring keeps its original
+    # strictness.
+    atol = 2e-4 if sp_engine == "a2a" else 1e-4
     jax.tree.map(
-        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-4), p_ref, p_tpu
+        lambda a, b: np.testing.assert_allclose(a, b, atol=atol), p_ref, p_tpu
     )
 
 
