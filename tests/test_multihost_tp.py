@@ -189,6 +189,30 @@ def test_striped_causal_ring_across_processes(processed_dir, tmp_path):
 
 
 @pytest.mark.slow
+def test_a2a_sp_across_processes(processed_dir, tmp_path):
+    """The all-to-all (Ulysses) SP engine SPANNING processes: mesh seq=2
+    over 2 jax.distributed CPU procs — the head<->seq lax.all_to_all
+    exchange crosses a real process boundary, causal family. Loss must
+    match the single-process run."""
+    def run(world_size, seq_par, models_sub, runs_sub):
+        return launch_training(
+            processed_dir, tmp_path, world_size=world_size, port=29543,
+            models_sub=models_sub, runs_sub=runs_sub,
+            env_overrides={
+                "DCT_MODEL": "weather_transformer_causal",
+                "DCT_N_LAYERS": "1",
+                "DCT_SP_ENGINE": "a2a",
+                "DCT_MESH_SEQ": str(seq_par),
+                "DCT_MESH_MODEL": "1",
+            },
+        )
+
+    m_sp = run(2, 2, "m_a2a", "r_a2a")
+    m_ref = run(1, 1, "m_a2a_ref", "r_a2a_ref")
+    assert abs(m_sp["val_loss"] - m_ref["val_loss"]) < 1e-3, (m_sp, m_ref)
+
+
+@pytest.mark.slow
 def test_zero1_across_processes(processed_dir, tmp_path):
     """ZeRO-1 weight-update sharding SPANNING processes: the data axis
     covers 2 jax.distributed CPU procs, Adam moments shard P('data') —
