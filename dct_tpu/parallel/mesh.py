@@ -17,6 +17,7 @@ inserts the collectives. No NCCL/gloo calls to translate.
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import numpy as np
@@ -30,7 +31,8 @@ AXES = ("data", "model", "seq", "pipe")
 def make_mesh(
     cfg: MeshConfig | None = None, devices=None, *, allow_subset: bool = False
 ) -> Mesh:
-    """Build a 3-axis mesh; axis size -1 absorbs all remaining devices.
+    """Build the 4-axis (data, model, seq, pipe) mesh; axis size -1
+    absorbs all remaining devices.
 
     The mesh must cover every device: silently training on a subset would
     idle chips (or, multi-host, exclude another process's devices from the
@@ -60,8 +62,73 @@ def make_mesh(
             f"Mesh {sizes} covers {need} of {n} devices; pass "
             "allow_subset=True if a partial mesh is intended (test rigs)"
         )
-    arr = np.array(devices[:need]).reshape([sizes[a] for a in AXES])
-    return Mesh(arr, AXES)
+    return Mesh(_device_grid([sizes[a] for a in AXES], devices), AXES)
+
+
+def _grid_blocks_contiguous(grid) -> bool:
+    """True when every process's data-axis rows form a contiguous aligned
+    block — the layout :func:`process_data_block` requires to feed each
+    host the rows its devices own."""
+    by_pid: dict[int, set] = {}
+    for idx in np.ndindex(grid.shape):
+        by_pid.setdefault(grid[idx].process_index, set()).add(idx[0])
+    data_size = grid.shape[0]
+    for rows_set in by_pid.values():
+        rows = sorted(rows_set)
+        n = len(rows)
+        if (
+            rows != list(range(rows[0], rows[0] + n))
+            or rows[0] % n
+            or data_size % n
+        ):
+            return False
+    return True
+
+
+def _device_grid(shape: list, devices: list):
+    """Device layout for the mesh grid.
+
+    On real TPU devices covering the whole mesh, defer to
+    ``mesh_utils.create_device_mesh``: it maps the logical axes onto the
+    physical ICI torus so each axis's collectives ride neighbor links
+    (naive enumeration order can put a ring's neighbors on opposite
+    corners of the slice — the scaling-book layout rule). Disable with
+    ``DCT_ICI_MESH=0``.
+
+    The ICI layout is only kept when every process's data-axis rows stay
+    a contiguous aligned block (the input-pipeline contract
+    :func:`process_data_block` enforces) — a torus mapping that
+    interleaves a host's rows falls back to enumeration order instead of
+    aborting training at startup. CPU rigs and explicit subsets always
+    use enumeration order, which tests rely on.
+    """
+    import sys
+
+    need = math.prod(shape)
+    want_ici = os.environ.get("DCT_ICI_MESH", "1").strip().lower() not in (
+        "0", "false", "no", "off"
+    )
+    if (
+        want_ici
+        and getattr(devices[0], "platform", "") == "tpu"
+        and need == len(devices)
+    ):
+        try:
+            from jax.experimental import mesh_utils
+
+            grid = mesh_utils.create_device_mesh(shape, devices=devices)
+            if _grid_blocks_contiguous(grid):
+                return grid
+            sys.stderr.write(
+                "[dct_tpu] ICI-aware layout interleaves a process's "
+                "data-axis rows; falling back to enumeration order\n"
+            )
+        except Exception as e:  # noqa: BLE001 — odd shapes/topologies:
+            sys.stderr.write(
+                f"[dct_tpu] create_device_mesh failed ({e}); falling back "
+                "to enumeration-order layout\n"
+            )
+    return np.array(devices[:need]).reshape(shape)
 
 
 def process_data_block(mesh: Mesh) -> tuple[int, int]:
@@ -75,7 +142,7 @@ def process_data_block(mesh: Mesh) -> tuple[int, int]:
     a block and each must supply the identical full block.
     """
     pid = jax.process_index()
-    grid = mesh.devices  # [data, model, seq]
+    grid = mesh.devices  # [data, model, seq, pipe]
     my_rows = sorted(
         {
             idx[0]

@@ -120,3 +120,88 @@ def test_state_replicated(rng):
     kernel = state.params["params"]["TorchStyleDense_0"]["kernel"]
     assert kernel.sharding == replicated_sharding(mesh)
     assert len(kernel.addressable_shards) == 8
+
+
+def test_device_grid_uses_ici_layout_on_tpu(monkeypatch):
+    """Full-coverage TPU meshes go through mesh_utils.create_device_mesh
+    (ICI-aware torus mapping); CPU rigs keep enumeration order."""
+    import numpy as _np
+
+    from dct_tpu.parallel import mesh as mesh_mod
+
+    class FakeTpu:
+        platform = "tpu"
+
+        def __init__(self, i, pid=0):
+            self.id = i
+            self.process_index = pid
+
+        def __repr__(self):
+            return f"tpu{self.id}"
+
+    fakes = [FakeTpu(i) for i in range(8)]
+    calls = []
+
+    from jax.experimental import mesh_utils
+
+    def fake_create(shape, devices=None):
+        calls.append(tuple(shape))
+        return _np.array(devices).reshape(shape)
+
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", fake_create)
+    grid = mesh_mod._device_grid([2, 2, 2, 1], fakes)
+    assert calls == [(2, 2, 2, 1)]
+    assert grid.shape == (2, 2, 2, 1)
+
+    # CPU devices: enumeration order, no create_device_mesh call.
+    cpu = jax.devices()[:8]
+    grid_cpu = mesh_mod._device_grid([8, 1, 1, 1], cpu)
+    assert calls == [(2, 2, 2, 1)]
+    assert list(grid_cpu.reshape(-1)) == list(cpu)
+
+    # A failing create_device_mesh degrades to enumeration order.
+    def boom(shape, devices=None):
+        raise ValueError("unsupported topology")
+
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", boom)
+    grid_fb = mesh_mod._device_grid([8, 1, 1, 1], fakes)
+    assert list(grid_fb.reshape(-1)) == fakes
+
+    # DCT_ICI_MESH=0 opts out entirely.
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", fake_create)
+    monkeypatch.setenv("DCT_ICI_MESH", "0")
+    grid_off = mesh_mod._device_grid([2, 2, 2, 1], fakes)
+    assert calls == [(2, 2, 2, 1)]  # not called again
+    assert list(grid_off.reshape(-1)) == fakes
+
+
+def test_device_grid_rejects_interleaved_process_rows(monkeypatch):
+    """A torus mapping that interleaves one process's data-axis rows
+    would break process_data_block's contiguous-block contract — the
+    layout must fall back to enumeration order, not abort training."""
+    import numpy as _np
+
+    from dct_tpu.parallel import mesh as mesh_mod
+
+    class FakeTpu:
+        platform = "tpu"
+
+        def __init__(self, i, pid):
+            self.id = i
+            self.process_index = pid
+
+    # Two processes; enumeration order gives each a contiguous half.
+    fakes = [FakeTpu(i, pid=i // 4) for i in range(8)]
+
+    from jax.experimental import mesh_utils
+
+    def interleaving_create(shape, devices=None):
+        # Rows alternate processes: pid pattern 0,1,0,1,... over data.
+        order = [0, 4, 1, 5, 2, 6, 3, 7]
+        return _np.array([devices[i] for i in order]).reshape(shape)
+
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", interleaving_create)
+    grid = mesh_mod._device_grid([8, 1, 1, 1], fakes)
+    # Fallback: enumeration order, which IS contiguous per process.
+    assert list(grid.reshape(-1)) == fakes
+    assert mesh_mod._grid_blocks_contiguous(grid)
