@@ -161,6 +161,9 @@ class TrainConfig:
     # Decoupled weight decay (AdamW); 0 keeps plain Adam (reference
     # parity — torch.optim.Adam has no decoupled decay).
     weight_decay: float = 0.0
+    # Global-norm gradient clipping (Lightning gradient_clip_val
+    # semantics); 0 = off, parity default.
+    grad_clip_norm: float = 0.0
     seed: int = 42
     log_every_n_steps: int = 5
     # Improvement over the reference (which never resumes,
@@ -203,6 +206,7 @@ class TrainConfig:
             "DCT_END_LR_FRACTION", c.end_lr_fraction, float
         )
         c.weight_decay = _env("DCT_WEIGHT_DECAY", c.weight_decay, float)
+        c.grad_clip_norm = _env("DCT_GRAD_CLIP_NORM", c.grad_clip_norm, float)
         c.seed = _env("DCT_SEED", c.seed, int)
         c.log_every_n_steps = _env("DCT_LOG_EVERY_N_STEPS", c.log_every_n_steps, int)
         c.resume = _env("DCT_RESUME", c.resume, bool)

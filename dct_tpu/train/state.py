@@ -72,7 +72,7 @@ def make_lr_schedule(
 def create_train_state(
     model, *, input_dim: int, lr: float, seed: int,
     example_shape: tuple | None = None, lr_schedule=None,
-    weight_decay: float = 0.0,
+    weight_decay: float = 0.0, grad_clip_norm: float = 0.0,
 ) -> TrainState:
     """Initialize params (torch-matching init lives in the model) and Adam.
 
@@ -102,6 +102,10 @@ def create_train_state(
         tx = optax.adamw(learning_rate=rate, weight_decay=weight_decay)
     else:
         tx = optax.adam(learning_rate=rate)
+    if grad_clip_norm > 0.0:
+        # Global-norm clipping BEFORE the optimizer (Lightning's
+        # gradient_clip_val semantics); 0 preserves parity exactly.
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,

@@ -108,3 +108,35 @@ def test_binary_counts_and_f1(rng):
     np.testing.assert_allclose(f1, 2 * 0.5 * 1.0 / 1.5)
     # Degenerate: no positives anywhere -> all zeros, no division error.
     assert precision_recall_f1(0.0, 0.0, 0.0) == (0.0, 0.0, 0.0)
+
+
+def test_grad_clip_norm_bounds_update():
+    """grad_clip_norm rescales the global gradient norm before Adam
+    (Lightning gradient_clip_val semantics): with an extreme clip the
+    first-step update direction is preserved but magnitudes are bounded;
+    with clip 0 the trajectory is the unclipped parity one."""
+    model = get_model(ModelConfig(), input_dim=5)
+    x = np.full((8, 5), 100.0, np.float32)  # huge inputs -> huge grads
+    y = np.zeros(8, np.int32)
+    w = np.ones(8, np.float32)
+    step = make_train_step(donate=False)
+
+    def first_update(clip):
+        state = create_train_state(
+            model, input_dim=5, lr=0.01, seed=0, grad_clip_norm=clip
+        )
+        p0 = jax.device_get(state.params)
+        state, m = step(state, x, y, w)
+        p1 = jax.device_get(state.params)
+        delta = jax.tree.map(lambda a, b: np.asarray(b) - np.asarray(a), p0, p1)
+        return float(m["train_loss"]), delta
+
+    loss_c, d_clip = first_update(1e-6)
+    loss_u, d_unclip = first_update(0.0)
+    assert loss_c == loss_u  # loss is computed before the update
+    # The clipped update is (much) smaller in every leaf...
+    norms_c = [float(np.abs(v).max()) for v in jax.tree.leaves(d_clip)]
+    norms_u = [float(np.abs(v).max()) for v in jax.tree.leaves(d_unclip)]
+    assert max(norms_c) < max(norms_u)
+    # ...and clip=0 really is the identity chain (plain Adam update ~lr).
+    assert abs(max(norms_u) - 0.01) < 0.002
