@@ -231,7 +231,7 @@ def bench_scaled_transformer() -> dict:
     """MXU-relevant transformer: step time, MFU, flash vs blockwise.
 
     MFU is computed from the SCANNED step time (DCT_SCALED_SCAN steps per
-    dispatch, default 8): the trainer's product path runs whole epochs as
+    dispatch, default 16): the trainer's product path runs whole epochs as
     one dispatch, so steady-state compute throughput is the honest basis.
     The per-dispatch step time is also reported — the gap between the two
     is the control-plane dispatch cost at this step size (round-2's 10.7%
@@ -255,7 +255,10 @@ def bench_scaled_transformer() -> dict:
     on_tpu = jax.default_backend() == "tpu"
     scaled = dict(SCALED)
     batch = SCALED_BATCH
-    scan_len = max(1, int(os.environ.get("DCT_SCALED_SCAN", "8")))
+    # 16 steps/dispatch: at the default config (~3.3 TFLOP/step) even a
+    # ~30 ms tunnel dispatch is <5% of the timed region, so mfu measures
+    # the MXU, not the control plane.
+    scan_len = max(1, int(os.environ.get("DCT_SCALED_SCAN", "16")))
     if not on_tpu:  # CPU sanity runs: keep it minutes, not hours
         scaled.update(d_model=128, d_ff=256, seq_len=256, n_layers=2)
         batch = 4

@@ -168,17 +168,26 @@ def test_ep_training_matches_single_device(rng):
     )
 
 
-def test_ep_sp_composed_training_matches_single_device(rng):
+@pytest.mark.parametrize("sp_engine", ["ring", "a2a"])
+def test_ep_sp_composed_training_matches_single_device(
+    rng, monkeypatch, sp_engine
+):
     """EP x SP x DP x TP in one step: experts AND attention heads over
-    ``model``, ring attention over ``seq``, batch over ``data`` — the
-    full 2x2x2 mesh — matching the single-device trajectory (ample
-    capacity -> no drops -> parallelism is layout, not math)."""
+    ``model``, SP attention over ``seq`` (both engines), batch over
+    ``data`` — the full 2x2x2 mesh — matching the single-device
+    trajectory (ample capacity -> no drops -> parallelism is layout, not
+    math)."""
     from dct_tpu.ops.attention import make_attention_fn
     from dct_tpu.parallel.mesh import make_global_batch
 
+    monkeypatch.setenv("DCT_SP_ENGINE", sp_engine)
     mesh = make_mesh(MeshConfig(data=2, model=2, seq=2))
     cfg = ModelConfig(
-        name="weather_moe", seq_len=SEQ, d_model=16, n_heads=2, n_layers=1,
+        name="weather_moe", seq_len=SEQ, d_model=16,
+        # a2a additionally needs H/tp to tile sp (4 heads); ring keeps
+        # the original 2-head shape.
+        n_heads=4 if sp_engine == "a2a" else 2,
+        n_layers=1,
         d_ff=32, n_experts=4, dropout=0.0, capacity_factor=8.0,
         # Force the sorted engine: at these tiny shapes "auto" picks the
         # einsum path, which would silently skip the explicit
