@@ -107,6 +107,10 @@ class ModelConfig:
     # only block boundaries forward, recompute internals backward — the
     # HBM-for-FLOPs trade (jax.checkpoint) that unlocks long sequences.
     remat: bool = False
+    # Causal family: sliding-window local attention — position t attends
+    # to the last `attn_window` positions only (0 = full causal). Pairs
+    # with DCT_SP_ENGINE=a2a when the seq axis is populated.
+    attn_window: int = 0
 
     @classmethod
     def from_env(cls) -> "ModelConfig":
@@ -135,6 +139,7 @@ class ModelConfig:
         c.n_microbatches = int(mb) if mb else c.n_microbatches
         c.horizon = _env("DCT_HORIZON", c.horizon, int)
         c.remat = _env("DCT_REMAT", c.remat, bool)
+        c.attn_window = _env("DCT_ATTN_WINDOW", c.attn_window, int)
         return c
 
 

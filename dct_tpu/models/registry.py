@@ -148,7 +148,10 @@ def _build_transformer_causal(
         d_ff=cfg.d_ff,
         num_classes=cfg.num_classes,
         dropout=cfg.dropout,
-        attn_fn=make_attention_fn(mesh, causal=True),
+        attn_fn=make_attention_fn(
+            mesh, causal=True,
+            window=cfg.attn_window if cfg.attn_window > 0 else None,
+        ),
         per_position=True,
         horizon=cfg.horizon,
         remat=cfg.remat,

@@ -73,15 +73,45 @@ def _finalize(l, o, dtype):
     return (o / jnp.maximum(l, 1e-20)[..., None]).astype(dtype)
 
 
-def dense_attention(q, k, v, *, causal: bool = False, scale: float | None = None):
-    """Reference numerics: full [Tq, Tk] score matrix. q,k,v [B, H, T, D]."""
+def _check_window(window: int | None, causal: bool) -> None:
+    """Op-layer window validation. ``None`` means full attention; "off"
+    must never be spelled 0 here — a 0 band would make every row fully
+    masked and softmax silently uniform over ALL positions (causality
+    broken). The '0 = off' convention lives in the CONFIG layer
+    (registry normalizes attn_window<=0 to None)."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("window requires causal attention")
+    if window < 1:
+        raise ValueError(
+            f"window must be >= 1 (got {window}); pass None for full "
+            "causal attention"
+        )
+
+
+def dense_attention(
+    q, k, v, *, causal: bool = False, scale: float | None = None,
+    window: int | None = None,
+):
+    """Reference numerics: full [Tq, Tk] score matrix. q,k,v [B, H, T, D].
+
+    ``window`` (causal-only): position t attends to at most the last
+    ``window`` positions [t-window+1, t] — sliding-window local
+    attention (Mistral/Longformer-style), the standard long-context
+    complement to sequence parallelism."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    _check_window(window, causal)
     s = jnp.einsum(
         "...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32
     ) * scale
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        pos_q = jnp.arange(tq)[:, None]
+        pos_k = jnp.arange(tk)[None, :]
+        mask = pos_q >= pos_k
+        if window is not None:
+            mask &= pos_q - pos_k < window
         s = jnp.where(mask, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(
@@ -90,10 +120,11 @@ def dense_attention(q, k, v, *, causal: bool = False, scale: float | None = None
 
 
 def _blockwise_stats(q, k, v, *, block_size: int, causal: bool,
-                     scale: float | None):
+                     scale: float | None, window: int | None = None):
     """Shared blockwise scan returning the raw online-softmax state
     (m, l, o) — finalized by the callers into output (and optionally lse)."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    _check_window(window, causal)
     t = k.shape[-2]
     if t % block_size:
         raise ValueError(f"seq len {t} not a multiple of block {block_size}")
@@ -113,6 +144,13 @@ def _blockwise_stats(q, k, v, *, block_size: int, causal: bool,
         if causal:
             k_pos = b_idx * block_size + jnp.arange(block_size)
             mask = q_pos[:, None] >= k_pos[None, :]
+            if window is not None:
+                # Sliding window: blocks fully outside every row's window
+                # contribute an all-False mask (their p rows zero out);
+                # XLA's scan keeps the shape static — the win is HBM and
+                # numerics, not skipped FLOPs (the Pallas kernel's tile
+                # skip is the FLOPs lever, single-shard TPU only).
+                mask &= q_pos[:, None] - k_pos[None, :] < window
         m, l, o = _online_block(q, kb, vb, scale, mask, m, l, o)
         return (m, l, o), None
 
@@ -125,13 +163,15 @@ def _blockwise_stats(q, k, v, *, block_size: int, causal: bool,
 
 def blockwise_attention(
     q, k, v, *, block_size: int = 512, causal: bool = False,
-    scale: float | None = None,
+    scale: float | None = None, window: int | None = None,
 ):
     """O(T)-memory attention on one device: scan KV in blocks of
     ``block_size`` through the shared online-softmax kernel. q,k,v
-    [B, H, T, D]; T must be a multiple of block_size (pad upstream)."""
+    [B, H, T, D]; T must be a multiple of block_size (pad upstream).
+    ``window``: causal sliding-window local attention."""
     m, l, o = _blockwise_stats(
-        q, k, v, block_size=block_size, causal=causal, scale=scale
+        q, k, v, block_size=block_size, causal=causal, scale=scale,
+        window=window,
     )
     return _finalize(l, o, q.dtype)
 
@@ -581,6 +621,7 @@ def a2a_attention(
     q, k, v, *, mesh: Mesh, causal: bool = False, scale: float | None = None,
     seq_axis: str = "seq", data_axis: str = "data", model_axis: str = "model",
     use_flash: bool | None = None, block_size: int = 512,
+    window: int | None = None,
 ):
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism over
     ``mesh[seq_axis]`` — the second SP engine beside :func:`ring_attention`.
@@ -608,7 +649,9 @@ def a2a_attention(
     if b < mesh.shape[data_axis]:
         # The batch-1 flax init trace cannot tile the data axis (same
         # escape as ring_attention); dense is numerically identical.
-        return dense_attention(q, k, v, causal=causal, scale=scale)
+        return dense_attention(
+            q, k, v, causal=causal, scale=scale, window=window
+        )
     tp = mesh.shape[model_axis]
     h_local = h // tp if h % tp == 0 else 0
     if (
@@ -617,19 +660,30 @@ def a2a_attention(
         or t % sp
         or h_local % sp
     ):
+        alternative = (
+            "or use DCT_SP_ENGINE=ring"
+            if window is None
+            else "or disable the sliding window (the ring engine has no "
+            "window support)"
+        )
         raise ValueError(
             f"a2a_attention shapes B={b}, H={h}, T={t} do not tile mesh "
             f"axes data={mesh.shape[data_axis]}, model={tp}, seq={sp} "
             f"(the seq axis must divide the heads per TP shard: "
             f"H/tp={h_local}, sp={sp}); adjust heads/seq_len or the mesh, "
-            "or use DCT_SP_ENGINE=ring"
+            f"{alternative}"
         )
     spec = P(data_axis, model_axis, seq_axis, None)
     flash_on, interpret = _resolve_flash(use_flash)
 
     def _kernel(ql, kl, vl):
-        # Full-sequence single-shard compute on [B_l, H_l/sp, T, D].
-        if flash_on and t % 128 == 0 and t >= 128:
+        # Full-sequence single-shard compute on [B_l, H_l/sp, T, D] —
+        # which is exactly why sliding-window composes with a2a (each
+        # device sees every position for its heads; the ring would need
+        # per-shard window bookkeeping). Windowed attention routes
+        # through the masked blockwise/dense paths (the Pallas kernel
+        # has no window tiles).
+        if window is None and flash_on and t % 128 == 0 and t >= 128:
             from dct_tpu.ops.pallas_attention import flash_attention
 
             return flash_attention(
@@ -639,9 +693,11 @@ def a2a_attention(
         if t > block_size and t % block_size == 0:
             return blockwise_attention(
                 ql, kl, vl, block_size=block_size, causal=causal,
-                scale=scale,
+                scale=scale, window=window,
             )
-        return dense_attention(ql, kl, vl, causal=causal, scale=scale)
+        return dense_attention(
+            ql, kl, vl, causal=causal, scale=scale, window=window
+        )
 
     def body(ql, kl, vl):
         # seq shard -> head shard: [B_l, H_l, T_l, D] -> [B_l, H_l/sp, T, D]
@@ -665,14 +721,27 @@ def a2a_attention(
 
 
 def make_attention_fn(mesh: Mesh | None = None, *, causal: bool = False,
-                      block_size: int = 512):
+                      block_size: int = 512, window: int | None = None):
     """Pick the attention path per :func:`select_attention_path`: ring (or
     the all-to-all engine, ``DCT_SP_ENGINE=a2a``) when the ``seq`` axis is
     populated, the Pallas flash kernel for long single-shard sequences on
-    TPU, blockwise/dense otherwise."""
+    TPU, blockwise/dense otherwise.
+
+    ``window`` (causal sliding-window local attention) composes with the
+    a2a SP engine and the single-shard paths; the ring engine would need
+    per-shard window bookkeeping it does not have — selecting both fails
+    loudly rather than silently attending globally."""
+    _check_window(window, causal)
     if mesh is not None and mesh.shape.get("seq", 1) > 1:
         if sp_engine() == "a2a":
-            return functools.partial(a2a_attention, mesh=mesh, causal=causal)
+            return functools.partial(
+                a2a_attention, mesh=mesh, causal=causal, window=window
+            )
+        if window is not None:
+            raise ValueError(
+                "sliding-window attention over a populated seq axis needs "
+                "DCT_SP_ENGINE=a2a (the ring engine has no window support)"
+            )
         return functools.partial(ring_attention, mesh=mesh, causal=causal)
 
     def attn(q, k, v):
@@ -685,18 +754,22 @@ def make_attention_fn(mesh: Mesh | None = None, *, causal: bool = False,
         path = select_attention_path(
             t, block_size=block_size, flash_block=max(bq, bk)
         )
-        if path == "flash" and t % bq == 0 and t % bk == 0:
+        if (
+            window is None
+            and path == "flash" and t % bq == 0 and t % bk == 0
+        ):
             from dct_tpu.ops.pallas_attention import flash_attention
 
             return flash_attention(
                 q, k, v, block_q=bq, block_k=bk, causal=causal,
                 interpret=bool(flash_interpret_mode()),
             )
-        # 'flash' whose override blocks do not divide t degrades here too.
+        # 'flash' whose override blocks do not divide t (or any windowed
+        # call — the kernel has no window tiles) degrades here too.
         if t > block_size and t % block_size == 0:
             return blockwise_attention(
-                q, k, v, block_size=block_size, causal=causal
+                q, k, v, block_size=block_size, causal=causal, window=window
             )
-        return dense_attention(q, k, v, causal=causal)
+        return dense_attention(q, k, v, causal=causal, window=window)
 
     return attn

@@ -277,3 +277,78 @@ def test_sp_engine_env_selects_a2a(qkv, monkeypatch):
     monkeypatch.setenv("DCT_SP_ENGINE", "bogus")
     with pytest.raises(ValueError, match="DCT_SP_ENGINE"):
         make_attention_fn(mesh)
+
+
+# --- sliding-window (local) attention ------------------------------------
+
+
+def _windowed_dense_reference(q, k, v, window):
+    """Independent oracle: explicit [Tq, Tk] banded mask + softmax."""
+    import math as _math
+
+    s = np.einsum(
+        "bhqd,bhkd->bhqk", np.asarray(q, np.float64), np.asarray(k, np.float64)
+    ) / _math.sqrt(q.shape[-1])
+    tq = q.shape[-2]
+    pos = np.arange(tq)
+    mask = (pos[:, None] >= pos[None, :]) & (pos[:, None] - pos[None, :] < window)
+    s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v, np.float64))
+
+
+@pytest.mark.parametrize("window", [1, 8, 64])
+def test_windowed_dense_matches_oracle(qkv, window):
+    q, k, v = qkv
+    ref = _windowed_dense_reference(q, k, v, window)
+    out = dense_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [8, 24])
+def test_windowed_blockwise_matches_dense(qkv, window):
+    q, k, v = qkv
+    ref = dense_attention(q, k, v, causal=True, window=window)
+    out = blockwise_attention(
+        q, k, v, block_size=16, causal=True, window=window
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_windowed_a2a_matches_dense(qkv):
+    """Sliding window composes with the a2a SP engine: full sequence per
+    device means the window mask is exact across shard boundaries."""
+    from dct_tpu.ops.attention import a2a_attention
+
+    q, k, v = qkv
+    mesh = make_mesh(MeshConfig(data=1, model=1, seq=4), allow_subset=True)
+    ref = dense_attention(q, k, v, causal=True, window=16)
+    out = a2a_attention(q, k, v, mesh=mesh, causal=True, window=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_window_requires_causal_and_a2a(qkv):
+    q, k, v = qkv
+    with pytest.raises(ValueError, match="causal"):
+        dense_attention(q, k, v, causal=False, window=8)
+    with pytest.raises(ValueError, match="causal"):
+        make_attention_fn(None, causal=False, window=8)
+    # Ring engine + window fails loudly instead of attending globally.
+    mesh = make_mesh(MeshConfig(data=2, model=1, seq=4))
+    with pytest.raises(ValueError, match="a2a"):
+        make_attention_fn(mesh, causal=True, window=8)
+
+
+def test_window_zero_rejected_at_op_layer(qkv):
+    """'0 = off' is a CONFIG-layer convention; the op layer must reject
+    window<1 loudly (a 0 band would silently softmax-uniform over all
+    positions, breaking causality)."""
+    q, k, v = qkv
+    for bad in (0, -3):
+        with pytest.raises(ValueError, match="window must be >= 1"):
+            dense_attention(q, k, v, causal=True, window=bad)
+        with pytest.raises(ValueError, match="window must be >= 1"):
+            blockwise_attention(q, k, v, block_size=16, causal=True, window=bad)
+        with pytest.raises(ValueError, match="window must be >= 1"):
+            make_attention_fn(None, causal=True, window=bad)
