@@ -302,3 +302,32 @@ def test_multi_horizon_serving_parity(rng):
     assert probs.shape == (3, 3, 2)
     np.testing.assert_allclose(probs, jax_probs, atol=2e-5)
     np.testing.assert_allclose(probs.sum(axis=-1), 1.0, atol=1e-5)
+
+
+def test_attn_window_limits_receptive_field(rng):
+    """ModelConfig.attn_window (DCT_ATTN_WINDOW) through the registry:
+    with window=2 and a single layer, perturbing a row more than 2
+    positions behind t must not change logits at t (the local-attention
+    receptive field is exactly the window), while the full-causal model
+    DOES see it."""
+    cfg = dict(CFG, n_layers=1)
+    x = rng.standard_normal((2, 8, 5)).astype(np.float32)
+    x2 = x.copy()
+    x2[:, 0] += 100.0  # corrupt the DISTANT past
+
+    def logits(attn_window, xin):
+        model = get_model(
+            ModelConfig(**cfg, attn_window=attn_window), input_dim=5
+        )
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
+        return np.asarray(model.apply(params, jnp.asarray(xin)))
+
+    # Window 2: positions >= 2 never attend to row 0 -> unchanged.
+    base_w = logits(2, x)
+    pert_w = logits(2, x2)
+    np.testing.assert_allclose(pert_w[:, 2:], base_w[:, 2:], atol=1e-5)
+    assert np.abs(pert_w[:, :2] - base_w[:, :2]).max() > 1e-3
+    # Full causal (attn_window=0 = off): the distant past IS visible.
+    base_f = logits(0, x)
+    pert_f = logits(0, x2)
+    assert np.abs(pert_f[:, 2:] - base_f[:, 2:]).max() > 1e-3
