@@ -466,6 +466,22 @@ def _ring_body(q, k, v, *, axis_name: str, ring_size: int, causal: bool,
     return _finalize(l, o, q.dtype)
 
 
+def _is_init_trace_escape(q, b: int, n_data: int) -> bool:
+    """Single-sourced policy for the SP engines' batch-1 dense escape.
+
+    The batch-1 init trace (flax shape inference, jitted by
+    create_train_state) cannot tile the data axis; dense attention is
+    numerically identical there. Gated to (batch 1, tracer) so any OTHER
+    undersized batch — eager misuse, or a jitted loader that skipped
+    BatchLoader's divisibility guarantee — raises the engine's sizing
+    error instead of silently replicating an O(T^2) global computation
+    per device (ADVICE r3). Residual risk: a genuinely batch-1 jitted
+    train step over a populated data axis would take this escape, but
+    such a step cannot tile the mesh at all and BatchLoader refuses to
+    produce it."""
+    return b == 1 and b < n_data and isinstance(q, jax.core.Tracer)
+
+
 def ring_attention(
     q, k, v, *, mesh: Mesh, causal: bool = False, scale: float | None = None,
     seq_axis: str = "seq", data_axis: str = "data", model_axis: str = "model",
@@ -500,10 +516,7 @@ def ring_attention(
         # with striped=True must fail at trace time, not pass the batch-1
         # init trace and surprise on the first real batch.
         raise ValueError("striped ring layout only applies to causal")
-    if b < mesh.shape[data_axis]:
-        # The batch-1 init trace (flax shape inference) cannot tile the data
-        # axis; dense is numerically identical, and no real batch is smaller
-        # than the data axis (BatchLoader guarantees divisibility).
+    if _is_init_trace_escape(q, b, mesh.shape[data_axis]):
         return dense_attention(q, k, v, causal=causal, scale=scale)
     if (
         b % mesh.shape[data_axis]
@@ -646,9 +659,7 @@ def a2a_attention(
     """
     sp = mesh.shape[seq_axis]
     b, h, t, _ = q.shape
-    if b < mesh.shape[data_axis]:
-        # The batch-1 flax init trace cannot tile the data axis (same
-        # escape as ring_attention); dense is numerically identical.
+    if _is_init_trace_escape(q, b, mesh.shape[data_axis]):
         return dense_attention(
             q, k, v, causal=causal, scale=scale, window=window
         )

@@ -187,6 +187,7 @@ class _PackageCache:
         self._lock = threading.Lock()
         self._entries: dict = {}
         self._generation = -1
+        self._live: set = set()
 
     def get_or_load(
         self, pkg: str, loader, live_pkgs, generation: int = 0
@@ -194,13 +195,20 @@ class _PackageCache:
         with self._lock:
             if generation >= self._generation:
                 self._generation = generation
-                for stale in set(self._entries) - set(live_pkgs):
+                self._live = set(live_pkgs)
+                for stale in set(self._entries) - self._live:
                     del self._entries[stale]
             cached = self._entries.get(pkg)
         if cached is None:
             loaded = loader()
             with self._lock:
-                cached = self._entries.setdefault(pkg, loaded)
+                if pkg in self._live or generation >= self._generation:
+                    cached = self._entries.setdefault(pkg, loaded)
+                else:
+                    # A newer generation retired this package while the
+                    # straggler was loading: serve it this once, but do
+                    # NOT resurrect it into the cache (ADVICE r3).
+                    cached = loaded
         return cached
 
 

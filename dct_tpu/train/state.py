@@ -88,7 +88,12 @@ def create_train_state(
     root = jax.random.PRNGKey(seed)
     init_key, dropout_key = jax.random.split(root)
     shape = example_shape if example_shape is not None else (1, input_dim)
-    variables = model.init(init_key, jnp.zeros(shape, jnp.float32))
+    # Jitted init: flax runs `init` eagerly by default, but the seq-parallel
+    # attention paths gate their batch-1 shape-inference escape on seeing a
+    # TRACER (ADVICE r3 — an eager small batch must raise, not silently go
+    # dense), so the init computation must be a trace. Jit also skips
+    # materializing throwaway init activations op-by-op.
+    variables = jax.jit(model.init)(init_key, jnp.zeros(shape, jnp.float32))
     if isinstance(variables, FrozenDict):
         variables = variables.unfreeze()
     # Keep ONLY the trainable collection: models may sow auxiliary outputs

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Background TPU relay watcher: probes every 5 min, logs status to
+# <repo>/.tpu_watch.log (gitignored). Usage: scripts/tpu_watch.sh [n_probes]
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG="$REPO/.tpu_watch.log"
+N="${1:-140}"
+for i in $(seq 1 "$N"); do
+  ts=$(date +%H:%M:%S)
+  out=$(timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256,256), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print('OK', d[0].platform, d[0].device_kind)
+" 2>/dev/null | tail -1)
+  echo "$ts ${out:-probe-timeout}" >> "$LOG"
+  case "$out" in OK\ tpu*) echo "$ts TPU-ALIVE" >> "$LOG";; esac
+  sleep 300
+done

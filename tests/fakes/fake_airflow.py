@@ -153,7 +153,11 @@ class BaseOperator:
         return other
 
     def __rrshift__(self, other):
-        other.__rshift__(self)
+        # Real Airflow supports `[t1, t2] >> op` — Python dispatches that
+        # to op.__rrshift__ with the LIST on the left (ADVICE r3).
+        others = other if isinstance(other, (list, tuple)) else [other]
+        for o in others:
+            o.__rshift__(self)
         return self
 
 

@@ -122,8 +122,11 @@ def test_ring_use_flash_true_forces_interpret_on_cpu(monkeypatch, rng):
     of crashing on an unsupported Mosaic compile."""
     monkeypatch.setenv("DCT_FLASH", "off")
     mesh = make_mesh(MeshConfig(data=2, model=2, seq=2))
+    # Batch must tile the data axis: eager undersized batches now raise
+    # rather than silently densifying (ADVICE r3), so this exercises the
+    # real ring path.
     q, k, v = (
-        jnp.asarray(rng.standard_normal((1, 2, 256, 8)), jnp.float32)
+        jnp.asarray(rng.standard_normal((2, 2, 256, 8)), jnp.float32)
         for _ in range(3)
     )
     out = ring_attention(q, k, v, mesh=mesh, use_flash=True)

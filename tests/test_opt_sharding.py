@@ -3,6 +3,7 @@ axis: layout-only — the training trajectory must not change."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from dct_tpu.config import MeshConfig, ModelConfig
@@ -45,9 +46,6 @@ def test_opt_state_specs_shard_over_data():
         v for k, v in specs.items() if "opt_state" not in k and "params" in k
     ]
     assert param_specs and all(s == P() for s in param_specs)
-
-
-import pytest
 
 
 @pytest.mark.parametrize(

@@ -109,6 +109,23 @@ def test_compat_allowlists_match_real_airflow_surface():
         assert extra <= own, f"{name}: {extra - own}"
 
 
+def test_fake_airflow_list_on_left_chaining():
+    """Real Airflow supports `[t1, t2] >> op` (list dispatches to
+    op.__rrshift__); the fake must reproduce it, not AttributeError
+    (ADVICE r3)."""
+    from tests.fakes import fake_airflow
+
+    with fake_airflow.DAG(dag_id="chain_test") as dag:
+        t1 = fake_airflow.BashOperator(task_id="t1", bash_command="true")
+        t2 = fake_airflow.BashOperator(task_id="t2", bash_command="true")
+        join = fake_airflow.BashOperator(task_id="join", bash_command="true")
+        [t1, t2] >> join
+
+    assert join.upstream == [t1, t2]
+    assert join in t1.downstream and join in t2.downstream
+    assert set(dag.tasks) == {"t1", "t2", "join"}
+
+
 # --- pyspark: the Spark ETL transform actually executes -----------------
 
 

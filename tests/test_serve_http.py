@@ -150,6 +150,33 @@ def test_multi_horizon_server(tmp_path):
         server.server_close()
 
 
+def test_package_cache_straggler_cannot_resurrect_retired_package():
+    """A request that started loading under generation N must not insert
+    its package back into the cache after a generation N+1 transition
+    retired it (ADVICE r3) — the straggler is served its one response,
+    but the retired weights do not linger until the next eviction."""
+    from dct_tpu.serving.server import _PackageCache
+
+    cache = _PackageCache()
+
+    def loader_a():
+        # While A's load is in flight, a newer-generation request lands
+        # and retires A from the live set.
+        cache.get_or_load("B", lambda: ("wB",), live_pkgs=["B"], generation=2)
+        return ("wA",)
+
+    out = cache.get_or_load(
+        "A", loader_a, live_pkgs=["A", "B"], generation=1
+    )
+    assert out == ("wA",)  # the straggler still gets its response
+    assert "A" not in cache._entries  # ...but A is not resurrected
+    assert cache._entries.get("B") == ("wB",)
+    # Same-generation duplicate first loads still cache (benign race).
+    assert cache.get_or_load(
+        "B", lambda: ("wB2",), live_pkgs=["B"], generation=2
+    ) == ("wB",)
+
+
 def test_endpoint_server_rollout_routing(processed_dir, tmp_path):
     """HTTP surface over the LOCAL rollout endpoint: traffic-weighted
     blue/green routing, live stage transitions from the persisted state,
