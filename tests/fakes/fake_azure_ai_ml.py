@@ -1,0 +1,437 @@
+"""A faithful fake of the azure-ai-ml surface the deploy layer drives.
+
+Same philosophy as fake_airflow/fake_pyspark/the mlflow fake: transcribe
+the REAL API's constructor and method signatures (azure-ai-ml 1.x — the
+SDK the reference's deploy DAGs import, /root/reference/dags/
+azure_auto_deploy.py:1-8) so a wrong kwarg or positional-vs-keyword
+mismatch in ``dct_tpu/deploy/azure.py`` fails HERE in CI instead of on a
+live workspace, and back them with evaluated in-memory semantics:
+
+- endpoints/deployments live in a module-level workspace store;
+- ``begin_*`` operations return LRO pollers with ``result()``/``wait()``;
+- traffic updates validate what the service validates (weights must be
+  ints summing to <= 100, nonzero weights must name existing
+  deployments);
+- deployment creation validates the CodeConfiguration/Environment file
+  paths actually exist in the package — proving ``generate_score_package``
+  produces what a managed-endpoint deployment consumes.
+
+Install via :func:`install` (sys.modules entries for ``azure``,
+``azure.ai``, ``azure.ai.ml``, ``azure.ai.ml.entities``,
+``azure.core.exceptions``, ``azure.identity``).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import sys
+import types
+
+
+class ResourceNotFoundError(Exception):
+    """azure.core.exceptions.ResourceNotFoundError stand-in."""
+
+
+class ValidationException(Exception):
+    """azure.ai.ml.exceptions.ValidationException stand-in."""
+
+
+# --- entities (signatures transcribed from azure-ai-ml 1.x) -------------
+
+
+class ClientSecretCredential:
+    def __init__(self, tenant_id, client_id, client_secret, **kwargs):
+        if not (tenant_id and client_id and client_secret):
+            raise ValueError("tenant_id, client_id, client_secret required")
+        self.tenant_id = tenant_id
+        self.client_id = client_id
+        self._client_secret = client_secret
+
+
+class ManagedOnlineEndpoint:
+    def __init__(
+        self,
+        *,
+        name=None,
+        tags=None,
+        properties=None,
+        auth_mode="key",
+        description=None,
+        location=None,
+        traffic=None,
+        mirror_traffic=None,
+        identity=None,
+        kind=None,
+        public_network_access=None,
+        **kwargs,
+    ):
+        if auth_mode not in ("key", "aml_token", "aad_token"):
+            raise ValidationException(
+                f"auth_mode must be key|aml_token|aad_token, got {auth_mode!r}"
+            )
+        self.name = name
+        self.tags = tags or {}
+        self.properties = properties or {}
+        self.auth_mode = auth_mode
+        self.description = description
+        self.location = location
+        self.traffic = dict(traffic or {})
+        self.mirror_traffic = dict(mirror_traffic or {})
+        self.identity = identity
+        self.kind = kind
+        self.public_network_access = public_network_access
+        self.provisioning_state = None  # set by the service
+
+
+class Model:
+    def __init__(
+        self,
+        *,
+        name=None,
+        version=None,
+        type=None,  # noqa: A002 - transcribed signature
+        path=None,
+        utc_time_created=None,
+        flavors=None,
+        description=None,
+        tags=None,
+        properties=None,
+        stage=None,
+        **kwargs,
+    ):
+        self.name = name
+        self.version = version
+        self.type = type or "custom_model"
+        self.path = path
+        self.description = description
+        self.tags = tags or {}
+        self.properties = properties or {}
+        self.stage = stage
+
+
+class CodeConfiguration:
+    def __init__(self, code=None, scoring_script=None):
+        self.code = code
+        self.scoring_script = scoring_script
+
+
+class Environment:
+    def __init__(
+        self,
+        *,
+        name=None,
+        version=None,
+        description=None,
+        image=None,
+        build=None,
+        conda_file=None,
+        tags=None,
+        properties=None,
+        datastore=None,
+        **kwargs,
+    ):
+        self.name = name
+        self.version = version
+        self.description = description
+        self.image = image
+        self.build = build
+        self.conda_file = conda_file
+        self.tags = tags or {}
+        self.properties = properties or {}
+
+
+class ManagedOnlineDeployment:
+    def __init__(
+        self,
+        *,
+        name,
+        endpoint_name=None,
+        tags=None,
+        properties=None,
+        description=None,
+        model=None,
+        code_configuration=None,
+        environment=None,
+        app_insights_enabled=False,
+        scale_settings=None,
+        request_settings=None,
+        liveness_probe=None,
+        readiness_probe=None,
+        environment_variables=None,
+        instance_type=None,
+        instance_count=None,
+        egress_public_network_access=None,
+        code_path=None,
+        scoring_script=None,
+        **kwargs,
+    ):
+        self.name = name
+        self.endpoint_name = endpoint_name
+        self.tags = tags or {}
+        self.properties = properties or {}
+        self.description = description
+        self.model = model
+        self.code_configuration = code_configuration
+        self.environment = environment
+        self.app_insights_enabled = app_insights_enabled
+        self.environment_variables = environment_variables or {}
+        self.instance_type = instance_type
+        self.instance_count = instance_count
+        self.provisioning_state = None
+
+
+# --- operations --------------------------------------------------------
+
+
+class LROPoller:
+    """azure.core.polling.LROPoller stand-in: already-completed op."""
+
+    def __init__(self, outcome):
+        self._outcome = outcome
+
+    def result(self, timeout=None):
+        return self._outcome
+
+    def wait(self, timeout=None):
+        return None
+
+    def status(self):
+        return "Succeeded"
+
+    def done(self):
+        return True
+
+
+class _Workspace:
+    """One workspace's state, keyed by (subscription, rg, workspace)."""
+
+    def __init__(self):
+        self.endpoints: dict[str, ManagedOnlineEndpoint] = {}
+        # {endpoint_name: {slot: ManagedOnlineDeployment}}
+        self.deployments: dict[str, dict[str, ManagedOnlineDeployment]] = {}
+
+
+_WORKSPACES: dict[tuple, _Workspace] = {}
+
+
+def reset():
+    _WORKSPACES.clear()
+
+
+class OnlineEndpointOperations:
+    def __init__(self, ws: _Workspace):
+        self._ws = ws
+
+    def get(self, name, **kwargs):
+        ep = self._ws.endpoints.get(name)
+        if ep is None:
+            raise ResourceNotFoundError(f"Endpoint {name!r} not found")
+        # The real client deserializes a FRESH entity per call: caller
+        # mutations (e.g. before a rejected update) must never alias the
+        # service-side state (code-review r4).
+        return copy.deepcopy(ep)
+
+    def list(self, **kwargs):
+        return [copy.deepcopy(e) for e in self._ws.endpoints.values()]
+
+    def begin_create_or_update(self, endpoint, *, local=False, **kwargs):
+        if not isinstance(endpoint, ManagedOnlineEndpoint):
+            raise ValidationException(
+                f"expected ManagedOnlineEndpoint, got {type(endpoint)}"
+            )
+        if not endpoint.name:
+            raise ValidationException("endpoint.name is required")
+        self._validate_traffic(endpoint)
+        stored = copy.deepcopy(endpoint)  # serialization boundary
+        stored.provisioning_state = "Succeeded"
+        self._ws.endpoints[endpoint.name] = stored
+        self._ws.deployments.setdefault(endpoint.name, {})
+        return LROPoller(copy.deepcopy(stored))
+
+    def begin_delete(self, name, *, local=False, **kwargs):
+        self.get(name)
+        del self._ws.endpoints[name]
+        self._ws.deployments.pop(name, None)
+        return LROPoller(None)
+
+    def _validate_traffic(self, endpoint):
+        deployed = set(self._ws.deployments.get(endpoint.name, {}))
+        for field_name, traffic in (
+            ("traffic", endpoint.traffic),
+            ("mirror_traffic", endpoint.mirror_traffic),
+        ):
+            for slot, weight in (traffic or {}).items():
+                if not isinstance(weight, int):
+                    raise ValidationException(
+                        f"{field_name}[{slot!r}] must be int, got "
+                        f"{type(weight).__name__}"
+                    )
+                if weight < 0 or weight > 100:
+                    raise ValidationException(
+                        f"{field_name}[{slot!r}]={weight} out of [0, 100]"
+                    )
+                if weight > 0 and slot not in deployed:
+                    raise ResourceNotFoundError(
+                        f"{field_name} routes {weight}% to deployment "
+                        f"{slot!r} which does not exist on endpoint "
+                        f"{endpoint.name!r}"
+                    )
+            if sum((traffic or {}).values()) > 100:
+                raise ValidationException(
+                    f"{field_name} weights sum past 100: {traffic}"
+                )
+
+
+class OnlineDeploymentOperations:
+    def __init__(self, ws: _Workspace):
+        self._ws = ws
+
+    def get(self, name, endpoint_name, **kwargs):
+        dep = self._ws.deployments.get(endpoint_name, {}).get(name)
+        if dep is None:
+            raise ResourceNotFoundError(
+                f"Deployment {name!r} not found on endpoint {endpoint_name!r}"
+            )
+        return copy.deepcopy(dep)
+
+    def list(self, endpoint_name, *, local=False, **kwargs):
+        if endpoint_name not in self._ws.endpoints:
+            raise ResourceNotFoundError(f"Endpoint {endpoint_name!r} not found")
+        return [
+            copy.deepcopy(d)
+            for d in self._ws.deployments.get(endpoint_name, {}).values()
+        ]
+
+    def begin_create_or_update(
+        self, deployment, *, local=False, vscode_debug=False,
+        skip_script_validation=False, **kwargs,
+    ):
+        if not isinstance(deployment, ManagedOnlineDeployment):
+            raise ValidationException(
+                f"expected ManagedOnlineDeployment, got {type(deployment)}"
+            )
+        if deployment.endpoint_name not in self._ws.endpoints:
+            raise ResourceNotFoundError(
+                f"Endpoint {deployment.endpoint_name!r} not found"
+            )
+        self._validate_package(deployment, skip_script_validation)
+        stored = copy.deepcopy(deployment)  # serialization boundary
+        stored.provisioning_state = "Succeeded"
+        self._ws.deployments.setdefault(deployment.endpoint_name, {})[
+            deployment.name
+        ] = stored
+        return LROPoller(copy.deepcopy(stored))
+
+    def begin_delete(self, name, endpoint_name, *, local=False, **kwargs):
+        self.get(name, endpoint_name)
+        del self._ws.deployments[endpoint_name][name]
+        # The service also drops the slot from live traffic maps.
+        ep = self._ws.endpoints.get(endpoint_name)
+        if ep is not None:
+            ep.traffic.pop(name, None)
+            ep.mirror_traffic.pop(name, None)
+        return LROPoller(None)
+
+    def _validate_package(self, deployment, skip_script_validation):
+        """What the service validates at create time: the scoring script
+        must exist under the code dir, the conda file must exist, the
+        model path must exist. This is the contract between
+        ``generate_score_package`` and a managed-endpoint deployment."""
+        cc = deployment.code_configuration
+        if cc is not None and not skip_script_validation:
+            script = os.path.join(str(cc.code), str(cc.scoring_script))
+            if not os.path.isfile(script):
+                raise ValidationException(
+                    f"scoring_script {cc.scoring_script!r} not found under "
+                    f"code dir {cc.code!r}"
+                )
+        env = deployment.environment
+        if env is not None and env.conda_file and not os.path.isfile(
+            str(env.conda_file)
+        ):
+            raise ValidationException(
+                f"conda_file {env.conda_file!r} does not exist"
+            )
+        model = deployment.model
+        if model is not None and model.path and not os.path.exists(
+            str(model.path)
+        ):
+            raise ValidationException(
+                f"model path {model.path!r} does not exist"
+            )
+
+
+class MLClient:
+    def __init__(
+        self,
+        credential,
+        subscription_id=None,
+        resource_group_name=None,
+        workspace_name=None,
+        *,
+        registry_name=None,
+        **kwargs,
+    ):
+        if credential is None:
+            raise ValidationException("credential is required")
+        if not (subscription_id and resource_group_name and workspace_name):
+            raise ValidationException(
+                "subscription_id, resource_group_name and workspace_name "
+                "are required for workspace-scoped operations"
+            )
+        self._credential = credential
+        self.subscription_id = subscription_id
+        self.resource_group_name = resource_group_name
+        self.workspace_name = workspace_name
+        key = (subscription_id, resource_group_name, workspace_name)
+        ws = _WORKSPACES.setdefault(key, _Workspace())
+        self.online_endpoints = OnlineEndpointOperations(ws)
+        self.online_deployments = OnlineDeploymentOperations(ws)
+
+
+def install():
+    """Install the fake under the real import paths. Returns the names
+    touched (for the test's module sandbox)."""
+    this = sys.modules[__name__]
+
+    azure = types.ModuleType("azure")
+    azure.__path__ = []  # mark as package
+    ai = types.ModuleType("azure.ai")
+    ai.__path__ = []
+    ml = types.ModuleType("azure.ai.ml")
+    ml.MLClient = MLClient
+    entities = types.ModuleType("azure.ai.ml.entities")
+    for cls in (
+        ManagedOnlineEndpoint, ManagedOnlineDeployment, Model,
+        CodeConfiguration, Environment,
+    ):
+        setattr(entities, cls.__name__, cls)
+    ml.entities = entities
+    ai.ml = ml
+    azure.ai = ai
+    core = types.ModuleType("azure.core")
+    core.__path__ = []
+    exceptions = types.ModuleType("azure.core.exceptions")
+    exceptions.ResourceNotFoundError = ResourceNotFoundError
+    core.exceptions = exceptions
+    azure.core = core
+    identity = types.ModuleType("azure.identity")
+    identity.ClientSecretCredential = ClientSecretCredential
+    azure.identity = identity
+
+    names = (
+        "azure", "azure.ai", "azure.ai.ml", "azure.ai.ml.entities",
+        "azure.core", "azure.core.exceptions", "azure.identity",
+    )
+    sys.modules.update({
+        "azure": azure,
+        "azure.ai": ai,
+        "azure.ai.ml": ml,
+        "azure.ai.ml.entities": entities,
+        "azure.core": core,
+        "azure.core.exceptions": exceptions,
+        "azure.identity": identity,
+    })
+    del this  # only the module objects above are the public surface
+    return names
