@@ -184,13 +184,16 @@ def blockwise_attention(
 
 def blockwise_attention_lse(
     q, k, v, *, block_size: int = 512, causal: bool = False,
-    scale: float | None = None,
+    scale: float | None = None, window: int | None = None,
+    q_offset: int = 0,
 ):
     """Blockwise attention returning (o, lse [..., T] f32) — the JAX-level
     twin of :func:`dct_tpu.ops.pallas_attention.flash_attention_lse`, used
-    as its rematerialized backward."""
+    as its rematerialized backward (incl. the windowed/offset variants the
+    ring's partial-band shards run)."""
     m, l, o = _blockwise_stats(
-        q, k, v, block_size=block_size, causal=causal, scale=scale
+        q, k, v, block_size=block_size, causal=causal, scale=scale,
+        window=window, q_offset=q_offset,
     )
     return _finalize(l, o, q.dtype), m + jnp.log(jnp.maximum(l, 1e-20))
 
@@ -307,30 +310,6 @@ def _merge_lse(o, lse, o_j, lse_j):
     return o * w + o_j.astype(jnp.float32) * w_j, lse_new
 
 
-def _banded_block_lse(q, k, v, scale, window, step, t_local, block_size=128):
-    """One partial-band shard of the windowed flash ring, finalized to
-    (o f32, lse) — the merge format of :func:`_merge_lse`.
-
-    The Pallas kernel has no window tiles, but the band's geometry is
-    STATIC per ring step (q-k distance = step*t_local + i - j), so this
-    runs the O(T*block)-memory blockwise scan with ``q_offset`` carrying
-    the inter-shard distance — not a materialized [Tq, Tk] mask, which
-    would negate the flash ring's memory bound on exactly the long-shard
-    workloads windowing targets (code-review r4). KV blocks entirely
-    behind the band (j <= step*L - window) are statically sliced off."""
-    shard_dist = step * t_local
-    j0 = max(0, shard_dist - (window or 0) + 1) if window is not None else 0
-    j0 -= j0 % block_size  # keep the scan block-aligned
-    if j0:
-        k = k[..., j0:, :]
-        v = v[..., j0:, :]
-    m, l, o = _blockwise_stats(
-        q, k, v, block_size=min(block_size, k.shape[-2]), causal=True,
-        scale=scale, window=window, q_offset=shard_dist - j0,
-    )
-    return _finalize(l, o, jnp.float32), m + jnp.log(jnp.maximum(l, 1e-20))
-
-
 def _ring_window_steps(window: int | None, t_local: int, ring_size: int) -> int:
     """How many CONTIGUOUS-layout ring steps can contribute under a causal
     sliding window: step s >= 1 consumes the shard ``s`` hops back, whose
@@ -362,10 +341,12 @@ def _ring_body_flash(q, k, v, *, axis_name: str, ring_size: int,
     ``window`` (causal sliding window) refines the step analysis with
     STATIC per-step distance bounds (q-k distance at step s spans
     [(s-1)L+1, (s+1)L-1], L = T_local): fully-in-band shards run the
-    plain flash kernel, partial band shards run the O(L*block)-memory
-    banded blockwise scan, and fully-out-of-band steps are not executed
-    at all — :func:`_ring_window_steps` truncates the ring, so far KV
-    shards are neither computed NOR communicated."""
+    plain flash kernel, partial band shards run the SAME kernel with its
+    in-kernel band mask and the static inter-shard distance as
+    ``q_offset`` (out-of-band tiles skip compute and DMA), and
+    fully-out-of-band steps are not executed at all —
+    :func:`_ring_window_steps` truncates the ring, so far KV shards are
+    neither computed NOR communicated."""
     from dct_tpu.ops.pallas_attention import flash_attention_lse
 
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
@@ -374,9 +355,10 @@ def _ring_body_flash(q, k, v, *, axis_name: str, ring_size: int,
     perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
     n_steps = _ring_window_steps(window, t_local, ring_size)
 
-    def call(q_, k_, v_, causal_):
+    def call(q_, k_, v_, causal_, window_=None, q_offset=0):
         return flash_attention_lse(
-            q_, k_, v_, block_q, block_k, causal_, scale, interpret
+            q_, k_, v_, block_q, block_k, causal_, scale, interpret,
+            window_, q_offset,
         )
 
     k_cur, v_cur = k, v
@@ -384,10 +366,8 @@ def _ring_body_flash(q, k, v, *, axis_name: str, ring_size: int,
     for step in range(n_steps):  # static unroll: ring_size is mesh shape
         if step == 0:
             if window is not None and window < t_local:
-                o_j, lse_j = _banded_block_lse(
-                    q, k_cur, v_cur, scale, window, 0, t_local
-                )
-                o, lse = o_j, lse_j
+                o_j, lse_j = call(q, k_cur, v_cur, True, window, 0)
+                o, lse = o_j.astype(jnp.float32), lse_j
             else:
                 o_j, lse_j = call(q, k_cur, v_cur, causal)
                 o, lse = o_j.astype(jnp.float32), lse_j
@@ -395,16 +375,15 @@ def _ring_body_flash(q, k, v, *, axis_name: str, ring_size: int,
             if causal:
                 d_max = (step + 1) * t_local - 1
                 if window is not None and d_max >= window:
-                    # Partial band shard: banded blockwise scan.
+                    # Partial band shard: windowed kernel, q shifted by
+                    # the static inter-shard distance.
                     o_j, lse_j = lax.cond(
                         my >= step,
-                        lambda kc=k_cur, vc=v_cur, s=step: (
-                            _banded_block_lse(
-                                q, kc, vc, scale, window, s, t_local
-                            )
+                        lambda kc=k_cur, vc=v_cur, s=step: call(
+                            q, kc, vc, True, window, s * t_local
                         ),
                         lambda: (
-                            jnp.zeros(q.shape, jnp.float32),
+                            jnp.zeros(q.shape, q.dtype),
                             jnp.full(q.shape[:-1], _NEG, jnp.float32),
                         ),
                     )
@@ -799,15 +778,13 @@ def a2a_attention(
     def _kernel(ql, kl, vl):
         # Full-sequence single-shard compute on [B_l, H_l/sp, T, D] —
         # each device sees every position for its heads, so windowing is
-        # just the single-shard mask. Windowed attention routes through
-        # the masked blockwise/dense paths (the Pallas kernel has no
-        # window tiles).
-        if window is None and flash_on and t % 128 == 0 and t >= 128:
+        # just the single-shard (in-kernel) band mask.
+        if flash_on and t % 128 == 0 and t >= 128:
             from dct_tpu.ops.pallas_attention import flash_attention
 
             return flash_attention(
                 ql, kl, vl, causal=causal, scale=scale,
-                interpret=bool(interpret),
+                interpret=bool(interpret), window=window,
             )
         if t > block_size and t % block_size == 0:
             return blockwise_attention(
@@ -871,18 +848,16 @@ def make_attention_fn(mesh: Mesh | None = None, *, causal: bool = False,
         path = select_attention_path(
             t, block_size=block_size, flash_block=max(bq, bk)
         )
-        if (
-            window is None
-            and path == "flash" and t % bq == 0 and t % bk == 0
-        ):
+        if path == "flash" and t % bq == 0 and t % bk == 0:
             from dct_tpu.ops.pallas_attention import flash_attention
 
+            # Windowed calls stay kernel-resident: the band mask lives in
+            # the kernel and out-of-band tiles skip compute + DMA.
             return flash_attention(
                 q, k, v, block_q=bq, block_k=bk, causal=causal,
-                interpret=bool(flash_interpret_mode()),
+                interpret=bool(flash_interpret_mode()), window=window,
             )
-        # 'flash' whose override blocks do not divide t (or any windowed
-        # call — the kernel has no window tiles) degrades here too.
+        # 'flash' whose override blocks do not divide t degrades here too.
         if t > block_size and t % block_size == 0:
             return blockwise_attention(
                 q, k, v, block_size=block_size, causal=causal, window=window

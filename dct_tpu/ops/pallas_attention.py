@@ -8,7 +8,12 @@ step is one ``[block_q, D]`` Q tile plus one ``[block_k, D]`` K/V tile —
 O(block) regardless of sequence length — while the online-softmax running
 stats (m, l, acc) persist in VMEM scratch across the KV sweep. The score
 matrix never exists in HBM, so memory is O(T·D) instead of O(T²); with
-``causal=True`` KV blocks entirely above the diagonal skip their MXU work.
+``causal=True`` KV blocks entirely above the diagonal skip their MXU work,
+and with ``window`` set the sliding-window band also skips every block
+entirely behind the band (compute AND DMA, in forward and both backward
+kernels) — O(T·window) FLOPs instead of the causal O(T²/2). ``q_offset``
+statically shifts the q positions so the windowed ring's partial-band
+shards (q-k distance = step·T_local) reuse the same kernel.
 
 The running stats use the same online update as
 :func:`dct_tpu.ops.attention._online_block`; they are re-expressed here in
@@ -51,7 +56,8 @@ _STATS_LANES = 128
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
                       n_kv: int, causal: bool, scale: float,
-                      with_lse: bool):
+                      with_lse: bool, window: int | None = None,
+                      q_offset: int = 0):
     if with_lse:
         lse_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -75,13 +81,18 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
             preferred_element_type=jnp.float32,
         )  # [bq, block_k]
         if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(
+            # ``q_offset`` shifts the q positions (the windowed ring's
+            # static inter-shard distance); k positions stay 0-based.
+            q_pos = q_offset + qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0
             )
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1
             )
             keep = q_pos >= k_pos
+            if window is not None:
+                # Sliding window band: attend iff 0 <= q_pos-k_pos < window.
+                keep &= q_pos - k_pos < window
             s = jnp.where(keep, s, _NEG)
         m_prev = m_ref[:, :1]  # [bq, 1]
         l_prev = l_ref[:, :1]
@@ -105,7 +116,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
         # j*block_k is <= the block's last query position (qi+1)*bq - 1;
         # blocks fully above the diagonal skip all compute (their DMA is
         # also elided — the index map refetches the resident block).
-        pl.when(j * block_k < (qi + 1) * bq)(_block)
+        work = j * block_k < q_offset + (qi + 1) * bq
+        if window is not None:
+            # ...and entirely-behind-the-band blocks (every distance
+            # >= window) skip too: this is where windowed flash recovers
+            # O(T*window) FLOPs from the O(T^2) causal sweep.
+            work &= q_offset + qi * bq - (j + 1) * block_k + 1 < window
+        pl.when(work)(_block)
     else:
         _block()
 
@@ -122,13 +139,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
 
 
 def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
-               scale: float | None, interpret: bool, with_lse: bool = False):
+               scale: float | None, interpret: bool, with_lse: bool = False,
+               window: int | None = None, q_offset: int = 0):
     b, h, t, d = q.shape
     tk = k.shape[2]  # rectangular Tq != Tk supported (striped ring blocks)
     if causal and tk != t:
         raise ValueError(
             f"causal flash needs square Tq==Tk, got {t} vs {tk}"
         )
+    if window is not None and not causal:
+        raise ValueError("flash window requires causal attention")
+    if q_offset and not causal:
+        # The offset only participates in the causal position math; a
+        # non-causal caller would silently get unshifted full attention.
+        raise ValueError("flash q_offset requires causal attention")
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     block_q = min(block_q, t)
     block_k = min(block_k, tk)
@@ -143,16 +167,23 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
     vf = v.reshape(b * h, tk, d)
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, n_kv=n_kv, causal=causal,
-        scale=scale, with_lse=with_lse,
+        scale=scale, with_lse=with_lse, window=window, q_offset=q_offset,
     )
     if causal:
-        # Skipped above-diagonal blocks would otherwise still be DMA'd:
-        # clamp the index map so they re-address the last needed block
-        # (already resident -> the fetch is elided), saving ~half the KV
-        # HBM traffic for causal attention.
+        # Skipped blocks would otherwise still be DMA'd: clamp the index
+        # map so they re-address a needed block (already resident -> the
+        # fetch is elided). Above-diagonal blocks clamp down (~half the
+        # KV HBM traffic for plain causal); with a window, behind-the-band
+        # blocks also clamp up, so KV traffic is O(T*window/block) total.
         def kv_index(bh, i, j):
-            last_needed = ((i + 1) * block_q - 1) // block_k
-            return (bh, jnp.minimum(j, last_needed), 0)
+            last_needed = (q_offset + (i + 1) * block_q - 1) // block_k
+            jj = jnp.minimum(j, last_needed)
+            if window is not None:
+                first_needed = jnp.maximum(
+                    0, (q_offset + i * block_q - window + 1) // block_k
+                )
+                jj = jnp.maximum(jj, jnp.minimum(first_needed, n_kv - 1))
+            return (bh, jj, 0)
     else:
         def kv_index(bh, i, j):
             return (bh, j, 0)
@@ -229,7 +260,7 @@ def _bwd_block(q, k, v, do, lse, delta, scale, keep):
 def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                            dk_ref, dv_ref, dk_acc, dv_acc, *,
                            block_q: int, n_q: int, causal: bool,
-                           scale: float):
+                           scale: float, window: int | None = None):
     j = pl.program_id(1)
     i = pl.program_id(2)
     bk = k_ref.shape[0]
@@ -255,6 +286,8 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
             q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             keep = q_pos >= k_pos
+            if window is not None:
+                keep &= q_pos - k_pos < window
         p, ds = _bwd_block(q, k, v, do, lse, delta, scale, keep)
         # dV_j += P^T dO_i ; dK_j += dS^T Q_i  (contract over the q rows)
         dv_acc[...] += jax.lax.dot_general(
@@ -268,8 +301,12 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     if causal:
         # q block i contributes to kv block j iff its last query position
-        # reaches the block's first key position.
-        pl.when((i + 1) * block_q > j * k_ref.shape[0])(_block)
+        # reaches the block's first key position (and, windowed, iff its
+        # first query is still inside the band of the block's last key).
+        work = (i + 1) * block_q > j * bk
+        if window is not None:
+            work &= i * block_q - (j + 1) * bk + 1 < window
+        pl.when(work)(_block)
     else:
         _block()
 
@@ -281,7 +318,8 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                          dq_ref, dq_acc, *, block_k: int, n_kv: int,
-                         causal: bool, scale: float):
+                         causal: bool, scale: float,
+                         window: int | None = None):
     i = pl.program_id(1)
     j = pl.program_id(2)
     bq = q_ref.shape[0]
@@ -307,6 +345,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 jnp.int32, (bq, block_k), 1
             )
             keep = q_pos >= k_pos
+            if window is not None:
+                keep &= q_pos - k_pos < window
         _, ds = _bwd_block(q, k, v, do, lse, delta, scale, keep)
         # dQ_i += dS K_j
         dq_acc[...] += jax.lax.dot_general(
@@ -315,7 +355,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         )
 
     if causal:
-        pl.when(j * block_k < (i + 1) * bq)(_block)
+        work = j * block_k < (i + 1) * bq
+        if window is not None:
+            work &= i * bq - (j + 1) * block_k + 1 < window
+        pl.when(work)(_block)
     else:
         _block()
 
@@ -325,7 +368,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
-               causal: bool, scale: float | None, interpret: bool):
+               causal: bool, scale: float | None, interpret: bool,
+               window: int | None = None):
     """FlashAttention-2-style backward: two Pallas kernels (dK/dV with the
     Q sweep innermost; dQ with the KV sweep innermost). The score matrix
     is recovered blockwise from the forward's lse — nothing O(T^2) ever
@@ -349,11 +393,23 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
         vma = frozenset()
     vma_kw = {"vma": vma} if vma else {}
 
-    q_spec = pl.BlockSpec((None, block_q, d), lambda bh, j, i: (bh, i, 0))
+    # Same DMA-elision trick as the forward: clamp skipped blocks'
+    # addresses onto a needed (resident) block so their fetch is elided.
+    # dK/dV sweeps q blocks i per kv block j: causal needs i >= j*bk/bq,
+    # a window needs i*bq <= window + (j+1)*bk - 2.
+    if causal:
+        def q_index(bh, j, i):
+            ii = jnp.maximum(i, (j * block_k) // block_q)
+            if window is not None:
+                i_last = (window + (j + 1) * block_k - 2) // block_q
+                ii = jnp.minimum(ii, jnp.maximum(i_last, 0))
+            return (bh, ii, 0)
+    else:
+        def q_index(bh, j, i):
+            return (bh, i, 0)
+    q_spec = pl.BlockSpec((None, block_q, d), q_index)
     kv_spec = pl.BlockSpec((None, block_k, d), lambda bh, j, i: (bh, j, 0))
-    lse_spec = pl.BlockSpec(
-        (None, block_q, _STATS_LANES), lambda bh, j, i: (bh, i, 0)
-    )
+    lse_spec = pl.BlockSpec((None, block_q, _STATS_LANES), q_index)
     try:
         compiler_params = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")
@@ -364,7 +420,7 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkdv_kernel, block_q=block_q, n_q=n_q,
-            causal=causal, scale=scale,
+            causal=causal, scale=scale, window=window,
         ),
         grid=(b * h, n_kv, n_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
@@ -381,15 +437,29 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
         interpret=interpret,
     )(qf, kf, vf, of, dof, lsef)
 
+    # dQ sweeps kv blocks j per q block i — same clamp as the forward's
+    # kv_index (above-diagonal down, behind-the-band up).
+    if causal:
+        def kv_index2(bh, i, j):
+            jj = jnp.minimum(j, ((i + 1) * block_q - 1) // block_k)
+            if window is not None:
+                j_first = jnp.maximum(
+                    0, (i * block_q - window + 1) // block_k
+                )
+                jj = jnp.maximum(jj, jnp.minimum(j_first, n_kv - 1))
+            return (bh, jj, 0)
+    else:
+        def kv_index2(bh, i, j):
+            return (bh, j, 0)
     q_spec2 = pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0))
-    kv_spec2 = pl.BlockSpec((None, block_k, d), lambda bh, i, j: (bh, j, 0))
+    kv_spec2 = pl.BlockSpec((None, block_k, d), kv_index2)
     lse_spec2 = pl.BlockSpec(
         (None, block_q, _STATS_LANES), lambda bh, i, j: (bh, i, 0)
     )
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, block_k=block_k, n_kv=n_kv,
-            causal=causal, scale=scale,
+            causal=causal, scale=scale, window=window,
         ),
         grid=(b * h, n_q, n_kv),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, q_spec2, lse_spec2],
@@ -405,26 +475,30 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
 )
 def flash_attention(q, k, v, block_q=128, block_k=128, causal=False,
-                    scale=None, interpret=False):
-    """Flash attention; q,k,v [B, H, T, D] -> [B, H, T, D]."""
+                    scale=None, interpret=False, window=None):
+    """Flash attention; q,k,v [B, H, T, D] -> [B, H, T, D].
+
+    ``window`` (causal-only sliding window): the band mask lives in the
+    kernel and fully-out-of-band KV tiles skip compute AND DMA — the
+    causal O(T^2/2) sweep becomes O(T*window)."""
     return _flash_fwd(
         q, k, v, block_q=block_q, block_k=block_k, causal=causal,
-        scale=scale, interpret=interpret,
+        scale=scale, interpret=interpret, window=window,
     )
 
 
-def _vjp_fwd(q, k, v, block_q, block_k, causal, scale, interpret):
+def _vjp_fwd(q, k, v, block_q, block_k, causal, scale, interpret, window):
     out, lse = _flash_fwd(
         q, k, v, block_q=block_q, block_k=block_k, causal=causal,
-        scale=scale, interpret=interpret, with_lse=True,
+        scale=scale, interpret=interpret, with_lse=True, window=window,
     )
     return out, (q, k, v, out, lse)
 
 
-def _vjp_bwd(block_q, block_k, causal, scale, interpret, res, g):
+def _vjp_bwd(block_q, block_k, causal, scale, interpret, window, res, g):
     q, k, v, o, lse = res
     rectangular = q.shape[-2] != k.shape[-2]  # bwd kernels assume square
     if rectangular or os.environ.get(
@@ -437,57 +511,90 @@ def _vjp_bwd(block_q, block_k, causal, scale, interpret, res, g):
         block = min(block_k, k.shape[-2])
         _, vjp = jax.vjp(
             lambda q_, k_, v_: blockwise_attention(
-                q_, k_, v_, block_size=block, causal=causal, scale=scale
+                q_, k_, v_, block_size=block, causal=causal, scale=scale,
+                window=window,
             ),
             q, k, v,
         )
         return vjp(g)
     return _flash_bwd(
         q, k, v, o, lse, g, block_q=block_q, block_k=block_k,
-        causal=causal, scale=scale, interpret=interpret,
+        causal=causal, scale=scale, interpret=interpret, window=window,
     )
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention_lse(q, k, v, block_q=128, block_k=128, causal=False,
-                        scale=None, interpret=False):
+                        scale=None, interpret=False, window=None,
+                        q_offset=0):
     """Flash attention that also returns the per-row log-sum-exp:
     (o [B,H,T,D], lse [B,H,T] f32). The lse makes finalized outputs
     MERGEABLE — ring attention combines per-KV-shard flash results with
     softmax weights ``exp(lse_j - logaddexp_j lse_j)``, which is exactly
-    the online-softmax accumulation factored across kernel calls."""
+    the online-softmax accumulation factored across kernel calls.
+
+    ``window``/``q_offset``: causal sliding-window band with the q
+    positions shifted by a STATIC offset — the windowed ring passes its
+    per-step inter-shard distance here, so partial-band shards run
+    kernel-resident with out-of-band tiles skipped."""
     return _flash_fwd(
         q, k, v, block_q=block_q, block_k=block_k, causal=causal,
-        scale=scale, interpret=interpret, with_lse=True,
+        scale=scale, interpret=interpret, with_lse=True, window=window,
+        q_offset=q_offset,
     )
 
 
-def _vjp_lse_fwd(q, k, v, block_q, block_k, causal, scale, interpret):
+def _vjp_lse_fwd(q, k, v, block_q, block_k, causal, scale, interpret,
+                 window, q_offset):
     out = _flash_fwd(
         q, k, v, block_q=block_q, block_k=block_k, causal=causal,
-        scale=scale, interpret=interpret, with_lse=True,
+        scale=scale, interpret=interpret, with_lse=True, window=window,
+        q_offset=q_offset,
     )
     return out, (q, k, v)
 
 
-def _vjp_lse_bwd(block_q, block_k, causal, scale, interpret, res, g):
+def _vjp_lse_bwd(block_q, block_k, causal, scale, interpret, window,
+                 q_offset, res, g):
     # Rematerialize through the numerically-identical JAX-level blockwise
     # path, which carries the SAME (o, lse) pair — so cotangents w.r.t.
     # the lse output (the ring merge weights depend on it) flow correctly.
     from dct_tpu.ops.attention import blockwise_attention_lse
 
     q, k, v = res
-    block = min(block_k, k.shape[-2])
+    # Static KV front-slice: with an offset band (the windowed ring's
+    # partial shards), keys at j <= q_offset - window are behind the band
+    # for EVERY q row — scanning them in the remat backward would waste
+    # the forward's O(T*window) bound on zeroed blocks (code-review r4).
+    # Their dk/dv are exactly zero, restored by the front pad below.
+    j0 = 0
+    if window is not None and q_offset:
+        j0 = max(0, q_offset - window + 1)
+        j0 -= j0 % max(block_k, 1)
+        j0 = min(j0, k.shape[-2])  # fully-out-of-band shard: empty slice
+    k_sl = k[..., j0:, :] if j0 else k
+    v_sl = v[..., j0:, :] if j0 else v
+    if k_sl.shape[-2] == 0:
+        return (
+            jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v)
+        )
+    block = min(block_k, k_sl.shape[-2])
     _, vjp = jax.vjp(
         lambda q_, k_, v_: blockwise_attention_lse(
-            q_, k_, v_, block_size=block, causal=causal, scale=scale
+            q_, k_, v_, block_size=block, causal=causal, scale=scale,
+            window=window, q_offset=q_offset - j0,
         ),
-        q, k, v,
+        q, k_sl, v_sl,
     )
-    return vjp(g)
+    dq, dk, dv = vjp(g)
+    if j0:
+        pad = [(0, 0)] * (k.ndim - 2) + [(j0, 0), (0, 0)]
+        dk = jnp.pad(dk, pad)
+        dv = jnp.pad(dv, pad)
+    return dq, dk, dv
 
 
 flash_attention_lse.defvjp(_vjp_lse_fwd, _vjp_lse_bwd)

@@ -140,3 +140,82 @@ def test_flash_under_jit(qkv):
     )(q, k, v)
     ref = dense_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+# --- sliding window in the kernel ----------------------------------------
+
+
+@pytest.mark.parametrize("window", [1, 17, 32, 100, 128])
+def test_flash_window_matches_dense(qkv, window):
+    """The in-kernel band mask (incl. the tile-skip conditions: blocks
+    entirely behind the band execute nothing) against the masked dense
+    oracle, at windows inside one tile, spanning tiles, and >= T."""
+    q, k, v = qkv
+    ref = dense_attention(q, k, v, causal=True, window=window)
+    out = flash_attention(
+        q, k, v, block_q=32, block_k=32, causal=True, interpret=True,
+        window=window,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("bwd_mode", ["kernel", "remat"])
+@pytest.mark.parametrize("window", [17, 64])
+def test_flash_window_grad_matches_dense(qkv, window, bwd_mode, monkeypatch):
+    """Windowed backward: both the FA2 backward kernels (band mask +
+    tile skip) and the blockwise remat escape against dense AD."""
+    monkeypatch.setenv("DCT_FLASH_BWD", bwd_mode)
+    q, k, v = qkv
+
+    def loss_flash(q, k, v):
+        return flash_attention(
+            q, k, v, block_q=32, block_k=32, causal=True, interpret=True,
+            window=window,
+        ).sum()
+
+    def loss_dense(q, k, v):
+        return dense_attention(q, k, v, causal=True, window=window).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd), atol=1e-4)
+
+
+def test_flash_window_requires_causal(qkv):
+    q, k, v = qkv
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(
+            q, k, v, block_q=32, block_k=32, causal=False, interpret=True,
+            window=8,
+        )
+
+
+@pytest.mark.parametrize("offset_blocks", [1, 3])
+def test_flash_lse_q_offset_matches_blockwise(qkv, offset_blocks):
+    """The static q_offset (the windowed ring's inter-shard distance)
+    against the JAX-level blockwise twin with the same offset — forward
+    o AND lse, since the ring's merge weights come from the lse."""
+    from dct_tpu.ops.attention import blockwise_attention_lse
+    from dct_tpu.ops.pallas_attention import flash_attention_lse
+
+    q, k, v = qkv
+    window = 100
+    q_offset = offset_blocks * T  # whole-shard distances like the ring's
+    o_k, lse_k = flash_attention_lse(
+        q, k, v, 32, 32, True, None, True, window, q_offset
+    )
+    o_b, lse_b = blockwise_attention_lse(
+        q, k, v, block_size=32, causal=True, window=window,
+        q_offset=q_offset,
+    )
+    # Rows fully out of band produce o=0 and lse ~ -inf in both paths;
+    # compare only the finite-lse rows for lse equality.
+    finite = np.asarray(lse_b) > -1e29
+    np.testing.assert_allclose(
+        np.asarray(o_k), np.asarray(o_b), atol=1e-5
+    )
+    if finite.any():
+        np.testing.assert_allclose(
+            np.asarray(lse_k)[finite], np.asarray(lse_b)[finite], atol=1e-5
+        )
