@@ -358,22 +358,45 @@ def bench_scaled_transformer() -> dict:
                 q, k, v, block_size=min(512, q.shape[-2]), causal=True
             )
 
+        # WINDOWED variants (DCT_SCALED_WINDOW, default seq_len/4): the
+        # in-kernel band skips every tile behind the window — compute AND
+        # DMA — so flash-window vs flash-causal quantifies the
+        # O(T*window)-vs-O(T^2/2) claim on hardware, and flash-window vs
+        # blockwise-window shows the kernel's edge over the masked XLA
+        # scan (which pays every block and masks).
+        win = int(os.environ.get("DCT_SCALED_WINDOW", str(max(1, t // 4))))
+
+        def flash_window(q, k, v):
+            return flash_attention(
+                q, k, v, block_q, block_k, True, None, False, win
+            )
+
+        def blockwise_window(q, k, v):
+            return blockwise_attention(
+                q, k, v, block_size=min(512, q.shape[-2]), causal=True,
+                window=win,
+            )
+
+        causal["attn_window"] = win
         for name, fn in (
-            ("flash", flash_causal), ("blockwise", blockwise_causal),
+            ("causal_flash", flash_causal),
+            ("causal_blockwise", blockwise_causal),
+            ("window_flash", flash_window),
+            ("window_blockwise", blockwise_window),
         ):
             try:
                 st = state.replace(apply_fn=build(fn).apply)
-                causal[f"attn_causal_{name}_ms"] = round(
+                causal[f"attn_{name}_ms"] = round(
                     _time_scanned_step(
                         epoch_step, st, stacks, scan_len=scan_len
                     ) * 1e3, 2,
                 )
             except Exception as e:  # noqa: BLE001
-                causal[f"attn_causal_{name}_error"] = (
+                causal[f"attn_{name}_error"] = (
                     f"{type(e).__name__}: {e}"
                 )
                 print(
-                    f"[bench] causal {name} leg FAILED "
+                    f"[bench] {name} leg FAILED "
                     f"({type(e).__name__}: {e})",
                     file=sys.stderr, flush=True,
                 )

@@ -53,7 +53,6 @@ def main():
         )
         return
     interpret = bool(mode)
-    causal_modes = (False, True)
     shapes = [
         # (B, H, T, D)
         (16, 8, 1024, 64),
@@ -69,25 +68,28 @@ def main():
         )
         k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
         v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
-        for causal in causal_modes:
+        # (causal, window): full bidirectional, full causal, and the
+        # sliding-window band (t/8) — the windowed kernel's tile skip
+        # should show ~T/(2*window)x over plain causal at large T.
+        for causal, window in ((False, None), (True, None), (True, t // 8)):
             # XLA blockwise baselines, fwd and fwd+bwd
             bw = jax.jit(
                 lambda q, k, v: blockwise_attention(
-                    q, k, v, block_size=512, causal=causal
+                    q, k, v, block_size=512, causal=causal, window=window
                 )
             )
 
             def bw_loss(q, k, v):
                 return blockwise_attention(
-                    q, k, v, block_size=512, causal=causal
+                    q, k, v, block_size=512, causal=causal, window=window
                 ).astype(jnp.float32).sum()
 
             bw_grad = jax.jit(jax.grad(bw_loss, argnums=(0, 1, 2)))
             t_bw = timeit(bw, q, k, v)
             t_bwg = timeit(bw_grad, q, k, v)
             print(
-                f"[{b}x{h}x{t}x{d} causal={causal}] blockwise "
-                f"fwd={t_bw*1e3:.2f}ms fwd+bwd={t_bwg*1e3:.2f}ms",
+                f"[{b}x{h}x{t}x{d} causal={causal} window={window}] "
+                f"blockwise fwd={t_bw*1e3:.2f}ms fwd+bwd={t_bwg*1e3:.2f}ms",
                 flush=True,
             )
             for (bq, bk) in blocks:
@@ -95,13 +97,13 @@ def main():
                     continue
                 fl = jax.jit(
                     lambda q, k, v, bq=bq, bk=bk: flash_attention(
-                        q, k, v, bq, bk, causal, None, interpret
+                        q, k, v, bq, bk, causal, None, interpret, window
                     )
                 )
 
                 def fl_loss(q, k, v, bq=bq, bk=bk):
                     return flash_attention(
-                        q, k, v, bq, bk, causal, None, interpret
+                        q, k, v, bq, bk, causal, None, interpret, window
                     ).astype(jnp.float32).sum()
 
                 fl_grad = jax.jit(jax.grad(fl_loss, argnums=(0, 1, 2)))
