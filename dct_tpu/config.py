@@ -108,9 +108,15 @@ class ModelConfig:
     # HBM-for-FLOPs trade (jax.checkpoint) that unlocks long sequences.
     remat: bool = False
     # Causal family: sliding-window local attention — position t attends
-    # to the last `attn_window` positions only (0 = full causal). Pairs
-    # with DCT_SP_ENGINE=a2a when the seq axis is populated.
+    # to the last `attn_window` positions only (0 = full causal). Works
+    # on every attention path incl. both SP engines.
     attn_window: int = 0
+    # Transformer families: grouped-query attention — K/V carry this many
+    # heads (0 = classic MHA, = n_heads), each serving
+    # n_heads/n_kv_heads query heads. The KV-bandwidth lever: smaller
+    # projections, KV HBM reads divided by the group size in the flash
+    # kernel, smaller KV payloads on the SP engines' collectives.
+    n_kv_heads: int = 0
 
     @classmethod
     def from_env(cls) -> "ModelConfig":
@@ -140,6 +146,7 @@ class ModelConfig:
         c.horizon = _env("DCT_HORIZON", c.horizon, int)
         c.remat = _env("DCT_REMAT", c.remat, bool)
         c.attn_window = _env("DCT_ATTN_WINDOW", c.attn_window, int)
+        c.n_kv_heads = _env("DCT_N_KV_HEADS", c.n_kv_heads, int)
         return c
 
 

@@ -372,13 +372,14 @@ class MoEBlock(nn.Module):
     mesh: object = None
     top_k: int = 1
     auto_threshold: int = 1 << 21
+    n_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x, *, train: bool):
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
         h = MultiHeadAttention(
             self.d_model, self.n_heads, self.attn_fn, dtype=self.dtype,
-            name="attn",
+            n_kv_heads=self.n_kv_heads, name="attn",
         )(h)
         h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
         x = x + h
@@ -414,6 +415,7 @@ class WeatherMoE(nn.Module):
     mesh: object = None
     top_k: int = 1
     auto_threshold: int = 1 << 21
+    n_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -440,6 +442,7 @@ class WeatherMoE(nn.Module):
                 mesh=self.mesh,
                 top_k=self.top_k,
                 auto_threshold=self.auto_threshold,
+                n_kv_heads=self.n_kv_heads,
                 name=f"block_{i}",
             )(h, train=train)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)

@@ -120,6 +120,7 @@ def _build_moe(
         mesh=mesh,
         top_k=cfg.router_top_k,
         auto_threshold=cfg.moe_auto_threshold,
+        n_kv_heads=cfg.n_kv_heads or None,
     )
 
 
@@ -156,6 +157,7 @@ def _build_transformer_causal(
         horizon=cfg.horizon,
         remat=cfg.remat,
         compute_dtype=compute_dtype or jnp.float32,
+        n_kv_heads=cfg.n_kv_heads or None,
     )
 
 
@@ -188,6 +190,7 @@ def _build_transformer_pp(
         mesh=mesh,
         remat=cfg.remat,
         compute_dtype=compute_dtype or jnp.float32,
+        n_kv_heads=cfg.n_kv_heads or None,
     )
 
 
@@ -213,4 +216,5 @@ def _build_transformer(
         attn_fn=attn_fn,
         remat=cfg.remat,
         compute_dtype=compute_dtype or jnp.float32,
+        n_kv_heads=cfg.n_kv_heads or None,
     )

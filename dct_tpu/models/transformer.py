@@ -44,25 +44,46 @@ def sincos_positions(seq_len: int, d_model: int) -> np.ndarray:
 
 class MultiHeadAttention(nn.Module):
     """MHA with injected attention kernel. Projections are single fused
-    qkv (column-parallel over ``model``) + output (row-parallel)."""
+    qkv (column-parallel over ``model``) + output (row-parallel).
+
+    ``n_kv_heads`` < ``n_heads`` selects grouped-query attention (GQA):
+    K/V carry fewer heads, each serving ``n_heads/n_kv_heads`` query
+    heads — the standard KV-bandwidth lever (smaller qkv projection,
+    KV HBM reads divided by the group size in the Pallas kernel, smaller
+    KV payloads on the SP engines' collectives). The fused output dim is
+    laid out GROUP-major ``(G, Hg + 2, Dh)`` (G = kv heads, Hg = q heads
+    per group): a ``model``-axis shard of the kernel's output dim is
+    GROUP-aligned, so each tensor-parallel shard owns whole groups —
+    q heads together with their kv head, no resharding before attention.
+    With ``n_kv_heads == n_heads`` this degenerates to exactly the
+    classic ``(H, 3, Dh)`` layout, so MHA checkpoints are unchanged."""
 
     d_model: int
     n_heads: int
-    attn_fn: object  # (q, k, v) [B,H,T,D] -> [B,H,T,D]
+    attn_fn: object  # (q [B,H,T,D], k/v [B,G,T,D]) -> [B,H,T,D]
     dtype: jnp.dtype = jnp.float32
+    n_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x):
         b, t, _ = x.shape
         head_dim = self.d_model // self.n_heads
-        qkv = TorchStyleDense(3 * self.d_model, dtype=self.dtype, name="qkv_proj")(x)
-        # Fused output dim is laid out (H, 3, Dh) so a ``model``-axis shard
-        # of the kernel's output dim is HEAD-aligned: each tensor-parallel
-        # shard owns whole heads' q,k,v — no cross-shard resharding before
-        # attention.
-        qkv = qkv.reshape(b, t, self.n_heads, 3, head_dim)
-        # [B, T, H, 3, Dh] -> 3 x [B, H, T, Dh]
-        q, k, v = (jnp.swapaxes(qkv[:, :, :, j], 1, 2) for j in range(3))
+        g = self.n_kv_heads or self.n_heads
+        if self.n_heads % g:
+            raise ValueError(
+                f"n_kv_heads ({g}) must divide n_heads ({self.n_heads})"
+            )
+        hg = self.n_heads // g
+        qkv = TorchStyleDense(
+            (self.n_heads + 2 * g) * head_dim, dtype=self.dtype,
+            name="qkv_proj",
+        )(x)
+        qkv = qkv.reshape(b, t, g, hg + 2, head_dim)
+        # [B, T, G, Hg+2, Dh]: per group, Hg q heads then one k and one v.
+        q = qkv[:, :, :, :hg].reshape(b, t, self.n_heads, head_dim)
+        q = jnp.swapaxes(q, 1, 2)  # [B, H, T, Dh]
+        k = jnp.swapaxes(qkv[:, :, :, hg], 1, 2)  # [B, G, T, Dh]
+        v = jnp.swapaxes(qkv[:, :, :, hg + 1], 1, 2)
         o = self.attn_fn(q, k, v)  # [B, H, T, D]
         o = jnp.moveaxis(o, 1, 2).reshape(b, t, self.d_model)
         return TorchStyleDense(self.d_model, dtype=self.dtype, name="o_proj")(o)
@@ -75,6 +96,7 @@ class TransformerBlock(nn.Module):
     dropout: float
     attn_fn: object
     dtype: jnp.dtype = jnp.float32
+    n_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -83,7 +105,7 @@ class TransformerBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
         h = MultiHeadAttention(
             self.d_model, self.n_heads, self.attn_fn, dtype=self.dtype,
-            name="attn",
+            n_kv_heads=self.n_kv_heads, name="attn",
         )(h)
         h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
         x = x + h
@@ -109,6 +131,7 @@ class _StageBlocks(nn.Module):
     attn_fn: object
     dtype: jnp.dtype = jnp.float32
     remat: bool = False
+    n_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, h):
@@ -120,7 +143,8 @@ class _StageBlocks(nn.Module):
         for i in range(self.layers_per_stage):
             h = block_cls(
                 self.d_model, self.n_heads, self.d_ff, 0.0, self.attn_fn,
-                dtype=self.dtype, name=f"block_{i}",
+                dtype=self.dtype, n_kv_heads=self.n_kv_heads,
+                name=f"block_{i}",
             )(h, False)
         return h
 
@@ -161,6 +185,7 @@ class WeatherTransformerPP(nn.Module):
     mesh: object = None
     remat: bool = False
     compute_dtype: jnp.dtype = jnp.float32
+    n_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -177,7 +202,7 @@ class WeatherTransformerPP(nn.Module):
         stage_mod = _StageBlocks(
             self.d_model, self.n_heads, self.d_ff,
             self.n_layers // self.n_stages, attn_fn, dtype=ct,
-            remat=self.remat,
+            remat=self.remat, n_kv_heads=self.n_kv_heads,
         )
 
         def init_stages(rng):
@@ -252,6 +277,7 @@ class WeatherTransformer(nn.Module):
     horizon: int = 1
     remat: bool = False
     compute_dtype: jnp.dtype = jnp.float32
+    n_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -287,6 +313,7 @@ class WeatherTransformer(nn.Module):
                 self.dropout,
                 attn_fn,
                 dtype=self.compute_dtype,
+                n_kv_heads=self.n_kv_heads,
                 name=f"block_{i}",
             )(h, train)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)

@@ -90,11 +90,34 @@ def _check_window(window: int | None, causal: bool) -> None:
         )
 
 
+def expand_kv(q, k, v):
+    """Grouped-query attention (GQA) KV expansion: K/V carry
+    ``n_kv_heads`` heads with ``H % n_kv_heads == 0``; each KV head
+    serves ``H/n_kv_heads`` consecutive query heads (the fused
+    projection's group-major layout). Returns (k, v) broadcast to the
+    full H — XLA fuses the broadcast into the downstream matmuls, and
+    the paths where materializing would cost real bandwidth (the Pallas
+    kernel, the SP engines' collectives) expand later or never
+    (grouped index maps)."""
+    h, hkv = q.shape[-3], k.shape[-3]
+    if h == hkv:
+        return k, v
+    if h % hkv:
+        raise ValueError(
+            f"GQA needs q heads ({h}) divisible by kv heads ({hkv})"
+        )
+    group = h // hkv
+    k = jnp.repeat(k, group, axis=-3)
+    v = jnp.repeat(v, group, axis=-3)
+    return k, v
+
+
 def dense_attention(
     q, k, v, *, causal: bool = False, scale: float | None = None,
     window: int | None = None,
 ):
-    """Reference numerics: full [Tq, Tk] score matrix. q,k,v [B, H, T, D].
+    """Reference numerics: full [Tq, Tk] score matrix. q,k,v [B, H, T, D]
+    (K/V may carry fewer GQA heads — :func:`expand_kv`).
 
     ``window`` (causal-only): position t attends to at most the last
     ``window`` positions [t-window+1, t] — sliding-window local
@@ -102,6 +125,7 @@ def dense_attention(
     complement to sequence parallelism."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     _check_window(window, causal)
+    k, v = expand_kv(q, k, v)
     s = jnp.einsum(
         "...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32
     ) * scale
@@ -131,6 +155,7 @@ def _blockwise_stats(q, k, v, *, block_size: int, causal: bool,
     scan instead of materializing a full [Tq, Tk] mask."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     _check_window(window, causal)
+    k, v = expand_kv(q, k, v)
     t = k.shape[-2]
     if t % block_size:
         raise ValueError(f"seq len {t} not a multiple of block {block_size}")
@@ -522,6 +547,10 @@ def _ring_body(q, k, v, *, axis_name: str, ring_size: int, causal: bool,
             mask = d >= 0
             if window is not None:
                 mask &= d < window
+        # GQA: the ring rotates the GROUPED kv shards (ICI payload stays
+        # at n_kv_heads); expansion to full heads happens per-use INSIDE
+        # the branch that computes, so band-skipped steps pay neither the
+        # matmuls nor the group-times KV materialization.
         if window is not None and (striped or step > 0):
             # Skip the QK/AV matmuls of shards the band fully masks (the
             # striped rotation interleaves near and far shards, so which
@@ -531,12 +560,14 @@ def _ring_body(q, k, v, *, axis_name: str, ring_size: int, causal: bool,
             m, l, o = lax.cond(
                 jnp.any(mask),
                 lambda kc=k_cur, vc=v_cur, mk=mask, m=m, l=l, o=o: (
-                    _online_block(q, kc, vc, scale, mk, m, l, o)
+                    _online_block(q, *expand_kv(q, kc, vc), scale, mk,
+                                  m, l, o)
                 ),
                 lambda m=m, l=l, o=o: (m, l, o),
             )
         else:
-            m, l, o = _online_block(q, k_cur, v_cur, scale, mask, m, l, o)
+            ke, ve = expand_kv(q, k_cur, v_cur)
+            m, l, o = _online_block(q, ke, ve, scale, mask, m, l, o)
         if step < n_steps - 1:  # the truncated ring skips the far hops
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
@@ -604,17 +635,19 @@ def ring_attention(
     if _is_init_trace_escape(q, b, mesh.shape[data_axis]):
         return dense_attention(q, k, v, causal=causal, scale=scale,
                                window=window)
+    h_kv = k.shape[1]
     if (
         b % mesh.shape[data_axis]
         or h % mesh.shape[model_axis]
+        or h_kv % mesh.shape[model_axis]
         or t % ring_size
     ):
         # Anything else is a sizing bug: silently falling back to dense
         # would discard sequence parallelism (and its O(T/P) memory bound)
         # on every step with no sign beyond the OOM/slowdown.
         raise ValueError(
-            f"ring_attention shapes B={b}, H={h}, T={t} do not tile mesh "
-            f"axes data={mesh.shape[data_axis]}, "
+            f"ring_attention shapes B={b}, H={h} (kv heads {h_kv}), T={t} "
+            f"do not tile mesh axes data={mesh.shape[data_axis]}, "
             f"model={mesh.shape[model_axis]}, seq={ring_size}; adjust "
             "batch/heads/seq_len or the mesh"
         )
@@ -757,20 +790,24 @@ def a2a_attention(
             q, k, v, causal=causal, scale=scale, window=window
         )
     tp = mesh.shape[model_axis]
+    h_kv = k.shape[1]
     h_local = h // tp if h % tp == 0 else 0
+    hkv_local = h_kv // tp if h_kv % tp == 0 else 0
     if (
         b % mesh.shape[data_axis]
         or h % tp
+        or h_kv % tp
         or t % sp
         or h_local % sp
+        or hkv_local % sp
     ):
         alternative = "or use DCT_SP_ENGINE=ring"
         raise ValueError(
-            f"a2a_attention shapes B={b}, H={h}, T={t} do not tile mesh "
-            f"axes data={mesh.shape[data_axis]}, model={tp}, seq={sp} "
-            f"(the seq axis must divide the heads per TP shard: "
-            f"H/tp={h_local}, sp={sp}); adjust heads/seq_len or the mesh, "
-            f"{alternative}"
+            f"a2a_attention shapes B={b}, H={h} (kv heads {h_kv}), T={t} "
+            f"do not tile mesh axes data={mesh.shape[data_axis]}, "
+            f"model={tp}, seq={sp} (the seq axis must divide the heads "
+            f"per TP shard: H/tp={h_local}, kv/tp={hkv_local}, sp={sp}); "
+            f"adjust heads/seq_len or the mesh, {alternative}"
         )
     spec = P(data_axis, model_axis, seq_axis, None)
     flash_on, interpret = _resolve_flash(use_flash)

@@ -142,6 +142,7 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
                scale: float | None, interpret: bool, with_lse: bool = False,
                window: int | None = None, q_offset: int = 0):
     b, h, t, d = q.shape
+    h_kv = k.shape[1]
     tk = k.shape[2]  # rectangular Tq != Tk supported (striped ring blocks)
     if causal and tk != t:
         raise ValueError(
@@ -153,6 +154,15 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
         # The offset only participates in the causal position math; a
         # non-causal caller would silently get unshifted full attention.
         raise ValueError("flash q_offset requires causal attention")
+    if h % h_kv:
+        raise ValueError(
+            f"GQA needs q heads ({h}) divisible by kv heads ({h_kv})"
+        )
+    # GQA: KV stay at their n_kv_heads in HBM — the grid runs per Q head
+    # and the KV index maps divide by the group size, so each KV head's
+    # tiles are fetched once per group sweep instead of being repeated
+    # H/h_kv times through memory.
+    group = h // h_kv
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     block_q = min(block_q, t)
     block_k = min(block_k, tk)
@@ -163,12 +173,19 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
         )
     n_kv = tk // block_k
     qf = q.reshape(b * h, t, d)
-    kf = k.reshape(b * h, tk, d)
-    vf = v.reshape(b * h, tk, d)
+    kf = k.reshape(b * h_kv, tk, d)
+    vf = v.reshape(b * h_kv, tk, d)
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, n_kv=n_kv, causal=causal,
         scale=scale, with_lse=with_lse, window=window, q_offset=q_offset,
     )
+    def kv_bh(bh):
+        # Flat [b*h] grid row -> flat [b*h_kv] KV row (group-major GQA
+        # layout: q head g*group + j reads kv head g).
+        if group == 1:
+            return bh
+        return (bh // h) * h_kv + (bh % h) // group
+
     if causal:
         # Skipped blocks would otherwise still be DMA'd: clamp the index
         # map so they re-address a needed block (already resident -> the
@@ -183,10 +200,10 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
                     0, (q_offset + i * block_q - window + 1) // block_k
                 )
                 jj = jnp.maximum(jj, jnp.minimum(first_needed, n_kv - 1))
-            return (bh, jj, 0)
+            return (kv_bh(bh), jj, 0)
     else:
         def kv_index(bh, i, j):
-            return (bh, j, 0)
+            return (kv_bh(bh), j, 0)
     try:
         compiler_params = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")
@@ -501,7 +518,12 @@ def _vjp_fwd(q, k, v, block_q, block_k, causal, scale, interpret, window):
 def _vjp_bwd(block_q, block_k, causal, scale, interpret, window, res, g):
     q, k, v, o, lse = res
     rectangular = q.shape[-2] != k.shape[-2]  # bwd kernels assume square
-    if rectangular or os.environ.get(
+    # GQA backward goes through the remat escape: the dK/dV kernel's grid
+    # is parallel over q heads, so grouped KV would race on the shared
+    # dk/dv accumulators; AD through the blockwise path's expand_kv
+    # broadcast performs the group-sum reduction instead.
+    grouped = q.shape[1] != k.shape[1]
+    if rectangular or grouped or os.environ.get(
         "DCT_FLASH_BWD", "kernel"
     ).strip().lower() == "remat":
         # Escape hatch: differentiate the numerically-identical blockwise

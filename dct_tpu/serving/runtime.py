@@ -109,22 +109,38 @@ def gru_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
 
 
 def _mha_numpy(weights: dict, prefix: str, h: np.ndarray,
-               n_heads: int, causal: bool = False) -> np.ndarray:
+               n_heads: int, causal: bool = False,
+               window: int | None = None,
+               n_kv_heads: int | None = None) -> np.ndarray:
     """Multi-head attention matching
     dct_tpu.models.transformer.MultiHeadAttention's fused-qkv layout
-    (``causal`` masks positions > query, the causal family's path)."""
+    (``causal`` masks positions > query, the causal family's path;
+    ``window`` adds the sliding-window band; ``n_kv_heads`` selects the
+    GQA group-major layout — both must mirror training or the served
+    model silently differs from the trained one)."""
     n, s, d_model = h.shape
     head_dim = d_model // n_heads
+    g = n_kv_heads or n_heads
+    hg = n_heads // g
     qkv = h @ weights[f"{prefix}/qkv_proj/kernel"] + weights[
         f"{prefix}/qkv_proj/bias"
     ]
-    qkv = qkv.reshape(n, s, n_heads, 3, head_dim)
-    q, k, v = (np.swapaxes(qkv[:, :, :, j], 1, 2) for j in range(3))
+    qkv = qkv.reshape(n, s, g, hg + 2, head_dim)
+    q = np.swapaxes(
+        qkv[:, :, :, :hg].reshape(n, s, n_heads, head_dim), 1, 2
+    )  # [N, H, S, Dh]
+    k = np.swapaxes(qkv[:, :, :, hg], 1, 2)  # [N, G, S, Dh]
+    v = np.swapaxes(qkv[:, :, :, hg + 1], 1, 2)
+    if hg > 1:
+        k = np.repeat(k, hg, axis=1)
+        v = np.repeat(v, hg, axis=1)
     scores = q @ np.swapaxes(k, -1, -2) / math.sqrt(head_dim)
     if causal:
-        scores = np.where(
-            np.tril(np.ones((s, s), bool)), scores, -1e30
-        )
+        mask = np.tril(np.ones((s, s), bool))
+        if window is not None:
+            pos = np.arange(s)
+            mask &= pos[:, None] - pos[None, :] < window
+        scores = np.where(mask, scores, -1e30)
     o = softmax_numpy(scores) @ v  # [N, H, S, Dh]
     o = np.moveaxis(o, 1, 2).reshape(n, s, d_model)
     return o @ weights[f"{prefix}/o_proj/kernel"] + weights[
@@ -138,12 +154,15 @@ def _dense_ffn_numpy(w: dict, pre: str, f: np.ndarray) -> np.ndarray:
 
 
 def _pre_ln_block(w: dict, pre: str, h: np.ndarray, n_heads: int, ffn,
-                  causal: bool = False) -> np.ndarray:
+                  causal: bool = False, window: int | None = None,
+                  n_kv_heads: int | None = None) -> np.ndarray:
     """One pre-LN residual block (attention + FFN) — the single source of
     the block math for the transformer, MoE, causal, AND pipeline-stage
     serving paths (train/serve parity lives or dies here)."""
     a = _layernorm(h, w[f"{pre}/ln_attn/scale"], w[f"{pre}/ln_attn/bias"])
-    h = h + _mha_numpy(w, f"{pre}/attn", a, n_heads, causal)
+    h = h + _mha_numpy(
+        w, f"{pre}/attn", a, n_heads, causal, window, n_kv_heads
+    )
     f = _layernorm(h, w[f"{pre}/ln_ffn/scale"], w[f"{pre}/ln_ffn/bias"])
     return h + ffn(w, pre, f)
 
@@ -172,12 +191,19 @@ def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn, *,
     d_model = int(meta["d_model"])
     n_heads = int(meta["n_heads"])
     n_layers = int(meta["n_layers"])
+    # Same config normalization as the registry: 0 = off for both.
+    window = int(meta.get("attn_window", 0) or 0) or None
+    if window is not None and not causal:
+        window = None  # window is a causal-family concept
+    n_kv = int(meta.get("n_kv_heads", 0) or 0) or None
     s = x.shape[1]
 
     h = x @ weights["in_proj/kernel"] + weights["in_proj/bias"]
     h = h + _sincos_positions(s, d_model)
     for i in range(n_layers):
-        h = _pre_ln_block(weights, f"block_{i}", h, n_heads, ffn, causal)
+        h = _pre_ln_block(
+            weights, f"block_{i}", h, n_heads, ffn, causal, window, n_kv
+        )
     return _head_numpy(
         weights, h, per_position, horizon=int(meta.get("horizon", 1))
     )
@@ -218,10 +244,14 @@ def transformer_pp_forward_numpy(
         for k, v in weights.items()
         if k.startswith("pp_stages/")
     }
+    n_kv = int(meta.get("n_kv_heads", 0) or 0) or None
     for st in range(n_stages):
         w = {k: v[st] for k, v in stage_keys.items()}
         for i in range(layers_per_stage):
-            h = _pre_ln_block(w, f"block_{i}", h, n_heads, _dense_ffn_numpy)
+            h = _pre_ln_block(
+                w, f"block_{i}", h, n_heads, _dense_ffn_numpy,
+                n_kv_heads=n_kv,
+            )
     return _head_numpy(weights, h, per_position=False)
 
 
