@@ -377,6 +377,50 @@ def bench_scaled_transformer() -> dict:
                 window=win,
             )
 
+        # GQA op-level A/B at the scaled attention shape: grouped KV
+        # (n_heads/4 kv heads) vs full MHA through the causal kernel —
+        # quantifies the KV-HBM-read reduction the divided index maps
+        # deliver; attention-only timing because GQA changes the param
+        # tree (the train-step legs above share one state).
+        try:
+            import jax as _jax
+
+            heads = scaled["n_heads"]
+            kvh = max(1, heads // 4)
+            dh = scaled["d_model"] // heads
+            rngk = np.random.default_rng(7)
+            shp = lambda h_: (batch, h_, t, dh)
+            qa = jnp.asarray(rngk.standard_normal(shp(heads)), jnp.bfloat16)
+            ka = jnp.asarray(rngk.standard_normal(shp(kvh)), jnp.bfloat16)
+            va = jnp.asarray(rngk.standard_normal(shp(kvh)), jnp.bfloat16)
+            kf = jnp.repeat(ka, heads // kvh, axis=1)
+            vf = jnp.repeat(va, heads // kvh, axis=1)
+
+            def _time_op(fn, *args, n=10):
+                out = fn(*args)
+                _jax.block_until_ready(out)
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    out = fn(*args)
+                _jax.block_until_ready(out)
+                return (time.perf_counter() - t0) / n
+
+            fl = _jax.jit(
+                lambda q_, k_, v_: flash_attention(
+                    q_, k_, v_, block_q, block_k, True
+                )
+            )
+            t_mha = _time_op(fl, qa, kf, vf)
+            t_gqa = _time_op(fl, qa, ka, va)
+            causal["attn_gqa"] = {
+                "kv_heads": kvh,
+                "mha_ms": round(t_mha * 1e3, 3),
+                "gqa_ms": round(t_gqa * 1e3, 3),
+                "speedup": round(t_mha / t_gqa, 2),
+            }
+        except Exception as e:  # noqa: BLE001
+            causal["attn_gqa"] = {"error": f"{type(e).__name__}: {e}"}
+
         causal["attn_window"] = win
         for name, fn in (
             ("causal_flash", flash_causal),

@@ -191,11 +191,13 @@ def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn, *,
     d_model = int(meta["d_model"])
     n_heads = int(meta["n_heads"])
     n_layers = int(meta["n_layers"])
-    # Same config normalization as the registry: 0 = off for both.
-    window = int(meta.get("attn_window", 0) or 0) or None
-    if window is not None and not causal:
-        window = None  # window is a causal-family concept
-    n_kv = int(meta.get("n_kv_heads", 0) or 0) or None
+    # Same config normalization as the registry: <= 0 = off for both
+    # (truthiness alone would turn a -1 sentinel into an all-masked band
+    # the trained model never had).
+    _w = int(meta.get("attn_window", 0) or 0)
+    window = _w if _w > 0 and causal else None
+    _g = int(meta.get("n_kv_heads", 0) or 0)
+    n_kv = _g if _g > 0 else None
     s = x.shape[1]
 
     h = x @ weights["in_proj/kernel"] + weights["in_proj/bias"]
@@ -244,7 +246,8 @@ def transformer_pp_forward_numpy(
         for k, v in weights.items()
         if k.startswith("pp_stages/")
     }
-    n_kv = int(meta.get("n_kv_heads", 0) or 0) or None
+    _g = int(meta.get("n_kv_heads", 0) or 0)
+    n_kv = _g if _g > 0 else None
     for st in range(n_stages):
         w = {k: v[st] for k, v in stage_keys.items()}
         for i in range(layers_per_stage):

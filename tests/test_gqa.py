@@ -288,3 +288,28 @@ def test_gqa_every_family_numpy_parity(family, rng):
         jax_logits = jax_logits[:, -1]
     np_logits = forward_numpy(_flatten_params(params["params"]), meta, x)
     np.testing.assert_allclose(np_logits, jax_logits, atol=2e-5)
+
+
+def test_serving_normalizes_negative_window_and_kv_like_registry(rng):
+    """A negative attn_window/n_kv_heads sentinel trains as OFF (registry
+    uses '> 0'); serving must normalize identically, not serve an
+    all-masked band (code-review r4)."""
+    from dct_tpu.serving.runtime import forward_numpy
+    from dct_tpu.serving.score_gen import _flatten_params
+
+    model = get_model(ModelConfig(**CFG), input_dim=5)
+    variables = model.init(jax.random.PRNGKey(6), jnp.zeros((1, 8, 5)))
+    params = {"params": variables["params"]}
+    x = rng.standard_normal((2, 8, 5)).astype(np.float32)
+    jax_logits = np.asarray(model.apply(params, jnp.asarray(x)))[:, -1]
+    weights = _flatten_params(params["params"])
+    meta = {
+        "model": "weather_transformer_causal", "input_dim": 5,
+        "seq_len": 8, "d_model": 16, "n_heads": 4, "n_layers": 1,
+        "d_ff": 32, "num_classes": 2, "dropout": 0.0, "horizon": 1,
+        "attn_window": -1, "n_kv_heads": -1,
+        "feature_names": ["a"] * 5,
+    }
+    np.testing.assert_allclose(
+        forward_numpy(weights, meta, x), jax_logits, atol=2e-5
+    )
