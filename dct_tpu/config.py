@@ -117,6 +117,13 @@ class ModelConfig:
     # projections, KV HBM reads divided by the group size in the flash
     # kernel, smaller KV payloads on the SP engines' collectives.
     n_kv_heads: int = 0
+    # Transformer families: position encoding — "sincos" (additive fixed
+    # table, the default) or "rope" (rotary embeddings applied to q/k
+    # inside attention; relative-position structure, the standard choice
+    # for long-context extrapolation). RoPE composes with both SP
+    # engines (global positions, rotation happens before the seq-sharded
+    # op) and with GQA.
+    pos_embed: str = "sincos"
 
     @classmethod
     def from_env(cls) -> "ModelConfig":
@@ -147,6 +154,7 @@ class ModelConfig:
         c.remat = _env("DCT_REMAT", c.remat, bool)
         c.attn_window = _env("DCT_ATTN_WINDOW", c.attn_window, int)
         c.n_kv_heads = _env("DCT_N_KV_HEADS", c.n_kv_heads, int)
+        c.pos_embed = _env("DCT_POS_EMBED", c.pos_embed, str)
         return c
 
 

@@ -373,13 +373,14 @@ class MoEBlock(nn.Module):
     top_k: int = 1
     auto_threshold: int = 1 << 21
     n_kv_heads: int | None = None
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool):
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
         h = MultiHeadAttention(
             self.d_model, self.n_heads, self.attn_fn, dtype=self.dtype,
-            n_kv_heads=self.n_kv_heads, name="attn",
+            n_kv_heads=self.n_kv_heads, rope=self.rope, name="attn",
         )(h)
         h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
         x = x + h
@@ -416,6 +417,7 @@ class WeatherMoE(nn.Module):
     top_k: int = 1
     auto_threshold: int = 1 << 21
     n_kv_heads: int | None = None
+    pos_embed: str = "sincos"
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -424,9 +426,11 @@ class WeatherMoE(nn.Module):
         attn_fn = self.attn_fn or make_attention_fn(None)
         x = jnp.asarray(x, self.compute_dtype)
         h = TorchStyleDense(self.d_model, dtype=self.compute_dtype, name="in_proj")(x)
-        h = h + jnp.asarray(
-            sincos_positions(self.seq_len, self.d_model), self.compute_dtype
-        )
+        if self.pos_embed != "rope":  # rope rotates q/k inside attention
+            h = h + jnp.asarray(
+                sincos_positions(self.seq_len, self.d_model),
+                self.compute_dtype,
+            )
         for i in range(self.n_layers):
             h = MoEBlock(
                 self.d_model,
@@ -443,6 +447,7 @@ class WeatherMoE(nn.Module):
                 top_k=self.top_k,
                 auto_threshold=self.auto_threshold,
                 n_kv_heads=self.n_kv_heads,
+                rope=self.pos_embed == "rope",
                 name=f"block_{i}",
             )(h, train=train)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)

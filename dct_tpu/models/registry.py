@@ -120,7 +120,8 @@ def _build_moe(
         mesh=mesh,
         top_k=cfg.router_top_k,
         auto_threshold=cfg.moe_auto_threshold,
-        n_kv_heads=cfg.n_kv_heads or None,
+        n_kv_heads=cfg.n_kv_heads if cfg.n_kv_heads > 0 else None,
+        pos_embed=cfg.pos_embed,
     )
 
 
@@ -157,7 +158,8 @@ def _build_transformer_causal(
         horizon=cfg.horizon,
         remat=cfg.remat,
         compute_dtype=compute_dtype or jnp.float32,
-        n_kv_heads=cfg.n_kv_heads or None,
+        n_kv_heads=cfg.n_kv_heads if cfg.n_kv_heads > 0 else None,
+        pos_embed=cfg.pos_embed,
     )
 
 
@@ -190,7 +192,8 @@ def _build_transformer_pp(
         mesh=mesh,
         remat=cfg.remat,
         compute_dtype=compute_dtype or jnp.float32,
-        n_kv_heads=cfg.n_kv_heads or None,
+        n_kv_heads=cfg.n_kv_heads if cfg.n_kv_heads > 0 else None,
+        pos_embed=cfg.pos_embed,
     )
 
 
@@ -216,5 +219,6 @@ def _build_transformer(
         attn_fn=attn_fn,
         remat=cfg.remat,
         compute_dtype=compute_dtype or jnp.float32,
-        n_kv_heads=cfg.n_kv_heads or None,
+        n_kv_heads=cfg.n_kv_heads if cfg.n_kv_heads > 0 else None,
+        pos_embed=cfg.pos_embed,
     )

@@ -42,6 +42,31 @@ def sincos_positions(seq_len: int, d_model: int) -> np.ndarray:
     return out
 
 
+def rope_tables(seq_len: int, head_dim: int) -> tuple[np.ndarray, np.ndarray]:
+    """Rotary-embedding cos/sin tables [S, Dh/2] (RoFormer/Llama-style,
+    rotate-half pairing). Static numpy — nothing to shard, and the tables
+    bake into the compiled program as constants."""
+    half = head_dim // 2
+    inv = 1.0 / np.power(10000.0, np.arange(half, dtype=np.float32) / half)
+    ang = np.arange(seq_len, dtype=np.float32)[:, None] * inv[None, :]
+    return np.cos(ang), np.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate q or k [..., T, Dh] by per-position angles ([T, Dh/2] cos/sin,
+    broadcast over batch/head axes). Positions are GLOBAL sequence
+    positions, so the rotation composes unchanged with both SP engines
+    (it runs on the full array before the seq-sharded attention op) and
+    with GQA (k rotates at its grouped head count)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = jnp.asarray(cos, x.dtype)
+    sin = jnp.asarray(sin, x.dtype)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+
+
 class MultiHeadAttention(nn.Module):
     """MHA with injected attention kernel. Projections are single fused
     qkv (column-parallel over ``model``) + output (row-parallel).
@@ -63,6 +88,7 @@ class MultiHeadAttention(nn.Module):
     attn_fn: object  # (q [B,H,T,D], k/v [B,G,T,D]) -> [B,H,T,D]
     dtype: jnp.dtype = jnp.float32
     n_kv_heads: int | None = None
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -84,6 +110,14 @@ class MultiHeadAttention(nn.Module):
         q = jnp.swapaxes(q, 1, 2)  # [B, H, T, Dh]
         k = jnp.swapaxes(qkv[:, :, :, hg], 1, 2)  # [B, G, T, Dh]
         v = jnp.swapaxes(qkv[:, :, :, hg + 1], 1, 2)
+        if self.rope:
+            if head_dim % 2:
+                raise ValueError(
+                    f"rope needs an even head_dim (got {head_dim})"
+                )
+            cos, sin = rope_tables(t, head_dim)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
         o = self.attn_fn(q, k, v)  # [B, H, T, D]
         o = jnp.moveaxis(o, 1, 2).reshape(b, t, self.d_model)
         return TorchStyleDense(self.d_model, dtype=self.dtype, name="o_proj")(o)
@@ -97,6 +131,7 @@ class TransformerBlock(nn.Module):
     attn_fn: object
     dtype: jnp.dtype = jnp.float32
     n_kv_heads: int | None = None
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -105,7 +140,7 @@ class TransformerBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
         h = MultiHeadAttention(
             self.d_model, self.n_heads, self.attn_fn, dtype=self.dtype,
-            n_kv_heads=self.n_kv_heads, name="attn",
+            n_kv_heads=self.n_kv_heads, rope=self.rope, name="attn",
         )(h)
         h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
         x = x + h
@@ -132,6 +167,7 @@ class _StageBlocks(nn.Module):
     dtype: jnp.dtype = jnp.float32
     remat: bool = False
     n_kv_heads: int | None = None
+    rope: bool = False
 
     @nn.compact
     def __call__(self, h):
@@ -144,7 +180,7 @@ class _StageBlocks(nn.Module):
             h = block_cls(
                 self.d_model, self.n_heads, self.d_ff, 0.0, self.attn_fn,
                 dtype=self.dtype, n_kv_heads=self.n_kv_heads,
-                name=f"block_{i}",
+                rope=self.rope, name=f"block_{i}",
             )(h, False)
         return h
 
@@ -186,6 +222,7 @@ class WeatherTransformerPP(nn.Module):
     remat: bool = False
     compute_dtype: jnp.dtype = jnp.float32
     n_kv_heads: int | None = None
+    pos_embed: str = "sincos"
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -203,6 +240,7 @@ class WeatherTransformerPP(nn.Module):
             self.d_model, self.n_heads, self.d_ff,
             self.n_layers // self.n_stages, attn_fn, dtype=ct,
             remat=self.remat, n_kv_heads=self.n_kv_heads,
+            rope=self.pos_embed == "rope",
         )
 
         def init_stages(rng):
@@ -216,7 +254,10 @@ class WeatherTransformerPP(nn.Module):
 
         x = jnp.asarray(x, ct)
         h = TorchStyleDense(self.d_model, dtype=ct, name="in_proj")(x)
-        h = h + jnp.asarray(sincos_positions(self.seq_len, self.d_model), ct)
+        if self.pos_embed != "rope":  # rope rotates q/k inside attention
+            h = h + jnp.asarray(
+                sincos_positions(self.seq_len, self.d_model), ct
+            )
         h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
 
         mesh = self.mesh
@@ -278,6 +319,7 @@ class WeatherTransformer(nn.Module):
     remat: bool = False
     compute_dtype: jnp.dtype = jnp.float32
     n_kv_heads: int | None = None
+    pos_embed: str = "sincos"
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -291,9 +333,11 @@ class WeatherTransformer(nn.Module):
         attn_fn = self.attn_fn or make_attention_fn(None)
         x = jnp.asarray(x, self.compute_dtype)
         h = TorchStyleDense(self.d_model, dtype=self.compute_dtype, name="in_proj")(x)
-        h = h + jnp.asarray(
-            sincos_positions(self.seq_len, self.d_model), self.compute_dtype
-        )
+        if self.pos_embed != "rope":  # rope rotates q/k inside attention
+            h = h + jnp.asarray(
+                sincos_positions(self.seq_len, self.d_model),
+                self.compute_dtype,
+            )
         # Activation rematerialization: store only block BOUNDARIES on the
         # forward pass and recompute block internals in backward — the
         # HBM-for-FLOPs trade that unlocks long sequences (activation
@@ -314,6 +358,7 @@ class WeatherTransformer(nn.Module):
                 attn_fn,
                 dtype=self.compute_dtype,
                 n_kv_heads=self.n_kv_heads,
+                rope=self.pos_embed == "rope",
                 name=f"block_{i}",
             )(h, train)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(h)

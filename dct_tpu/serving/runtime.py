@@ -20,6 +20,7 @@ drift from the tested one.
 
 from __future__ import annotations
 
+import functools
 import math
 
 import numpy as np
@@ -108,16 +109,40 @@ def gru_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
     return h @ weights["head/kernel"] + weights["head/bias"]
 
 
+@functools.lru_cache(maxsize=8)
+def _rope_tables_np(s: int, half: int) -> tuple:
+    """Cached [S, Dh/2] cos/sin tables: a served L-layer transformer
+    would otherwise rebuild identical trig tables 2L times per request."""
+    inv = 1.0 / np.power(
+        10000.0, np.arange(half, dtype=np.float32) / half
+    )
+    ang = np.arange(s, dtype=np.float32)[:, None] * inv[None, :]
+    return np.cos(ang), np.sin(ang)
+
+
+def _rope_numpy(x: np.ndarray) -> np.ndarray:
+    """Rotate q/k [N, H, S, Dh] — numpy twin of
+    dct_tpu.models.transformer.apply_rope (rotate-half pairing)."""
+    half = x.shape[-1] // 2
+    cos, sin = _rope_tables_np(x.shape[-2], half)
+    x1, x2 = x[..., :half], x[..., half:]
+    return np.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+
+
 def _mha_numpy(weights: dict, prefix: str, h: np.ndarray,
                n_heads: int, causal: bool = False,
                window: int | None = None,
-               n_kv_heads: int | None = None) -> np.ndarray:
+               n_kv_heads: int | None = None,
+               rope: bool = False) -> np.ndarray:
     """Multi-head attention matching
     dct_tpu.models.transformer.MultiHeadAttention's fused-qkv layout
     (``causal`` masks positions > query, the causal family's path;
     ``window`` adds the sliding-window band; ``n_kv_heads`` selects the
-    GQA group-major layout — both must mirror training or the served
-    model silently differs from the trained one)."""
+    GQA group-major layout; ``rope`` rotates q/k — each must mirror
+    training or the served model silently differs from the trained
+    one)."""
     n, s, d_model = h.shape
     head_dim = d_model // n_heads
     g = n_kv_heads or n_heads
@@ -131,6 +156,9 @@ def _mha_numpy(weights: dict, prefix: str, h: np.ndarray,
     )  # [N, H, S, Dh]
     k = np.swapaxes(qkv[:, :, :, hg], 1, 2)  # [N, G, S, Dh]
     v = np.swapaxes(qkv[:, :, :, hg + 1], 1, 2)
+    if rope:
+        q = _rope_numpy(q)
+        k = _rope_numpy(k)
     if hg > 1:
         k = np.repeat(k, hg, axis=1)
         v = np.repeat(v, hg, axis=1)
@@ -155,13 +183,14 @@ def _dense_ffn_numpy(w: dict, pre: str, f: np.ndarray) -> np.ndarray:
 
 def _pre_ln_block(w: dict, pre: str, h: np.ndarray, n_heads: int, ffn,
                   causal: bool = False, window: int | None = None,
-                  n_kv_heads: int | None = None) -> np.ndarray:
+                  n_kv_heads: int | None = None,
+                  rope: bool = False) -> np.ndarray:
     """One pre-LN residual block (attention + FFN) — the single source of
     the block math for the transformer, MoE, causal, AND pipeline-stage
     serving paths (train/serve parity lives or dies here)."""
     a = _layernorm(h, w[f"{pre}/ln_attn/scale"], w[f"{pre}/ln_attn/bias"])
     h = h + _mha_numpy(
-        w, f"{pre}/attn", a, n_heads, causal, window, n_kv_heads
+        w, f"{pre}/attn", a, n_heads, causal, window, n_kv_heads, rope
     )
     f = _layernorm(h, w[f"{pre}/ln_ffn/scale"], w[f"{pre}/ln_ffn/bias"])
     return h + ffn(w, pre, f)
@@ -198,13 +227,16 @@ def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn, *,
     window = _w if _w > 0 and causal else None
     _g = int(meta.get("n_kv_heads", 0) or 0)
     n_kv = _g if _g > 0 else None
+    rope = str(meta.get("pos_embed", "sincos")) == "rope"
     s = x.shape[1]
 
     h = x @ weights["in_proj/kernel"] + weights["in_proj/bias"]
-    h = h + _sincos_positions(s, d_model)
+    if not rope:  # rope rotates q/k inside attention instead
+        h = h + _sincos_positions(s, d_model)
     for i in range(n_layers):
         h = _pre_ln_block(
-            weights, f"block_{i}", h, n_heads, ffn, causal, window, n_kv
+            weights, f"block_{i}", h, n_heads, ffn, causal, window, n_kv,
+            rope,
         )
     return _head_numpy(
         weights, h, per_position, horizon=int(meta.get("horizon", 1))
@@ -239,8 +271,10 @@ def transformer_pp_forward_numpy(
     layers_per_stage = n_layers // n_stages
     s = x.shape[1]
 
+    rope = str(meta.get("pos_embed", "sincos")) == "rope"
     h = x @ weights["in_proj/kernel"] + weights["in_proj/bias"]
-    h = h + _sincos_positions(s, d_model)
+    if not rope:
+        h = h + _sincos_positions(s, d_model)
     stage_keys = {
         k[len("pp_stages/"):]: v
         for k, v in weights.items()
@@ -253,7 +287,7 @@ def transformer_pp_forward_numpy(
         for i in range(layers_per_stage):
             h = _pre_ln_block(
                 w, f"block_{i}", h, n_heads, _dense_ffn_numpy,
-                n_kv_heads=n_kv,
+                n_kv_heads=n_kv, rope=rope,
             )
     return _head_numpy(weights, h, per_position=False)
 

@@ -313,3 +313,14 @@ def test_serving_normalizes_negative_window_and_kv_like_registry(rng):
     np.testing.assert_allclose(
         forward_numpy(weights, meta, x), jax_logits, atol=2e-5
     )
+
+
+def test_registry_normalizes_negative_kv_heads():
+    """The registry must treat n_kv_heads <= 0 as OFF ('> 0' rule, same
+    as attn_window and serving) — truthiness alone would pass -1 through
+    to a negative head count (4 % -1 == 0 in Python) and crash init
+    (code-review r4)."""
+    model = get_model(ModelConfig(**CFG, n_kv_heads=-1), input_dim=5)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
+    kern = params["params"]["block_0"]["attn"]["qkv_proj"]["kernel"]
+    assert kern.shape == (16, 3 * 16)  # classic MHA layout

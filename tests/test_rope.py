@@ -1,0 +1,150 @@
+"""Rotary position embeddings (DCT_POS_EMBED=rope): relative-position
+encoding applied to q/k inside attention — the standard long-context
+choice, composing with GQA, sliding windows, and both SP engines
+(rotation uses GLOBAL positions and runs before the seq-sharded op).
+Capability beyond the reference (which has no attention, SURVEY §2.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dct_tpu.config import MeshConfig, ModelConfig
+from dct_tpu.models.registry import get_model
+from dct_tpu.models.transformer import apply_rope, rope_tables
+from dct_tpu.parallel.mesh import make_mesh
+
+
+def test_rope_rotation_preserves_norm_and_inner_structure(rng):
+    """Rotations preserve norms, and q.k after RoPE depends on positions
+    only through their DIFFERENCE — the relative-position property that
+    is the whole point of rotary embeddings."""
+    dh, t = 8, 16
+    cos, sin = rope_tables(t, dh)
+    x = rng.standard_normal((1, 1, t, dh)).astype(np.float32)
+    xr = np.asarray(apply_rope(jnp.asarray(x), cos, sin))
+    np.testing.assert_allclose(
+        np.linalg.norm(xr, axis=-1), np.linalg.norm(x, axis=-1), atol=1e-5
+    )
+
+    # Same q/k VECTORS planted at positions (i, j) and (i+s, j+s) must
+    # produce the same score.
+    qv = rng.standard_normal(dh).astype(np.float32)
+    kv = rng.standard_normal(dh).astype(np.float32)
+
+    def score(qi, kj):
+        q = np.zeros((1, 1, t, dh), np.float32)
+        k = np.zeros((1, 1, t, dh), np.float32)
+        q[0, 0, qi] = qv
+        k[0, 0, kj] = kv
+        qr = np.asarray(apply_rope(jnp.asarray(q), cos, sin))
+        kr = np.asarray(apply_rope(jnp.asarray(k), cos, sin))
+        return float(qr[0, 0, qi] @ kr[0, 0, kj])
+
+    np.testing.assert_allclose(score(3, 1), score(9, 7), atol=1e-5)
+    np.testing.assert_allclose(score(5, 5), score(12, 12), atol=1e-5)
+    # Different separations give different scores (not position-blind).
+    assert abs(score(3, 1) - score(3, 2)) > 1e-6
+
+
+CFG = dict(
+    name="weather_transformer_causal", seq_len=8, d_model=16, n_heads=4,
+    n_layers=1, d_ff=32, dropout=0.0,
+)
+
+
+def test_rope_changes_logits_and_param_tree_is_unchanged(rng):
+    """RoPE adds no params (same tree as sincos) but must actually change
+    the function — and the additive sincos table must be OFF."""
+    x = rng.standard_normal((2, 8, 5)).astype(np.float32)
+    m_sincos = get_model(ModelConfig(**CFG), input_dim=5)
+    m_rope = get_model(ModelConfig(**CFG, pos_embed="rope"), input_dim=5)
+    p1 = m_sincos.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
+    p2 = m_rope.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
+    assert jax.tree_util.tree_structure(p1) == jax.tree_util.tree_structure(p2)
+    out1 = np.asarray(m_sincos.apply(p1, jnp.asarray(x)))
+    out2 = np.asarray(m_rope.apply(p1, jnp.asarray(x)))
+    assert np.abs(out1 - out2).max() > 1e-4
+
+
+@pytest.mark.parametrize("engine", ["ring", "a2a"])
+def test_rope_over_seq_mesh_matches_meshless(rng, engine, monkeypatch):
+    """RoPE composes with BOTH SP engines: rotation happens on global
+    positions before the seq-sharded op, so the sharded model equals the
+    meshless one (with GQA in the mix — the a2a engine exchanges the
+    rotated grouped KV heads)."""
+    monkeypatch.setenv("DCT_SP_ENGINE", engine)
+    x = rng.standard_normal((4, 8, 5)).astype(np.float32)
+    cfg = ModelConfig(**CFG, pos_embed="rope", n_kv_heads=2)
+    meshless = get_model(cfg, input_dim=5)
+    params = meshless.init(jax.random.PRNGKey(1), jnp.zeros((1, 8, 5)))
+    out_local = meshless.apply(params, jnp.asarray(x))
+    # a2a needs kv-heads-per-TP-shard (2/tp) to tile sp=2 -> tp=1 there.
+    tp = 2 if engine == "ring" else 1
+    mesh = make_mesh(
+        MeshConfig(data=2, model=tp, seq=2), allow_subset=True
+    )
+    sharded = get_model(cfg, input_dim=5, mesh=mesh)
+    out_sharded = sharded.apply(params, jnp.asarray(x))
+    np.testing.assert_allclose(
+        np.asarray(out_sharded), np.asarray(out_local), atol=1e-4
+    )
+
+
+def test_rope_trains_finite(processed_dir, tmp_path):
+    from dct_tpu.config import DataConfig, RunConfig, TrainConfig
+    from dct_tpu.tracking.client import LocalTracking
+    from dct_tpu.train.trainer import Trainer
+
+    cfg = RunConfig(
+        data=DataConfig(
+            processed_dir=processed_dir, models_dir=str(tmp_path / "m")
+        ),
+        model=ModelConfig(**CFG, pos_embed="rope"),
+        train=TrainConfig(epochs=1, batch_size=4, lr=1e-3, bf16_compute=False),
+    )
+    res = Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    assert np.isfinite(res.val_loss)
+
+
+@pytest.mark.parametrize(
+    "family",
+    ["weather_transformer", "weather_transformer_causal",
+     "weather_transformer_pp", "weather_moe"],
+)
+def test_rope_every_family_numpy_parity(family, rng):
+    """The numpy serving twin must mirror RoPE (and skip the additive
+    table) for every deployable transformer family."""
+    from dct_tpu.serving.runtime import forward_numpy
+    from dct_tpu.serving.score_gen import _flatten_params
+
+    cfg = ModelConfig(
+        name=family, seq_len=10, d_model=16, n_heads=4, n_layers=2,
+        d_ff=32, dropout=0.0, pos_embed="rope",
+    )
+    model = get_model(cfg, input_dim=5)
+    variables = model.init(jax.random.PRNGKey(5), jnp.zeros((1, 10, 5)))
+    params = {"params": variables["params"]}
+    meta = {
+        "model": family, "input_dim": 5, "seq_len": 10, "d_model": 16,
+        "n_heads": 4, "n_layers": 2, "d_ff": 32, "n_experts": 4,
+        "capacity_factor": 1.25, "n_stages": 2, "num_classes": 2,
+        "dropout": 0.0, "horizon": 1, "pos_embed": "rope",
+        "feature_names": [f"f{i}_norm" for i in range(5)],
+    }
+    x = rng.standard_normal((3, 10, 5)).astype(np.float32)
+    jax_logits = np.asarray(model.apply(params, jnp.asarray(x), train=False))
+    if family == "weather_transformer_causal":
+        jax_logits = jax_logits[:, -1]
+    np_logits = forward_numpy(_flatten_params(params["params"]), meta, x)
+    np.testing.assert_allclose(np_logits, jax_logits, atol=2e-5)
+
+
+def test_rope_rejects_odd_head_dim():
+    cfg = ModelConfig(
+        name="weather_transformer_causal", seq_len=8, d_model=12,
+        n_heads=4, n_layers=1, d_ff=16, pos_embed="rope",
+    )  # head_dim = 3
+    model = get_model(cfg, input_dim=5)
+    with pytest.raises(ValueError, match="even head_dim"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
