@@ -154,7 +154,9 @@ class ModelConfig:
         c.remat = _env("DCT_REMAT", c.remat, bool)
         c.attn_window = _env("DCT_ATTN_WINDOW", c.attn_window, int)
         c.n_kv_heads = _env("DCT_N_KV_HEADS", c.n_kv_heads, int)
-        c.pos_embed = _env("DCT_POS_EMBED", c.pos_embed, str)
+        c.pos_embed = _env(
+            "DCT_POS_EMBED", c.pos_embed, str
+        ).strip().lower()
         return c
 
 

@@ -49,6 +49,13 @@ def get_model(cfg: ModelConfig, *, input_dim: int | None = None, **kwargs) -> nn
         raise KeyError(
             f"Unknown model '{cfg.name}'. Registered: {sorted(MODEL_REGISTRY)}"
         )
+    if cfg.pos_embed not in ("sincos", "rope"):
+        # Loud, like the other attention knobs: a typo ("Rope", "rotary")
+        # would otherwise silently train with sincos while the operator
+        # believes RoPE is on — and serving would mirror the mistake.
+        raise ValueError(
+            f"pos_embed={cfg.pos_embed!r} must be 'sincos' or 'rope'"
+        )
     dim = cfg.input_dim if input_dim is None else input_dim
     if dim is None:
         raise ValueError("input_dim must be provided (inferred from data)")

@@ -213,6 +213,36 @@ def test_a2a_sp_across_processes(processed_dir, tmp_path):
 
 
 @pytest.mark.slow
+def test_windowed_gqa_rope_ring_across_processes(processed_dir, tmp_path):
+    """The round-4 attention stack COMPOSED across a real process
+    boundary: sliding window (truncated ring hops) x grouped KV shards
+    (GQA — the rotated ring payload stays at n_kv_heads) x rotary
+    embeddings, causal family over mesh seq=2 spanning 2 jax.distributed
+    CPU procs on the default (ring) engine. Loss must match the
+    single-process run (all three features are layout/structure, not
+    batch-dependent math)."""
+    def run(world_size, seq_par, models_sub, runs_sub):
+        return launch_training(
+            processed_dir, tmp_path, world_size=world_size, port=29545,
+            models_sub=models_sub, runs_sub=runs_sub,
+            env_overrides={
+                "DCT_MODEL": "weather_transformer_causal",
+                "DCT_N_LAYERS": "1",
+                "DCT_N_HEADS": "4",
+                "DCT_N_KV_HEADS": "2",
+                "DCT_POS_EMBED": "rope",
+                "DCT_ATTN_WINDOW": "3",
+                "DCT_MESH_SEQ": str(seq_par),
+                "DCT_MESH_MODEL": "1",
+            },
+        )
+
+    m_sp = run(2, 2, "m_wgr", "r_wgr")
+    m_ref = run(1, 1, "m_wgr_ref", "r_wgr_ref")
+    assert abs(m_sp["val_loss"] - m_ref["val_loss"]) < 1e-3, (m_sp, m_ref)
+
+
+@pytest.mark.slow
 def test_zero1_across_processes(processed_dir, tmp_path):
     """ZeRO-1 weight-update sharding SPANNING processes: the data axis
     covers 2 jax.distributed CPU procs, Adam moments shard P('data') —
