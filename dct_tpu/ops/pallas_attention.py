@@ -54,6 +54,16 @@ from dct_tpu.ops.attention import _NEG
 _STATS_LANES = 128
 
 
+def _kv_flat_row(bh, h: int, h_kv: int):
+    """Flat [b*h] Q row -> flat [b*h_kv] KV row under the group-major GQA
+    layout (q head g*group + j reads kv head g). The single source of the
+    head mapping for the forward AND backward kernels' index maps."""
+    if h == h_kv:
+        return bh
+    group = h // h_kv
+    return (bh // h) * h_kv + (bh % h) // group
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
                       n_kv: int, causal: bool, scale: float,
                       with_lse: bool, window: int | None = None,
@@ -180,11 +190,7 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
         scale=scale, with_lse=with_lse, window=window, q_offset=q_offset,
     )
     def kv_bh(bh):
-        # Flat [b*h] grid row -> flat [b*h_kv] KV row (group-major GQA
-        # layout: q head g*group + j reads kv head g).
-        if group == 1:
-            return bh
-        return (bh // h) * h_kv + (bh % h) // group
+        return _kv_flat_row(bh, h, h_kv)
 
     if causal:
         # Skipped blocks would otherwise still be DMA'd: clamp the index
@@ -277,9 +283,16 @@ def _bwd_block(q, k, v, do, lse, delta, scale, keep):
 def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                            dk_ref, dv_ref, dk_acc, dv_acc, *,
                            block_q: int, n_q: int, causal: bool,
-                           scale: float, window: int | None = None):
+                           scale: float, window: int | None = None,
+                           group: int = 1):
+    """dK/dV: grid (b*h_kv, kv blocks, group*n_q). The innermost sweep
+    runs the GROUP's q heads back to back (i = member*n_q + qi) into one
+    sequential accumulator — that is how GQA stays kernel-resident here:
+    a q-head-parallel grid would race grouped dk/dv. With group == 1 this
+    is exactly the classic per-head sweep."""
     j = pl.program_id(1)
     i = pl.program_id(2)
+    qi = i % n_q  # q block WITHIN the current group member's sweep
     bk = k_ref.shape[0]
 
     @pl.when(i == 0)
@@ -300,7 +313,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         keep = None
         if causal:
             bq = q.shape[0]
-            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             keep = q_pos >= k_pos
             if window is not None:
@@ -317,17 +330,17 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         )
 
     if causal:
-        # q block i contributes to kv block j iff its last query position
+        # q block qi contributes to kv block j iff its last query position
         # reaches the block's first key position (and, windowed, iff its
         # first query is still inside the band of the block's last key).
-        work = (i + 1) * block_q > j * bk
+        work = (qi + 1) * block_q > j * bk
         if window is not None:
-            work &= i * block_q - (j + 1) * bk + 1 < window
+            work &= qi * block_q - (j + 1) * bk + 1 < window
         pl.when(work)(_block)
     else:
         _block()
 
-    @pl.when(i == n_q - 1)
+    @pl.when(i == group * n_q - 1)
     def _finalize():
         dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
@@ -390,15 +403,25 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
     """FlashAttention-2-style backward: two Pallas kernels (dK/dV with the
     Q sweep innermost; dQ with the KV sweep innermost). The score matrix
     is recovered blockwise from the forward's lse — nothing O(T^2) ever
-    touches HBM in the backward either."""
+    touches HBM in the backward either.
+
+    GQA runs kernel-resident in BOTH directions: dQ reads the grouped KV
+    through divided index maps (like the forward), and dK/dV grids over
+    the b*h_kv KV heads with the group's q heads swept sequentially into
+    one accumulator (a q-head-parallel grid would race); dk/dv come back
+    at the grouped head count."""
     b, h, t, d = q.shape
+    h_kv = k.shape[1]
+    group = h // h_kv
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     n_q = t // block_q
     n_kv = t // block_k
     flat = lambda a: a.reshape(b * h, t, d)
-    qf, kf, vf, of, dof = map(flat, (q, k, v, o, do))
+    qf, of, dof = map(flat, (q, o, do))
+    kf = k.reshape(b * h_kv, t, d)
+    vf = v.reshape(b * h_kv, t, d)
     # Forward lse [B,H,T] -> lane-broadcast [bh, T, LANES] (Mosaic wants
     # >=2-D vector tiles; lane 0 is read back in-kernel).
     lsef = jnp.broadcast_to(
@@ -412,18 +435,23 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
 
     # Same DMA-elision trick as the forward: clamp skipped blocks'
     # addresses onto a needed (resident) block so their fetch is elided.
-    # dK/dV sweeps q blocks i per kv block j: causal needs i >= j*bk/bq,
-    # a window needs i*bq <= window + (j+1)*bk - 2.
-    if causal:
-        def q_index(bh, j, i):
-            ii = jnp.maximum(i, (j * block_k) // block_q)
+    # dK/dV sweeps i = member*n_q + qi per kv block j (grid row is a KV
+    # head): causal needs qi >= j*bk/bq, a window needs
+    # qi*bq <= window + (j+1)*bk - 2; the flat q row is the member's head.
+    def q_row(bh, i):
+        if group == 1:
+            return bh
+        return (bh // h_kv) * h + (bh % h_kv) * group + i // n_q
+
+    def q_index(bh, j, i):
+        qi = i % n_q
+        if causal:
+            qi = jnp.maximum(qi, (j * block_k) // block_q)
             if window is not None:
                 i_last = (window + (j + 1) * block_k - 2) // block_q
-                ii = jnp.minimum(ii, jnp.maximum(i_last, 0))
-            return (bh, ii, 0)
-    else:
-        def q_index(bh, j, i):
-            return (bh, i, 0)
+                qi = jnp.minimum(qi, jnp.maximum(i_last, 0))
+        return (q_row(bh, i), qi, 0)
+
     q_spec = pl.BlockSpec((None, block_q, d), q_index)
     kv_spec = pl.BlockSpec((None, block_k, d), lambda bh, j, i: (bh, j, 0))
     lse_spec = pl.BlockSpec((None, block_q, _STATS_LANES), q_index)
@@ -437,14 +465,14 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkdv_kernel, block_q=block_q, n_q=n_q,
-            causal=causal, scale=scale, window=window,
+            causal=causal, scale=scale, window=window, group=group,
         ),
-        grid=(b * h, n_kv, n_q),
+        grid=(b * h_kv, n_kv, group * n_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
         out_specs=[kv_spec, kv_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), k.dtype, **vma_kw),
-            jax.ShapeDtypeStruct((b * h, t, d), v.dtype, **vma_kw),
+            jax.ShapeDtypeStruct((b * h_kv, t, d), k.dtype, **vma_kw),
+            jax.ShapeDtypeStruct((b * h_kv, t, d), v.dtype, **vma_kw),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),  # dk accumulator
@@ -454,8 +482,11 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
         interpret=interpret,
     )(qf, kf, vf, of, dof, lsef)
 
+    kv_row = lambda bh: _kv_flat_row(bh, h, h_kv)
+
     # dQ sweeps kv blocks j per q block i — same clamp as the forward's
-    # kv_index (above-diagonal down, behind-the-band up).
+    # kv_index (above-diagonal down, behind-the-band up), KV rows divided
+    # to the grouped head.
     if causal:
         def kv_index2(bh, i, j):
             jj = jnp.minimum(j, ((i + 1) * block_q - 1) // block_k)
@@ -464,10 +495,10 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
                     0, (i * block_q - window + 1) // block_k
                 )
                 jj = jnp.maximum(jj, jnp.minimum(j_first, n_kv - 1))
-            return (bh, jj, 0)
+            return (kv_row(bh), jj, 0)
     else:
         def kv_index2(bh, i, j):
-            return (bh, j, 0)
+            return (kv_row(bh), j, 0)
     q_spec2 = pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0))
     kv_spec2 = pl.BlockSpec((None, block_k, d), kv_index2)
     lse_spec2 = pl.BlockSpec(
@@ -488,7 +519,7 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
     )(qf, kf, vf, of, dof, lsef)
 
     unflat = lambda a: a.reshape(b, h, t, d)
-    return unflat(dq), unflat(dk), unflat(dv)
+    return unflat(dq), dk.reshape(b, h_kv, t, d), dv.reshape(b, h_kv, t, d)
 
 
 @functools.partial(
@@ -518,12 +549,7 @@ def _vjp_fwd(q, k, v, block_q, block_k, causal, scale, interpret, window):
 def _vjp_bwd(block_q, block_k, causal, scale, interpret, window, res, g):
     q, k, v, o, lse = res
     rectangular = q.shape[-2] != k.shape[-2]  # bwd kernels assume square
-    # GQA backward goes through the remat escape: the dK/dV kernel's grid
-    # is parallel over q heads, so grouped KV would race on the shared
-    # dk/dv accumulators; AD through the blockwise path's expand_kv
-    # broadcast performs the group-sum reduction instead.
-    grouped = q.shape[1] != k.shape[1]
-    if rectangular or grouped or os.environ.get(
+    if rectangular or os.environ.get(
         "DCT_FLASH_BWD", "kernel"
     ).strip().lower() == "remat":
         # Escape hatch: differentiate the numerically-identical blockwise

@@ -107,21 +107,28 @@ def test_grouped_flash_matches_oracle(grouped_qkv, causal, window):
     np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
 
 
-def test_grouped_flash_grad_matches_dense(grouped_qkv):
-    """GQA backward routes through the remat escape (the dK/dV kernel's
-    q-head-parallel grid would race on grouped accumulators); AD through
-    expand_kv's broadcast performs the group-sum — must equal dense AD."""
+@pytest.mark.parametrize("bwd_mode", ["kernel", "remat"])
+@pytest.mark.parametrize("window", [None, 24])
+def test_grouped_flash_grad_matches_dense(grouped_qkv, bwd_mode, window,
+                                          monkeypatch):
+    """GQA backward, both modes: the kernel path grids dK/dV over the KV
+    heads and sweeps the group's q heads sequentially into one
+    accumulator (no race — a q-head-parallel grid would have one); the
+    remat escape gets the group-sum from AD through expand_kv's
+    broadcast. Both must equal dense AD, composed with the window."""
     from dct_tpu.ops.pallas_attention import flash_attention
 
+    monkeypatch.setenv("DCT_FLASH_BWD", bwd_mode)
     q, k, v = grouped_qkv
 
     def loss_flash(q, k, v):
         return flash_attention(
-            q, k, v, block_q=16, block_k=16, causal=True, interpret=True
+            q, k, v, block_q=16, block_k=16, causal=True, interpret=True,
+            window=window,
         ).sum()
 
     def loss_dense(q, k, v):
-        return dense_attention(q, k, v, causal=True).sum()
+        return dense_attention(q, k, v, causal=True, window=window).sum()
 
     g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)

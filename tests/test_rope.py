@@ -150,17 +150,11 @@ def test_rope_rejects_odd_head_dim():
         model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 5)))
 
 
-def test_unknown_pos_embed_rejected_loudly():
+def test_unknown_pos_embed_rejected_loudly(monkeypatch):
     """A typo ("Rope", "rotary") must raise, not silently train sincos
     while the operator believes RoPE is on (code-review r4); the env
     reader also normalizes case/whitespace."""
-    import dct_tpu.config as config
-
     with pytest.raises(ValueError, match="pos_embed"):
         get_model(ModelConfig(**CFG, pos_embed="rotary"), input_dim=5)
-    import os
-    os.environ["DCT_POS_EMBED"] = " ROPE "
-    try:
-        assert ModelConfig.from_env().pos_embed == "rope"
-    finally:
-        del os.environ["DCT_POS_EMBED"]
+    monkeypatch.setenv("DCT_POS_EMBED", " ROPE ")
+    assert ModelConfig.from_env().pos_embed == "rope"
