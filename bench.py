@@ -193,6 +193,21 @@ def _chip_peak_tflops() -> float | None:
     return peak / 1e12 if peak else None
 
 
+# Set by main(): sections stream per-leg values into the live record via
+# _leg() the moment they are measured, so a relay death LATER in a section
+# cannot lose legs that already ran (the r4 on-chip run lost ~35 min of
+# scanned-leg measurements exactly this way — the relay died during the
+# causal_blockwise compile and the section's exception discarded them).
+_LIVE_RECORD: dict | None = None
+
+
+def _leg(key: str, value) -> None:
+    print(f"[bench] leg {key}={value}", file=sys.stderr, flush=True)
+    if _LIVE_RECORD is not None:
+        _LIVE_RECORD.setdefault("scaled_legs", {})[key] = value
+        _flush_partial(_LIVE_RECORD)
+
+
 def _time_step(step_fn, state, args, *, n: int = 8) -> float:
     """Seconds per optimizer step, post-compilation."""
     import jax
@@ -306,6 +321,7 @@ def bench_scaled_transformer() -> dict:
     t_blockwise = _time_scanned_step(
         epoch_step, state, stacks, scan_len=scan_len
     )
+    _leg("attn_blockwise_ms", round(t_blockwise * 1e3, 2))
 
     t_flash = None
     state_fl = None
@@ -338,6 +354,7 @@ def bench_scaled_transformer() -> dict:
             t_flash = _time_scanned_step(
                 epoch_step, state_fl, stacks, scan_len=scan_len
             )
+            _leg("attn_flash_ms", round(t_flash * 1e3, 2))
         except Exception as e:  # noqa: BLE001
             state_fl = None
             causal["attn_flash_error"] = f"{type(e).__name__}: {e}"
@@ -418,6 +435,7 @@ def bench_scaled_transformer() -> dict:
                 "gqa_ms": round(t_gqa * 1e3, 3),
                 "speedup": round(t_mha / t_gqa, 2),
             }
+            _leg("attn_gqa", causal["attn_gqa"])
         except Exception as e:  # noqa: BLE001
             causal["attn_gqa"] = {"error": f"{type(e).__name__}: {e}"}
 
@@ -435,6 +453,7 @@ def bench_scaled_transformer() -> dict:
                         epoch_step, st, stacks, scan_len=scan_len
                     ) * 1e3, 2,
                 )
+                _leg(f"attn_{name}_ms", causal[f"attn_{name}_ms"])
             except Exception as e:  # noqa: BLE001
                 causal[f"attn_{name}_error"] = (
                     f"{type(e).__name__}: {e}"
@@ -455,7 +474,15 @@ def bench_scaled_transformer() -> dict:
         state_fl if (t_flash is not None and t_flash <= t_blockwise) else state
     )
     step = make_train_step(donate=False)
-    t_dispatch = _time_step(step, best_state, (gx, gy, gw))
+    try:
+        t_dispatch = _time_step(step, best_state, (gx, gy, gw))
+    except Exception as e:  # noqa: BLE001 — a relay death here must not
+        # discard the scanned legs above (they carry the MFU number)
+        t_dispatch = None
+        print(
+            f"[bench] dispatch-timing leg FAILED ({type(e).__name__}: {e})",
+            file=sys.stderr, flush=True,
+        )
     flops = transformer_train_flops(
         batch=batch, input_dim=input_dim, **scaled
     )
@@ -466,7 +493,9 @@ def bench_scaled_transformer() -> dict:
             "scan_len": scan_len, "remat": remat,
         },
         "step_time_ms": round(t_best * 1e3, 2),
-        "step_time_dispatch_ms": round(t_dispatch * 1e3, 2),
+        "step_time_dispatch_ms": (
+            round(t_dispatch * 1e3, 2) if t_dispatch is not None else None
+        ),
         "flops_per_step": flops,
         "tflops_per_sec": round(flops / t_best / 1e12, 2),
         "attn_blockwise_ms": round(t_blockwise * 1e3, 2),
@@ -536,6 +565,7 @@ def bench_scaled_moe() -> dict:
             state_sorted = shard_state_with_rules(state_sorted, mesh)
         st = state_sorted.replace(apply_fn=model.apply)
         times[engine] = _time_step(step, st, (gx, gy, gw), n=5)
+        _leg(f"moe_{engine}_ms", round(times[engine] * 1e3, 2))
 
     return {
         "config": {**size, "batch": batch, "dtype": "bfloat16"},
@@ -762,6 +792,8 @@ def main():
         "unit": "samples/sec/chip",
         "mfu": None,
     }
+    global _LIVE_RECORD
+    _LIVE_RECORD = record
     # Overwrite any stale partial from a previous run BEFORE the first
     # section: an early crash must leave this run's (empty) record, not a
     # prior run's numbers masquerading as this run's partials.
@@ -834,6 +866,10 @@ def main():
                 "scaled_transformer", bench_scaled_transformer
             )
             record["scaled"] = scaled
+            if isinstance(scaled, dict) and "error" not in scaled:
+                # the streamed legs were a crash hedge; the full dict
+                # supersedes them
+                record.pop("scaled_legs", None)
             # null mfu = peak unknown (CPU fallback rig) or the section
             # deadline-skipped, so absence can't read as "not measured".
             record["mfu"] = scaled.get("mfu")
@@ -841,6 +877,13 @@ def main():
 
         if not (skip_scaled or _over_deadline("scaled_moe")):
             record["moe"] = _optional("scaled_moe", bench_scaled_moe)
+            if isinstance(record["moe"], dict) and "error" not in record["moe"]:
+                legs = record.get("scaled_legs")
+                if legs:
+                    for k in [k for k in legs if k.startswith("moe_")]:
+                        legs.pop(k)
+                    if not legs:
+                        record.pop("scaled_legs", None)
             _flush_partial(record)
 
         if not _over_deadline("serving"):
