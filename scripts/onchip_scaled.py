@@ -26,6 +26,14 @@ ensure_live_backend()
 
 import bench  # noqa: E402
 
+# A tuning sweep has no timeout-kill risk to mitigate: disable bench's
+# deadline gates unless the caller explicitly sets one, and restart the
+# clock from here either way (bench read _BENCH_T0 at import).
+import time as _time  # noqa: E402
+
+bench._DEADLINE = float(os.environ.get("DCT_BENCH_DEADLINE", "0"))
+bench._BENCH_T0 = _time.perf_counter()
+
 
 def main() -> None:
     scaled = bench._section("scaled_transformer", bench.bench_scaled_transformer)
