@@ -143,23 +143,49 @@ def bench_tpu(data) -> tuple[float, float]:
     return samples / dt / n_chips, float(jax.device_get(losses)[-1])
 
 
-def bench_trainer_loop(data, tmp: str) -> float:
+def bench_trainer_loop(data, tmp: str, epoch_chunk: int = 1) -> float:
     """The PRODUCT number: Trainer.fit() at parity config — eval,
     best/last checkpointing, resume-state saves, logging, per-epoch
-    dispatch all included. Returns samples/sec/chip."""
+    dispatch all included. Returns samples/sec/chip.
+
+    ``epoch_chunk`` > 1 exercises the multi-epoch-per-dispatch path
+    (TrainConfig.epoch_chunk): on a slow control plane the per-epoch
+    host round trip dominates this number, and the chunked leg
+    quantifies how much of the gap to the fused bench_tpu figure that
+    round trip explains."""
     from dct_tpu.config import (
         DataConfig, RunConfig, TrackingConfig, TrainConfig,
     )
     from dct_tpu.tracking.client import LocalTracking
     from dct_tpu.train.trainer import Trainer
 
+    # Chunked leg: TWO uniform spans of K epochs — span 0 absorbs the
+    # XLA compile, span 1 is the steady measurement. A remainder span
+    # (K' < K) would compile a SECOND program inside the steady window
+    # and measure compilation, not throughput.
+    epochs = (1 + TIMED_EPOCHS) if epoch_chunk == 1 else 2 * epoch_chunk
     cfg = RunConfig(
-        data=DataConfig(models_dir=os.path.join(tmp, "bench_models")),
-        train=TrainConfig(epochs=1 + TIMED_EPOCHS, batch_size=BATCH),
+        data=DataConfig(
+            # The serving section reads bench_models/ (the chunk=1 leg's
+            # artifacts); the chunked leg writes beside it.
+            models_dir=os.path.join(
+                tmp,
+                "bench_models" if epoch_chunk == 1
+                else f"bench_models_ec{epoch_chunk}",
+            )
+        ),
+        train=TrainConfig(
+            epochs=epochs, batch_size=BATCH, epoch_chunk=epoch_chunk,
+        ),
         tracking=TrackingConfig(experiment="bench"),
     )
     tracker = LocalTracking(
-        root=os.path.join(tmp, "bench_runs"), experiment="bench"
+        root=os.path.join(
+            tmp,
+            "bench_runs" if epoch_chunk == 1
+            else f"bench_runs_ec{epoch_chunk}",
+        ),
+        experiment="bench",
     )
     trainer = Trainer(cfg, tracker=tracker)
     result = trainer.fit(data)
@@ -900,6 +926,25 @@ def main():
                     file=sys.stderr, flush=True,
                 )
                 return {"error": f"{type(e).__name__}: {e}"}
+
+        # Same product loop with all timed epochs in ONE dispatch
+        # (TrainConfig.epoch_chunk): the delta to the leg above is the
+        # per-epoch control-plane round trip, the dominant term on a
+        # tunneled chip at the parity batch size.
+        if not _over_deadline("trainer_loop_chunked"):
+            # K >= 2 always: at DCT_BENCH_EPOCHS=1 a chunk of 1 would
+            # silently re-measure the unchunked path into the same dirs.
+            chunked = _optional(
+                "trainer_loop_chunked", bench_trainer_loop, data, tmp,
+                max(2, TIMED_EPOCHS),
+            )
+            if isinstance(chunked, float):
+                record["trainer_loop_chunked_samples_per_sec_per_chip"] = (
+                    round(chunked, 1)
+                )
+            else:
+                record["trainer_loop_chunked_samples_per_sec_per_chip"] = None
+            _flush_partial(record)
 
         if not (skip_scaled or _over_deadline("scaled_transformer")):
             scaled = _optional(

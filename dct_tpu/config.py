@@ -214,6 +214,15 @@ class TrainConfig:
     # EarlyStopping with the ModelCheckpoint the reference configures).
     early_stop_patience: int = 0
     early_stop_min_delta: float = 0.0
+    # Epochs fused into ONE XLA dispatch (scan path only; 1 = parity).
+    # On a slow control plane each epoch costs a host round trip that can
+    # dwarf the compute at parity batch sizes; chunking K epochs amortizes
+    # it to 1/K. Trade-offs, all chunk-granular: deploy checkpoints and
+    # resume snapshots land at chunk boundaries (per-epoch metrics are
+    # still returned and logged), early stopping is evaluated per epoch
+    # but can only take effect between chunks, and K epochs of batches
+    # are staged in HBM at once.
+    epoch_chunk: int = 1
 
     @classmethod
     def from_env(cls) -> "TrainConfig":
@@ -243,6 +252,7 @@ class TrainConfig:
         c.early_stop_min_delta = _env(
             "DCT_EARLY_STOP_MIN_DELTA", c.early_stop_min_delta, float
         )
+        c.epoch_chunk = _env("DCT_EPOCH_CHUNK", c.epoch_chunk, int)
         return c
 
 

@@ -228,6 +228,38 @@ def make_epoch_train_eval_step(donate: bool = True, accum_steps: int = 1):
     return jax.jit(epoch_fused, donate_argnums=(0,) if donate else ())
 
 
+def make_multi_epoch_train_eval_step(donate: bool = True,
+                                     accum_steps: int = 1):
+    """K training epochs, each followed by a full validation pass, as ONE
+    XLA program — an outer ``lax.scan`` over epochs of the fused
+    epoch-train+eval body. Numerically identical to K sequential calls of
+    make_epoch_train_eval_step (same scan order, same rng folding via the
+    step counter), but one host dispatch where K would each pay a control-
+    plane round trip — the throughput lever behind
+    ``TrainConfig.epoch_chunk`` on tunneled/slow-dispatch rigs.
+
+    Args are the per-epoch stacks with a leading epoch dim:
+    xs/ys/ws: [K, S, B, ...]; the validation stacks [S_v, B, ...] are
+    shared (fixed order) across epochs and NOT donated.
+
+    Returns (state, losses[K, S], val_sums[K, 6]).
+    """
+
+    def multi_epoch(state: TrainState, xs, ys, ws, vxs, vys, vws):
+        def epoch_body(st, stacks):
+            exs, eys, ews = stacks
+            st, losses = _epoch_train_scan(st, exs, eys, ews, accum_steps)
+            sums = _epoch_eval_scan(st, vxs, vys, vws)
+            return st, (losses, jnp.stack(sums))
+
+        state, (losses, val_sums) = jax.lax.scan(
+            epoch_body, state, (xs, ys, ws)
+        )
+        return state, losses, val_sums
+
+    return jax.jit(multi_epoch, donate_argnums=(0,) if donate else ())
+
+
 def make_eval_step():
     """Per-batch jitted eval step returning running-sum metrics."""
     return jax.jit(_eval_body)

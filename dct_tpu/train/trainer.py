@@ -43,6 +43,7 @@ from dct_tpu.parallel.distributed import is_coordinator
 from dct_tpu.parallel.mesh import (
     make_global_batch,
     make_global_epoch,
+    make_global_epoch_chunk,
     make_mesh,
     process_data_block,
 )
@@ -378,24 +379,53 @@ class Trainer:
         es_best: float | None = None
         es_stale = 0
 
-        # Epoch-ahead input pipeline (scan path): epoch e+1's host batch
-        # assembly + H2D staging runs on a worker thread WHILE epoch e
-        # computes on device — shuffle/stack/device_put leave the step
-        # critical path (device_put is async; the transfer itself also
-        # overlaps compute). One epoch deep: bounded host memory, and the
-        # device queue never sees stale epochs after an early stop.
-        def _assemble_epoch(e: int):
+        # Epoch chunking (scan path): fuse K epochs into one dispatch.
+        # On a slow control plane every epoch pays a host round trip that
+        # can dwarf the compute at parity batch sizes; chunking amortizes
+        # it to 1/K. Per-epoch metrics are preserved (the fused program
+        # returns losses[K, S] and val_sums[K, 6]); checkpoints, resume
+        # snapshots, and early-stop effects move to chunk boundaries
+        # (config.TrainConfig.epoch_chunk documents the trade).
+        chunk = max(1, cfg.train.epoch_chunk) if use_scan else 1
+        multi_fused = None
+        if chunk > 1:
+            from dct_tpu.train.steps import make_multi_epoch_train_eval_step
+
+            multi_fused = make_multi_epoch_train_eval_step(accum_steps=accum)
+
+        # Epoch-ahead input pipeline (scan path): the next span's host
+        # batch assembly + H2D staging runs on a worker thread WHILE the
+        # current span computes on device — shuffle/stack/device_put leave
+        # the step critical path (device_put is async; the transfer itself
+        # also overlaps compute). One span deep: bounded host memory, and
+        # the device queue never sees stale epochs after an early stop.
+        def _assemble_span(e0: int, k: int):
             # Annotated HERE so the profiler span follows the work onto
             # the prefetch thread (the consumer side only joins a future).
             with annotate("host_epoch_assembly"):
-                xs, ys, ws = self._stack_epoch(train_loader, e)
-                if accum > 1:
-                    # Whole accumulation groups only; the ragged tail
-                    # (< accum batches) is dropped, like drop_last on the
-                    # group granularity.
-                    s_eff = (xs.shape[0] // accum) * accum
-                    xs, ys, ws = xs[:s_eff], ys[:s_eff], ws[:s_eff]
-                return xs.shape[0], make_global_epoch(self.mesh, xs, ys, ws)
+                per = []
+                for e in range(e0, e0 + k):
+                    xs, ys, ws = self._stack_epoch(train_loader, e)
+                    if accum > 1:
+                        # Whole accumulation groups only; the ragged tail
+                        # (< accum batches) is dropped, like drop_last on
+                        # the group granularity.
+                        s_eff = (xs.shape[0] // accum) * accum
+                        xs, ys, ws = xs[:s_eff], ys[:s_eff], ws[:s_eff]
+                    per.append((xs, ys, ws))
+                if k == 1 and multi_fused is None:
+                    xs, ys, ws = per[0]
+                    return xs.shape[0], make_global_epoch(
+                        self.mesh, xs, ys, ws
+                    )
+                import numpy as _np
+
+                kxs = _np.stack([p[0] for p in per])
+                kys = _np.stack([p[1] for p in per])
+                kws = _np.stack([p[2] for p in per])
+                return kxs.shape[1], make_global_epoch_chunk(
+                    self.mesh, kxs, kys, kws
+                )
 
         prefetch_pool = None
         prefetched = None
@@ -406,54 +436,89 @@ class Trainer:
                 max_workers=1, thread_name_prefix="epoch-prefetch"
             )
         try:
-            for epoch in range(start_epoch, target_epochs):
-                profiler.maybe_start(epoch)
+            epoch = start_epoch
+            while epoch < target_epochs:
+                k = min(chunk, target_epochs - epoch) if use_scan else 1
+                profiler.maybe_start_span(epoch, k)
                 timer.start()
                 if use_scan:
                     if prefetched is not None:
-                        n_steps, (gxs, gys, gws) = prefetched.result()
+                        n_steps, globs = prefetched.result()
                     else:
-                        n_steps, (gxs, gys, gws) = _assemble_epoch(epoch)
-                    # Train epoch + full eval in ONE dispatch (the saved
-                    # host round trip is most of an epoch's wall time on
-                    # a slow control plane at the parity batch size).
-                    state, losses, val_sums = epoch_fused(
-                        state, gxs, gys, gws, *val_global
-                    )
-                    # Prefetch one epoch ahead UNLESS early stopping is
-                    # armed and already stale: the next epoch may never
-                    # run, and a speculative full-epoch H2D would sit in
-                    # HBM through checkpointing/upload for nothing.
+                        n_steps, globs = _assemble_span(epoch, k)
+                    # Train span + full eval in ONE dispatch (the saved
+                    # host round trips are most of an epoch's wall time
+                    # on a slow control plane at the parity batch size).
+                    if multi_fused is not None:
+                        state, losses, val_sums = multi_fused(
+                            state, *globs, *val_global
+                        )
+                    else:
+                        state, losses, val_sums = epoch_fused(
+                            state, *globs, *val_global
+                        )
+                    # Prefetch the next span UNLESS early stopping is
+                    # armed and could trigger within this one: the next
+                    # span may never run, and a speculative multi-epoch
+                    # H2D would sit in HBM through checkpointing/upload
+                    # for nothing.
                     speculative_ok = not (
                         cfg.train.early_stop_patience > 0
-                        and es_stale + 1 >= cfg.train.early_stop_patience
+                        and es_stale + k >= cfg.train.early_stop_patience
                     )
-                    if epoch + 1 < target_epochs and speculative_ok:
+                    nxt = epoch + k
+                    if nxt < target_epochs and speculative_ok:
                         prefetched = prefetch_pool.submit(
-                            _assemble_epoch, epoch + 1
+                            _assemble_span, nxt,
+                            min(chunk, target_epochs - nxt),
                         )
                     else:
                         prefetched = None
                     jax.block_until_ready(state.params)
-                    # The fused program runs the validation pass inside
-                    # the timed window; credit those forwards to MFU.
+                    # The fused program runs the validation pass(es)
+                    # inside the timed window; credit them to MFU.
                     epoch_stats = timer.stop(
-                        epoch, n_steps * global_batch,
-                        eval_samples=len(val_idx),
+                        epoch, k * n_steps * global_batch,
+                        eval_samples=k * len(val_idx),
                     )
-                    losses_host = jax.device_get(losses)
-                    n_updates = len(losses_host)
-                    for i in range(n_updates):
+                    import numpy as _np
+
+                    if multi_fused is not None:
+                        # [K, S] losses / [K, 6] eval sums
+                        losses_host = _np.asarray(jax.device_get(losses))
+                        val_host = _np.asarray(jax.device_get(val_sums))
+                    else:  # [S] / 6-tuple — the k == 1 parity layout
+                        losses_host = _np.asarray(
+                            jax.device_get(losses)
+                        )[None]
+                        val_host = _np.asarray(
+                            [float(v) for v in jax.device_get(val_sums)]
+                        )[None]
+                    flat = losses_host.reshape(-1)
+                    for i in range(flat.size):
                         if (global_step + i + 1) % cfg.train.log_every_n_steps == 0:
                             self.tracker.log_metrics(
-                                {"train_loss": float(losses_host[i])},
+                                {"train_loss": float(flat[i])},
                                 step=global_step + i + 1,
                             )
-                    global_step += n_updates
+                    global_step += flat.size
                     # Reference parity: the logged train_loss is the
                     # EPOCH-AGGREGATED mean (Lightning epoch aggregation of
-                    # jobs/train_lightning_ddp.py:70), not the last batch.
-                    epoch_loss = float(losses_host.mean()) if n_steps else None
+                    # jobs/train_lightning_ddp.py:70), not the last batch —
+                    # one (train_loss, val_loss, val_acc, counts) entry per
+                    # epoch in the span.
+                    sub_epochs = []
+                    for i in range(k):
+                        ls, accs, c, tp, fp, fn = (
+                            float(v) for v in val_host[i]
+                        )
+                        sub_epochs.append((
+                            float(losses_host[i].mean())
+                            if losses_host[i].size else None,
+                            ls / c if c else float("nan"),
+                            accs / c if c else float("nan"),
+                            (tp, fp, fn),
+                        ))
                 else:
                     import numpy as _np
 
@@ -495,49 +560,77 @@ class Trainer:
                     epoch_stats = timer.stop(epoch, n_steps * global_batch)
                     epoch_loss = loss_sum / n_updates if n_updates else None
 
-                if use_scan:
-                    ls, accs, c, tp, fp, fn = (
-                        float(v) for v in jax.device_get(val_sums)
-                    )
-                    val_loss = ls / c if c else float("nan")
-                    val_acc = accs / c if c else float("nan")
-                else:
+                if not use_scan:
                     val_loss, val_acc, (tp, fp, fn) = self._evaluate(
                         state, eval_step, val_loader
                     )
-                epoch_rec = {
-                    "epoch": epoch,
-                    "train_loss": epoch_loss if epoch_loss is not None else float("nan"),
-                    "val_loss": val_loss,
-                    "val_acc": val_acc,
-                }
-                epoch_metrics = {
-                    "train_loss_epoch": epoch_rec["train_loss"],
-                    "val_loss": val_loss,
-                    "val_acc": val_acc,
-                    "epoch_time": epoch_stats.seconds,
-                    "samples_per_sec": epoch_stats.samples_per_sec,
-                    "samples_per_sec_per_chip": epoch_stats.samples_per_sec_per_chip,
-                }
-                if cfg.model.num_classes == 2:
-                    # Positive class 1 = "rain" (the reference's label
-                    # encoding, jobs/preprocess.py:23-25). One-vs-rest
-                    # counts would mislead for num_classes > 2, so the
-                    # P/R/F1 surface is binary-only.
-                    val_precision, val_recall, val_f1 = precision_recall_f1(
-                        tp, fp, fn
+                    sub_epochs = [
+                        (epoch_loss, val_loss, val_acc, (tp, fp, fn))
+                    ]
+                # Per-epoch bookkeeping for every epoch in the span; with
+                # k > 1 the chunk is the dispatch unit, so wall time is
+                # span-amortized and the metric step is reconstructed per
+                # epoch from the update count.
+                span_updates = flat.size if use_scan else 0
+                per_epoch_updates = span_updates // k if k else 0
+                last_rec = None
+                stop_early = False
+                for i, (epoch_loss, val_loss, val_acc, (tp, fp, fn)) in (
+                    enumerate(sub_epochs)
+                ):
+                    epoch_rec = {
+                        "epoch": epoch + i,
+                        "train_loss": epoch_loss if epoch_loss is not None else float("nan"),
+                        "val_loss": val_loss,
+                        "val_acc": val_acc,
+                    }
+                    epoch_metrics = {
+                        "train_loss_epoch": epoch_rec["train_loss"],
+                        "val_loss": val_loss,
+                        "val_acc": val_acc,
+                        "epoch_time": epoch_stats.seconds / k,
+                        "samples_per_sec": epoch_stats.samples_per_sec,
+                        "samples_per_sec_per_chip": epoch_stats.samples_per_sec_per_chip,
+                    }
+                    if cfg.model.num_classes == 2:
+                        # Positive class 1 = "rain" (the reference's label
+                        # encoding, jobs/preprocess.py:23-25). One-vs-rest
+                        # counts would mislead for num_classes > 2, so the
+                        # P/R/F1 surface is binary-only.
+                        val_precision, val_recall, val_f1 = precision_recall_f1(
+                            tp, fp, fn
+                        )
+                        epoch_rec["val_f1"] = val_f1
+                        epoch_metrics.update(
+                            val_precision=val_precision,
+                            val_recall=val_recall,
+                            val_f1=val_f1,
+                        )
+                    history.append(epoch_rec)
+                    if epoch_stats.mfu is not None:
+                        epoch_metrics["mfu"] = epoch_stats.mfu
+                    metric_step = (
+                        global_step - span_updates
+                        + (i + 1) * per_epoch_updates
+                        if use_scan else global_step
                     )
-                    epoch_rec["val_f1"] = val_f1
-                    epoch_metrics.update(
-                        val_precision=val_precision,
-                        val_recall=val_recall,
-                        val_f1=val_f1,
-                    )
-                history.append(epoch_rec)
-                if epoch_stats.mfu is not None:
-                    epoch_metrics["mfu"] = epoch_stats.mfu
-                self.tracker.log_metrics(epoch_metrics, step=global_step)
-                profiler.maybe_stop(epoch)
+                    self.tracker.log_metrics(epoch_metrics, step=metric_step)
+                    last_rec = epoch_rec
+                    # Early stopping (monitor val_loss, min mode — the
+                    # companion of the reference's ModelCheckpoint
+                    # policy). val_loss is a globally-reduced scalar, so
+                    # every SPMD rank takes the same branch; a nan never
+                    # counts as an improvement (including as the first
+                    # es_best). Inside a span the epochs already ran on
+                    # device; the stop takes effect at the span boundary,
+                    # and the es state freezes at the trigger point.
+                    if cfg.train.early_stop_patience > 0 and not stop_early:
+                        es_best, es_stale, stop_early = early_stop_update(
+                            val_loss, es_best, es_stale,
+                            patience=cfg.train.early_stop_patience,
+                            min_delta=cfg.train.early_stop_min_delta,
+                        )
+                profiler.maybe_stop_span(epoch, k)
                 # Host-gather BEFORE the coordinator gate: with TP/SP
                 # spanning processes this is a collective every rank must
                 # join; in the common fully-addressable case only the
@@ -545,26 +638,19 @@ class Trainer:
                 if params_cross_process or self.coordinator:
                     host_params = to_host(state.params)
                 if self.coordinator:
-                    ckpt_metrics = {"val_loss": val_loss, "val_acc": val_acc}
-                    if "val_f1" in epoch_rec:
-                        ckpt_metrics["val_f1"] = epoch_rec["val_f1"]
+                    # Deploy-checkpoint policy at span granularity: only
+                    # the span-end params exist on device, so best/last
+                    # selection sees the span-end epoch's metrics (k == 1
+                    # reduces to the per-epoch policy exactly).
+                    _, last_vl, last_va, _ = sub_epochs[-1]
+                    ckpt_metrics = {"val_loss": last_vl, "val_acc": last_va}
+                    if "val_f1" in last_rec:
+                        ckpt_metrics["val_f1"] = last_rec["val_f1"]
                     ckptr.update(
-                        epoch=epoch,
+                        epoch=epoch + k - 1,
                         metrics=ckpt_metrics,
                         params=host_params,
                         meta=meta,
-                    )
-                # Early stopping (monitor val_loss, min mode — the
-                # companion of the reference's ModelCheckpoint policy).
-                # val_loss is a globally-reduced scalar, so every SPMD
-                # rank takes the same branch; a nan never counts as an
-                # improvement (including as the first es_best).
-                stop_early = False
-                if cfg.train.early_stop_patience > 0:
-                    es_best, es_stale, stop_early = early_stop_update(
-                        val_loss, es_best, es_stale,
-                        patience=cfg.train.early_stop_patience,
-                        min_delta=cfg.train.early_stop_min_delta,
                     )
 
                 # Every process keeps its own resume state (host-local
@@ -583,12 +669,13 @@ class Trainer:
                 state_ckptr.save_async(
                     jax.device_put(state, declared_shardings),
                     meta={
-                        "epochs_completed": epoch + 1,
+                        "epochs_completed": epoch + k,
                         "target_epochs": (
-                            epoch + 1 if stop_early else target_epochs
+                            epoch + k if stop_early else target_epochs
                         ),
                     },
                 )
+                epoch += k
                 if stop_early:
                     break
 
