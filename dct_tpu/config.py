@@ -220,8 +220,9 @@ class TrainConfig:
     # it to 1/K. Trade-offs, all chunk-granular: deploy checkpoints and
     # resume snapshots land at chunk boundaries (per-epoch metrics are
     # still returned and logged), early stopping is evaluated per epoch
-    # but can only take effect between chunks, and K epochs of batches
-    # are staged in HBM at once.
+    # but can only take effect between chunks, and up to 2K epochs of
+    # batches are resident in HBM at once (the current span plus the
+    # span-ahead prefetch).
     epoch_chunk: int = 1
 
     @classmethod

@@ -317,7 +317,11 @@ class Trainer:
         use_scan = cfg.train.use_scan
         accum = max(1, cfg.train.grad_accum_steps)
         if use_scan:
-            epoch_fused = make_epoch_train_eval_step(accum_steps=accum)
+            # Built only for the per-epoch path: with epoch_chunk > 1
+            # every span (including k == 1 remainders) dispatches the
+            # multi-epoch program instead.
+            if max(1, cfg.train.epoch_chunk) == 1:
+                epoch_fused = make_epoch_train_eval_step(accum_steps=accum)
         else:
             train_step = make_train_step(accum_steps=accum)
             eval_step = make_eval_step()

@@ -1,0 +1,229 @@
+#!/usr/bin/env python3
+"""Unattended on-chip measurement campaign.
+
+Relay windows are scarce and flaky (rounds 2-4: hours-long wedges, one
+mid-section death), so when the chip IS reachable every minute must
+produce a durable number. This script runs the full measurement agenda
+in ONE process (the relay serializes one TPU session), ordered by
+evidence value, appending one JSON line per completed item to
+ONCHIP_CAMPAIGN.jsonl — a crash or relay death keeps everything already
+measured.
+
+    python scripts/onchip_campaign.py            # full agenda
+    DCT_CAMPAIGN_SECTIONS=mfu,flash python ...   # subset
+
+Sections (value order, VERDICT r3 items 2-4/8):
+  mfu     - scaled transformer at the base config, then bigger d_model /
+            remat variants (DCT_SCALED_* sweep through bench's section)
+  flash   - flash-vs-blockwise tile sweep at the scaled attention shape
+  moe     - sorted-vs-einsum dispatch at E=32 (the crossover regime)
+  trainer - product Trainer.fit() loop, chunked vs per-epoch dispatch
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+OUT_PATH = os.environ.get(
+    "DCT_CAMPAIGN_OUT", os.path.join(_REPO_ROOT, "ONCHIP_CAMPAIGN.jsonl")
+)
+
+from dct_tpu.utils.platform import ensure_live_backend  # noqa: E402
+
+ensure_live_backend()
+
+import bench  # noqa: E402
+
+# A campaign has no timeout-kill to outrun: run every leg of every bench
+# section it borrows, and restart the clock (bench read it at import).
+bench._DEADLINE = float(os.environ.get("DCT_BENCH_DEADLINE", "0"))
+bench._BENCH_T0 = time.perf_counter()
+
+
+def emit(section: str, item: str, payload) -> None:
+    rec = {"section": section, "item": item, "t": round(time.time(), 1),
+           "result": payload}
+    with open(OUT_PATH, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(f"[campaign] {section}/{item}: {json.dumps(payload)[:200]}",
+          file=sys.stderr, flush=True)
+
+
+def item(section: str, name: str, fn) -> object:
+    """Run one agenda item; failure emits an error record and continues
+    (a dead relay fails every later item fast — the jsonl shows where)."""
+    t0 = time.perf_counter()
+    try:
+        out = fn()
+    except Exception as e:  # noqa: BLE001
+        emit(section, name, {"error": f"{type(e).__name__}: {e}"})
+        return None
+    emit(section, name, {"seconds": round(time.perf_counter() - t0, 1),
+                         **(out if isinstance(out, dict) else {"value": out})})
+    return out
+
+
+def run_mfu() -> None:
+    """DCT_SCALED_* sweep through bench's scaled section (scan-16 MFU).
+    Config order: the base record first (the driver measures this), then
+    the knobs most likely to raise MFU."""
+    base = dict(bench.SCALED)
+    base_batch = bench.SCALED_BATCH
+    configs = [
+        ("base", {}, {}),
+        ("dmodel768", {"d_model": 768, "d_ff": 3072}, {}),
+        ("dmodel1024", {"d_model": 1024, "d_ff": 4096}, {}),
+        ("batch64", {}, {"batch": 64}),
+        ("seq2048_remat", {"seq_len": 2048}, {"remat": "1"}),
+        ("layers8", {"n_layers": 8}, {}),
+    ]
+    wanted = os.environ.get("DCT_CAMPAIGN_MFU", "").strip()
+    if wanted:
+        keep = set(wanted.split(","))
+        configs = [c for c in configs if c[0] in keep]
+    for name, upd, extra in configs:
+        bench.SCALED = {**base, **upd}
+        bench.SCALED_BATCH = int(extra.get("batch", base_batch))
+        if "remat" in extra:
+            os.environ["DCT_REMAT"] = extra["remat"]
+        else:
+            os.environ.pop("DCT_REMAT", None)
+        item("mfu", name, bench.bench_scaled_transformer)
+    bench.SCALED = base
+    bench.SCALED_BATCH = base_batch
+    os.environ.pop("DCT_REMAT", None)
+
+
+def run_flash() -> None:
+    """Tile sweep at the scaled attention shape: jit-level flash vs XLA
+    blockwise, fwd and fwd+bwd, causal and windowed — the data for
+    choosing DCT_FLASH_BLOCK_Q/K defaults."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dct_tpu.ops.attention import blockwise_attention
+    from dct_tpu.ops.pallas_attention import flash_attention
+
+    def timeit(fn, *args, n=10):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / n
+
+    rng = np.random.default_rng(0)
+    # BxHxTxD, comma-separated via env (CPU smoke rigs need tiny T: the
+    # XLA blockwise baseline at T=8192 costs minutes per call there).
+    shapes_env = os.environ.get(
+        "DCT_CAMPAIGN_FLASH_SHAPES", "8x8x2048x64,2x8x8192x64"
+    )
+    shapes = [
+        tuple(int(v) for v in s.split("x"))
+        for s in shapes_env.split(",") if s.strip()
+    ]
+    blocks = [(128, 128), (256, 256), (256, 512), (512, 512)]
+    for (b, h, t, d) in shapes:
+        q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+        for causal, window in ((True, None), (True, t // 8)):
+            tag = (
+                f"{b}x{h}x{t}x{d}"
+                + ("_causal" if causal else "")
+                + (f"_w{window}" if window else "")
+            )
+
+            def bw_fwd():
+                f = jax.jit(lambda q, k, v: blockwise_attention(
+                    q, k, v, block_size=512, causal=causal, window=window))
+                fb = jax.jit(jax.grad(
+                    lambda q, k, v: blockwise_attention(
+                        q, k, v, block_size=512, causal=causal,
+                        window=window,
+                    ).astype(jnp.float32).sum(),
+                    argnums=(0, 1, 2)))
+                return {"fwd_ms": round(timeit(f, q, k, v) * 1e3, 3),
+                        "fwdbwd_ms": round(timeit(fb, q, k, v) * 1e3, 3)}
+
+            base = item("flash", f"{tag}_blockwise", bw_fwd)
+            for (bq, bk) in blocks:
+                if t % bq or t % bk:
+                    continue
+
+                def fl_pair(bq=bq, bk=bk):
+                    f = jax.jit(lambda q, k, v: flash_attention(
+                        q, k, v, bq, bk, causal, None, False, window))
+                    fb = jax.jit(jax.grad(
+                        lambda q, k, v: flash_attention(
+                            q, k, v, bq, bk, causal, None, False, window,
+                        ).astype(jnp.float32).sum(),
+                        argnums=(0, 1, 2)))
+                    out = {"fwd_ms": round(timeit(f, q, k, v) * 1e3, 3),
+                           "fwdbwd_ms": round(timeit(fb, q, k, v) * 1e3, 3)}
+                    if isinstance(base, dict) and base.get("fwd_ms"):
+                        out["fwd_speedup"] = round(
+                            base["fwd_ms"] / out["fwd_ms"], 2)
+                        out["fwdbwd_speedup"] = round(
+                            base["fwdbwd_ms"] / out["fwdbwd_ms"], 2)
+                    return out
+
+                item("flash", f"{tag}_flash_{bq}x{bk}", fl_pair)
+
+
+def run_moe() -> None:
+    item("moe", "e32", bench.bench_scaled_moe)
+
+
+def run_trainer() -> None:
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        data = bench._prepare_data(tmp)
+        item("trainer", "per_epoch",
+             lambda: {"samples_per_sec_per_chip":
+                      round(bench.bench_trainer_loop(data, tmp), 1)})
+        item("trainer", "chunked",
+             lambda: {"samples_per_sec_per_chip":
+                      round(bench.bench_trainer_loop(
+                          data, tmp, max(2, bench.TIMED_EPOCHS)), 1)})
+
+
+SECTIONS = {
+    "mfu": run_mfu,
+    "flash": run_flash,
+    "moe": run_moe,
+    "trainer": run_trainer,
+}
+
+
+def main() -> None:
+    import jax
+
+    emit("campaign", "start", {
+        "platform": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+    })
+    names = os.environ.get(
+        "DCT_CAMPAIGN_SECTIONS", "mfu,flash,moe,trainer"
+    ).split(",")
+    for name in [n.strip() for n in names if n.strip()]:
+        fn = SECTIONS.get(name)
+        if fn is None:
+            emit("campaign", name, {"error": f"unknown section {name!r}"})
+            continue
+        fn()
+    emit("campaign", "end", {})
+
+
+if __name__ == "__main__":
+    main()
