@@ -63,8 +63,12 @@ def _online_block(q, k, v, scale, mask, m, l, o):
         # A fully-masked row would otherwise get p=exp(0)=1 per entry.
         p = jnp.where(mask, p, 0.0)
     l_new = l * alpha + p.sum(axis=-1)
+    # P·V with operands in V's dtype (bf16 on the product path — f32 MXU
+    # rate is a fraction of bf16's; accumulation stays f32 via
+    # preferred_element_type). f32 inputs are untouched: p is already f32.
     o_new = o * alpha[..., None] + jnp.einsum(
-        "...qk,...kd->...qd", p, v.astype(jnp.float32)
+        "...qk,...kd->...qd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
     )
     return m_new, l_new, o_new
 

@@ -83,13 +83,22 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     def _block():
-        q = q_ref[...].astype(jnp.float32) * scale  # [bq, D]
-        k = k_ref[...].astype(jnp.float32)  # [block_k, D]
-        v = v_ref[...].astype(jnp.float32)
+        # MXU operands stay in the INPUT dtype (bf16 on the product
+        # path): upcasting q/k/v to f32 before the dots would run the
+        # matmuls at f32 MXU rate — a fraction of bf16 throughput, and
+        # the likely reason the kernel lost to XLA blockwise on-chip in
+        # r2. bf16xbf16 products accumulate in f32 on the MXU (each
+        # product is exactly representable), so only the p·V cast below
+        # changes numerics, the same trade the official TPU flash
+        # kernels make. The scale moves AFTER the dot so it applies in
+        # f32 regardless of input dtype.
+        q = q_ref[...]  # [bq, D]
+        k = k_ref[...]  # [block_k, D]
+        v = v_ref[...]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [bq, block_k]
+        ) * scale  # [bq, block_k] f32
         if causal:
             # ``q_offset`` shifts the q positions (the windowed ring's
             # static inter-shard distance); k positions stay 0-based.
@@ -115,7 +124,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
             p = jnp.where(keep, p, 0.0)
         l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -264,18 +273,20 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
 
 
 def _bwd_block(q, k, v, do, lse, delta, scale, keep):
-    """Shared per-(i,j) backward math in f32: returns (p, ds) with
+    """Shared per-(i,j) backward math: returns (p, ds) with
     p = softmax weights recovered from the forward lse, ds = the score
-    cotangent. q,do [bq,d] · k,v [bk,d] · lse,delta [bq,1]."""
+    cotangent. q,do [bq,d] · k,v [bk,d] · lse,delta [bq,1]. MXU operands
+    stay in the input dtype (see the forward's dtype note); p/ds come
+    back f32 and are cast at their consuming matmuls."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [bq, bk]
+    ) * scale  # [bq, bk] f32
     p = jnp.exp(s - lse)
     if keep is not None:
         p = jnp.where(keep, p, 0.0)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [bq, bk]
+    )  # [bq, bk] f32
     ds = p * (dp - delta) * scale
     return p, ds
 
@@ -301,15 +312,19 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def _block():
-        q = q_ref[...].astype(jnp.float32)
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
-        o = o_ref[...].astype(jnp.float32)
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        o = o_ref[...]
         lse = lse_ref[:, :1]
-        # delta_i = rowsum(dO ⊙ O): recomputed per block (cheap VPU work)
+        # delta_i = rowsum(dO ⊙ O): recomputed per block (cheap VPU work,
+        # upcast — elementwise f32 is free relative to the matmuls)
         # instead of shipping a [bh, T] side input through HBM.
-        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
         keep = None
         if causal:
             bq = q.shape[0]
@@ -321,11 +336,11 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         p, ds = _bwd_block(q, k, v, do, lse, delta, scale, keep)
         # dV_j += P^T dO_i ; dK_j += dS^T Q_i  (contract over the q rows)
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -359,13 +374,16 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     def _block():
-        q = q_ref[...].astype(jnp.float32)
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
-        o = o_ref[...].astype(jnp.float32)
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        o = o_ref[...]
         lse = lse_ref[:, :1]
-        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
         keep = None
         if causal:
             q_pos = i * bq + jax.lax.broadcasted_iota(
@@ -380,7 +398,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         _, ds = _bwd_block(q, k, v, do, lse, delta, scale, keep)
         # dQ_i += dS K_j
         dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
