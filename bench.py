@@ -227,6 +227,11 @@ _VARIANT_LEG_NAMES = (
     "gqa",
 )
 
+# Share of DCT_BENCH_DEADLINE the optional variant legs may consume —
+# the rest is reserved for the MoE/serving/dataplane sections behind
+# them (one constant so the two gate sites cannot drift).
+_VARIANT_LEG_BUDGET = 0.55
+
 # Set by main(): sections stream per-leg values into the live record via
 # _leg() the moment they are measured, so a relay death LATER in a section
 # cannot lose legs that already ran (the r4 on-chip run lost ~35 min of
@@ -444,7 +449,11 @@ def bench_scaled_transformer() -> dict:
             (flash_causal, blockwise_causal, flash_window, blockwise_window),
         ))
         for i, (name, fn) in enumerate(variant_legs):
-            if _over_deadline(f"scaled:{name}"):
+            # 55%: the causal/window variants are the first to yield —
+            # they re-measure the same kernels the mandatory legs above
+            # already timed, while MoE/serving behind them have no other
+            # source in the record.
+            if _over_deadline(f"scaled:{name}", frac=_VARIANT_LEG_BUDGET):
                 causal["deadline_skipped"] = list(_VARIANT_LEG_NAMES[i:])
                 break
             try:
@@ -472,7 +481,7 @@ def bench_scaled_transformer() -> dict:
         # tree (the train-step legs above share one state). Runs after
         # the causal/window legs: those carry the headline flash-vs-
         # blockwise claims, so under deadline pressure they go first.
-        if _over_deadline("scaled:gqa"):
+        if _over_deadline("scaled:gqa", frac=_VARIANT_LEG_BUDGET):
             skipped = causal.setdefault("deadline_skipped", [])
             if "gqa" not in skipped:
                 skipped.append("gqa")
@@ -797,12 +806,19 @@ _BENCH_T0 = time.perf_counter()
 _DEADLINE = float(os.environ.get("DCT_BENCH_DEADLINE", "1500"))
 
 
-def _over_deadline(name: str) -> bool:
+def _over_deadline(name: str, frac: float = 1.0) -> bool:
+    """``frac`` < 1 carves out budget for the sections BEHIND this one:
+    on-chip the scaled section's optional variant legs cost ~7 min each
+    (tunnel compiles), and at frac=1 they starve the MoE/serving
+    sections the record also needs (the E>=16 sorted_speedup is a
+    driver-record deliverable, not a nice-to-have)."""
     elapsed = time.perf_counter() - _BENCH_T0
-    if _DEADLINE > 0 and elapsed > _DEADLINE:
+    budget = _DEADLINE * frac
+    if _DEADLINE > 0 and elapsed > budget:
         print(
             f"[bench] SKIP {name}: {elapsed:.0f}s elapsed > "
-            f"DCT_BENCH_DEADLINE={_DEADLINE:.0f}s",
+            f"{budget:.0f}s ({frac:.0%} of "
+            f"DCT_BENCH_DEADLINE={_DEADLINE:.0f}s)",
             file=sys.stderr, flush=True,
         )
         return True
