@@ -64,6 +64,20 @@ def _kv_flat_row(bh, h: int, h_kv: int):
     return (bh // h) * h_kv + (bh % h) // group
 
 
+def _compiler_params():
+    """Shared grid semantics for all three kernels: dims 0/1 are
+    parallel (each (row, block) instance owns its scratch lifecycle —
+    init at its inner sweep's first step, finalize at its last), only
+    the innermost accumulation sweep is order-dependent. One helper so
+    forward and backward cannot drift."""
+    try:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    except (AttributeError, TypeError):  # pragma: no cover - older jax
+        return None
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
                       n_kv: int, causal: bool, scale: float,
                       with_lse: bool, window: int | None = None,
@@ -219,12 +233,7 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
     else:
         def kv_index(bh, i, j):
             return (kv_bh(bh), j, 0)
-    try:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary")
-        )
-    except (AttributeError, TypeError):  # pragma: no cover - older jax
-        compiler_params = None
+    compiler_params = _compiler_params()
     # Under a vma-checked shard_map the outputs must declare the inputs'
     # device-varying axes explicitly; outside shard_map (and on jax
     # versions without vma typing) this resolves to no kwarg at all.
@@ -473,12 +482,7 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q: int, block_k: int,
     q_spec = pl.BlockSpec((None, block_q, d), q_index)
     kv_spec = pl.BlockSpec((None, block_k, d), lambda bh, j, i: (bh, j, 0))
     lse_spec = pl.BlockSpec((None, block_q, _STATS_LANES), q_index)
-    try:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary")
-        )
-    except (AttributeError, TypeError):  # pragma: no cover - older jax
-        compiler_params = None
+    compiler_params = _compiler_params()
 
     dk, dv = pl.pallas_call(
         functools.partial(
