@@ -275,8 +275,22 @@ SECTIONS = {
 def main() -> None:
     import jax
 
+    platform = jax.default_backend()
+    if platform != "tpu" and os.environ.get(
+        "DCT_CAMPAIGN_ALLOW_CPU", ""
+    ).strip() != "1":
+        # An on-chip campaign on a CPU fallback produces numbers that
+        # answer none of the questions it exists for — and a cron-
+        # triggered start against a dead relay would pollute the jsonl
+        # with them. Refuse loudly (smoke rigs set the env).
+        emit("campaign", "refused", {
+            "platform": platform,
+            "reason": "no TPU backend; set DCT_CAMPAIGN_ALLOW_CPU=1 "
+                      "for a CPU smoke run",
+        })
+        sys.exit(3)
     emit("campaign", "start", {
-        "platform": jax.default_backend(),
+        "platform": platform,
         "device": str(jax.devices()[0]),
     })
     names = os.environ.get(
