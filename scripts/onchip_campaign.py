@@ -280,14 +280,15 @@ def main() -> None:
         "DCT_CAMPAIGN_ALLOW_CPU", ""
     ).strip() != "1":
         # An on-chip campaign on a CPU fallback produces numbers that
-        # answer none of the questions it exists for — and a cron-
-        # triggered start against a dead relay would pollute the jsonl
-        # with them. Refuse loudly (smoke rigs set the env).
-        emit("campaign", "refused", {
-            "platform": platform,
-            "reason": "no TPU backend; set DCT_CAMPAIGN_ALLOW_CPU=1 "
-                      "for a CPU smoke run",
-        })
+        # answer none of the questions it exists for. Refuse on stderr
+        # ONLY — a watcher retry loop hitting this every poll must not
+        # pile non-measurement records into the results jsonl (smoke
+        # rigs set DCT_CAMPAIGN_ALLOW_CPU=1).
+        print(
+            f"[campaign] REFUSED: backend is {platform!r}, not tpu; "
+            "set DCT_CAMPAIGN_ALLOW_CPU=1 for a CPU smoke run",
+            file=sys.stderr, flush=True,
+        )
         sys.exit(3)
     emit("campaign", "start", {
         "platform": platform,

@@ -1,0 +1,54 @@
+#!/bin/bash
+# Poll the axon relay ports with curl (NO jax — a JAX probe against a
+# half-recovered relay can take or wedge the single TPU claim) and start
+# scripts/onchip_campaign.py once when a port listens. If the campaign
+# refuses (exit 3: port up but no claimable TPU), resume polling.
+# Usage: scripts/relay_watch_campaign.sh [max_polls] [poll_seconds]
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG="$REPO/.relay_watch.log"
+N="${1:-200}"
+SLEEP="${2:-120}"
+PORTS="8081 8083 8093 8103 8113 8123"
+
+# Single instance only: two watchers would both launch the campaign
+# against the relay's ONE serialized TPU session (a stale nohup from a
+# prior session plus a fresh start is exactly how that happens).
+LOCK="$REPO/.relay_watch.lock"
+exec 9>"$LOCK"
+if ! flock -n 9; then
+  echo "$(date +%H:%M:%S) another watcher holds $LOCK — exiting" >> "$LOG"
+  exit 5
+fi
+
+for i in $(seq 1 "$N"); do
+  up=""
+  for p in $PORTS; do
+    if curl -s -o /dev/null --max-time 2 "http://127.0.0.1:$p/"; then
+      up="$p"
+      break
+    fi
+  done
+  ts=$(date +%H:%M:%S)
+  if [ -n "$up" ]; then
+    echo "$ts port $up listening — waiting 30s then starting campaign" >> "$LOG"
+    sleep 30
+    ( cd "$REPO" && python scripts/onchip_campaign.py \
+        >> "$REPO/.campaign_run.log" 2>&1 )
+    rc=$?
+    echo "$(date +%H:%M:%S) campaign exit=$rc" >> "$LOG"
+    if [ "$rc" -ne 3 ]; then
+      # 0 = ran (jsonl has the numbers); other nonzero = real failure
+      # worth human eyes either way. 3 = refused (no TPU yet): keep
+      # polling.
+      exit "$rc"
+    fi
+  else
+    echo "$ts all relay ports down" >> "$LOG"
+  fi
+  sleep "$SLEEP"
+done
+# Distinct exit so a supervisor can tell "never got a TPU" from
+# "campaign ran" (0) and "campaign failed" (its nonzero).
+echo "$(date +%H:%M:%S) poll budget exhausted" >> "$LOG"
+exit 4
