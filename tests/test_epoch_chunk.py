@@ -40,17 +40,23 @@ def _history_key(history):
     ]
 
 
+def _assert_same_run(r1, r2):
+    """Params bitwise-equal AND identical per-epoch metric history."""
+    l1 = jax.tree.leaves(r1.state.params)
+    l2 = jax.tree.leaves(r2.state.params)
+    assert len(l1) == len(l2)
+    for a, b in zip(l1, l2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert _history_key(r1.history) == _history_key(r2.history)
+
+
 def test_chunked_matches_per_epoch(tmp_path, weather_data):
     """chunk=2 over 5 epochs (spans 2+2+1 — the remainder span compiles
     its own K) reproduces chunk=1 bitwise: params and history."""
     r1, _ = _fit(tmp_path, weather_data, "c1", epochs=5, epoch_chunk=1)
     r2, _ = _fit(tmp_path, weather_data, "c2", epochs=5, epoch_chunk=2)
 
-    for a, b in zip(
-        jax.tree.leaves(r1.state.params), jax.tree.leaves(r2.state.params)
-    ):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-    assert _history_key(r1.history) == _history_key(r2.history)
+    _assert_same_run(r1, r2)
     assert len(r2.history) == 5
 
 
@@ -118,3 +124,19 @@ def test_chunked_logs_per_epoch_metrics(tmp_path, weather_data):
                         if "val_loss" in json.loads(line):
                             hits += 1
     assert hits == 3, f"expected 3 per-epoch val_loss records, saw {hits}"
+
+
+def test_chunked_composes_with_grad_accum(tmp_path, weather_data):
+    """chunk x grad_accum: each epoch's stack truncates to whole
+    accumulation groups BEFORE the chunk stacking, and the K-epoch scan
+    reshapes per epoch — the trajectory must match the per-epoch path
+    under the same accumulation."""
+    r1, _ = _fit(
+        tmp_path, weather_data, "a1",
+        epochs=4, epoch_chunk=1, grad_accum_steps=2,
+    )
+    r2, _ = _fit(
+        tmp_path, weather_data, "a2",
+        epochs=4, epoch_chunk=2, grad_accum_steps=2,
+    )
+    _assert_same_run(r1, r2)
