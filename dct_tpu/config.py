@@ -174,6 +174,12 @@ class TrainConfig:
     # size, matching the reference's per-rank DataLoader(batch_size=4).
     batch_size: int = 4
     lr: float = 0.01
+    # Optimizer family: adam (parity; weight_decay>0 upgrades to AdamW),
+    # adamw, sgd (+momentum), adafactor (factored second moments — the
+    # TPU choice when optimizer memory matters), lion. The reference is
+    # locked to Adam (jobs/train_lightning_ddp.py:88).
+    optimizer: str = "adam"
+    momentum: float = 0.0  # sgd only
     # LR schedule: 'constant' (reference parity) or 'cosine'; optional
     # linear warmup. decay_steps 0 = auto (the run's total update count).
     lr_schedule: str = "constant"
@@ -231,6 +237,8 @@ class TrainConfig:
         c.epochs = _env("DCT_EPOCHS", c.epochs, int)
         c.batch_size = _env("DCT_BATCH_SIZE", c.batch_size, int)
         c.lr = _env("DCT_LR", c.lr, float)
+        c.optimizer = _env("DCT_OPTIMIZER", c.optimizer, str)
+        c.momentum = _env("DCT_MOMENTUM", c.momentum, float)
         c.lr_schedule = _env("DCT_LR_SCHEDULE", c.lr_schedule, str)
         c.warmup_steps = _env("DCT_WARMUP_STEPS", c.warmup_steps, int)
         c.decay_steps = _env("DCT_DECAY_STEPS", c.decay_steps, int)

@@ -69,10 +69,79 @@ def make_lr_schedule(
     )
 
 
+def make_optimizer(
+    rate, *, optimizer: str = "adam", weight_decay: float = 0.0,
+    momentum: float = 0.0, grad_clip_norm: float = 0.0,
+) -> optax.GradientTransformation:
+    """Optimizer family selection (``DCT_OPTIMIZER``; reference is locked
+    to ``Adam(lr=0.01)``, jobs/train_lightning_ddp.py:88):
+
+    - ``adam`` (parity default): optax.adam; a positive ``weight_decay``
+      auto-upgrades to AdamW (decoupled decay) — the long-standing
+      behavior, so existing configs keep their trajectory.
+    - ``adamw``: AdamW explicitly (decay may be 0).
+    - ``sgd``: momentum trace + DECOUPLED weight decay (AdamW-style:
+      the decay term joins AFTER the momentum trace and is scaled by
+      lr alongside the update, never entering the momentum buffer) —
+      deliberately unlike torch SGD's coupled L2.
+    - ``adafactor``: factored second moments (rank-1 row/col statistics
+      for matrices) — the classic TPU choice when optimizer memory
+      matters; decay via ``weight_decay_rate``; ``momentum`` threads
+      through natively.
+    - ``lion``: sign-momentum optimizer; decay is built in.
+
+    ``momentum`` on a family whose update rule has no such knob
+    (adam/adamw/lion use betas) raises instead of silently ignoring the
+    operator's intent.
+    """
+    opt = optimizer.strip().lower()
+    if momentum and opt not in ("sgd", "adafactor"):
+        raise ValueError(
+            f"DCT_MOMENTUM={momentum} is only meaningful for sgd/"
+            f"adafactor (got optimizer={optimizer!r}; adam/adamw/lion "
+            "are governed by their betas)"
+        )
+    if opt == "adam":
+        tx = (
+            optax.adamw(learning_rate=rate, weight_decay=weight_decay)
+            if weight_decay > 0.0
+            else optax.adam(learning_rate=rate)
+        )
+    elif opt == "adamw":
+        tx = optax.adamw(learning_rate=rate, weight_decay=weight_decay)
+    elif opt == "sgd":
+        parts = []
+        if momentum:
+            parts.append(optax.trace(decay=momentum))
+        if weight_decay > 0.0:
+            parts.append(optax.add_decayed_weights(weight_decay))
+        parts.append(optax.scale_by_learning_rate(rate))
+        tx = optax.chain(*parts)
+    elif opt == "adafactor":
+        tx = optax.adafactor(
+            learning_rate=rate,
+            momentum=momentum or None,
+            weight_decay_rate=weight_decay if weight_decay > 0.0 else None,
+        )
+    elif opt == "lion":
+        tx = optax.lion(learning_rate=rate, weight_decay=weight_decay)
+    else:
+        raise ValueError(
+            f"DCT_OPTIMIZER={optimizer!r} not in "
+            "('adam', 'adamw', 'sgd', 'adafactor', 'lion')"
+        )
+    if grad_clip_norm > 0.0:
+        # Global-norm clipping BEFORE the optimizer (Lightning's
+        # gradient_clip_val semantics); 0 preserves parity exactly.
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+    return tx
+
+
 def create_train_state(
     model, *, input_dim: int, lr: float, seed: int,
     example_shape: tuple | None = None, lr_schedule=None,
     weight_decay: float = 0.0, grad_clip_norm: float = 0.0,
+    optimizer: str = "adam", momentum: float = 0.0,
 ) -> TrainState:
     """Initialize params (torch-matching init lives in the model) and Adam.
 
@@ -101,16 +170,10 @@ def create_train_state(
     # which must not enter the optimizer.
     params = {"params": variables["params"]}
     rate = lr_schedule if lr_schedule is not None else lr
-    if weight_decay > 0.0:
-        # AdamW (decoupled decay) — capability beyond the reference's
-        # plain Adam; 0 preserves the parity trajectory exactly.
-        tx = optax.adamw(learning_rate=rate, weight_decay=weight_decay)
-    else:
-        tx = optax.adam(learning_rate=rate)
-    if grad_clip_norm > 0.0:
-        # Global-norm clipping BEFORE the optimizer (Lightning's
-        # gradient_clip_val semantics); 0 preserves parity exactly.
-        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+    tx = make_optimizer(
+        rate, optimizer=optimizer, weight_decay=weight_decay,
+        momentum=momentum, grad_clip_norm=grad_clip_norm,
+    )
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,

@@ -233,6 +233,7 @@ class Trainer:
             seed=cfg.train.seed, example_shape=example_shape,
             lr_schedule=lr_schedule, weight_decay=cfg.train.weight_decay,
             grad_clip_norm=cfg.train.grad_clip_norm,
+            optimizer=cfg.train.optimizer, momentum=cfg.train.momentum,
         )
         # Name-pattern rules: tensor-parallel placement for the transformer
         # family, full replication for the MLP (no patterns match). TP/SP
