@@ -59,6 +59,82 @@ def _find_checkpoint(models_dir: str) -> str:
     )
 
 
+def _score_jax(params, meta: dict, x, chunk: int):
+    """Accelerator batch scoring (``DCT_PREDICT_ENGINE=jax``): rebuild
+    the registry model from the checkpoint's self-describing meta, shard
+    each chunk's batch over the mesh ``data`` axis (layout from the
+    operator's DCT_MESH_* env, like every other entry point), and run
+    the jitted forward on whatever backend is live (TPU on the product
+    rig). The numpy engine stays the default — it is the serving twin;
+    this one is the throughput path for dataset-scale scoring,
+    parity-tested against numpy to float32 tolerance
+    (tests/test_predict_job.py)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from dct_tpu.config import MeshConfig, ModelConfig
+    from dct_tpu.models.registry import (
+        get_model, is_causal_model, is_sequence_model,
+    )
+    from dct_tpu.ops.attention import make_attention_fn
+    from dct_tpu.parallel.mesh import batch_sharding, make_mesh
+
+    family = meta.get("model", "weather_mlp")
+    fields = {f.name for f in dataclasses.fields(ModelConfig)}
+    cfg = ModelConfig(name=family, **{
+        k: v for k, v in meta.items() if k in fields and k != "name"
+    })
+    mesh = make_mesh(MeshConfig.from_env())
+    dtype = (
+        jnp.bfloat16
+        if os.environ.get("DCT_PREDICT_DTYPE", "float32") == "bfloat16"
+        else jnp.float32
+    )
+    input_dim = int(meta["input_dim"])
+    if is_sequence_model(family):
+        model = get_model(
+            cfg, input_dim=input_dim, compute_dtype=dtype,
+            attn_fn=make_attention_fn(mesh), mesh=mesh,
+        )
+    else:
+        model = get_model(cfg, input_dim=input_dim, compute_dtype=dtype)
+    causal = is_causal_model(family)
+
+    @jax.jit
+    def forward(p, xb):
+        logits = model.apply({"params": p}, xb, train=False)
+        if causal:
+            # The numpy twin serves the LAST position's forecast
+            # (runtime._head_numpy takes h[:, -1, :]): [N, S, C] -> [N, C]
+            # and multi-horizon [N, S, H, C] -> [N, H, C]. Slicing here
+            # keeps the two engines' output contracts identical.
+            logits = logits[:, -1]
+        return jax.nn.softmax(logits, axis=-1)
+
+    sharding = batch_sharding(mesh)
+    dp = mesh.shape["data"]
+    # Fixed-size, data-axis-divisible chunks (last one padded) so the
+    # jitted forward traces ONCE and every device_put lays out evenly.
+    chunk = max(dp, -(-chunk // dp) * dp)
+    parts = []
+    for start in range(0, len(x), chunk):
+        piece = np.ascontiguousarray(x[start:start + chunk], np.float32)
+        real = len(piece)
+        pad = (chunk - real) if len(x) > chunk else ((-real) % dp)
+        if pad:
+            piece = np.concatenate(
+                [piece, np.repeat(piece[-1:], pad, axis=0)]
+            )
+        out = np.asarray(
+            jax.device_get(forward(params["params"],
+                                   jax.device_put(piece, sharding)))
+        )
+        parts.append(out[:real])
+    return np.concatenate(parts, axis=0)
+
+
 def main() -> None:
     import pandas as pd
 
@@ -74,7 +150,11 @@ def main() -> None:
     )
 
     ckpt = _find_checkpoint(models_dir)
-    weights, meta = weights_from_checkpoint(ckpt)
+    # One msgpack restore serves both engines: numpy flattens the tree
+    # into serving weights, jax applies it directly.
+    from dct_tpu.checkpoint.manager import load_checkpoint
+
+    params, meta = load_checkpoint(ckpt)
     family = meta.get("model", "weather_mlp")
     print(f"Scoring with {ckpt} (family={family})")
 
@@ -102,11 +182,24 @@ def main() -> None:
     # O(chunk * heads * seq^2) scores — a whole-dataset forward would OOM
     # at exactly the scale a batch job exists for.
     chunk = int(os.environ.get("DCT_PREDICT_CHUNK", "8192"))
-    probs_parts = []
-    for start in range(0, len(x), chunk):
-        piece = np.ascontiguousarray(x[start:start + chunk], np.float32)
-        probs_parts.append(softmax_numpy(forward_numpy(weights, meta, piece)))
-    probs = np.concatenate(probs_parts, axis=0)
+    engine = os.environ.get("DCT_PREDICT_ENGINE", "numpy").strip().lower()
+    if engine == "jax":
+        probs = _score_jax(params, meta, x, chunk)
+    elif engine == "numpy":
+        # The serving twin — bitwise the same math the deployed score.py
+        # runs, so batch and online predictions cannot diverge.
+        weights, _meta2 = weights_from_checkpoint(ckpt)
+        probs_parts = []
+        for start in range(0, len(x), chunk):
+            piece = np.ascontiguousarray(x[start:start + chunk], np.float32)
+            probs_parts.append(
+                softmax_numpy(forward_numpy(weights, meta, piece))
+            )
+        probs = np.concatenate(probs_parts, axis=0)
+    else:
+        raise ValueError(
+            f"DCT_PREDICT_ENGINE={engine!r} not in ('numpy', 'jax')"
+        )
 
     frame = {"row": index}
     if probs.ndim == 3:

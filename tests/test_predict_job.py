@@ -149,3 +149,60 @@ def test_predict_picks_newest_best_by_mtime(processed_dir, tmp_path):
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert os.path.basename(best) in r.stdout, r.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "model_env",
+    [
+        None,  # flagship MLP
+        {"DCT_MODEL": "weather_transformer", "DCT_SEQ_LEN": "8",
+         "DCT_D_MODEL": "16", "DCT_N_HEADS": "2", "DCT_D_FF": "32"},
+        # Causal family: the jax engine must slice the last position to
+        # match the numpy twin's forecast contract.
+        {"DCT_MODEL": "weather_transformer_causal", "DCT_SEQ_LEN": "8",
+         "DCT_D_MODEL": "16", "DCT_N_HEADS": "2", "DCT_N_LAYERS": "1",
+         "DCT_D_FF": "32"},
+        # Multi-horizon causal: probs come back [N, H, C] in BOTH
+        # engines (per-horizon prob/pred columns).
+        {"DCT_MODEL": "weather_transformer_causal", "DCT_SEQ_LEN": "8",
+         "DCT_D_MODEL": "16", "DCT_N_HEADS": "2", "DCT_N_LAYERS": "1",
+         "DCT_D_FF": "32", "DCT_HORIZON": "3"},
+    ],
+    ids=["mlp", "transformer", "causal", "causal_h3"],
+)
+def test_predict_jax_engine_matches_numpy(processed_dir, tmp_path, model_env):
+    """DCT_PREDICT_ENGINE=jax (mesh-sharded accelerator scoring) must
+    match the numpy serving twin to f32 tolerance — including across
+    the fixed-chunk padding of the last piece."""
+    env = _train(processed_dir, tmp_path, model_env)
+    outs = {}
+    for engine in ("numpy", "jax"):
+        out = str(tmp_path / f"pred_{engine}.parquet")
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "jobs", "predict.py")],
+            env={**env, "DCT_PREDICTIONS": out,
+                 "DCT_PREDICT_ENGINE": engine,
+                 "DCT_PREDICT_CHUNK": "96"},  # forces a padded tail
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        outs[engine] = pd.read_parquet(out)
+    a, b = outs["numpy"], outs["jax"]
+    assert (a["row"] == b["row"]).all()
+    prob_cols = [c for c in a.columns if c.startswith("prob")]
+    assert prob_cols
+    for c in prob_cols:
+        np.testing.assert_allclose(a[c], b[c], atol=2e-5)
+    assert (a["predicted"] == b["predicted"]).mean() > 0.999
+
+
+def test_predict_unknown_engine_fails_loudly(processed_dir, tmp_path):
+    env = _train(processed_dir, tmp_path)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "jobs", "predict.py")],
+        env={**env, "DCT_PREDICT_ENGINE": "cuda"},
+        capture_output=True, text=True,
+    )
+    assert r.returncode != 0
+    assert "DCT_PREDICT_ENGINE" in r.stderr
