@@ -394,10 +394,43 @@ class TrainStateCheckpointer:
         template = self._tree(state)
         treedef = jax.tree.structure(template)
         tleaves = jax.tree.leaves(template)
+
+        def _mismatch(detail: str) -> KeyError:
+            # The most common cause is a CONFIG change between runs — a
+            # different DCT_OPTIMIZER restructures opt_state, so the
+            # saved flat leaves no longer line up with this run's
+            # template. Name that instead of a bare index; a silent
+            # misaligned restore would train from garbage weights.
+            return KeyError(
+                f"Checkpoint {candidates[0]} does not match this run's "
+                f"TrainState: {detail}. Typically DCT_OPTIMIZER (or "
+                "another state-shaping knob) changed since the "
+                "checkpoint was written. Restore the original setting, "
+                f"or clear {self.dirpath} to restart the trajectory."
+            )
+
+        # Count check BOTH directions: a template with FEWER leaves than
+        # the checkpoint would otherwise restore silently with every flat
+        # index shifted onto the wrong (often identically-shaped) array.
+        saved_groups = {
+            k.split("_s")[0] for k in restored if k and k[0].isdigit()
+        }
+        if len(saved_groups) != len(tleaves):
+            raise _mismatch(
+                f"{len(saved_groups)} leaf groups saved, "
+                f"{len(tleaves)} expected"
+            )
         leaves = []
         for i, t in enumerate(tleaves):
             if str(i) in restored:
-                leaves.append(restored[str(i)])
+                whole = restored[str(i)]
+                if tuple(whole.shape) != tuple(getattr(t, "shape", ())):
+                    raise _mismatch(
+                        f"leaf {i} has shape {tuple(whole.shape)} on disk "
+                        f"but {tuple(getattr(t, 'shape', ()))} in the "
+                        "template"
+                    )
+                leaves.append(whole)
                 continue
             prefix = f"{i}_s"
             part_by_key = {
@@ -409,7 +442,7 @@ class TrainStateCheckpointer:
                 if k.startswith(prefix)
             }
             if not part_by_key:
-                raise KeyError(f"Checkpoint {candidates[0]} missing leaf {i}")
+                raise _mismatch(f"no data for template leaf {i}")
             leaves.append(self._reassemble(t, part_by_key))
         tree = jax.tree.unflatten(treedef, leaves)
         return state.replace(

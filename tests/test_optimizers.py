@@ -130,3 +130,32 @@ def test_sgd_decay_is_decoupled():
 def test_momentum_on_beta_optimizer_raises():
     with pytest.raises(ValueError, match="DCT_MOMENTUM"):
         make_optimizer(0.01, optimizer="adam", momentum=0.9)
+
+
+def test_cross_optimizer_resume_fails_loudly(tmp_path, weather_data):
+    """Resuming with a different DCT_OPTIMIZER restructures opt_state;
+    the restore must name the cause, not die on a bare leaf index."""
+
+    def run(optimizer, lr, resume):
+        cfg = RunConfig(
+            data=DataConfig(models_dir=str(tmp_path / "m_xres")),
+            train=TrainConfig(
+                epochs=1, batch_size=4, lr=lr, optimizer=optimizer,
+                resume=resume,
+            ),
+            tracking=TrackingConfig(experiment="opt"),
+        )
+        tracker = LocalTracking(
+            root=str(tmp_path / "r_xres"), experiment="opt"
+        )
+        return Trainer(cfg, tracker=tracker).fit(weather_data)
+
+    run("adam", 0.01, False)
+    # fewer template leaves than saved (adam -> adafactor) ...
+    with pytest.raises(KeyError, match="DCT_OPTIMIZER"):
+        run("adafactor", 0.003, True)
+    # ... and the REVERSE direction (more saved than template: adam's
+    # count+mu+nu vs sgd's bare trace) must also refuse — a silent
+    # index-shifted restore would load nu arrays as params.
+    with pytest.raises(KeyError, match="DCT_OPTIMIZER"):
+        run("sgd", 0.01, True)
