@@ -208,7 +208,21 @@ def make_epoch_train_step(donate: bool = True, accum_steps: int = 1):
     return jax.jit(epoch_train, donate_argnums=(0,) if donate else ())
 
 
-def make_epoch_train_eval_step(donate: bool = True, accum_steps: int = 1):
+def _epoch_donate(donate: bool, donate_stacks: bool) -> tuple:
+    """Donation sets for the fused train+eval programs: argnum 0 is the
+    state; 1-3 are the single-use epoch/span stacks (donating them frees
+    a full span of HBM before activations peak). The validation stacks
+    (4-6) are NEVER donated — they are reused every span. Callers that
+    re-dispatch the same stacks (the bench's timed repeats) must keep
+    donate_stacks=False or their second call reads donated buffers."""
+    nums = (0,) if donate else ()
+    if donate_stacks:
+        nums = nums + (1, 2, 3)
+    return nums
+
+
+def make_epoch_train_eval_step(donate: bool = True, accum_steps: int = 1,
+                               donate_stacks: bool = False):
     """Train epoch + full validation pass as ONE XLA program — one host
     dispatch per epoch where train-then-eval would cost two. On a slow
     control plane (tunneled TPU) the saved round trip is most of an
@@ -225,11 +239,13 @@ def make_epoch_train_eval_step(donate: bool = True, accum_steps: int = 1):
         state, losses = _epoch_train_scan(state, xs, ys, ws, accum_steps)
         return state, losses, _epoch_eval_scan(state, vxs, vys, vws)
 
-    return jax.jit(epoch_fused, donate_argnums=(0,) if donate else ())
+    donate_argnums = _epoch_donate(donate, donate_stacks)
+    return jax.jit(epoch_fused, donate_argnums=donate_argnums)
 
 
 def make_multi_epoch_train_eval_step(donate: bool = True,
-                                     accum_steps: int = 1):
+                                     accum_steps: int = 1,
+                                     donate_stacks: bool = False):
     """K training epochs, each followed by a full validation pass, as ONE
     XLA program — an outer ``lax.scan`` over epochs of the fused
     epoch-train+eval body. Numerically identical to K sequential calls of
@@ -257,7 +273,9 @@ def make_multi_epoch_train_eval_step(donate: bool = True,
         )
         return state, losses, val_sums
 
-    return jax.jit(multi_epoch, donate_argnums=(0,) if donate else ())
+    return jax.jit(
+        multi_epoch, donate_argnums=_epoch_donate(donate, donate_stacks)
+    )
 
 
 def make_eval_step():

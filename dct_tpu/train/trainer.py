@@ -320,9 +320,13 @@ class Trainer:
         if use_scan:
             # Built only for the per-epoch path: with epoch_chunk > 1
             # every span (including k == 1 remainders) dispatches the
-            # multi-epoch program instead.
+            # multi-epoch program instead. Span stacks are single-use in
+            # the trainer, so donating them frees a full span of HBM
+            # before the step's activations peak.
             if max(1, cfg.train.epoch_chunk) == 1:
-                epoch_fused = make_epoch_train_eval_step(accum_steps=accum)
+                epoch_fused = make_epoch_train_eval_step(
+                    accum_steps=accum, donate_stacks=True
+                )
         else:
             train_step = make_train_step(accum_steps=accum)
             eval_step = make_eval_step()
@@ -396,7 +400,9 @@ class Trainer:
         if chunk > 1:
             from dct_tpu.train.steps import make_multi_epoch_train_eval_step
 
-            multi_fused = make_multi_epoch_train_eval_step(accum_steps=accum)
+            multi_fused = make_multi_epoch_train_eval_step(
+                accum_steps=accum, donate_stacks=True
+            )
 
         # Epoch-ahead input pipeline (scan path): the next span's host
         # batch assembly + H2D staging runs on a worker thread WHILE the
