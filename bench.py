@@ -848,20 +848,34 @@ _PARTIAL_PATH = os.environ.get(
 )
 
 
-def _flush_partial(record: dict) -> None:
-    # Atomic replace: a SIGKILL mid-write must not corrupt the previous
-    # flush — that is the record this file exists to preserve.
+def _json_default(o):
+    """Serialization fallback for the partial record: a numpy scalar (or
+    anything else json chokes on) leaking into a leg value must degrade
+    to a representable form, never raise — a TypeError thrown FROM the
+    evidence hedge would kill the section it exists to protect."""
     try:
-        tmp_path = _PARTIAL_PATH + ".tmp"
+        return float(o)
+    except (TypeError, ValueError):
+        return str(o)
+
+
+def _flush_partial(record: dict) -> None:
+    # Serialize once, crash-proof (see _json_default), then atomic
+    # replace: a SIGKILL mid-write must not corrupt the previous flush —
+    # that is the record this file exists to preserve.
+    payload = json.dumps(record, default=_json_default)
+    tmp_path = _PARTIAL_PATH + ".tmp"
+    try:
         with open(tmp_path, "w") as f:
-            json.dump(record, f)
-            f.write("\n")
+            f.write(payload + "\n")
         os.replace(tmp_path, _PARTIAL_PATH)
     except OSError as e:  # read-only rigs: stderr echo still lands
         print(f"[bench] partial write failed: {e}", file=sys.stderr)
-    print(
-        f"[bench] partial: {json.dumps(record)}", file=sys.stderr, flush=True
-    )
+        try:
+            os.remove(tmp_path)
+        except OSError:
+            pass
+    print(f"[bench] partial: {payload}", file=sys.stderr, flush=True)
 
 
 def main():

@@ -1,0 +1,90 @@
+"""The bench's evidence-preservation machinery: streamed legs, partial
+flushes, and deadline gates. Round 4 lost ~35 min of on-chip scanned-leg
+measurements to an exception AFTER the legs had run — these tests pin
+the hedges that prevent a recurrence (bench.py:_leg/_flush_partial,
+_over_deadline, and the skip markers)."""
+
+import importlib
+import json
+import os
+import time
+
+import pytest
+
+
+@pytest.fixture()
+def bench_mod(tmp_path, monkeypatch):
+    # conftest.py already puts the repo root on sys.path for every test.
+    monkeypatch.setenv(
+        "DCT_BENCH_PARTIAL", str(tmp_path / "BENCH_PARTIAL.json")
+    )
+    import bench
+
+    bench = importlib.reload(bench)
+    yield bench
+    # Reload again so the monkeypatched partial path does not leak into
+    # other suites that import bench.
+    monkeypatch.undo()
+    importlib.reload(bench)
+
+
+def _partial(bench):
+    with open(bench._PARTIAL_PATH) as f:
+        return json.loads(f.read())
+
+
+def test_leg_streams_into_live_record(bench_mod):
+    rec = {"metric": "m"}
+    bench_mod._LIVE_RECORD = rec
+    try:
+        bench_mod._leg("attn_blockwise_ms", 12.34)
+        bench_mod._leg("attn_gqa", {"speedup": 1.5})
+    finally:
+        bench_mod._LIVE_RECORD = None
+    on_disk = _partial(bench_mod)
+    assert on_disk["scaled_legs"]["attn_blockwise_ms"] == 12.34
+    assert on_disk["scaled_legs"]["attn_gqa"] == {"speedup": 1.5}
+    assert rec["scaled_legs"] == on_disk["scaled_legs"]
+
+
+def test_leg_without_live_record_is_stderr_only(bench_mod, capsys):
+    bench_mod._LIVE_RECORD = None
+    bench_mod._leg("attn_flash_ms", 7.0)  # must not raise
+    assert "attn_flash_ms=7.0" in capsys.readouterr().err
+    assert not os.path.exists(bench_mod._PARTIAL_PATH)
+
+
+def test_partial_flush_is_atomic_and_additive(bench_mod):
+    bench_mod._flush_partial({"a": 1})
+    bench_mod._flush_partial({"a": 1, "b": 2})
+    assert _partial(bench_mod) == {"a": 1, "b": 2}
+    assert not os.path.exists(bench_mod._PARTIAL_PATH + ".tmp")
+
+
+def test_deadline_fraction_gates(bench_mod, monkeypatch):
+    monkeypatch.setattr(bench_mod, "_DEADLINE", 100.0)
+    # Shift the bench's own epoch so ~60s appear elapsed: over a 55%
+    # budget (55s), under the full deadline. (Patching bench state, not
+    # the global clock — stdlib perf_counter stays untouched.)
+    monkeypatch.setattr(
+        bench_mod, "_BENCH_T0", time.perf_counter() - 60.0
+    )
+    assert bench_mod._over_deadline("x", frac=0.55) is True
+    assert bench_mod._over_deadline("x") is False
+    # Deadline disabled -> never over, any fraction.
+    monkeypatch.setattr(bench_mod, "_DEADLINE", 0.0)
+    assert bench_mod._over_deadline("x", frac=0.55) is False
+
+
+def test_flush_survives_numpy_scalars(bench_mod):
+    """A np scalar leaking into a leg value must not raise FROM the
+    hedge (a TypeError here would kill the section it protects)."""
+    import numpy as np
+
+    bench_mod._flush_partial({
+        "v": np.float32(12.5), "flag": np.bool_(True),
+        "arr_note": np.int64(3),
+    })
+    on_disk = _partial(bench_mod)
+    assert on_disk["v"] == 12.5 and on_disk["flag"] == 1.0
+    assert not os.path.exists(bench_mod._PARTIAL_PATH + ".tmp")
