@@ -1025,7 +1025,9 @@ def main():
     for skippable in ("scaled", "moe", "serving", "host_dataplane"):
         record.setdefault(skippable, None)
     _flush_partial(record)
-    print(json.dumps(record))
+    # Same crash-proof serialization as the partials: the ONE deliverable
+    # line must not die on a numpy scalar that leaked into a leg value.
+    print(json.dumps(record, default=_json_default))
 
 
 if __name__ == "__main__":

@@ -37,10 +37,27 @@ for i in $(seq 1 "$N"); do
         >> "$REPO/.campaign_run.log" 2>&1 )
     rc=$?
     echo "$(date +%H:%M:%S) campaign exit=$rc" >> "$LOG"
+    if [ "$rc" -eq 0 ]; then
+      # Same live window, same single process slot: also land a full
+      # driver-style bench record as insurance against the relay being
+      # dead again at end-of-round bench time. Write via temp + mv so a
+      # bench crash cannot truncate a previous good record.
+      echo "$(date +%H:%M:%S) campaign done — running full bench" >> "$LOG"
+      ( cd "$REPO" && python bench.py \
+          > "$REPO/.bench_onchip.tmp" \
+          2>> "$REPO/.campaign_run.log" )
+      brc=$?
+      if [ "$brc" -eq 0 ] && [ -s "$REPO/.bench_onchip.tmp" ]; then
+        mv "$REPO/.bench_onchip.tmp" "$REPO/BENCH_ONCHIP_LATEST.json"
+        echo "$(date +%H:%M:%S) bench record landed" >> "$LOG"
+        exit 0
+      fi
+      rm -f "$REPO/.bench_onchip.tmp"
+      echo "$(date +%H:%M:%S) bench FAILED exit=$brc" >> "$LOG"
+      exit 6  # campaign ran but the insurance bench did not land
+    fi
     if [ "$rc" -ne 3 ]; then
-      # 0 = ran (jsonl has the numbers); other nonzero = real failure
-      # worth human eyes either way. 3 = refused (no TPU yet): keep
-      # polling.
+      # nonzero (not 3) = real failure worth human eyes.
       exit "$rc"
     fi
   else
