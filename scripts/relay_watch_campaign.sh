@@ -32,12 +32,16 @@ for i in $(seq 1 "$N"); do
   ts=$(date +%H:%M:%S)
   if [ -n "$up" ]; then
     echo "$ts port $up listening — waiting 30s then starting campaign" >> "$LOG"
-    if [ "$i" -gt "$((N / 2))" ]; then
-      # Late in the poll budget: run the SHORT agenda so campaign+bench
-      # finish inside the window instead of colliding with whatever
-      # claims the relay after it (e.g. the round's end-of-round bench).
+    if [ "$N" -ge 20 ] && [ "$i" -gt "$((N / 2))" ] \
+        && [ -z "${DCT_CAMPAIGN_SECTIONS:-}" ]; then
+      # Late in a LONG poll budget and no operator-chosen agenda: run
+      # the SHORT default so campaign+bench finish inside the window
+      # instead of colliding with whatever claims the relay after it
+      # (e.g. the round's end-of-round bench). An explicit
+      # DCT_CAMPAIGN_SECTIONS always wins; tiny budgets (interactive
+      # babysitting) never truncate.
       export DCT_CAMPAIGN_SECTIONS="mfu,moe,trainer"
-      export DCT_CAMPAIGN_MFU="base,dmodel1024"
+      export DCT_CAMPAIGN_MFU="${DCT_CAMPAIGN_MFU:-base,dmodel1024}"
       echo "$ts late window: short agenda ($DCT_CAMPAIGN_SECTIONS)" >> "$LOG"
     fi
     sleep 30
