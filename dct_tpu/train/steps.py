@@ -258,7 +258,15 @@ def make_multi_epoch_train_eval_step(donate: bool = True,
     xs/ys/ws: [K, S, B, ...]; the validation stacks [S_v, B, ...] are
     shared (fixed order) across epochs and NOT donated.
 
-    Returns (state, losses[K, S], val_sums[K, 6]).
+    Returns (state, losses[K, S], val_sums = 6-tuple of [K] arrays).
+    The sums come back as a TUPLE (the scan stacks each leaf separately)
+    rather than one jnp.stack'd [K, 6] array, so every sum keeps its own
+    dtype — a single f32 stack would silently coerce any future integer
+    count leaf, and hosts that want exactness can upcast each leaf to
+    float64 after device_get (ADVICE r4). Today all six are f32 weighted
+    sums by design (fractional sample weights), exact for integral
+    weights up to 2^24 per epoch — the k == 1 fused path shares that
+    bound, it is an accumulation property, not a stacking one.
     """
 
     def multi_epoch(state: TrainState, xs, ys, ws, vxs, vys, vws):
@@ -266,7 +274,7 @@ def make_multi_epoch_train_eval_step(donate: bool = True,
             exs, eys, ews = stacks
             st, losses = _epoch_train_scan(st, exs, eys, ews, accum_steps)
             sums = _epoch_eval_scan(st, vxs, vys, vws)
-            return st, (losses, jnp.stack(sums))
+            return st, (losses, sums)
 
         state, (losses, val_sums) = jax.lax.scan(
             epoch_body, state, (xs, ys, ws)

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import math
 import os
+import sys
 from dataclasses import dataclass, field
 
 import jax
@@ -80,6 +81,61 @@ def early_stop_update(
     if improved:
         return val_loss, 0, False
     return best, stale + 1, stale + 1 >= patience
+
+
+def span_shadow_warning(
+    history: list, span_end_vl_min: float, chunk: int
+) -> str | None:
+    """With ``epoch_chunk`` > 1 only span-END params exist on device, so
+    the deploy "best" checkpoint can only ever hold a span-end epoch. If
+    a mid-span epoch achieved the run's best val_loss, that optimum is
+    recorded in history but unreachable by the checkpoint — a silent
+    divergence operators should see named (ADVICE r4). Returns the
+    warning line, or None."""
+    if chunk <= 1 or not history:
+        return None
+    valid = [
+        h["val_loss"] for h in history if not math.isnan(h["val_loss"])
+    ]
+    if not valid or min(valid) >= span_end_vl_min - 1e-12:
+        return None
+    return (
+        f"[dct_tpu] epoch_chunk={chunk}: the run's best val_loss "
+        f"{min(valid):.6f} occurred MID-span; the deploy 'best' "
+        f"checkpoint holds the best span-END epoch "
+        f"({span_end_vl_min:.6f}). Lower DCT_EPOCH_CHUNK if the deploy "
+        "checkpoint must capture the optimum."
+    )
+
+
+def optimizer_identity(train_cfg) -> dict:
+    """The knobs that select (and can reshape) the optax state tree
+    (train.state.make_optimizer): the name picks the chain, ``momentum``
+    > 0 adds the sgd trace leaf, and a positive ``weight_decay`` turns
+    adam into adamw. Persisted in the train-state meta and compared
+    EXACTLY on resume: two configs can produce structurally isomorphic
+    opt_state trees (same leaf count, same shapes — e.g. adam vs adamw,
+    whose decay transform holds no state), so the count/shape heuristic
+    in checkpoint.manager.restore cannot catch a cross-restore between
+    them (ADVICE r4). Values are plain JSON scalars so the comparison
+    survives the meta.json round trip."""
+    # Same normalization as state.make_optimizer: 'Adam' and ' adam'
+    # build the identical chain and must not refuse each other.
+    name = str(train_cfg.optimizer).strip().lower()
+    wd = float(train_cfg.weight_decay)
+    # Mirror make_optimizer's chain selection exactly (state.py): adam
+    # with a positive weight_decay IS adamw, and adamw at wd == 0
+    # degenerates to adam — spellings that build the identical chain
+    # must not refuse each other's checkpoints.
+    if name == "adam" and wd > 0:
+        name = "adamw"
+    elif name == "adamw" and wd == 0:
+        name = "adam"
+    return {
+        "name": name,
+        "momentum": float(train_cfg.momentum),
+        "weight_decay": wd,
+    }
 
 
 @dataclass
@@ -267,14 +323,30 @@ class Trainer:
         #   state — each DAG run extends the same optimization trajectory.
         start_epoch = 0
         target_epochs = cfg.train.epochs
+        opt_identity = optimizer_identity(cfg.train)
         if cfg.train.resume and state_ckptr.exists():
+            saved = state_ckptr.load_meta()
+            saved_opt = saved.get("optimizer")
+            if saved_opt is not None and saved_opt != opt_identity:
+                # Named refusal BEFORE restore: opt_state trees of
+                # different optimizer configs can be structurally
+                # isomorphic (same leaf count/shapes), so the manager's
+                # count/shape check would let a cross-restore through and
+                # the run would train from mismatched moments.
+                raise RuntimeError(
+                    f"Resume refused: the checkpoint under "
+                    f"{state_ckptr.dirpath} was written by optimizer "
+                    f"{saved_opt} but this run configures {opt_identity}. "
+                    "Restore the original DCT_OPTIMIZER / DCT_MOMENTUM / "
+                    "DCT_WEIGHT_DECAY, or clear the train_state dir to "
+                    "restart the trajectory."
+                )
             # Restore yields host arrays; re-apply the mesh placement.
             state = shard_state_with_rules(
                 state_ckptr.restore(state), self.mesh,
                 shard_opt=cfg.train.shard_opt_state,
                 shard_params=cfg.train.shard_params,
             )
-            saved = state_ckptr.load_meta()
             if "epochs_completed" in saved:
                 start_epoch = int(saved["epochs_completed"])
             else:  # pre-meta checkpoint: derive from the step counter
@@ -387,12 +459,16 @@ class Trainer:
 
         es_best: float | None = None
         es_stale = 0
+        # For the epoch_chunk > 1 shadowing diagnostic: only span-END
+        # params ever exist on device, so only span-end epochs can become
+        # the deploy "best" checkpoint (ADVICE r4).
+        span_end_vl_min = float("inf")
 
         # Epoch chunking (scan path): fuse K epochs into one dispatch.
         # On a slow control plane every epoch pays a host round trip that
         # can dwarf the compute at parity batch sizes; chunking amortizes
         # it to 1/K. Per-epoch metrics are preserved (the fused program
-        # returns losses[K, S] and val_sums[K, 6]); checkpoints, resume
+        # returns losses[K, S] and a 6-tuple of [K] eval sums); checkpoints, resume
         # snapshots, and early-stop effects move to chunk boundaries
         # (config.TrainConfig.epoch_chunk documents the trade).
         chunk = max(1, cfg.train.epoch_chunk) if use_scan else 1
@@ -495,9 +571,18 @@ class Trainer:
                     import numpy as _np
 
                     if multi_fused is not None:
-                        # [K, S] losses / [K, 6] eval sums
+                        # [K, S] losses; val_sums is a 6-tuple of [K]
+                        # arrays (dtype-preserving — see
+                        # make_multi_epoch_train_eval_step). Stack on
+                        # host as float64 -> [K, 6] exact.
                         losses_host = _np.asarray(jax.device_get(losses))
-                        val_host = _np.asarray(jax.device_get(val_sums))
+                        val_host = _np.stack(
+                            [
+                                _np.asarray(v, dtype=_np.float64)
+                                for v in jax.device_get(val_sums)
+                            ],
+                            axis=1,
+                        )
                     else:  # [S] / 6-tuple — the k == 1 parity layout
                         losses_host = _np.asarray(
                             jax.device_get(losses)
@@ -641,6 +726,9 @@ class Trainer:
                             patience=cfg.train.early_stop_patience,
                             min_delta=cfg.train.early_stop_min_delta,
                         )
+                _span_end_vl = sub_epochs[-1][1]
+                if not math.isnan(_span_end_vl):
+                    span_end_vl_min = min(span_end_vl_min, _span_end_vl)
                 profiler.maybe_stop_span(epoch, k)
                 # Host-gather BEFORE the coordinator gate: with TP/SP
                 # spanning processes this is a collective every rank must
@@ -684,6 +772,9 @@ class Trainer:
                         "target_epochs": (
                             epoch + k if stop_early else target_epochs
                         ),
+                        # Exact resume refusal across optimizer configs
+                        # whose state trees are isomorphic (ADVICE r4).
+                        "optimizer": opt_identity,
                     },
                 )
                 epoch += k
@@ -739,6 +830,10 @@ class Trainer:
                     self.tracker.log_artifact(best_path, artifact_path="model")
         self.tracker.end_run()
 
+        if self.coordinator:
+            shadow = span_shadow_warning(history, span_end_vl_min, chunk)
+            if shadow:
+                print(shadow, file=sys.stderr, flush=True)
         final = history[-1] if history else {"val_loss": float("nan"), "val_acc": float("nan")}
         steady = timer.history[1:] if len(timer.history) > 1 else timer.history
         return TrainResult(

@@ -261,6 +261,11 @@ def run_trainer() -> None:
              lambda: {"samples_per_sec_per_chip":
                       round(bench.bench_trainer_loop(
                           data, tmp, max(2, bench.TIMED_EPOCHS)), 1)})
+        # North-star val-loss parity (BASELINE.md protocol row 1): the
+        # torch side runs on the host CPU, ours on whatever backend this
+        # campaign runs on — on-chip this IS the reference-vs-TPU band.
+        item("trainer", "val_parity",
+             lambda: bench.bench_val_parity(data, tmp))
 
 
 SECTIONS = {

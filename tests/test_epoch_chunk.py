@@ -168,3 +168,29 @@ def test_chunked_composes_with_zero1(tmp_path, weather_data):
     )
     assert [h["epoch"] for h in r_res.history] == [4, 5]
     assert np.isfinite(r_res.history[-1]["val_loss"])
+
+
+def test_span_shadow_warning_logic():
+    """ADVICE r4: when a mid-span epoch holds the run's best val_loss,
+    the divergence between history-best and (span-end-only) deploy
+    checkpoint must be named, not silent."""
+    from dct_tpu.train.trainer import span_shadow_warning
+
+    hist = [
+        {"val_loss": 0.5}, {"val_loss": 0.1},  # span 1: interior best
+        {"val_loss": 0.3}, {"val_loss": 0.2},  # span 2
+    ]
+    span_end_min = 0.2  # best among epochs 1 and 3 (span ends)
+    msg = span_shadow_warning(hist, span_end_min, chunk=2)
+    assert msg and "0.100000" in msg and "0.200000" in msg
+
+    # Span-end epoch IS the optimum -> silent.
+    assert span_shadow_warning(
+        [{"val_loss": 0.5}, {"val_loss": 0.1}], 0.1, chunk=2
+    ) is None
+    # chunk == 1: every epoch is a span end; never warns.
+    assert span_shadow_warning(hist, 0.2, chunk=1) is None
+    # NaN val_losses (no eval batches) must not poison the min().
+    assert span_shadow_warning(
+        [{"val_loss": float("nan")}], float("inf"), chunk=2
+    ) is None

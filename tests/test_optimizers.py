@@ -151,11 +151,100 @@ def test_cross_optimizer_resume_fails_loudly(tmp_path, weather_data):
         return Trainer(cfg, tracker=tracker).fit(weather_data)
 
     run("adam", 0.01, False)
-    # fewer template leaves than saved (adam -> adafactor) ...
+    # The meta now records which optimizer wrote the checkpoint, so the
+    # refusal is an exact, NAMED one from the trainer (ADVICE r4) —
+    # before restore(), catching even configs whose opt_state trees are
+    # structurally isomorphic (the count/shape heuristic in
+    # checkpoint.manager stays as the backstop for pre-meta checkpoints).
+    with pytest.raises(RuntimeError, match="DCT_OPTIMIZER"):
+        run("adafactor", 0.003, True)
+    # ... and the REVERSE direction (adam's count+mu+nu vs sgd's bare
+    # trace) must also refuse by name.
+    with pytest.raises(RuntimeError, match="DCT_OPTIMIZER"):
+        run("sgd", 0.01, True)
+
+
+def test_premeta_checkpoint_hits_manager_backstop(tmp_path, weather_data):
+    """A checkpoint whose meta.json predates the optimizer stanza (or
+    lost it) skips the trainer's identity refusal — the manager's
+    count/shape heuristic must still catch the cross-restore with its
+    named KeyError (the backstop the identity check layers on top of)."""
+    import glob
+    import json
+
+    def run(optimizer, lr, resume):
+        cfg = RunConfig(
+            data=DataConfig(models_dir=str(tmp_path / "m_pre")),
+            train=TrainConfig(
+                epochs=1, batch_size=4, lr=lr, optimizer=optimizer,
+                resume=resume,
+            ),
+            tracking=TrackingConfig(experiment="opt"),
+        )
+        tracker = LocalTracking(
+            root=str(tmp_path / "r_pre"), experiment="opt"
+        )
+        return Trainer(cfg, tracker=tracker).fit(weather_data)
+
+    run("adam", 0.01, False)
+    # Simulate a pre-meta checkpoint: strip the optimizer stanza.
+    metas = glob.glob(
+        str(tmp_path / "m_pre" / "train_state" / "**" / "meta.json"),
+        recursive=True,
+    )
+    assert metas
+    for path in metas:
+        with open(path) as f:
+            meta = json.load(f)
+        meta.pop("optimizer", None)
+        with open(path, "w") as f:
+            json.dump(meta, f)
     with pytest.raises(KeyError, match="DCT_OPTIMIZER"):
         run("adafactor", 0.003, True)
-    # ... and the REVERSE direction (more saved than template: adam's
-    # count+mu+nu vs sgd's bare trace) must also refuse — a silent
-    # index-shifted restore would load nu arrays as params.
-    with pytest.raises(KeyError, match="DCT_OPTIMIZER"):
-        run("sgd", 0.01, True)
+
+
+def test_isomorphic_opt_state_cross_restore_refused(tmp_path, weather_data):
+    """The case the count/shape heuristic CANNOT catch (ADVICE r4): adam
+    vs adam+weight_decay (auto-upgraded to adamw) produce opt_state trees
+    with identical leaf counts and shapes — only the persisted optimizer
+    identity distinguishes them."""
+
+    def run(resume, **kw):
+        cfg = RunConfig(
+            data=DataConfig(models_dir=str(tmp_path / "m_iso")),
+            train=TrainConfig(
+                epochs=1, batch_size=4, optimizer="adam", resume=resume,
+                **kw,
+            ),
+            tracking=TrackingConfig(experiment="opt"),
+        )
+        tracker = LocalTracking(
+            root=str(tmp_path / "r_iso"), experiment="opt"
+        )
+        return Trainer(cfg, tracker=tracker).fit(weather_data)
+
+    run(False)
+    with pytest.raises(RuntimeError, match="weight_decay"):
+        run(True, weight_decay=0.01)
+    # Matching config still resumes (extends the trajectory).
+    r = run(True)
+    assert [h["epoch"] for h in r.history] == [1]
+
+
+def test_optimizer_identity_canonicalizes_adamw_alias():
+    """Spellings that build the identical optax chain must produce the
+    same persisted identity: adam+wd>0 IS adamw (make_optimizer's
+    auto-upgrade), adamw at wd=0 degenerates to adam, and case/space
+    variants normalize."""
+    from dct_tpu.train.trainer import optimizer_identity
+
+    ident = lambda **kw: optimizer_identity(TrainConfig(**kw))
+    assert ident(optimizer="adam", weight_decay=0.01) == ident(
+        optimizer="adamw", weight_decay=0.01
+    )
+    assert ident(optimizer="adamw", weight_decay=0.0) == ident(
+        optimizer=" Adam ", weight_decay=0.0
+    )
+    assert ident(optimizer="adam", weight_decay=0.01) != ident(
+        optimizer="adam", weight_decay=0.0
+    )
