@@ -143,6 +143,12 @@ def fmt_trainer(items) -> list[str]:
                 f"- {r['item']}: {res['samples_per_sec_per_chip']} "
                 "samples/sec/chip"
             )
+        elif "torch_val_loss" in res:  # the trainer/val_parity item
+            lines.append(
+                f"- {r['item']}: torch val_loss {res['torch_val_loss']} "
+                f"vs jax {res['jax_val_loss']} "
+                f"(abs diff {res['abs_diff']})"
+            )
         else:
             lines.append(f"- {r['item']}: ERROR {res.get('error', '?')[:80]}")
     if "per_epoch" in vals and "chunked" in vals and vals["per_epoch"]:

@@ -299,6 +299,20 @@ def main() -> None:
         "platform": platform,
         "device": str(jax.devices()[0]),
     })
+    # Arm bench's _leg() streaming: legs measured INSIDE a borrowed bench
+    # section (e.g. bench_val_parity's torch half) flush into the bench
+    # partial file the moment they exist — without this, a relay death
+    # mid-item loses them (the jsonl only gets whole-item results). On a
+    # TPU run the partial carries platform:"tpu", so bench.py's
+    # prior_onchip stash can pick it up as same-rig evidence.
+    bench._LIVE_RECORD = {
+        "metric": "onchip_campaign_partial",
+        "platform": platform,
+        "generated_utc": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+    }
+    bench._flush_partial(bench._LIVE_RECORD)
     names = os.environ.get(
         "DCT_CAMPAIGN_SECTIONS", "mfu,flash,stripedk,moe,trainer"
     ).split(",")

@@ -62,9 +62,36 @@ for i in $(seq 1 "$N"); do
       if [ "$brc" -eq 0 ] && [ -s "$REPO/.bench_onchip.tmp" ]; then
         mv "$REPO/.bench_onchip.tmp" "$REPO/BENCH_ONCHIP_LATEST.json"
         echo "$(date +%H:%M:%S) bench record landed" >> "$LOG"
+        # Commit the evidence the moment it exists: measured on-chip
+        # numbers must survive a crashed session or a dead relay at
+        # end-of-round bench time (they are exactly what prior_onchip
+        # carries forward). Best-effort: a dirty-tree conflict must not
+        # turn a successful window into a nonzero exit.
+        # -f: BENCH_PARTIAL.json is tracked but gitignored, and git add
+        # refuses ignored paths (exit 1) even for tracked files — which
+        # would abort this chain before the commit. The commit is
+        # pathspec'd so operator-staged WIP can never be swept in.
+        (
+          cd "$REPO" \
+          && git add -f ONCHIP_CAMPAIGN.jsonl BENCH_ONCHIP_LATEST.json \
+               BENCH_PARTIAL.json 2>> "$LOG" \
+          && git commit \
+               -m "Land on-chip campaign results and insurance bench record" \
+               -- ONCHIP_CAMPAIGN.jsonl BENCH_ONCHIP_LATEST.json \
+                  BENCH_PARTIAL.json >> "$LOG" 2>&1
+        ) || echo "$(date +%H:%M:%S) evidence auto-commit failed" >> "$LOG"
         exit 0
       fi
       rm -f "$REPO/.bench_onchip.tmp"
+      # Even a failed insurance bench leaves streamed evidence: the
+      # campaign jsonl and whatever partial the bench flushed.
+      (
+        cd "$REPO" \
+        && git add -f ONCHIP_CAMPAIGN.jsonl BENCH_PARTIAL.json 2>> "$LOG" \
+        && git commit \
+             -m "Land on-chip campaign results (insurance bench failed)" \
+             -- ONCHIP_CAMPAIGN.jsonl BENCH_PARTIAL.json >> "$LOG" 2>&1
+      ) || echo "$(date +%H:%M:%S) evidence auto-commit failed" >> "$LOG"
       echo "$(date +%H:%M:%S) bench FAILED exit=$brc" >> "$LOG"
       exit 6  # campaign ran but the insurance bench did not land
     fi

@@ -1,0 +1,66 @@
+"""End-to-end smoke of ``bench.main()`` — the exact artifact the driver
+runs at end of round. The unit tests in test_val_parity.py /
+test_bench_record.py pin the pieces; this pins the WIRING: the one JSON
+line must land with the prior-onchip carry-forward, the val-parity
+numbers, and the probe stanza all present on a CPU-fallback run (the
+round-4 failure mode was precisely good pieces that never reached the
+driver's record)."""
+
+import importlib
+import json
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_main_cpu_record_carries_everything(
+    tmp_path, monkeypatch, capsys
+):
+    monkeypatch.setenv("DCT_BENCH_ROWS", "2000")
+    monkeypatch.setenv("DCT_BENCH_EPOCHS", "1")
+    monkeypatch.setenv("DCT_BENCH_TORCH_EPOCHS", "1")
+    monkeypatch.setenv("DCT_VAL_PARITY_EPOCHS", "1")
+    monkeypatch.setenv("DCT_BENCH_SCALED", "0")
+    monkeypatch.setenv(
+        "DCT_BENCH_PARTIAL", str(tmp_path / "BENCH_PARTIAL.json")
+    )
+    import bench
+
+    bench = importlib.reload(bench)
+    monkeypatch.setattr(bench, "_REPO_ROOT", str(tmp_path))
+    # Plant prior on-chip evidence the CPU run must carry forward.
+    onchip = {"platform": "tpu", "value": 8342288.0, "mfu": 0.21,
+              "generated_utc": "2026-07-31T04:00:00Z"}
+    (tmp_path / "BENCH_ONCHIP_LATEST.json").write_text(json.dumps(onchip))
+    (tmp_path / "ONCHIP_CAMPAIGN.jsonl").write_text(
+        json.dumps({"section": "campaign", "item": "start",
+                    "result": {"platform": "tpu"}}) + "\n"
+        + json.dumps({"section": "mfu", "item": "base", "t": 1753934400.0,
+                      "result": {"mfu": 0.21}}) + "\n"
+    )
+    try:
+        bench.main()
+    finally:
+        out = capsys.readouterr().out
+        monkeypatch.undo()
+        importlib.reload(bench)
+
+    record = json.loads(out.strip().splitlines()[-1])
+    # The driver's contract: one JSON line, headline fields present.
+    assert record["metric"] == "weather_parity_train_samples_per_sec_per_chip"
+    assert record["platform"] == "cpu"
+    assert record["value"] > 0
+    assert record["probe"]["platform"] == "cpu"
+    assert "generated_utc" in record
+    # Carry-forward: verbatim record + campaign digest, provenance-labeled.
+    po = record["prior_onchip"]
+    assert po["source"] == "BENCH_ONCHIP_LATEST.json"
+    assert po["record"] == onchip
+    assert po["captured_utc"] == "2026-07-31T04:00:00Z"
+    assert po["campaign"]["tpu_item_count"] == 1
+    # North-star val parity: both numbers in the driver record.
+    vp = record["val_parity"]
+    assert vp["torch_val_loss"] > 0 and vp["jax_val_loss"] > 0
+    # The partial on disk must equal the printed record (crash hedge).
+    with open(tmp_path / "BENCH_PARTIAL.json") as f:
+        assert json.load(f) == record

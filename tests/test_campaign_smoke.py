@@ -1,0 +1,98 @@
+"""End-to-end smoke of scripts/onchip_campaign.py — the machine that
+must not fail in a live relay window (VERDICT r4 weak-5: it had only
+ever run its refusal/exit-code paths). Runs the real script as a
+subprocess in CPU smoke mode with a tiny agenda and checks the jsonl
+contract the digest/carry-forward tooling depends on."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_campaign(tmp_path, extra_env):
+    env = {
+        k: v for k, v in os.environ.items()
+        if k != "PALLAS_AXON_POOL_IPS"  # skip axon registration entirely
+    }
+    env.update(
+        JAX_PLATFORMS="cpu",
+        DCT_CAMPAIGN_ALLOW_CPU="1",
+        DCT_CAMPAIGN_OUT=str(tmp_path / "campaign.jsonl"),
+        DCT_BENCH_PARTIAL=str(tmp_path / "partial.json"),
+        DCT_BENCH_ROWS="1000",
+        DCT_BENCH_EPOCHS="1",
+        DCT_VAL_PARITY_EPOCHS="1",
+        **extra_env,
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "onchip_campaign.py")],
+        env=env, capture_output=True, text=True, timeout=900, cwd=REPO,
+    )
+    lines = []
+    out_path = tmp_path / "campaign.jsonl"
+    if out_path.exists():
+        lines = [
+            json.loads(l)
+            for l in out_path.read_text().splitlines() if l.strip()
+        ]
+    return proc, lines
+
+
+@pytest.mark.slow
+def test_campaign_trainer_section_cpu_smoke(tmp_path):
+    proc, recs = _run_campaign(
+        tmp_path, {"DCT_CAMPAIGN_SECTIONS": "trainer"}
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # Contract: start record carries the platform (the carry-forward
+    # digest tracks it to exclude CPU smoke runs like this one).
+    assert recs[0]["section"] == "campaign" and recs[0]["item"] == "start"
+    assert recs[0]["result"]["platform"] == "cpu"
+    assert recs[-1] == {**recs[-1], "section": "campaign", "item": "end"}
+    items = {(r["section"], r["item"]) for r in recs}
+    assert ("trainer", "per_epoch") in items
+    assert ("trainer", "chunked") in items
+    assert ("trainer", "val_parity") in items
+    by_item = {r["item"]: r["result"] for r in recs if r["section"] == "trainer"}
+    assert by_item["per_epoch"]["samples_per_sec_per_chip"] > 0
+    assert by_item["val_parity"]["torch_val_loss"] > 0
+    # Every completed item carries its wall time (window budgeting).
+    assert all(
+        "seconds" in res or "error" in res for res in by_item.values()
+    ), by_item
+    # The campaign arms bench's _leg() streaming, so intra-item hedges
+    # (the torch half of val_parity) land in the partial file the moment
+    # they are measured — a relay death mid-item cannot lose them.
+    partial = json.loads((tmp_path / "partial.json").read_text())
+    assert partial["metric"] == "onchip_campaign_partial"
+    assert partial["platform"] == "cpu"
+    assert (
+        partial["scaled_legs"]["val_parity_torch"]["torch_val_loss"] > 0
+    )
+
+
+def test_campaign_refuses_cpu_without_optin(tmp_path):
+    env = {
+        k: v for k, v in os.environ.items()
+        if k != "PALLAS_AXON_POOL_IPS"
+    }
+    env.update(
+        JAX_PLATFORMS="cpu",
+        DCT_CAMPAIGN_OUT=str(tmp_path / "campaign.jsonl"),
+        DCT_CAMPAIGN_SECTIONS="trainer",
+    )
+    env.pop("DCT_CAMPAIGN_ALLOW_CPU", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "onchip_campaign.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    # Exit 3 = the watcher's "port up, no claimable TPU" retry signal;
+    # and the refusal must NOT pollute the results jsonl.
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
+    assert "REFUSED" in proc.stderr
+    assert not (tmp_path / "campaign.jsonl").exists()
