@@ -12,12 +12,17 @@ measured.
     python scripts/onchip_campaign.py            # full agenda
     DCT_CAMPAIGN_SECTIONS=mfu,flash python ...   # subset
 
-Sections (value order, VERDICT r3 items 2-4/8):
-  mfu     - scaled transformer at the base config, then bigger d_model /
-            remat variants (DCT_SCALED_* sweep through bench's section)
-  flash   - flash-vs-blockwise tile sweep at the scaled attention shape
-  moe     - sorted-vs-einsum dispatch at E=32 (the crossover regime)
-  trainer - product Trainer.fit() loop, chunked vs per-epoch dispatch
+Sections (default order = evidence value per tunnel-minute):
+  mfu      - scaled transformer: base config + the two knobs most likely
+             to raise MFU (DCT_SCALED_* sweep through bench's section)
+  moe      - sorted-vs-einsum dispatch at E=32 (the crossover regime)
+  trainer  - product Trainer.fit() loop, chunked vs per-epoch dispatch,
+             plus the north-star val-loss parity item
+  stripedk - first real Mosaic compile of the striped/windowed ring
+             kernel geometries
+  flash    - flash-vs-blockwise tile sweep at the scaled attention shape
+  mfu_deep - the remaining MFU sweep configs (d_model 768, seq2048+remat,
+             8 layers)
 """
 
 from __future__ import annotations
@@ -70,24 +75,58 @@ def item(section: str, name: str, fn) -> object:
     return out
 
 
-def run_mfu() -> None:
-    """DCT_SCALED_* sweep through bench's scaled section (scan-16 MFU).
-    Config order: the base record first (the driver measures this), then
-    the knobs most likely to raise MFU."""
+# The MFU sweep is split into a CORE pass (run first: the driver-record
+# config plus the two knobs most likely to raise MFU) and a DEEP pass
+# (appended after every other section): each scan-16 config costs a
+# ~5-7 min tunnel compile, relay windows have averaged under an hour,
+# and a window that dies mid-sweep must have already banked the MoE/
+# trainer/val-parity deliverables the old front-loaded order starved.
+MFU_CORE = [
+    ("base", {}, {}),
+    ("dmodel1024", {"d_model": 1024, "d_ff": 4096}, {}),
+    ("batch64", {}, {"batch": 64}),
+]
+MFU_DEEP = [
+    ("dmodel768", {"d_model": 768, "d_ff": 3072}, {}),
+    ("seq2048_remat", {"seq_len": 2048}, {"remat": "1"}),
+    ("layers8", {"n_layers": 8}, {}),
+]
+
+
+_MFU_FILTER_CHECKED = False
+
+
+def _run_mfu_configs(configs) -> None:
+    """DCT_SCALED_* sweep through bench's scaled section (scan-16 MFU)."""
+    global _MFU_FILTER_CHECKED
     base = dict(bench.SCALED)
     base_batch = bench.SCALED_BATCH
-    configs = [
-        ("base", {}, {}),
-        ("dmodel768", {"d_model": 768, "d_ff": 3072}, {}),
-        ("dmodel1024", {"d_model": 1024, "d_ff": 4096}, {}),
-        ("batch64", {}, {"batch": 64}),
-        ("seq2048_remat", {"seq_len": 2048}, {"remat": "1"}),
-        ("layers8", {"n_layers": 8}, {}),
-    ]
     wanted = os.environ.get("DCT_CAMPAIGN_MFU", "").strip()
     if wanted:
         keep = set(wanted.split(","))
+        known = {c[0] for c in MFU_CORE + MFU_DEEP}
+        if not _MFU_FILTER_CHECKED and keep - known:
+            # Once per run: a typo'd config name must leave a visible
+            # record, not silently consume a scarce relay window.
+            emit("mfu", "filter", {
+                "error": (
+                    f"unknown DCT_CAMPAIGN_MFU configs "
+                    f"{sorted(keep - known)}; known: {sorted(known)}"
+                )
+            })
+        _MFU_FILTER_CHECKED = True
         configs = [c for c in configs if c[0] in keep]
+        if not configs:
+            # Legit when the wanted names live in the OTHER mfu pass of
+            # a full-default run — but say so, in case the operator's
+            # section list never reaches that pass.
+            print(
+                f"[campaign] mfu pass empty after DCT_CAMPAIGN_MFU="
+                f"{wanted!r}; remaining configs are in the other "
+                "mfu/mfu_deep pass",
+                file=sys.stderr, flush=True,
+            )
+            return
     for name, upd, extra in configs:
         bench.SCALED = {**base, **upd}
         bench.SCALED_BATCH = int(extra.get("batch", base_batch))
@@ -99,6 +138,14 @@ def run_mfu() -> None:
     bench.SCALED = base
     bench.SCALED_BATCH = base_batch
     os.environ.pop("DCT_REMAT", None)
+
+
+def run_mfu() -> None:
+    _run_mfu_configs(MFU_CORE)
+
+
+def run_mfu_deep() -> None:
+    _run_mfu_configs(MFU_DEEP)
 
 
 def timeit(fn, *args, n=10):
@@ -270,6 +317,7 @@ def run_trainer() -> None:
 
 SECTIONS = {
     "mfu": run_mfu,
+    "mfu_deep": run_mfu_deep,
     "flash": run_flash,
     "stripedk": run_striped_kernels,
     "moe": run_moe,
@@ -313,8 +361,12 @@ def main() -> None:
         ),
     }
     bench._flush_partial(bench._LIVE_RECORD)
+    # Default order = evidence value per tunnel-minute: every VERDICT
+    # deliverable (core MFU, MoE E=32, chunked trainer + val parity,
+    # first Mosaic compile of the striped bodies) banks BEFORE the long
+    # flash tile sweep and the deep MFU configs.
     names = os.environ.get(
-        "DCT_CAMPAIGN_SECTIONS", "mfu,flash,stripedk,moe,trainer"
+        "DCT_CAMPAIGN_SECTIONS", "mfu,moe,trainer,stripedk,flash,mfu_deep"
     ).split(",")
     for name in [n.strip() for n in names if n.strip()]:
         fn = SECTIONS.get(name)
