@@ -1176,6 +1176,10 @@ def main():
     )
     try:
         _plat.ensure_live_backend(budget=probe_budget)
+        # Share compiled programs across the window's processes
+        # (campaign -> insurance bench -> driver bench): over the tunnel
+        # each scan program costs ~5-7 min to compile.
+        _plat.enable_compilation_cache()
     finally:
         if _plat.LAST_PROBE:
             record["probe"] = dict(_plat.LAST_PROBE)

@@ -57,6 +57,61 @@ def probe_default_backend(timeout: float = 150.0) -> str | None:
 LAST_PROBE: dict = {}
 
 
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at a shared on-disk dir.
+
+    Over the tunneled TPU a single scan-16 train program costs ~5-7 min
+    to compile, and a live relay window runs the SAME programs in
+    multiple processes back to back (campaign, then the insurance
+    bench, then possibly the driver's own bench) — without a persistent
+    cache every process pays every compile again. Called by the long-
+    running measurement entry points. ``DCT_JAX_CACHE``: ``off`` (and
+    the usual falsy spellings) disables; the default ``auto`` enables on
+    the TPU backend ONLY and silently returns None elsewhere (XLA:CPU
+    AOT entries are machine-feature-pinned — a mismatched load can
+    SIGILL); ``force`` enables on any backend.
+
+    Returns the cache dir in use, or None when disabled/unavailable.
+    """
+    mode = os.environ.get("DCT_JAX_CACHE", "auto").strip().lower()
+    if mode in ("0", "false", "no", "off", "disable", "none"):
+        return None
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001
+        return None
+    if mode != "force" and backend != "tpu":
+        # TPU-only by default: the cache exists for the tunnel's ~5-7 min
+        # compiles. XLA:CPU AOT entries are machine-feature-pinned and a
+        # mismatched load warns it "could lead to execution errors such
+        # as SIGILL" (observed on this rig) — a cache is never worth a
+        # possibly-crashing measurement process.
+        return None
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    path = (
+        cache_dir
+        or os.environ.get("DCT_JAX_CACHE_DIR")
+        or os.path.join(repo_root, ".jax_cache")
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Cache every compile that took >= 2 s: dispatch-tier programs
+        # are cheap to rebuild, but everything the tunnel makes slow
+        # (and every CPU scan program behind it) is worth keeping.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization,
+        # never a reason to fail a measurement run
+        sys.stderr.write(f"[dct_tpu] compilation cache unavailable: {e}\n")
+        return None
+    return path
+
+
 class BackendRequiredError(RuntimeError):
     """Raised under DCT_REQUIRE_TPU=1 when no accelerator came up."""
 
