@@ -4,6 +4,11 @@
 Sweeps (block_q, block_k) over the attention shapes the scaled bench uses
 and prints fwd / fwd+bwd step times for flash vs the XLA blockwise path.
 Run on the real chip:  python scripts/tune_flash.py
+
+NOTE: for unattended on-chip runs prefer the campaign's ``flash`` section
+(``scripts/onchip_campaign.py`` — same sweep, but every measurement
+streams to ONCHIP_CAMPAIGN.jsonl and survives a relay death; this script
+prints to stdout only). Kept as the interactive/quick variant.
 """
 
 from __future__ import annotations
