@@ -9,7 +9,10 @@ REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG="$REPO/.relay_watch.log"
 N="${1:-200}"
 SLEEP="${2:-120}"
-PORTS="8081 8083 8093 8103 8113 8123"
+# Overridable for the end-to-end rig (tests/test_watcher_e2e.py points
+# this at a dummy listener inside a cloned repo); the default is the
+# axon relay's real port set.
+PORTS="${DCT_RELAY_PORTS:-8081 8083 8093 8103 8113 8123}"
 
 # Single instance only: two watchers would both launch the campaign
 # against the relay's ONE serialized TPU session (a stale nohup from a

@@ -63,3 +63,26 @@ def weather_data(processed_dir):
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+def cpu_smoke_env(**overrides) -> dict:
+    """Subprocess env for CPU smoke runs of the measurement tooling
+    (campaign / watcher rigs): drops the axon pool registration, strips
+    any ambient DCT_* knobs (an operator's exported DCT_CAMPAIGN_OUT or
+    DCT_BENCH_PARTIAL would redirect a rig's evidence outside its
+    sandbox), pins the CPU backend and tiny work sizes. One definition
+    shared by every rig so the knob set cannot drift between them."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k != "PALLAS_AXON_POOL_IPS" and not k.startswith("DCT_")
+    }
+    env.update(
+        JAX_PLATFORMS="cpu",
+        DCT_BENCH_ROWS="1000",
+        DCT_BENCH_EPOCHS="1",
+        DCT_BENCH_TORCH_EPOCHS="1",
+        DCT_VAL_PARITY_EPOCHS="1",
+        DCT_BENCH_SCALED="0",
+    )
+    env.update({k: str(v) for k, v in overrides.items()})
+    return env

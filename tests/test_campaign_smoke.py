@@ -15,18 +15,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run_campaign(tmp_path, extra_env):
-    env = {
-        k: v for k, v in os.environ.items()
-        if k != "PALLAS_AXON_POOL_IPS"  # skip axon registration entirely
-    }
-    env.update(
-        JAX_PLATFORMS="cpu",
+    from tests.conftest import cpu_smoke_env
+
+    env = cpu_smoke_env(
         DCT_CAMPAIGN_ALLOW_CPU="1",
         DCT_CAMPAIGN_OUT=str(tmp_path / "campaign.jsonl"),
         DCT_BENCH_PARTIAL=str(tmp_path / "partial.json"),
-        DCT_BENCH_ROWS="1000",
-        DCT_BENCH_EPOCHS="1",
-        DCT_VAL_PARITY_EPOCHS="1",
         **extra_env,
     )
     proc = subprocess.run(

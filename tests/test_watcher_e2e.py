@@ -1,0 +1,139 @@
+"""End-to-end rig for the relay watcher's SUCCESS path — the flow the
+whole round hinges on (port up -> campaign -> insurance bench ->
+evidence auto-commit) and the one that had never executed anywhere
+(VERDICT r4 weak-5; its git-add-of-ignored-file bug shipped silently
+for exactly that reason).
+
+The rig clones this repo into tmp (the script derives its repo root
+from its own path, so every write and the auto-commit land in the
+clone), binds a dummy HTTP listener as the "relay", and runs the real
+script to completion in CPU smoke mode.
+"""
+
+import http.server
+import json
+import os
+import shutil
+import signal
+import subprocess
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_watcher_success_path_lands_and_commits_evidence(tmp_path):
+    clone = tmp_path / "clone"
+    subprocess.run(
+        ["git", "clone", "-q", "--no-hardlinks", REPO, str(clone)],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["git", "config", "user.email", "rig@example.com"],
+        cwd=clone, check=True,
+    )
+    subprocess.run(
+        ["git", "config", "user.name", "rig"], cwd=clone, check=True
+    )
+    # Overlay the CURRENT code (clone is HEAD; the working tree may be
+    # ahead mid-session — in the driver's clean checkout this is a
+    # no-op) for everything the watcher flow executes.
+    for rel in (
+        "scripts/relay_watch_campaign.sh",
+        "scripts/onchip_campaign.py",
+        "bench.py",
+    ):
+        shutil.copy(os.path.join(REPO, rel), clone / rel)
+    shutil.copytree(
+        os.path.join(REPO, "dct_tpu"), clone / "dct_tpu",
+        dirs_exist_ok=True,
+    )
+    subprocess.run(
+        ["git", "add", "-A"], cwd=clone, check=True, capture_output=True
+    )
+    subprocess.run(
+        ["git", "commit", "-q", "-m", "rig overlay", "--allow-empty"],
+        cwd=clone, check=True, capture_output=True,
+    )
+    head_before = subprocess.run(
+        ["git", "rev-parse", "HEAD"], cwd=clone, check=True,
+        capture_output=True, text=True,
+    ).stdout.strip()
+
+    # Bind port 0 directly: race-free vs a probe-then-rebind helper.
+    httpd = http.server.HTTPServer(
+        ("127.0.0.1", 0), http.server.SimpleHTTPRequestHandler
+    )
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    from tests.conftest import cpu_smoke_env
+
+    env = cpu_smoke_env(
+        DCT_RELAY_PORTS=port,
+        DCT_CAMPAIGN_ALLOW_CPU="1",
+        DCT_CAMPAIGN_SECTIONS="trainer",
+    )
+    # start_new_session so a timeout can kill the WHOLE tree — killing
+    # only the bash watcher would orphan the python campaign/bench
+    # grandchildren mid-write into tmp_path.
+    proc = subprocess.Popen(
+        ["bash", str(clone / "scripts" / "relay_watch_campaign.sh"),
+         "2", "5"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=clone, start_new_session=True,
+    )
+    try:
+        # sleep(30) + campaign + full bench must fit even on a loaded
+        # rig (the campaign smoke alone budgets 900 s).
+        stdout, stderr = proc.communicate(timeout=1800)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        proc.wait()
+        raise
+    finally:
+        httpd.shutdown()
+
+    log = (clone / ".relay_watch.log").read_text() if (
+        clone / ".relay_watch.log"
+    ).exists() else "(no log)"
+    assert proc.returncode == 0, (proc.returncode, log, stderr[-800:])
+
+    # The insurance bench record landed and is a valid driver-style line.
+    record = json.loads((clone / "BENCH_ONCHIP_LATEST.json").read_text())
+    assert record["metric"] == (
+        "weather_parity_train_samples_per_sec_per_chip"
+    )
+    assert record["platform"] == "cpu"  # smoke rig
+    assert record["val_parity"]["torch_val_loss"] > 0
+
+    # The campaign streamed its jsonl.
+    camp = [
+        json.loads(l)
+        for l in (clone / "ONCHIP_CAMPAIGN.jsonl").read_text().splitlines()
+    ]
+    assert ("trainer", "val_parity") in {
+        (r["section"], r["item"]) for r in camp
+    }
+
+    # And the evidence was auto-committed — the crash-protection the
+    # watcher exists to provide (nothing else from the tree swept in).
+    head_after = subprocess.run(
+        ["git", "rev-parse", "HEAD"], cwd=clone, check=True,
+        capture_output=True, text=True,
+    ).stdout.strip()
+    assert head_after != head_before, log
+    committed = subprocess.run(
+        ["git", "show", "--stat", "--name-only", "--format=%s", "HEAD"],
+        cwd=clone, check=True, capture_output=True, text=True,
+    ).stdout
+    assert "Land on-chip campaign results" in committed
+    assert "BENCH_ONCHIP_LATEST.json" in committed
+    assert "ONCHIP_CAMPAIGN.jsonl" in committed
+    status = subprocess.run(
+        ["git", "status", "--porcelain", "--untracked-files=no"],
+        cwd=clone, check=True, capture_output=True, text=True,
+    ).stdout
+    assert "bench.py" not in status  # tracked sources untouched
