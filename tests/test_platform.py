@@ -215,3 +215,27 @@ def test_ensure_keeps_live_backend(monkeypatch):
         assert jax.config.jax_platforms == "axon,cpu"
     finally:
         jax.config.update("jax_platforms", prev)
+
+
+def test_compilation_cache_gating(tmp_path, monkeypatch):
+    """TPU-only by default: XLA:CPU AOT cache entries are machine-
+    feature-pinned and a mismatched load can SIGILL (observed on this
+    rig) — on the CPU test backend the cache must stay off unless
+    forced, and every falsy spelling must disable it."""
+    from dct_tpu.utils.platform import enable_compilation_cache
+
+    cache = tmp_path / "jc"
+    for off in ("0", "false", "no", "off", "disable", "none"):
+        monkeypatch.setenv("DCT_JAX_CACHE", off)
+        assert enable_compilation_cache(str(cache)) is None
+    monkeypatch.setenv("DCT_JAX_CACHE", "auto")
+    assert enable_compilation_cache(str(cache)) is None  # cpu backend
+    monkeypatch.setenv("DCT_JAX_CACHE", "force")
+    import jax
+
+    try:
+        assert enable_compilation_cache(str(cache)) == str(cache)
+        assert cache.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
