@@ -55,9 +55,17 @@ def _client():
 
 
 def _orchestrator():
-    from dct_tpu.deploy.rollout import RolloutOrchestrator
+    from dct_tpu.deploy.rollout import (
+        RolloutOrchestrator,
+        package_run_correlation_id,
+    )
 
-    return RolloutOrchestrator(_client(), ENDPOINT_NAME, soak_seconds=SOAK_SECONDS)
+    # Each stage task is its own process; the package dir carries the
+    # shipped training cycle's run-correlation ID for its stage events.
+    return RolloutOrchestrator(
+        _client(), ENDPOINT_NAME, soak_seconds=SOAK_SECONDS,
+        run_id=package_run_correlation_id(DEPLOY_DIR),
+    )
 
 
 def prepare_package(**context):

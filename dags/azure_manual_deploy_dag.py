@@ -61,9 +61,15 @@ def prepare_package(**context):
 
 
 def force_deploy(**context):
-    from dct_tpu.deploy.rollout import RolloutOrchestrator
+    from dct_tpu.deploy.rollout import (
+        RolloutOrchestrator,
+        package_run_correlation_id,
+    )
 
-    ro = RolloutOrchestrator(_client(), ENDPOINT_NAME)
+    ro = RolloutOrchestrator(
+        _client(), ENDPOINT_NAME,
+        run_id=package_run_correlation_id(DEPLOY_DIR),
+    )
     ro.ensure_endpoint()
     ro.client.deploy(ENDPOINT_NAME, "blue", DEPLOY_DIR)
     ro.client.set_traffic(ENDPOINT_NAME, {"blue": 100})

@@ -123,7 +123,14 @@ with DAG(
         )
         launch = BashOperator(
             task_id="tpu_spmd_training",
-            bash_command=f"cd {_REPO} && DCT_RESUME={RESUME} {TRAIN_CMD}",
+            # Run-correlation ID minted at task runtime (fresh per DAG
+            # run); an externally exported DCT_RUN_ID wins. See
+            # dags/training_dag.py.
+            bash_command=(
+                f"cd {_REPO} && "
+                'DCT_RUN_ID="${DCT_RUN_ID:-dct-$(date +%s)-$$}" '
+                f"DCT_RESUME={RESUME} {TRAIN_CMD}"
+            ),
             execution_timeout=timedelta(hours=3),
         )
     else:

@@ -89,7 +89,15 @@ with DAG(
         )
         launch = BashOperator(
             task_id="tpu_spmd_training",
-            bash_command=f"cd {_REPO} && DCT_RESUME={RESUME} {TRAIN_CMD}",
+            # Run-correlation ID minted at TASK runtime (fresh per DAG
+            # run, unlike script-build-time minting): every event record
+            # of this training cycle — trainer, checkpoint, tracking —
+            # carries it. An externally exported DCT_RUN_ID wins.
+            bash_command=(
+                f"cd {_REPO} && "
+                'DCT_RUN_ID="${DCT_RUN_ID:-dct-$(date +%s)-$$}" '
+                f"DCT_RESUME={RESUME} {TRAIN_CMD}"
+            ),
             execution_timeout=timedelta(hours=3),
         )
     else:

@@ -32,6 +32,8 @@ import jax
 import numpy as np
 from flax import serialization
 
+from dct_tpu.observability import events as _events
+
 
 def needs_cross_process_gather(tree) -> bool:
     """True when any leaf is sharded across processes (not addressable
@@ -123,6 +125,12 @@ class BestLastCheckpointer:
                     os.remove(self.best_model_path)
             self.best_value = value
             self.best_model_path = new_path
+        _events.get_default().emit(
+            "checkpoint", "best_saved" if improved else "last_saved",
+            epoch=int(epoch),
+            path=self.best_model_path if improved else self.last_path,
+            **{self.monitor: value},
+        )
         return improved
 
 
@@ -269,6 +277,13 @@ class TrainStateCheckpointer:
         os.rename(next_dir, live)
         if os.path.isdir(old):
             shutil.rmtree(old)
+        # Emitted from whichever thread published (EventLog is locked);
+        # the resume tier is per-process, so every rank's event appears,
+        # rank-stamped, in the shared log.
+        _events.get_default().emit(
+            "checkpoint", "resume_state_saved", dir=live,
+            epochs_completed=(meta or {}).get("epochs_completed"),
+        )
         return live
 
     def save_async(self, state, meta: dict | None = None) -> None:

@@ -378,6 +378,51 @@ class ProfileConfig:
 
 
 @dataclass
+class ObservabilityConfig:
+    """The operator plane (dct_tpu.observability): structured event log,
+    goodput/badput ledger, rank heartbeats, Prometheus metrics dump.
+
+    ON by default — observability that must be remembered per-run is
+    observability that is absent during the incident. All sinks live
+    under ``logs/`` (gitignored) unless redirected; every writer
+    degrades to a no-op on OS errors, so a full disk never fails a run.
+
+    ``run_id`` is the run-correlation ID stamped on every event record:
+    normally minted by the DAG/launcher and delivered via ``DCT_RUN_ID``
+    so all ranks of one continuous-training cycle agree; a process that
+    was never launched mints its own.
+    """
+
+    enabled: bool = True
+    events_dir: str = "logs/events"
+    run_id: str | None = None
+    heartbeat_dir: str = "logs/heartbeats"
+    # Same-phase heartbeats inside this window are throttled (writes are
+    # tiny, but per-step beats must not become an I/O hot loop).
+    heartbeat_interval: float = 5.0
+    # A heartbeat older than this marks its rank stalled to the monitor.
+    heartbeat_stall_seconds: float = 120.0
+    # End-of-run Prometheus text dump; "" = <events_dir>/train_metrics.prom.
+    metrics_path: str = ""
+
+    @classmethod
+    def from_env(cls) -> "ObservabilityConfig":
+        c = cls()
+        c.enabled = _env("DCT_OBSERVABILITY", c.enabled, bool)
+        c.events_dir = _env("DCT_EVENTS_DIR", c.events_dir, str)
+        c.run_id = os.environ.get("DCT_RUN_ID") or c.run_id
+        c.heartbeat_dir = _env("DCT_HEARTBEAT_DIR", c.heartbeat_dir, str)
+        c.heartbeat_interval = _env(
+            "DCT_HEARTBEAT_INTERVAL", c.heartbeat_interval, float
+        )
+        c.heartbeat_stall_seconds = _env(
+            "DCT_HEARTBEAT_STALL_SECONDS", c.heartbeat_stall_seconds, float
+        )
+        c.metrics_path = _env("DCT_METRICS_PROM", c.metrics_path, str)
+        return c
+
+
+@dataclass
 class RunConfig:
     """Top-level bundle passed to the Trainer."""
 
@@ -388,6 +433,7 @@ class RunConfig:
     dist: DistributedConfig = field(default_factory=DistributedConfig)
     tracking: TrackingConfig = field(default_factory=TrackingConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
+    obs: ObservabilityConfig = field(default_factory=ObservabilityConfig)
 
     @classmethod
     def from_env(cls) -> "RunConfig":
@@ -399,6 +445,7 @@ class RunConfig:
             dist=DistributedConfig.from_env(),
             tracking=TrackingConfig.from_env(),
             profile=ProfileConfig.from_env(),
+            obs=ObservabilityConfig.from_env(),
         )
 
     def to_dict(self) -> dict:

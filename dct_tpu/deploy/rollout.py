@@ -79,12 +79,43 @@ def prepare_package(tracker, deploy_dir: str) -> dict:
     shutil.rmtree(os.path.join(deploy_dir, "_dl"))
 
     meta = generate_score_package(model_ckpt, deploy_dir)
+    # Persist the shipped model's provenance INSIDE the package: each
+    # rollout stage runs in its own Airflow task process with no env
+    # inheritance from the training launch, and the package dir is the
+    # one artifact every stage shares — so it carries the training
+    # cycle's run-correlation ID for the stage events to adopt.
+    import json
+
+    with open(os.path.join(deploy_dir, "run_info.json"), "w") as f:
+        json.dump(
+            {
+                "tracking_run_id": best.run_id,
+                "run_correlation_id": best.run_correlation_id,
+                "val_loss": best.metrics.get("val_loss"),
+            },
+            f,
+            indent=2,
+        )
     return {
         "run_id": best.run_id,
+        "run_correlation_id": best.run_correlation_id,
         "val_loss": best.metrics.get("val_loss"),
         "deploy_dir": deploy_dir,
         "model_meta": meta,
     }
+
+
+def package_run_correlation_id(package_dir: str) -> str | None:
+    """The training cycle's run-correlation ID persisted by
+    :func:`prepare_package`; None for pre-observability packages or any
+    read failure (correlation is best-effort, never a deploy blocker)."""
+    import json
+
+    try:
+        with open(os.path.join(package_dir, "run_info.json")) as f:
+            return json.load(f).get("run_correlation_id") or None
+    except (OSError, ValueError):
+        return None
 
 
 def choose_slot(traffic: dict[str, int]) -> tuple[str, str | None]:
@@ -127,6 +158,7 @@ class RolloutOrchestrator:
         canary_percent: int = 10,
         soak_seconds: float = 30.0,
         sleep_fn=time.sleep,
+        run_id: str | None = None,
     ):
         self.client = client
         self.endpoint = endpoint
@@ -135,6 +167,10 @@ class RolloutOrchestrator:
         self.soak_seconds = soak_seconds
         self.sleep_fn = sleep_fn
         self.events: list[RolloutEvent] = []
+        # Run-correlation ID for stage events: pass the shipped
+        # package's (package_run_correlation_id); deploy_new_slot adopts
+        # it from the package automatically when unset.
+        self.run_id = run_id
 
     # -- stages --------------------------------------------------------
     def ensure_endpoint(self) -> None:
@@ -149,6 +185,8 @@ class RolloutOrchestrator:
             c.create_endpoint(self.endpoint)
 
     def deploy_new_slot(self, package_dir: str) -> tuple[str, str | None]:
+        if self.run_id is None:
+            self.run_id = package_run_correlation_id(package_dir)
         self.ensure_endpoint()
         new_slot, old_slot = choose_slot(self.client.get_traffic(self.endpoint))
         self.client.deploy(self.endpoint, new_slot, package_dir)
@@ -190,10 +228,22 @@ class RolloutOrchestrator:
         return self.events
 
     def _record(self, stage: str) -> None:
-        self.events.append(
-            RolloutEvent(
-                stage=stage,
-                traffic=dict(self.client.get_traffic(self.endpoint)),
-                mirror=dict(self.client.get_mirror_traffic(self.endpoint)),
-            )
+        ev = RolloutEvent(
+            stage=stage,
+            traffic=dict(self.client.get_traffic(self.endpoint)),
+            mirror=dict(self.client.get_mirror_traffic(self.endpoint)),
+        )
+        self.events.append(ev)
+        # Stage events adopt the SHIPPED training cycle's
+        # run-correlation ID (from the package's run_info.json / ctor)
+        # so one grep spans train -> deploy; a standalone rollout falls
+        # back to the process default.
+        from dct_tpu.observability import events as _events
+
+        log = _events.get_default()
+        if self.run_id and self.run_id != log.run_id:
+            log = _events.EventLog(log.path, run_id=self.run_id, rank=log.rank)
+        log.emit(
+            "deploy", stage, endpoint=self.endpoint,
+            traffic=ev.traffic, mirror=ev.mirror,
         )

@@ -30,8 +30,29 @@ import os
 import shlex
 import signal
 import subprocess
+import sys
 import time
 from dataclasses import dataclass
+
+from dct_tpu.observability.events import (
+    EventLog,
+    mint_run_id,
+    observability_enabled,
+)
+from dct_tpu.observability.heartbeat import HeartbeatMonitor
+
+
+def _launcher_event_log(env: dict) -> EventLog:
+    """The orchestrator-side event log, built from the SAME env the ranks
+    will inherit so launcher and rank records land in one file under one
+    run-correlation ID (rank=None marks orchestrator records)."""
+    events_dir = env.get("DCT_EVENTS_DIR", "logs/events")
+    enabled = observability_enabled(env) and bool(events_dir)
+    return EventLog(
+        os.path.join(events_dir, "events.jsonl") if enabled else None,
+        run_id=env["DCT_RUN_ID"],
+        rank=None,
+    )
 
 
 def remote_command(exec_template: str, host: str, command: str) -> str:
@@ -89,9 +110,22 @@ def build_spmd_launch_script(
     stagger_seconds: int = 5,
     extra_env: dict[str, str] | None = None,
     fail_fast_poll_seconds: int = 2,
+    run_id: str | None = None,
 ) -> str:
     """Generate the launch block: same program on every host, coordinator
     env injected, staggered start, fail-fast join, exit-code conjunction.
+
+    Every rank additionally receives the same ``DCT_RUN_ID``
+    run-correlation ID, so one grep over the structured event log
+    reconstructs the whole launch. The ID is resolved when the script
+    RUNS, not when it is built (``run_id`` arg pins it; otherwise the
+    runtime environment's ``DCT_RUN_ID``, else minted by the script) —
+    Airflow renders BashOperator commands at DAG-parse time, and a
+    parse-time mint would be shared by every run of the parsed script.
+    The value is spliced into each rank's env as an unquoted ``$RUN_ID``
+    expansion OUTSIDE the shlex-quoted command token, so it expands on
+    the LAUNCHER host for every exec template (ssh flattens one quoting
+    level; the remote shell must never see the bare variable).
 
     Host 0 is the coordinator (MASTER_ADDR), mirroring the reference env
     contract (docker-compose.yml:121-124) so the same script works under
@@ -108,18 +142,44 @@ def build_spmd_launch_script(
     """
     world = len(hosts)
     master = hosts[0]
-    lines = [f"echo 'Launching SPMD training on {world} hosts...'", "set -m"]
+    # Placeholder protocol: the env prefix carries a token that survives
+    # shlex.quote unchanged; after quoting, the token is replaced by
+    # '"$RUN_ID"' — closing the single-quoted command token, splicing a
+    # double-quoted launcher-side expansion, and reopening it. Every
+    # exec template therefore ships the RESOLVED id, never the variable.
+    _PH = "__DCT_RUN_ID__"
+    lines = [
+        f"echo 'Launching SPMD training on {world} hosts...'",
+        (
+            f"RUN_ID={shlex.quote(run_id)}"
+            if run_id
+            else 'RUN_ID="${DCT_RUN_ID:-dct-$(date +%s)-$$}"'
+        ),
+        # The splice below expands $RUN_ID OUTSIDE the quoted command
+        # token and the remote shell re-parses the result, so the value
+        # MUST be shell-inert: strip to the id alphabet (an operator's
+        # 'run 2026' or a $(...) would otherwise split or execute on
+        # every host), and re-mint if nothing survives.
+        "RUN_ID=\"$(printf %s \"$RUN_ID\" | tr -cd 'A-Za-z0-9._-')\"",
+        'RUN_ID="${RUN_ID:-dct-$$}"',
+        'echo "run_id=$RUN_ID"',
+        "set -m",
+    ]
     for rank, host in enumerate(hosts):
         env = {
             "MASTER_ADDR": master,
             "MASTER_PORT": str(coordinator_port),
             "NODE_RANK": str(rank),
             "WORLD_SIZE": str(world),
+            "DCT_RUN_ID": _PH,
             **(extra_env or {}),
         }
         env_prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
         full = f"{env_prefix} {command}"
-        lines.append(remote_command(exec_template, host, full) + " &")
+        launch_line = remote_command(exec_template, host, full).replace(
+            _PH, "'\"$RUN_ID\"'"
+        )
+        lines.append(launch_line + " &")
         lines.append(f"PID{rank}=$!")
         lines.append(f"DONE{rank}=0")
         if rank == 0 and world > 1:
@@ -184,7 +244,18 @@ class RankResult:
 class LocalProcessLauncher:
     """The two-container rig, without containers: N local processes running
     the identical SPMD program with coordinator env, staggered start, join,
-    and exit-code conjunction."""
+    and exit-code conjunction.
+
+    Observability duties (the launcher already babysits the ranks, so it
+    is the natural monitor): it MINTS the run-correlation ID and passes
+    it to every rank via ``DCT_RUN_ID``; it emits launcher events
+    (launch_start / rank_exit / rank_stalled / launch_end) into the same
+    event log the ranks write; and while joined on the ranks it scans
+    their heartbeat files, REPORTING stalled/dead/straggling ranks and
+    progress skew instead of waiting silently. Detection never kills: a
+    stalled-but-alive rank may be paying a long compile — the operator
+    signal is the point, fail-fast on real exits stays the enforcement.
+    """
 
     def __init__(
         self,
@@ -194,12 +265,18 @@ class LocalProcessLauncher:
         timeout: float = 600.0,
         fail_fast: bool = True,
         poll_seconds: float = 0.2,
+        heartbeat_dir: str | None = None,
+        heartbeat_stall_seconds: float = 120.0,
+        heartbeat_scan_seconds: float = 5.0,
     ):
         self.coordinator_port = coordinator_port
         self.stagger_seconds = stagger_seconds
         self.timeout = timeout
         self.fail_fast = fail_fast
         self.poll_seconds = poll_seconds
+        self.heartbeat_dir = heartbeat_dir
+        self.heartbeat_stall_seconds = heartbeat_stall_seconds
+        self.heartbeat_scan_seconds = heartbeat_scan_seconds
 
     def cleanup_zombies(self, pattern: str) -> None:
         subprocess.run(["pkill", "-9", "-f", pattern], check=False)
@@ -215,6 +292,40 @@ class LocalProcessLauncher:
         procs: list[subprocess.Popen] = []
         base_env = dict(os.environ)
         base_env.update(env or {})
+        # Correlation: one run ID for the whole launch, minted here (the
+        # launcher is the minter of record) unless the caller/DAG already
+        # chose one — every rank inherits it via env.
+        base_env["DCT_RUN_ID"] = base_env.get("DCT_RUN_ID") or mint_run_id()
+        if self.heartbeat_dir:
+            base_env.setdefault("DCT_HEARTBEAT_DIR", self.heartbeat_dir)
+        events = _launcher_event_log(base_env)
+        events.emit(
+            "launcher", "launch_start",
+            world_size=world_size, argv=list(argv),
+        )
+        # Default to the SAME dir ObservabilityConfig defaults the ranks
+        # to (they inherit this cwd): out of the box the monitor is
+        # ARMED, not waiting for an operator to remember a knob.
+        hb_dir = (
+            base_env.get("DCT_HEARTBEAT_DIR")
+            or self.heartbeat_dir
+            or "logs/heartbeats"
+        )
+        # Gated on the SAME observability switch the ranks honor: with
+        # DCT_OBSERVABILITY off no rank writes beats, and an ungated
+        # monitor would report every healthy rank missing.
+        monitor = (
+            HeartbeatMonitor(
+                hb_dir,
+                world_size,
+                stall_seconds=self.heartbeat_stall_seconds,
+                run_id=base_env["DCT_RUN_ID"],
+            )
+            if hb_dir and observability_enabled(base_env)
+            else None
+        )
+        flagged: set[tuple[int, str]] = set()
+        last_scan = 0.0
         try:
             for rank in range(world_size):
                 rank_env = dict(base_env)
@@ -249,11 +360,23 @@ class LocalProcessLauncher:
                         continue
                     codes[rank] = rc
                     progressed = True
+                    events.emit(
+                        "launcher", "rank_exit", exited_rank=rank,
+                        returncode=rc,
+                    )
                     if rc != 0 and self.fail_fast and not killed:
                         killed = True
                         for q in procs:
                             if q.poll() is None:
                                 _kill_group(q)
+                # Liveness beyond PIDs: a rank can be alive and wedged in
+                # a collective. Scan heartbeats on a slow cadence and
+                # NAME stalled/missing ranks while still joined.
+                if monitor is not None and (
+                    time.monotonic() - last_scan >= self.heartbeat_scan_seconds
+                ):
+                    last_scan = time.monotonic()
+                    self._flag_heartbeats(monitor, codes, flagged, events)
                 if not progressed and len(codes) < world_size:
                     time.sleep(self.poll_seconds)
             for rank, p in enumerate(procs):
@@ -265,7 +388,18 @@ class LocalProcessLauncher:
                         _kill_group(p)
                         p.wait()
                         rc = -signal.SIGKILL
+                        events.emit(
+                            "launcher", "rank_timeout_killed",
+                            exited_rank=rank,
+                        )
                     codes[rank] = rc
+            skew = monitor.report() if monitor is not None else {}
+            events.emit(
+                "launcher", "launch_end",
+                returncodes=[codes[r] for r in range(world_size)],
+                success=all(codes[r] == 0 for r in range(world_size)),
+                **{k: skew[k] for k in ("epoch_skew", "step_skew") if k in skew},
+            )
             return [
                 RankResult(rank=r, returncode=codes[r])
                 for r in range(world_size)
@@ -274,6 +408,46 @@ class LocalProcessLauncher:
             for p in procs:
                 if p.poll() is None:
                     _kill_group(p)
+
+    def _flag_heartbeats(
+        self,
+        monitor: HeartbeatMonitor,
+        codes: dict[int, int],
+        flagged: set,
+        events: EventLog,
+    ) -> None:
+        """One monitor pass: warn (stderr + event) once per (rank, state)
+        for stalled/missing ranks that have not exited, and once per new
+        epoch-skew level when ranks visibly diverge."""
+        statuses = monitor.scan()
+        for s in statuses:
+            if s.rank in codes or s.state not in ("stalled", "missing"):
+                continue
+            key = (s.rank, s.state)
+            if key in flagged:
+                continue
+            flagged.add(key)
+            age = f" (last beat {s.age_seconds:.0f}s ago)" if s.age_seconds else ""
+            print(
+                f"[launcher] rank {s.rank} heartbeat {s.state}{age} — "
+                "process alive but not progressing"
+                if s.state == "stalled"
+                else f"[launcher] rank {s.rank} has written no heartbeat",
+                file=sys.stderr, flush=True,
+            )
+            events.emit(
+                "launcher", f"rank_{s.state}", flagged_rank=s.rank,
+                age_seconds=s.age_seconds, step=s.step, epoch=s.epoch,
+            )
+        skew = monitor.skew(statuses)
+        if skew["epoch_skew"] > 1 and ("skew", skew["epoch_skew"]) not in flagged:
+            flagged.add(("skew", skew["epoch_skew"]))
+            print(
+                f"[launcher] straggler skew: ranks span {skew['epoch_skew']}"
+                f" epochs / {skew['step_skew']} steps",
+                file=sys.stderr, flush=True,
+            )
+            events.emit("launcher", "rank_skew", **skew)
 
     @staticmethod
     def all_succeeded(results: list[RankResult]) -> bool:

@@ -45,6 +45,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from dct_tpu.parallel.shard_map_compat import shard_map
 from jax import lax
 from jax.sharding import PartitionSpec as P
 from flax import linen as nn
@@ -344,7 +346,7 @@ class MoEFFN(nn.Module):
         # replicated over ``model``, but the vma type system cannot prove
         # value-equality after a collective; numerics are pinned against
         # the single-shard engine by tests.
-        return jax.shard_map(
+        return shard_map(
             body,
             mesh=mesh,
             in_specs=(

@@ -1,0 +1,48 @@
+"""Platform-wide telemetry: the operator plane the reference lacks.
+
+The reference platform's only observability is stdout prints scraped from
+Airflow task logs (SURVEY §5.1). This package is the TPU-scale operator
+plane built on four pillars:
+
+- :mod:`events` — append-only structured JSONL event log with a
+  run-correlation ID minted by the DAG/launcher and passed via env to
+  every rank, so ONE grep reconstructs a whole continuous-training cycle
+  (launch -> train -> checkpoint -> tracking -> deploy) across processes.
+- :mod:`goodput` — wall-clock ledger classifying run time into
+  train_step / eval / compile / checkpoint / data_wait /
+  startup_recovery, the "what fraction of the run was productive?"
+  accounting the pjit/TPUv4 training reports treat as first-class.
+- :mod:`heartbeat` — per-rank liveness files + a launcher-side monitor
+  that names stalled/dead/straggling ranks instead of waiting silently
+  on join.
+- :mod:`prometheus` — text-exposition (0.0.4) rendering for the serving
+  server's ``GET /metrics`` and the trainer's end-of-run metrics dump.
+
+Everything here is dependency-free, failure-isolated (a full disk or an
+unwritable dir degrades telemetry to a no-op, never fails training), and
+clock-injectable for tests.
+"""
+
+from dct_tpu.observability.events import (  # noqa: F401
+    EventLog,
+    current_run_id,
+    event_log_from_config,
+    get_default,
+    mint_run_id,
+    set_default,
+)
+from dct_tpu.observability.goodput import (  # noqa: F401
+    CATEGORIES,
+    GoodputLedger,
+)
+from dct_tpu.observability.heartbeat import (  # noqa: F401
+    HeartbeatMonitor,
+    HeartbeatWriter,
+    RankStatus,
+)
+from dct_tpu.observability.prometheus import (  # noqa: F401
+    LATENCY_BUCKETS,
+    HistogramAccumulator,
+    MetricFamily,
+    render,
+)

@@ -1,0 +1,77 @@
+"""End-of-run Prometheus text dump for the trainer.
+
+Training jobs are batch processes — nothing scrapes them live on a
+hermetic TPU-VM. The standard bridge is the textfile pattern (Prometheus
+node-exporter ``--collector.textfile.directory``): the run writes its
+final metrics as an exposition-format file and any file-shipping agent
+turns them into series. Same metric names every run, labelled by the
+run-correlation ID, so goodput is chartable across continuous-training
+cycles.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+from dct_tpu.observability.prometheus import MetricFamily, render
+
+
+def write_train_metrics_prom(
+    path: str,
+    goodput_summary: dict,
+    *,
+    run_id: str,
+    samples_per_sec: float = 0.0,
+    val_loss: float | None = None,
+) -> str | None:
+    """Write the run's final metrics at ``path`` (tmp+rename so a
+    shipping agent never reads a torn file). Returns the path, or None
+    when the write failed (telemetry never fails the run)."""
+    labels = {"run_id": run_id}
+    fams = [
+        MetricFamily(
+            "dct_train_goodput_seconds", "gauge",
+            "Run wall seconds by goodput/badput category.",
+        ),
+        MetricFamily(
+            "dct_train_goodput_fraction", "gauge",
+            "Productive (train_step + eval) seconds over wall seconds.",
+        ).add(goodput_summary.get("goodput_fraction", 0.0), labels),
+        MetricFamily(
+            "dct_train_wall_seconds", "gauge",
+            "Total run wall seconds (Trainer.fit entry to summary).",
+        ).add(goodput_summary.get("wall_seconds", 0.0), labels),
+        MetricFamily(
+            "dct_train_samples_per_sec", "gauge",
+            "Mean training throughput over the run.",
+        ).add(samples_per_sec, labels),
+        MetricFamily(
+            "dct_train_epochs_total", "counter",
+            "Epochs completed by this run.",
+        ).add(goodput_summary.get("epochs", 0), labels),
+    ]
+    for cat, sec in goodput_summary.get("categories", {}).items():
+        fams[0].add(sec, {**labels, "category": cat})
+    fams[0].add(
+        goodput_summary.get("unattributed_seconds", 0.0),
+        {**labels, "category": "unattributed"},
+    )
+    if val_loss is not None and math.isfinite(val_loss):
+        fams.append(
+            MetricFamily(
+                "dct_train_val_loss", "gauge",
+                "Final validation loss of the run.",
+            ).add(val_loss, labels)
+        )
+    tmp = path + ".tmp"
+    try:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(tmp, "w") as f:
+            f.write(render(fams))
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path

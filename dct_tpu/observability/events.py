@@ -1,0 +1,204 @@
+"""Structured event log with run-correlation IDs.
+
+One continuous-training cycle spans many processes: the DAG/launcher, N
+SPMD ranks, the tracking store, the deploy rollout. The reference
+correlates them by eyeballing Airflow task timestamps; here every record
+carries a **run-correlation ID** so ``grep <run_id> events.jsonl``
+reconstructs the whole cycle.
+
+ID contract (the launcher is the minter of record):
+
+1. the DAG/launcher mints the ID (:func:`mint_run_id`) and exports it as
+   ``DCT_RUN_ID`` into every rank's environment;
+2. every in-process component resolves the same ID via
+   :func:`current_run_id` (env first; a process that was never launched
+   — unit tests, ad-hoc runs — mints its own and pins it in its env so
+   all later components of that process agree);
+3. records are single-line JSON appended with ``O_APPEND`` — atomic for
+   lines under ``PIPE_BUF``, so concurrent ranks can safely share one
+   ``events.jsonl``.
+
+Record schema (every key always present, extras per event)::
+
+    {"ts": <unix seconds>, "run_id": "dct-...", "rank": <int|null>,
+     "component": "trainer|launcher|checkpoint|tracking|deploy|serving",
+     "event": "...", ...}
+
+``rank`` is null for orchestrator-side processes (launcher, DAG tasks).
+
+Telemetry must never fail the run: any OS error while emitting disables
+the log for the rest of the process instead of raising.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+import uuid
+
+
+def _jsonable(value):
+    """Strict-JSON scrub: a NaN val_loss must not poison the line for
+    spec-compliant consumers (jq, Promtail), so non-finite floats become
+    strings; containers recurse; anything exotic falls back to str."""
+    if isinstance(value, float):
+        return value if math.isfinite(value) else repr(value)
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if value is None or isinstance(value, (str, int, bool)):
+        return value
+    return str(value)
+
+
+def mint_run_id() -> str:
+    return "dct-" + uuid.uuid4().hex[:12]
+
+
+def current_run_id(*, mint: bool = True) -> str | None:
+    """The process's run-correlation ID: ``DCT_RUN_ID`` if the launcher
+    set one, else freshly minted AND pinned into this process's env so
+    every later component (tracking, checkpointing) agrees on it."""
+    rid = os.environ.get("DCT_RUN_ID")
+    if rid:
+        return rid
+    if not mint:
+        return None
+    rid = mint_run_id()
+    os.environ["DCT_RUN_ID"] = rid
+    return rid
+
+
+def _rank_from_env() -> int | None:
+    for var in ("DCT_PROCESS_ID", "NODE_RANK"):
+        raw = os.environ.get(var)
+        if raw:
+            try:
+                return int(raw)
+            except ValueError:
+                pass
+    return None
+
+
+class EventLog:
+    """Append-only JSONL emitter; ``path=None`` disables (all emits
+    no-op but ``run_id`` stays resolvable for stamping other records)."""
+
+    def __init__(
+        self,
+        path: str | None,
+        *,
+        run_id: str,
+        rank: int | None = None,
+        clock=time.time,
+    ):
+        self.path = path
+        self.run_id = run_id
+        self.rank = rank
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._dead = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.path) and not self._dead
+
+    def emit(self, component: str, event: str, **fields) -> None:
+        if not self.enabled:
+            return
+        rec = {
+            "ts": round(self._clock(), 6),
+            "run_id": self.run_id,
+            "rank": self.rank,
+            "component": component,
+            "event": event,
+        }
+        rec.update(fields)
+        try:
+            line = json.dumps(_jsonable(rec), allow_nan=False) + "\n"
+            with self._lock:
+                parent = os.path.dirname(self.path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                with open(self.path, "a") as f:
+                    f.write(line)
+        except (OSError, ValueError):
+            # Full disk / unwritable dir / closed fd: telemetry degrades
+            # to silence, training continues.
+            self._dead = True
+
+
+def observability_enabled(env=None) -> bool:
+    """THE parse of ``DCT_OBSERVABILITY`` (default on), with the exact
+    semantics of config._env's bool cast — one definition so the
+    trainer, the launcher, and the env-built default log can never
+    disagree about whether observability is enabled."""
+    raw = (env if env is not None else os.environ).get("DCT_OBSERVABILITY")
+    if raw is None:
+        return True
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def event_log_from_config(cfg, *, rank: int | None = None) -> "EventLog":
+    """Build the process event log from an ``ObservabilityConfig`` and
+    install it as the process default so layers without config plumbing
+    (checkpoint manager, tracking client) stamp the same run ID."""
+    rid = cfg.run_id or current_run_id()
+    path = (
+        os.path.join(cfg.events_dir, "events.jsonl")
+        if cfg.enabled and cfg.events_dir
+        else None
+    )
+    log = EventLog(path, run_id=rid, rank=rank)
+    set_default(log)
+    return log
+
+
+# ----------------------------------------------------------------------
+# Process-default log: layers that have no config plumbing (checkpoint
+# manager, tracking client) emit through this. The trainer installs the
+# config-built log via event_log_from_config; standalone processes fall
+# back to an env-built one (DCT_EVENTS_DIR / DCT_RUN_ID /
+# DCT_OBSERVABILITY), rebuilt whenever those env vars change so
+# monkeypatched tests see their own sink.
+
+_explicit: EventLog | None = None
+_cached: tuple[tuple, EventLog] | None = None
+_default_lock = threading.Lock()
+
+_ENV_KEYS = (
+    "DCT_OBSERVABILITY",
+    "DCT_EVENTS_DIR",
+    "DCT_RUN_ID",
+    "DCT_PROCESS_ID",
+    "NODE_RANK",
+)
+
+
+def set_default(log: EventLog | None) -> None:
+    global _explicit
+    _explicit = log
+
+
+def get_default() -> EventLog:
+    global _cached
+    if _explicit is not None:
+        return _explicit
+    with _default_lock:
+        rid = current_run_id()
+        key = tuple(os.environ.get(k) for k in _ENV_KEYS)
+        if _cached is not None and _cached[0] == key:
+            return _cached[1]
+        events_dir = os.environ.get("DCT_EVENTS_DIR", "logs/events")
+        enabled = observability_enabled() and events_dir
+        log = EventLog(
+            os.path.join(events_dir, "events.jsonl") if enabled else None,
+            run_id=rid,
+            rank=_rank_from_env(),
+        )
+        _cached = (key, log)
+        return log

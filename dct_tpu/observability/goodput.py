@@ -1,0 +1,173 @@
+"""Goodput/badput accounting: a wall-clock ledger for the training run.
+
+"What fraction of the last run was productive training?" is the question
+the TPU-scale training literature treats as first-class (the pjit/TPUv4
+report decomposes wall time into compile vs. step vs. data stall) and
+the reference platform cannot answer at all. The ledger classifies run
+wall time into:
+
+- ``train_step``        — jitted train dispatches after their program
+  compiled (the fused train+eval path bills its validation pass here
+  too: it runs inside the same dispatch);
+- ``eval``              — standalone validation passes (eager path);
+- ``compile``           — FIRST dispatch of each distinct program
+  (detected by dispatch key: compile and first execution are one
+  indivisible host call, and compile dominates it, so the whole first
+  dispatch is billed here — the standard convention);
+- ``checkpoint``        — deploy-tier writes, resume-state snapshots,
+  artifact upload;
+- ``data_wait``         — host batch assembly / H2D staging the device
+  had to wait for (a prefetched span that is already resolved costs
+  ~zero here: that is the point of the prefetch);
+- ``startup_recovery``  — everything before the first epoch: dataset
+  load, model init, state creation/sharding, resume restore.
+
+Seconds not claimed by any category surface as ``unattributed_seconds``
+in the summary — honest accounting, never silently absorbed. The clock
+is injectable for tests.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+CATEGORIES = (
+    "train_step",
+    "eval",
+    "compile",
+    "checkpoint",
+    "data_wait",
+    "startup_recovery",
+)
+
+#: The productive categories: goodput_fraction's numerator and the
+#: ``goodput_``-prefixed tracker metrics use the SAME set, so the
+#: fraction always equals sum(goodput_*_seconds) / wall_seconds. (On the
+#: fused scan path eval runs inside the train dispatch and is billed to
+#: train_step; ``eval`` gets real time only on the eager path.)
+GOODPUT_CATEGORIES = ("train_step", "eval")
+
+#: Canonical name for time the ledger could not attribute.
+UNATTRIBUTED = "unattributed"
+
+
+class GoodputLedger:
+    """Accumulates per-category wall seconds between :meth:`start` and
+    :meth:`summary`. Spans are main-thread sequential by construction
+    (the trainer's loop), so categories never double-count."""
+
+    def __init__(self, *, clock=time.perf_counter):
+        self._clock = clock
+        self.seconds: dict[str, float] = dict.fromkeys(CATEGORIES, 0.0)
+        self._t0: float | None = None
+        self._seen_dispatch_keys: set = set()
+        self._epoch_walls: list[tuple[int, float]] = []
+        self._last_report: tuple[float, dict] | None = None
+
+    # -- clock surface (for callers that bracket non-contiguous code) --
+    def clock(self) -> float:
+        return self._clock()
+
+    def start(self) -> None:
+        if self._t0 is None:
+            self._t0 = self._clock()
+
+    def add(self, category: str, seconds: float) -> None:
+        if category not in self.seconds:
+            raise KeyError(
+                f"unknown goodput category {category!r}; "
+                f"known: {CATEGORIES}"
+            )
+        self.seconds[category] += max(0.0, float(seconds))
+
+    @contextmanager
+    def span(self, category: str):
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self.add(category, self._clock() - t0)
+
+    # -- compile detection ---------------------------------------------
+    def dispatch_category(self, category: str, key: str) -> str:
+        """First time ``key`` is seen the dispatch is the program's
+        compile+first-execution; bill it to ``compile``."""
+        if key in self._seen_dispatch_keys:
+            return category
+        self._seen_dispatch_keys.add(key)
+        return "compile"
+
+    @contextmanager
+    def dispatch(self, category: str, *, key: str | None = None):
+        with self.span(self.dispatch_category(category, key or category)):
+            yield
+
+    def add_dispatch(self, category: str, key: str, seconds: float) -> None:
+        """Non-contextmanager form for dispatches whose timing window is
+        interleaved with other code (the trainer's prefetch submit sits
+        between the fused call and its block_until_ready)."""
+        self.add(self.dispatch_category(category, key), seconds)
+
+    # -- epoch feed (EpochTimer calls this) ----------------------------
+    def note_epoch(self, epoch: int, seconds: float) -> None:
+        self._epoch_walls.append((int(epoch), float(seconds)))
+
+    # -- reporting -----------------------------------------------------
+    def wall_seconds(self) -> float:
+        return 0.0 if self._t0 is None else self._clock() - self._t0
+
+    def accounted_seconds(self) -> float:
+        return sum(self.seconds.values())
+
+    def epoch_report(self) -> dict:
+        """Per-category seconds since the previous call (or since
+        :meth:`start`): the per-epoch/per-span goodput record."""
+        now = self._clock()
+        if self._last_report is None:
+            prev_t = self._t0 if self._t0 is not None else now
+            prev = dict.fromkeys(CATEGORIES, 0.0)
+        else:
+            prev_t, prev = self._last_report
+        delta = {c: self.seconds[c] - prev[c] for c in CATEGORIES}
+        dt = max(0.0, now - prev_t)
+        self._last_report = (now, dict(self.seconds))
+        good = sum(delta[c] for c in GOODPUT_CATEGORIES)
+        return {
+            "seconds": dt,
+            "categories": delta,
+            "goodput_fraction": good / dt if dt > 0 else 0.0,
+        }
+
+    def summary(self) -> dict:
+        """Run-end record: category seconds, wall clock, the productive
+        fraction, and the honest remainder."""
+        wall = self.wall_seconds()
+        accounted = self.accounted_seconds()
+        good = sum(self.seconds[c] for c in GOODPUT_CATEGORIES)
+        return {
+            "wall_seconds": wall,
+            "accounted_seconds": accounted,
+            f"{UNATTRIBUTED}_seconds": max(0.0, wall - accounted),
+            "goodput_fraction": good / wall if wall > 0 else 0.0,
+            "categories": dict(self.seconds),
+            "epochs": len(self._epoch_walls),
+        }
+
+    def tracker_metrics(self) -> dict:
+        """The summary flattened into scalar metrics, named so goodput
+        regressions are queryable in the tracking store next to
+        val_loss (``metrics.goodput_fraction DESC`` works like
+        ``metrics.val_loss ASC``)."""
+        s = self.summary()
+        out = {
+            "goodput_fraction": s["goodput_fraction"],
+            "wall_seconds": s["wall_seconds"],
+        }
+        for cat, sec in s["categories"].items():
+            # GOODPUT_CATEGORIES are the productive time; the rest is
+            # overhead an operator wants driven toward zero.
+            prefix = "goodput" if cat in GOODPUT_CATEGORIES else "badput"
+            out[f"{prefix}_{cat}_seconds"] = sec
+        out[f"badput_{UNATTRIBUTED}_seconds"] = s[f"{UNATTRIBUTED}_seconds"]
+        return out

@@ -1,0 +1,207 @@
+"""Per-rank heartbeats + the launcher-side stall/straggler monitor.
+
+The reference's launcher ``wait``s on rank PIDs: a rank wedged in a
+collective (its peer died, the fabric hiccuped) stays "alive" to the
+orchestrator until the 3-hour task timeout. Heartbeats make liveness
+*semantic*: each rank atomically rewrites a small per-rank JSON file
+with its step/epoch progress, and the monitor (run by whoever babysits
+the ranks — :class:`dct_tpu.launch.launcher.LocalProcessLauncher`, or
+an operator's watch loop over a shared filesystem) classifies each
+rank:
+
+- ``starting`` — no file yet, within the startup grace window;
+- ``ok``       — file fresh (younger than ``stall_seconds``);
+- ``stalled``  — file exists but stale: the process may be alive and
+  wedged (exactly the case PID-liveness cannot see);
+- ``missing``  — no file after the grace window (crashed before its
+  first beat, or heartbeats are mis-rooted);
+- ``done``     — final beat (``phase == "done"``) written; age is
+  expected to grow, never stalls.
+
+Files are ``rank_<r>.json`` under one directory (shared dir for
+single-host / NFS; per-host dirs aggregate by copying — the records are
+self-describing). Writes are tmp+rename so readers never see a torn
+record. Records from a DIFFERENT run-correlation ID are treated as
+absent: a stale file from yesterday's run must not make today's dead
+rank look alive.
+
+Clock-injectable throughout; writer failures degrade to silence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"rank_{rank:05d}.json")
+
+
+class HeartbeatWriter:
+    """Rank-side: atomically rewrite this rank's heartbeat file."""
+
+    def __init__(
+        self,
+        directory: str,
+        rank: int,
+        *,
+        run_id: str | None = None,
+        min_interval: float = 0.0,
+        clock=time.time,
+    ):
+        self.directory = directory
+        self.rank = int(rank)
+        self.run_id = run_id
+        self.min_interval = float(min_interval)
+        self._clock = clock
+        self._last_write: float | None = None
+        self._last_phase: str | None = None
+        self._dead = False
+
+    @property
+    def path(self) -> str:
+        return heartbeat_path(self.directory, self.rank)
+
+    def beat(
+        self,
+        *,
+        step: int | None = None,
+        epoch: int | None = None,
+        phase: str = "train",
+        force: bool = False,
+    ) -> bool:
+        """Write a heartbeat; returns True if written. Same-phase beats
+        inside ``min_interval`` are throttled (a per-step caller must
+        not turn the heartbeat into an I/O hot loop); phase transitions
+        and ``force`` always write."""
+        if self._dead:
+            return False
+        now = self._clock()
+        if (
+            not force
+            and phase == self._last_phase
+            and self._last_write is not None
+            and now - self._last_write < self.min_interval
+        ):
+            return False
+        rec = {
+            "rank": self.rank,
+            "run_id": self.run_id,
+            "pid": os.getpid(),
+            "time": round(now, 3),
+            "step": step,
+            "epoch": epoch,
+            "phase": phase,
+        }
+        tmp = self.path + f".tmp.{os.getpid()}"
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            self._dead = True  # liveness telemetry must never kill a rank
+            return False
+        self._last_write = now
+        self._last_phase = phase
+        return True
+
+    def close(self, *, step: int | None = None, epoch: int | None = None):
+        """Final beat: marks the rank done so the monitor stops ageing it."""
+        self.beat(step=step, epoch=epoch, phase="done", force=True)
+
+
+def read_heartbeat(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+@dataclass
+class RankStatus:
+    rank: int
+    state: str  # starting | ok | stalled | missing | done
+    age_seconds: float | None = None
+    step: int | None = None
+    epoch: int | None = None
+    phase: str | None = None
+
+
+class HeartbeatMonitor:
+    """Orchestrator-side: classify every expected rank and quantify
+    progress skew (the straggler signal)."""
+
+    def __init__(
+        self,
+        directory: str,
+        world_size: int,
+        *,
+        stall_seconds: float = 60.0,
+        run_id: str | None = None,
+        clock=time.time,
+    ):
+        self.directory = directory
+        self.world_size = int(world_size)
+        self.stall_seconds = float(stall_seconds)
+        self.run_id = run_id
+        self._clock = clock
+        self._started_at = clock()
+
+    def scan(self) -> list[RankStatus]:
+        now = self._clock()
+        grace = now - self._started_at < self.stall_seconds
+        out: list[RankStatus] = []
+        for rank in range(self.world_size):
+            rec = read_heartbeat(heartbeat_path(self.directory, rank))
+            if rec is not None and self.run_id and rec.get("run_id") != self.run_id:
+                rec = None  # a previous run's leftover is NOT a heartbeat
+            if rec is None:
+                out.append(
+                    RankStatus(rank, "starting" if grace else "missing")
+                )
+                continue
+            age = max(0.0, now - float(rec.get("time", 0.0)))
+            phase = rec.get("phase")
+            if phase == "done":
+                state = "done"
+            elif age > self.stall_seconds:
+                state = "stalled"
+            else:
+                state = "ok"
+            out.append(
+                RankStatus(
+                    rank,
+                    state,
+                    age_seconds=age,
+                    step=rec.get("step"),
+                    epoch=rec.get("epoch"),
+                    phase=phase,
+                )
+            )
+        return out
+
+    @staticmethod
+    def skew(statuses: list[RankStatus]) -> dict:
+        """Progress spread across ranks that reported any: the live
+        straggler signal (a rank 3 epochs behind its peers is about to
+        become everyone's collective stall)."""
+        epochs = [s.epoch for s in statuses if s.epoch is not None]
+        steps = [s.step for s in statuses if s.step is not None]
+        return {
+            "epoch_skew": max(epochs) - min(epochs) if epochs else 0,
+            "step_skew": max(steps) - min(steps) if steps else 0,
+        }
+
+    def report(self) -> dict:
+        statuses = self.scan()
+        return {
+            "ranks": {s.rank: s.state for s in statuses},
+            "stalled": [s.rank for s in statuses if s.state == "stalled"],
+            "missing": [s.rank for s in statuses if s.state == "missing"],
+            **self.skew(statuses),
+        }

@@ -38,6 +38,8 @@ import os
 
 import jax
 import jax.numpy as jnp
+
+from dct_tpu.parallel.shard_map_compat import pcast_varying, shard_map
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -538,9 +540,9 @@ def _ring_body(q, k, v, *, axis_name: str, ring_size: int, causal: bool,
     # from the first iteration on; typing them that way up front keeps
     # every step's accumulator type fixed.
     axes = tuple(vary_axes) or (axis_name,)
-    m = lax.pcast(jnp.full(q.shape[:-1], _NEG, jnp.float32), axes, to="varying")
-    l = lax.pcast(jnp.zeros(q.shape[:-1], jnp.float32), axes, to="varying")
-    o = lax.pcast(jnp.zeros(q.shape, jnp.float32), axes, to="varying")
+    m = pcast_varying(jnp.full(q.shape[:-1], _NEG, jnp.float32), axes)
+    l = pcast_varying(jnp.zeros(q.shape[:-1], jnp.float32), axes)
+    o = pcast_varying(jnp.zeros(q.shape, jnp.float32), axes)
     k_cur, v_cur = k, v
     for step in range(n_steps):  # static unroll: ring_size is mesh shape
         src = (my - step) % ring_size
@@ -724,7 +726,7 @@ def ring_attention(
             )
             vma_kw = {}
         qs, ks, vs = (jnp.take(a, perm, axis=-2) for a in (q, k, v))
-        out = jax.shard_map(
+        out = shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             **vma_kw,
         )(qs, ks, vs)
@@ -742,7 +744,7 @@ def ring_attention(
         # check_vma=False: pallas interpret mode evaluates the kernel
         # jaxpr with non-varying internal consts, tripping the vma checker
         # (jax suggests exactly this workaround); numerics are unaffected.
-        return jax.shard_map(
+        return shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )(q, k, v)
@@ -755,7 +757,7 @@ def ring_attention(
         vary_axes=(data_axis, model_axis, seq_axis),
         window=window,
     )
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 
@@ -851,7 +853,7 @@ def a2a_attention(
 
     # check_vma=False for the same reason as the flash ring: interpret-
     # mode pallas internals trip the varying-axes checker spuriously.
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

@@ -29,6 +29,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from dct_tpu.parallel.shard_map_compat import pcast_varying, shard_map
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -61,8 +63,8 @@ def _pipeline_body(params, xs, *, stage_fn, axis: str, n_stages: int):
     # The carry becomes device-varying over the pipe axis from the first
     # tick (stage-dependent compute); type the initial carry that way so
     # the scan carry type is fixed (same recipe as ring attention).
-    act0 = lax.pcast(jnp.zeros_like(xs[0]), (axis,), to="varying")
-    ys0 = lax.pcast(jnp.zeros_like(xs), (axis,), to="varying")
+    act0 = pcast_varying(jnp.zeros_like(xs[0]), (axis,))
+    ys0 = pcast_varying(jnp.zeros_like(xs), (axis,))
 
     def tick(carry, t):
         act, ys = carry
@@ -144,7 +146,7 @@ def pipeline_apply(
     # the compiler inserts the TP collectives inside each stage — PP x TP
     # compose without hand-written stage communication.
     manual = {axis} | ({data_axis} if data_axis is not None else set())
-    ys = jax.shard_map(
+    ys = shard_map(
         body,
         mesh=mesh,
         in_specs=(param_specs, xs_spec),

@@ -1,0 +1,70 @@
+"""``shard_map`` / VMA typing across the JAX API move.
+
+The kernels are written against the stable ``jax.shard_map`` API
+(JAX >= 0.5: per-output varying-manual-axes checking spelled
+``check_vma``, explicit ``lax.pcast(..., to="varying")`` to type scan
+accumulators); this rig's JAX 0.4.x only ships the experimental
+``jax.experimental.shard_map.shard_map``. One resolver so every call
+site stays written in the modern spelling and older rigs keep working
+(the same degrade-gracefully idiom as orchestration.compat).
+
+Old-JAX translation:
+
+- ``check_vma`` maps to the pre-rename ``check_rep``; when the caller
+  did not ask for checking, it is FORCED off — the 0.4.x replication
+  checker predates VMA typing and rejects modern programs whose scan
+  carries are deliberately pcast-to-varying. The checker is a static
+  verifier only; disabling it changes no numerics.
+- ``pcast_varying`` becomes a no-op: without VMA typing there is no
+  accumulator type to pin, plain values are already valid carries.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+_HAS_STABLE = hasattr(jax, "shard_map")
+
+
+def shard_map(
+    f,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    check_vma: bool | None = None,
+    axis_names=None,
+):
+    """Modern-signature ``shard_map``; ``check_vma`` and partial-manual
+    ``axis_names`` are translated to the installed API's knobs
+    (``check_rep`` and the complementary ``auto=`` set on experimental
+    builds: modern code names the axes that ARE manual, 0.4.x names the
+    ones that are NOT)."""
+    if _HAS_STABLE:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as sm
+
+    kw = {}
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=bool(check_vma) if check_vma is not None else False,
+        **kw,
+    )
+
+
+def pcast_varying(x, axes):
+    """``lax.pcast(x, axes, to="varying")`` where VMA typing exists;
+    identity elsewhere (pre-VMA JAX has no value typing to adjust)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, tuple(axes), to="varying")
+    if hasattr(lax, "pvary"):  # the 0.5.x-era spelling
+        return lax.pvary(x, tuple(axes))
+    return x

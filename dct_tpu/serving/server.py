@@ -13,6 +13,10 @@ wire contract on stdlib ``http.server``:
   checkpoints return per-horizon probability lists)
 - ``GET /healthz``  — 200 once the model is loaded (the endpoint analog
   of the compose healthchecks, docker-compose.yml:48-52)
+- ``GET /metrics``  — Prometheus text exposition of the per-slot
+  request/error counters and latency histograms
+  (:mod:`dct_tpu.observability.prometheus`), scrapeable by any
+  Prometheus-compatible agent
 
 Status-code policy, shared by both server modes: anything that is the
 REQUEST's fault (malformed JSON/envelope, validate_payload failures,
@@ -63,6 +67,18 @@ class _JsonHandler(BaseHTTPRequestHandler):
             body = b'{"error": "non-finite values in response"}'
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_metrics(self) -> None:
+        """``GET /metrics``: Prometheus text exposition of the server's
+        slot metrics (scrapers require the versioned content type)."""
+        from dct_tpu.observability.prometheus import CONTENT_TYPE
+
+        body = self.server.slot_metrics.prometheus_text().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -120,6 +136,9 @@ class ScoreHandler(_JsonHandler):
     pure numpy on read-only weights)."""
 
     def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path == "/metrics":
+            self._reply_metrics()
+            return
         if self.path != "/healthz":
             self._reply(404, {"error": f"no route {self.path}"})
             return
@@ -135,14 +154,22 @@ class ScoreHandler(_JsonHandler):
         )
 
     def do_POST(self):  # noqa: N802 (http.server API)
+        import time
+
         if self.path != "/score":
             self._reply(404, {"error": f"no route {self.path}"})
             return
         data = self._read_data_envelope()
         if data is None:
             return
-        result, _server_fault = self._score(
+        t0 = time.perf_counter()
+        result, server_fault = self._score(
             self.server.model_weights, self.server.model_meta, data
+        )
+        # Single-checkpoint mode has one implicit slot; same /metrics
+        # series shape as the endpoint mode so dashboards carry over.
+        self.server.slot_metrics.record(
+            "default", time.perf_counter() - t0, ok=not server_fault
         )
         if result is not None:
             self._reply(200, result)
@@ -156,6 +183,7 @@ def make_server(ckpt_path: str, *, host: str = "127.0.0.1", port: int = 0):
     server = ThreadingHTTPServer((host, port), ScoreHandler)
     server.model_weights = weights
     server.model_meta = meta
+    server.slot_metrics = _SlotMetrics()
     return server
 
 
@@ -217,7 +245,9 @@ class _SlotMetrics:
     during a canary (the Azure endpoint surfaces the same per-deployment
     request/latency series). Bounded memory: a sliding window of the
     last 1024 latencies per slot — p50/p99 reflect recent traffic, not
-    all-time history."""
+    all-time history — plus an all-time cumulative latency histogram in
+    Prometheus bucket layout for ``GET /metrics`` (fixed size: bucket
+    counters only, no samples retained)."""
 
     def __init__(self):
         import threading
@@ -226,13 +256,22 @@ class _SlotMetrics:
         self._by_slot: dict = {}
 
     def record(self, slot: str, seconds: float, ok: bool) -> None:
+        from dct_tpu.observability.prometheus import HistogramAccumulator
+
         with self._lock:
             m = self._by_slot.setdefault(
-                slot, {"requests": 0, "errors": 0, "lat": []}
+                slot,
+                {
+                    "requests": 0,
+                    "errors": 0,
+                    "lat": [],
+                    "hist": HistogramAccumulator(),
+                },
             )
             m["requests"] += 1
             if not ok:
                 m["errors"] += 1
+            m["hist"].observe(seconds)
             lat = m["lat"]
             lat.append(seconds)
             if len(lat) > 1024:
@@ -256,6 +295,43 @@ class _SlotMetrics:
                     )
                 out[slot] = entry
             return out
+
+    def prometheus_text(self) -> str:
+        """Text exposition (0.0.4) of every slot's series. Histogram
+        state is deep-copied under the lock; rendering happens outside
+        it so a slow scrape never blocks request recording."""
+        import copy
+
+        from dct_tpu.observability.prometheus import MetricFamily, render
+
+        with self._lock:
+            slots = {
+                slot: {
+                    "requests": m["requests"],
+                    "errors": m["errors"],
+                    "hist": copy.deepcopy(m["hist"]),
+                }
+                for slot, m in self._by_slot.items()
+            }
+        req = MetricFamily(
+            "dct_requests_total", "counter",
+            "Scoring requests served, by deployment slot.",
+        )
+        err = MetricFamily(
+            "dct_request_errors_total", "counter",
+            "Server-fault scoring errors, by deployment slot "
+            "(client 4xx never counts against a slot).",
+        )
+        lat = MetricFamily(
+            "dct_request_latency_seconds", "histogram",
+            "End-to-end scoring latency, by deployment slot.",
+        )
+        for slot in sorted(slots):
+            m = slots[slot]
+            req.add(m["requests"], {"slot": slot})
+            err.add(m["errors"], {"slot": slot})
+            m["hist"].samples_into(lat, {"slot": slot})
+        return render([req, err, lat])
 
 
 class EndpointScoreHandler(_JsonHandler):
@@ -293,7 +369,11 @@ class EndpointScoreHandler(_JsonHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
         import urllib.parse
 
-        if urllib.parse.urlparse(self.path).path != "/healthz":
+        route = urllib.parse.urlparse(self.path).path
+        if route == "/metrics":
+            self._reply_metrics()
+            return
+        if route != "/healthz":
             self._reply(404, {"error": f"no route {self.path}"})
             return
         client = self._client()

@@ -31,6 +31,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Protocol
 
+from dct_tpu.observability import events as _events
+
 
 @dataclass
 class RunInfo:
@@ -39,6 +41,10 @@ class RunInfo:
     metrics: dict = field(default_factory=dict)  # final value per key
     params: dict = field(default_factory=dict)
     artifact_dir: str | None = None
+    # The platform event log's run-correlation ID stamped at start_run
+    # time (None for pre-observability runs): lets the deploy side join
+    # the model it ships back to the training cycle that produced it.
+    run_correlation_id: str | None = None
 
 
 class TrackingClient(Protocol):
@@ -68,9 +74,13 @@ class LocalTracking:
         self._run_id = uuid.uuid4().hex[:16]
         d = self._run_dir(self._run_id)
         os.makedirs(os.path.join(d, "artifacts"), exist_ok=True)
+        log = _events.get_default()
         meta = {
             "run_id": self._run_id,
             "experiment": self.experiment,
+            # Correlation with the platform event log: the tracking run
+            # is one record of a launcher-minted training cycle.
+            "run_correlation_id": log.run_id,
             "start_time": time.time(),
             "params": params or {},
             "status": "RUNNING",
@@ -78,6 +88,10 @@ class LocalTracking:
         with open(os.path.join(d, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2)
         self._active = True
+        log.emit(
+            "tracking", "run_start",
+            tracking_run_id=self._run_id, experiment=self.experiment,
+        )
         return self._run_id
 
     def log_metrics(self, metrics: dict, step: int) -> None:
@@ -111,6 +125,10 @@ class LocalTracking:
         with open(os.path.join(d, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2)
         self._active = False
+        _events.get_default().emit(
+            "tracking", "run_end",
+            tracking_run_id=self._run_id, status=status,
+        )
 
     # -- query surface (the deploy DAGs' selection query) --------------
     def _final_metrics(self, run_dir: str) -> dict:
@@ -152,6 +170,7 @@ class LocalTracking:
                     metrics=metrics,
                     params=meta.get("params", {}),
                     artifact_dir=os.path.join(run_dir, "artifacts"),
+                    run_correlation_id=meta.get("run_correlation_id"),
                 )
         return best
 
@@ -185,6 +204,19 @@ class MlflowTracking:
             self._mlflow.log_params(
                 {k: v for k, v in params.items() if v is not None}
             )
+        log = _events.get_default()
+        try:
+            # Queryable correlation on the MLflow side too:
+            # tags."dct.run_correlation_id" joins the tracking store to
+            # the platform event log.
+            self._mlflow.set_tag("dct.run_correlation_id", log.run_id)
+        except Exception:  # noqa: BLE001 — tagging is best-effort
+            pass
+        log.emit(
+            "tracking", "run_start",
+            tracking_run_id=self._run.info.run_id,
+            experiment=self.experiment,
+        )
         return self._run.info.run_id
 
     def log_metrics(self, metrics: dict, step: int) -> None:
@@ -194,7 +226,11 @@ class MlflowTracking:
         self._mlflow.log_artifact(local_path, artifact_path=artifact_path)
 
     def end_run(self, status: str = "FINISHED") -> None:
+        run_id = self._run.info.run_id if self._run is not None else None
         self._mlflow.end_run(status=status)
+        _events.get_default().emit(
+            "tracking", "run_end", tracking_run_id=run_id, status=status,
+        )
 
     def search_best_run(self, metric: str = "val_loss", mode: str = "min") -> RunInfo | None:
         order = "ASC" if mode == "min" else "DESC"
@@ -209,10 +245,16 @@ class MlflowTracking:
         if len(runs) == 0:
             return None
         row = runs.iloc[0]
+        rid = None
+        try:  # the tag column exists only for observability-era runs
+            rid = row.get("tags.dct.run_correlation_id") or None
+        except Exception:  # noqa: BLE001 — correlation is best-effort
+            pass
         return RunInfo(
             run_id=row["run_id"],
             experiment=self.experiment,
             metrics={metric: float(row[f"metrics.{metric}"])},
+            run_correlation_id=rid,
         )
 
     def download_artifacts(self, run_id: str, artifact_path: str, dst: str) -> str:

@@ -52,6 +52,9 @@ from dct_tpu.parallel.sharding_rules import (
     shard_state_with_rules,
     state_shardings,
 )
+from dct_tpu.observability.events import event_log_from_config
+from dct_tpu.observability.goodput import GoodputLedger
+from dct_tpu.observability.heartbeat import HeartbeatWriter
 from dct_tpu.tracking.client import get_tracker
 from dct_tpu.train.state import create_train_state
 from dct_tpu.utils.profiling import EpochTimer, Profiler, annotate
@@ -152,6 +155,10 @@ class TrainResult:
     steady_samples_per_sec_per_chip: float = 0.0
     run_id: str | None = None
     state: object | None = None
+    # Goodput/badput summary (observability.goodput.GoodputLedger) and
+    # the run-correlation ID every event record of this run carries.
+    goodput: dict = field(default_factory=dict)
+    run_correlation_id: str | None = None
 
 
 class Trainer:
@@ -168,6 +175,30 @@ class Trainer:
     # ------------------------------------------------------------------
     def fit(self, data: WeatherArrays | None = None) -> TrainResult:
         cfg = self.cfg
+        # Observability plane: structured events (installed as the
+        # process default so the checkpoint/tracking layers stamp the
+        # same run-correlation ID), the goodput ledger, and this rank's
+        # heartbeat. Everything degrades to no-ops when disabled.
+        events = event_log_from_config(
+            cfg.obs, rank=jax.process_index()
+        )
+        ledger = GoodputLedger()
+        ledger.start()
+        heartbeat = None
+        if cfg.obs.enabled and cfg.obs.heartbeat_dir:
+            heartbeat = HeartbeatWriter(
+                cfg.obs.heartbeat_dir,
+                jax.process_index(),
+                run_id=events.run_id,
+                min_interval=cfg.obs.heartbeat_interval,
+            )
+            heartbeat.beat(phase="startup", force=True)
+        events.emit(
+            "trainer", "fit_start",
+            model=cfg.model.name, epochs=cfg.train.epochs,
+            resume=cfg.train.resume, world_size=jax.process_count(),
+        )
+        _t_startup = ledger.clock()
         if data is None:
             data = load_processed_dataset(
                 cfg.data.processed_dir,
@@ -442,6 +473,7 @@ class Trainer:
             n_chips=self.mesh.size,
             flops_per_sample=flops_per_sample,
             peak_flops=chip_peak_flops(),
+            ledger=ledger,
         )
         profiler = Profiler(
             cfg.profile.trace_dir,
@@ -522,6 +554,11 @@ class Trainer:
             prefetch_pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="epoch-prefetch"
             )
+        # Everything up to here — dataset load, model init, state
+        # creation/sharding, resume restore, validation staging — is the
+        # run's startup/recovery cost in the goodput ledger.
+        ledger.add("startup_recovery", ledger.clock() - _t_startup)
+        completed = False
         try:
             epoch = start_epoch
             while epoch < target_epochs:
@@ -529,13 +566,32 @@ class Trainer:
                 profiler.maybe_start_span(epoch, k)
                 timer.start()
                 if use_scan:
-                    if prefetched is not None:
-                        n_steps, globs = prefetched.result()
-                    else:
-                        n_steps, globs = _assemble_span(epoch, k)
+                    # Goodput: joining the prefetch future (or assembling
+                    # inline) is time the DEVICE spends waiting on data.
+                    with ledger.span("data_wait"):
+                        if prefetched is not None:
+                            n_steps, globs = prefetched.result()
+                        else:
+                            n_steps, globs = _assemble_span(epoch, k)
                     # Train span + full eval in ONE dispatch (the saved
                     # host round trips are most of an epoch's wall time
                     # on a slow control plane at the parity batch size).
+                    # Beat BEFORE the span's dispatch: the fused program
+                    # can legitimately block for minutes (first-span
+                    # compile, k fused epochs), and the monitor must see
+                    # the rank reached the dispatch rather than ageing
+                    # the previous span-end beat across the whole gap.
+                    # (Size DCT_HEARTBEAT_STALL_SECONDS above the
+                    # longest expected single dispatch.)
+                    if heartbeat is not None:
+                        heartbeat.beat(
+                            step=global_step, epoch=epoch, phase="dispatch",
+                        )
+                    # The dispatch window closes at block_until_ready
+                    # below; a span of k epochs and a ragged remainder
+                    # span are DIFFERENT XLA programs, so the ledger's
+                    # compile detection keys on k.
+                    _t_dispatch = ledger.clock()
                     if multi_fused is not None:
                         state, losses, val_sums = multi_fused(
                             state, *globs, *val_global
@@ -562,6 +618,13 @@ class Trainer:
                     else:
                         prefetched = None
                     jax.block_until_ready(state.params)
+                    # Fused dispatch (train + eval in one program) bills
+                    # to train_step; its first occurrence per program
+                    # shape is the compile.
+                    ledger.add_dispatch(
+                        "train_step", f"scan_k{k}",
+                        ledger.clock() - _t_dispatch,
+                    )
                     # The fused program runs the validation pass(es)
                     # inside the timed window; credit them to MFU.
                     epoch_stats = timer.stop(
@@ -572,9 +635,13 @@ class Trainer:
 
                     if multi_fused is not None:
                         # [K, S] losses; val_sums is a 6-tuple of [K]
-                        # arrays (dtype-preserving — see
-                        # make_multi_epoch_train_eval_step). Stack on
-                        # host as float64 -> [K, 6] exact.
+                        # arrays (dtype-preserving per leaf — see
+                        # make_multi_epoch_train_eval_step). Stack
+                        # host-side as float64 -> [K, 6]; the upcast
+                        # only protects the stacking, precision is
+                        # bounded by the on-device f32 accumulation
+                        # (exact for integral weights up to 2^24 per
+                        # epoch, steps.py).
                         losses_host = _np.asarray(jax.device_get(losses))
                         val_host = _np.stack(
                             [
@@ -626,7 +693,8 @@ class Trainer:
                         pending.append(batch)
                         if len(pending) < accum:
                             continue
-                        with annotate("host_batch_staging"):
+                        with annotate("host_batch_staging"), \
+                                ledger.span("data_wait"):
                             if accum > 1:
                                 bx = _np.concatenate([b.x for b in pending])
                                 by = _np.concatenate([b.y for b in pending])
@@ -640,12 +708,23 @@ class Trainer:
                                 )
                             x, y, w = make_global_batch(self.mesh, bx, by, bw)
                         pending = []
-                        state, metrics = train_step(state, x, y, w)
+                        # The device_get of the loss is the step's real
+                        # sync point — include it in the dispatch window.
+                        with ledger.dispatch("train_step", key="eager_step"):
+                            state, metrics = train_step(state, x, y, w)
+                            loss_host = float(
+                                jax.device_get(metrics["train_loss"])
+                            )
                         global_step += 1
                         n_steps += accum
                         n_updates += 1
-                        loss_host = float(jax.device_get(metrics["train_loss"]))
                         loss_sum += loss_host
+                        # Per-step liveness on the eager path (the
+                        # writer's min_interval throttles the I/O).
+                        if heartbeat is not None:
+                            heartbeat.beat(
+                                step=global_step, epoch=epoch, phase="train",
+                            )
                         if global_step % cfg.train.log_every_n_steps == 0:
                             self.tracker.log_metrics(
                                 {"train_loss": loss_host}, step=global_step
@@ -657,12 +736,21 @@ class Trainer:
                     epoch_loss = loss_sum / n_updates if n_updates else None
 
                 if not use_scan:
-                    val_loss, val_acc, (tp, fp, fn) = self._evaluate(
-                        state, eval_step, val_loader
-                    )
+                    with ledger.dispatch("eval", key="eager_eval"):
+                        val_loss, val_acc, (tp, fp, fn) = self._evaluate(
+                            state, eval_step, val_loader
+                        )
                     sub_epochs = [
                         (epoch_loss, val_loss, val_acc, (tp, fp, fn))
                     ]
+                # Per-span goodput: category deltas since the previous
+                # report, logged to the tracker next to val_loss so a
+                # goodput regression is queryable like an accuracy one.
+                span_goodput = ledger.epoch_report()
+                if heartbeat is not None:
+                    heartbeat.beat(
+                        step=global_step, epoch=epoch + k - 1, phase="train"
+                    )
                 # Per-epoch bookkeeping for every epoch in the span; with
                 # k > 1 the chunk is the dispatch unit, so wall time is
                 # span-amortized and the metric step is reconstructed per
@@ -687,6 +775,9 @@ class Trainer:
                         "epoch_time": epoch_stats.seconds / k,
                         "samples_per_sec": epoch_stats.samples_per_sec,
                         "samples_per_sec_per_chip": epoch_stats.samples_per_sec_per_chip,
+                        # Span-level fraction (the span is the dispatch
+                        # unit; every epoch in it shares the value).
+                        "goodput_fraction": span_goodput["goodput_fraction"],
                     }
                     if cfg.model.num_classes == 2:
                         # Positive class 1 = "rain" (the reference's label
@@ -711,6 +802,13 @@ class Trainer:
                         if use_scan else global_step
                     )
                     self.tracker.log_metrics(epoch_metrics, step=metric_step)
+                    events.emit(
+                        "trainer", "epoch_end",
+                        epoch=epoch + i,
+                        train_loss=epoch_rec["train_loss"],
+                        val_loss=val_loss, val_acc=val_acc,
+                        goodput_fraction=span_goodput["goodput_fraction"],
+                    )
                     last_rec = epoch_rec
                     # Early stopping (monitor val_loss, min mode — the
                     # companion of the reference's ModelCheckpoint
@@ -734,6 +832,7 @@ class Trainer:
                 # spanning processes this is a collective every rank must
                 # join; in the common fully-addressable case only the
                 # coordinator pays the device-to-host copy.
+                _t_ckpt = ledger.clock()
                 if params_cross_process or self.coordinator:
                     host_params = to_host(state.params)
                 if self.coordinator:
@@ -777,9 +876,14 @@ class Trainer:
                         "optimizer": opt_identity,
                     },
                 )
+                # Both checkpoint tiers' synchronous cost (host gather,
+                # deploy-tier writes, the resume snapshot's device->host
+                # copy; the npz write itself overlaps on a worker thread).
+                ledger.add("checkpoint", ledger.clock() - _t_ckpt)
                 epoch += k
                 if stop_early:
                     break
+            completed = True
 
         finally:
             # Crash-path hygiene: never leave a jax.profiler session open,
@@ -792,11 +896,25 @@ class Trainer:
                 try:
                     state_ckptr.wait()
                 finally:
-                    if prefetch_pool is not None:
-                        prefetch_pool.shutdown(wait=True)
+                    try:
+                        if prefetch_pool is not None:
+                            prefetch_pool.shutdown(wait=True)
+                    finally:
+                        # Terminal heartbeat: "done" stops the monitor
+                        # ageing this rank; "failed" names a crash that
+                        # an exit code alone cannot (the rank may be
+                        # killed by fail-fast before it can exit).
+                        if heartbeat is not None:
+                            heartbeat.beat(
+                                phase="done" if completed else "failed",
+                                force=True,
+                            )
+                        if not completed:
+                            events.emit("trainer", "fit_failed")
 
         # Rank-0 post-train artifact upload, mirroring
         # jobs/train_lightning_ddp.py:146-164 (best, else last.ckpt fallback).
+        _t_upload = ledger.clock()
         best_path = ckptr.best_model_path
         if self.coordinator:
             if not os.path.exists(best_path):
@@ -828,6 +946,33 @@ class Trainer:
                         )
                     self.tracker.log_artifact(mlmodel, artifact_path="model")
                     self.tracker.log_artifact(best_path, artifact_path="model")
+        ledger.add("checkpoint", ledger.clock() - _t_upload)
+
+        # Run-end goodput accounting: logged to the tracker NEXT TO
+        # val_loss (a goodput regression becomes queryable exactly like
+        # an accuracy regression), emitted as a structured event, and
+        # dumped in Prometheus text exposition for scrape-less rigs.
+        goodput_summary = ledger.summary()
+        self.tracker.log_metrics(ledger.tracker_metrics(), step=global_step)
+        events.emit("trainer", "goodput_summary", **goodput_summary)
+        # An explicit DCT_METRICS_PROM must work even with the event log
+        # disabled (textfile-collector-only rigs clear DCT_EVENTS_DIR).
+        if self.coordinator and cfg.obs.enabled and (
+            cfg.obs.metrics_path or cfg.obs.events_dir
+        ):
+            from dct_tpu.observability.dump import write_train_metrics_prom
+
+            final_vl = (
+                history[-1]["val_loss"] if history else float("nan")
+            )
+            write_train_metrics_prom(
+                cfg.obs.metrics_path
+                or os.path.join(cfg.obs.events_dir, "train_metrics.prom"),
+                goodput_summary,
+                run_id=events.run_id,
+                samples_per_sec=timer.samples_per_sec,
+                val_loss=final_vl,
+            )
         self.tracker.end_run()
 
         if self.coordinator:
@@ -835,6 +980,12 @@ class Trainer:
             if shadow:
                 print(shadow, file=sys.stderr, flush=True)
         final = history[-1] if history else {"val_loss": float("nan"), "val_acc": float("nan")}
+        events.emit(
+            "trainer", "fit_end",
+            val_loss=final["val_loss"], val_acc=final["val_acc"],
+            epochs_run=len(history),
+            goodput_fraction=goodput_summary["goodput_fraction"],
+        )
         steady = timer.history[1:] if len(timer.history) > 1 else timer.history
         return TrainResult(
             val_loss=final["val_loss"],
@@ -849,6 +1000,8 @@ class Trainer:
             ),
             run_id=run_id,
             state=state,
+            goodput=goodput_summary,
+            run_correlation_id=events.run_id,
         )
 
     # ------------------------------------------------------------------

@@ -157,6 +157,10 @@ class EpochTimer:
     # with the chip peak this turns throughput into per-epoch MFU.
     flops_per_sample: float | None = None
     peak_flops: float | None = None
+    # Optional goodput ledger (observability.goodput.GoodputLedger): each
+    # stop() feeds the epoch's wall seconds to the ledger's per-epoch
+    # marks, so goodput reports share the timer's clock windows.
+    ledger: object | None = None
     history: list = field(default_factory=list)
     _t0: float = 0.0
 
@@ -190,6 +194,8 @@ class EpochTimer:
             mfu=mfu,
         )
         self.history.append(stats)
+        if self.ledger is not None:
+            self.ledger.note_epoch(epoch, dt)
         return stats
 
     @property

@@ -38,8 +38,15 @@ def main() -> int:
     log = get_logger("train_tpu")
     import jax
 
+    from dct_tpu.observability.events import current_run_id
+
+    # Correlation ID: launcher-minted via DCT_RUN_ID, or minted here for
+    # an unlaunched (ad-hoc) run. Logged first so a human can jump from
+    # the Airflow task log into the structured event log with one grep.
+    run_id = cfg.obs.run_id or current_run_id()
     log.info(
-        "devices=%d processes=%d process_index=%d platform=%s",
+        "run_id=%s devices=%d processes=%d process_index=%d platform=%s",
+        run_id,
         jax.device_count(),
         jax.process_count(),
         jax.process_index(),

@@ -106,8 +106,13 @@ MFU_DEEP = [
 _MFU_FILTER_CHECKED = False
 
 
-def _run_mfu_configs(configs) -> None:
-    """DCT_SCALED_* sweep through bench's scaled section (scan-16 MFU)."""
+def _run_mfu_configs(configs, section: str) -> None:
+    """DCT_SCALED_* sweep through bench's scaled section (scan-16 MFU).
+
+    ``section`` is the campaign section running this pass ("mfu" or
+    "mfu_deep"): records — including the unknown-DCT_CAMPAIGN_MFU error
+    record — file under the section that actually detected them, so the
+    jsonl shows WHICH pass hit what (ADVICE r5)."""
     global _MFU_FILTER_CHECKED
     base = dict(bench.SCALED)
     base_batch = bench.SCALED_BATCH
@@ -118,7 +123,7 @@ def _run_mfu_configs(configs) -> None:
         if not _MFU_FILTER_CHECKED and keep - known:
             # Once per run: a typo'd config name must leave a visible
             # record, not silently consume a scarce relay window.
-            emit("mfu", "filter", {
+            emit(section, "filter", {
                 "error": (
                     f"unknown DCT_CAMPAIGN_MFU configs "
                     f"{sorted(keep - known)}; known: {sorted(known)}"
@@ -131,7 +136,7 @@ def _run_mfu_configs(configs) -> None:
             # a full-default run — but say so, in case the operator's
             # section list never reaches that pass.
             print(
-                f"[campaign] mfu pass empty after DCT_CAMPAIGN_MFU="
+                f"[campaign] {section} pass empty after DCT_CAMPAIGN_MFU="
                 f"{wanted!r}; remaining configs are in the other "
                 "mfu/mfu_deep pass",
                 file=sys.stderr, flush=True,
@@ -144,18 +149,18 @@ def _run_mfu_configs(configs) -> None:
             os.environ["DCT_REMAT"] = extra["remat"]
         else:
             os.environ.pop("DCT_REMAT", None)
-        item("mfu", name, bench.bench_scaled_transformer)
+        item(section, name, bench.bench_scaled_transformer)
     bench.SCALED = base
     bench.SCALED_BATCH = base_batch
     os.environ.pop("DCT_REMAT", None)
 
 
 def run_mfu() -> None:
-    _run_mfu_configs(MFU_CORE)
+    _run_mfu_configs(MFU_CORE, "mfu")
 
 
 def run_mfu_deep() -> None:
-    _run_mfu_configs(MFU_DEEP)
+    _run_mfu_configs(MFU_DEEP, "mfu_deep")
 
 
 def timeit(fn, *args, n=10):

@@ -14,6 +14,26 @@ SLEEP="${2:-120}"
 # axon relay's real port set.
 PORTS="${DCT_RELAY_PORTS:-8081 8083 8093 8103 8113 8123}"
 
+# Best-effort evidence commit: per-file 'git add -f || true' (a missing
+# file — bench crashed before its first flush — must not block the
+# others; -f because BENCH_PARTIAL.json is gitignored), commit pathspec
+# restricted to files that EXIST (a missing pathspec would otherwise
+# abort the commit with "did not match any file(s)").
+commit_evidence() {
+  msg="$1"; shift
+  have=""
+  for f in "$@"; do
+    if [ -e "$REPO/$f" ]; then
+      git -C "$REPO" add -f "$f" 2>> "$LOG" || true
+      have="$have $f"
+    fi
+  done
+  # shellcheck disable=SC2086 — word-splitting of $have is intended
+  [ -n "$have" ] \
+    && ( cd "$REPO" && git commit -m "$msg" -- $have >> "$LOG" 2>&1 ) \
+    || echo "$(date +%H:%M:%S) evidence auto-commit failed" >> "$LOG"
+}
+
 # Single instance only: two watchers would both launch the campaign
 # against the relay's ONE serialized TPU session (a stale nohup from a
 # prior session plus a fresh start is exactly how that happens).
@@ -75,31 +95,24 @@ for i in $(seq 1 "$N"); do
         # end-of-round bench time (they are exactly what prior_onchip
         # carries forward). Best-effort: a dirty-tree conflict must not
         # turn a successful window into a nonzero exit.
-        # -f: BENCH_PARTIAL.json is tracked but gitignored, and git add
-        # refuses ignored paths (exit 1) even for tracked files — which
-        # would abort this chain before the commit. The commit is
-        # pathspec'd so operator-staged WIP can never be swept in.
-        (
-          cd "$REPO" \
-          && git add -f ONCHIP_CAMPAIGN.jsonl BENCH_ONCHIP_LATEST.json \
-               BENCH_PARTIAL.json 2>> "$LOG" \
-          && git commit \
-               -m "Land on-chip campaign results and insurance bench record" \
-               -- ONCHIP_CAMPAIGN.jsonl BENCH_ONCHIP_LATEST.json \
-                  BENCH_PARTIAL.json >> "$LOG" 2>&1
-        ) || echo "$(date +%H:%M:%S) evidence auto-commit failed" >> "$LOG"
+        # -f: BENCH_PARTIAL.json is gitignored (untracked until a window
+        # lands it), and git add refuses ignored paths (exit 1) — which
+        # would abort this chain before the commit. Each file is added
+        # in its OWN best-effort add, and the commit pathspec names only
+        # files that exist: one missing evidence file (bench crashed
+        # before its first flush) must not block committing the others,
+        # at either the add OR the commit ("pathspec did not match").
+        # The commit stays pathspec'd so operator-staged WIP can never
+        # be swept in.
+        commit_evidence "Land on-chip campaign results and insurance bench record" \
+          ONCHIP_CAMPAIGN.jsonl BENCH_ONCHIP_LATEST.json BENCH_PARTIAL.json
         exit 0
       fi
       rm -f "$REPO/.bench_onchip.tmp"
       # Even a failed insurance bench leaves streamed evidence: the
       # campaign jsonl and whatever partial the bench flushed.
-      (
-        cd "$REPO" \
-        && git add -f ONCHIP_CAMPAIGN.jsonl BENCH_PARTIAL.json 2>> "$LOG" \
-        && git commit \
-             -m "Land on-chip campaign results (insurance bench failed)" \
-             -- ONCHIP_CAMPAIGN.jsonl BENCH_PARTIAL.json >> "$LOG" 2>&1
-      ) || echo "$(date +%H:%M:%S) evidence auto-commit failed" >> "$LOG"
+      commit_evidence "Land on-chip campaign results (insurance bench failed)" \
+        ONCHIP_CAMPAIGN.jsonl BENCH_PARTIAL.json
       echo "$(date +%H:%M:%S) bench FAILED exit=$brc" >> "$LOG"
       exit 6  # campaign ran but the insurance bench did not land
     fi

@@ -99,9 +99,10 @@ def test_failed_endpoint_recreated(tmp_path):
     assert client.get_traffic("ep") == {BLUE: 100}
 
 
-def test_prepare_package_selects_best_run(tmp_path):
+def test_prepare_package_selects_best_run(tmp_path, monkeypatch):
     """End-to-end: tracking store with two runs -> package built from the
     lower-val_loss one (the deploy DAGs' selection policy)."""
+    monkeypatch.delenv("DCT_RUN_ID", raising=False)  # restored after
     store = LocalTracking(root=str(tmp_path / "runs"), experiment="weather_forecasting")
 
     def finished_run(val_loss, seed):
@@ -125,6 +126,27 @@ def test_prepare_package_selects_best_run(tmp_path):
     assert abs(info["val_loss"] - 0.2) < 1e-9
     for f in ("model.ckpt", "model.npz", "model_meta.json", "score.py", "conda.yaml"):
         assert os.path.exists(os.path.join(str(tmp_path / "deploy"), f))
+    # Deploy-side correlation channel: the package carries the SHIPPED
+    # training cycle's run-correlation ID (each rollout stage runs in
+    # its own task process — the package dir is the one shared
+    # artifact), and a fresh orchestrator adopts it at deploy time.
+    from dct_tpu.deploy.rollout import package_run_correlation_id
+
+    best = store.search_best_run("val_loss", "min")
+    assert best.run_correlation_id
+    assert info["run_correlation_id"] == best.run_correlation_id
+    assert (
+        package_run_correlation_id(str(tmp_path / "deploy"))
+        == best.run_correlation_id
+    )
+    ro = RolloutOrchestrator(
+        LocalEndpointClient(state_path=str(tmp_path / "ep.json")),
+        "ep", sleep_fn=lambda s: None,
+    )
+    ro.run(str(tmp_path / "deploy"))
+    assert ro.run_id == best.run_correlation_id
+    # A pre-observability package yields None, never a crash.
+    assert package_run_correlation_id(str(tmp_path / "nope")) is None
 
 
 def test_prepare_package_no_runs_raises(tmp_path):

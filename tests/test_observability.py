@@ -1,0 +1,563 @@
+"""Observability layer: goodput ledger, rank heartbeats, structured
+event log, Prometheus text exposition, and run-correlation propagation
+through the launcher (ISSUE 1 acceptance assertions live in
+tests/test_observability_e2e.py)."""
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+from dct_tpu.observability.events import EventLog
+from dct_tpu.observability.goodput import CATEGORIES, GoodputLedger
+from dct_tpu.observability.heartbeat import (
+    HeartbeatMonitor,
+    HeartbeatWriter,
+)
+from dct_tpu.observability.prometheus import (
+    HistogramAccumulator,
+    MetricFamily,
+    render,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- goodput ledger ----------------------------------------------------
+
+
+def test_goodput_categories_sum_to_wall_time():
+    clk = FakeClock()
+    led = GoodputLedger(clock=clk)
+    led.start()
+    with led.span("startup_recovery"):
+        clk.advance(2.0)
+    with led.dispatch("train_step", key="k1"):  # first dispatch: compile
+        clk.advance(5.0)
+    with led.dispatch("train_step", key="k1"):  # now the real step
+        clk.advance(1.0)
+    with led.span("data_wait"):
+        clk.advance(0.5)
+    with led.span("checkpoint"):
+        clk.advance(0.25)
+    s = led.summary()
+    assert s["categories"]["compile"] == pytest.approx(5.0)
+    assert s["categories"]["train_step"] == pytest.approx(1.0)
+    assert s["categories"]["startup_recovery"] == pytest.approx(2.0)
+    assert s["wall_seconds"] == pytest.approx(8.75)
+    # Every second accounted: categories sum exactly to wall time.
+    assert sum(s["categories"].values()) == pytest.approx(s["wall_seconds"])
+    assert s["unattributed_seconds"] == pytest.approx(0.0)
+    assert s["goodput_fraction"] == pytest.approx(1.0 / 8.75)
+
+
+def test_goodput_compile_detection_per_program_key():
+    """Each DISTINCT program key pays one compile; a new key (a ragged
+    remainder span compiles a different XLA program) compiles again."""
+    clk = FakeClock()
+    led = GoodputLedger(clock=clk)
+    led.start()
+    for key, dt in (("k4", 10.0), ("k4", 1.0), ("k4", 1.0), ("k1", 3.0)):
+        with led.dispatch("train_step", key=key):
+            clk.advance(dt)
+    assert led.seconds["compile"] == pytest.approx(13.0)
+    assert led.seconds["train_step"] == pytest.approx(2.0)
+
+
+def test_goodput_gap_surfaces_as_unattributed():
+    clk = FakeClock()
+    led = GoodputLedger(clock=clk)
+    led.start()
+    with led.span("train_step"):
+        clk.advance(1.0)
+    clk.advance(3.0)  # un-spanned time must not vanish
+    s = led.summary()
+    assert s["unattributed_seconds"] == pytest.approx(3.0)
+    assert s["goodput_fraction"] == pytest.approx(0.25)
+
+
+def test_goodput_epoch_report_is_delta_not_cumulative():
+    clk = FakeClock()
+    led = GoodputLedger(clock=clk)
+    led.start()
+    with led.span("train_step"):
+        clk.advance(4.0)
+    r1 = led.epoch_report()
+    assert r1["categories"]["train_step"] == pytest.approx(4.0)
+    assert r1["goodput_fraction"] == pytest.approx(1.0)
+    with led.span("train_step"):
+        clk.advance(1.0)
+    with led.span("checkpoint"):
+        clk.advance(1.0)
+    r2 = led.epoch_report()
+    assert r2["categories"]["train_step"] == pytest.approx(1.0)
+    assert r2["goodput_fraction"] == pytest.approx(0.5)
+
+
+def test_goodput_unknown_category_refused():
+    led = GoodputLedger(clock=FakeClock())
+    with pytest.raises(KeyError):
+        led.add("coffee_break", 1.0)
+
+
+def test_goodput_fraction_matches_goodput_prefixed_categories():
+    """The fraction's numerator and the goodput_-prefixed tracker
+    metrics use the SAME productive set (train_step + eval): an eager
+    run with heavy validation must not report contradictory numbers."""
+    clk = FakeClock()
+    led = GoodputLedger(clock=clk)
+    led.start()
+    with led.span("train_step"):
+        clk.advance(2.0)
+    with led.span("eval"):
+        clk.advance(2.0)
+    with led.span("checkpoint"):
+        clk.advance(4.0)
+    s = led.summary()
+    assert s["goodput_fraction"] == pytest.approx(0.5)
+    m = led.tracker_metrics()
+    good = sum(v for k, v in m.items() if k.startswith("goodput_") and k.endswith("_seconds"))
+    assert good / m["wall_seconds"] == pytest.approx(m["goodput_fraction"])
+
+
+def test_observability_enabled_parse_is_shared(monkeypatch):
+    """config._env(bool), events.observability_enabled, and the launcher
+    must agree on every spelling of DCT_OBSERVABILITY — a half-disabled
+    run (trainer silent, launcher/checkpoint still writing) is worse
+    than either state."""
+    from dct_tpu.config import ObservabilityConfig
+    from dct_tpu.launch.launcher import _launcher_event_log
+    from dct_tpu.observability.events import observability_enabled
+
+    for raw, expected in (
+        (None, True), ("1", True), ("true", True), ("YES", True),
+        ("on", True), ("0", False), ("false", False), ("off", False),
+        ("disabled", False), ("2", False), ("", False),
+    ):
+        if raw is None:
+            monkeypatch.delenv("DCT_OBSERVABILITY", raising=False)
+        else:
+            monkeypatch.setenv("DCT_OBSERVABILITY", raw)
+        env = {"DCT_RUN_ID": "dct-x"}
+        if raw is not None:
+            env["DCT_OBSERVABILITY"] = raw
+        assert observability_enabled(env) is expected, raw
+        assert _launcher_event_log(env).enabled is expected, raw
+        assert ObservabilityConfig.from_env().enabled is expected, raw
+
+
+def test_goodput_tracker_metric_names():
+    """The tracker surface: goodput_ prefixes productive categories,
+    badput_ the overhead ones — queryable next to val_loss."""
+    clk = FakeClock()
+    led = GoodputLedger(clock=clk)
+    led.start()
+    with led.span("train_step"):
+        clk.advance(1.0)
+    m = led.tracker_metrics()
+    assert "goodput_fraction" in m
+    assert "goodput_train_step_seconds" in m
+    assert "goodput_eval_seconds" in m
+    for cat in ("compile", "checkpoint", "data_wait", "startup_recovery"):
+        assert f"badput_{cat}_seconds" in m
+    assert "badput_unattributed_seconds" in m
+    assert all(isinstance(v, float) for v in m.values())
+
+
+def test_epoch_timer_feeds_ledger():
+    from dct_tpu.utils.profiling import EpochTimer
+
+    led = GoodputLedger(clock=FakeClock())
+    led.start()
+    timer = EpochTimer(n_chips=1, ledger=led)
+    timer.start()
+    timer.stop(0, samples=10)
+    timer.start()
+    timer.stop(1, samples=10)
+    assert led.summary()["epochs"] == 2
+
+
+# -- heartbeats --------------------------------------------------------
+
+
+def test_heartbeat_write_stall_and_skew(tmp_path):
+    clk = FakeClock(1000.0)
+    hb_dir = str(tmp_path / "hb")
+    w0 = HeartbeatWriter(hb_dir, 0, run_id="dct-x", clock=clk)
+    w1 = HeartbeatWriter(hb_dir, 1, run_id="dct-x", clock=clk)
+    mon = HeartbeatMonitor(
+        hb_dir, 3, stall_seconds=60.0, run_id="dct-x", clock=clk
+    )
+
+    # Startup grace: nobody has beaten yet -> "starting", not "missing".
+    assert [s.state for s in mon.scan()] == ["starting"] * 3
+
+    assert w0.beat(step=10, epoch=5)
+    assert w1.beat(step=2, epoch=1)
+    sts = mon.scan()
+    assert [s.state for s in sts] == ["ok", "ok", "starting"]
+    assert mon.skew(sts) == {"epoch_skew": 4, "step_skew": 8}
+
+    # Rank 1 goes quiet; rank 2 never starts. Past the stall window the
+    # monitor names both, differently.
+    clk.advance(61.0)
+    w0.beat(step=50, epoch=9, force=True)
+    sts = mon.scan()
+    assert sts[0].state == "ok"
+    assert sts[1].state == "stalled"
+    assert sts[1].age_seconds == pytest.approx(61.0)
+    assert sts[2].state == "missing"
+    rep = mon.report()
+    assert rep["stalled"] == [1] and rep["missing"] == [2]
+
+    # A final "done" beat never stalls, however old it gets.
+    w1.close(epoch=1)
+    clk.advance(10_000.0)
+    assert mon.scan()[1].state == "done"
+
+
+def test_heartbeat_ignores_other_runs_leftovers(tmp_path):
+    """Yesterday's heartbeat file must not make today's dead rank look
+    alive: records from another run_id are treated as absent."""
+    clk = FakeClock(100.0)
+    hb_dir = str(tmp_path / "hb")
+    HeartbeatWriter(hb_dir, 0, run_id="dct-old", clock=clk).beat(epoch=3)
+    clk.advance(120.0)
+    mon = HeartbeatMonitor(
+        hb_dir, 1, stall_seconds=60.0, run_id="dct-new", clock=clk
+    )
+    clk.advance(61.0)  # past the grace window
+    assert mon.scan()[0].state == "missing"
+    # Without a run_id filter the stale record would have counted.
+    assert HeartbeatMonitor(
+        hb_dir, 1, stall_seconds=1e6, run_id=None, clock=clk
+    ).scan()[0].state == "ok"
+
+
+def test_heartbeat_throttles_same_phase_beats(tmp_path):
+    clk = FakeClock()
+    w = HeartbeatWriter(
+        str(tmp_path), 0, run_id="r", min_interval=5.0, clock=clk
+    )
+    assert w.beat(step=1)
+    clk.advance(1.0)
+    assert not w.beat(step=2)  # same phase, inside the window
+    assert w.beat(step=2, phase="checkpoint")  # phase change writes
+    clk.advance(6.0)
+    assert w.beat(step=3, phase="checkpoint")  # window elapsed
+
+
+def test_heartbeat_writer_failure_degrades_to_noop(tmp_path):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("file where the dir should be")
+    w = HeartbeatWriter(str(blocker), 0, run_id="r")
+    assert not w.beat(step=1)  # no raise
+    assert not w.beat(step=2)
+
+
+# -- event log ---------------------------------------------------------
+
+
+def test_event_log_schema_and_strict_json(tmp_path):
+    path = str(tmp_path / "ev" / "events.jsonl")
+    clk = FakeClock(123.0)
+    log = EventLog(path, run_id="dct-abc", rank=1, clock=clk)
+    log.emit("trainer", "epoch_end", epoch=0, val_loss=float("nan"))
+    log.emit("checkpoint", "best_saved", path="/x/y.ckpt")
+    recs = [
+        json.loads(line) for line in open(path).read().splitlines()
+    ]
+    assert len(recs) == 2
+    for rec in recs:
+        # The fixed schema keys are always present.
+        assert set(rec) >= {"ts", "run_id", "rank", "component", "event"}
+        assert rec["run_id"] == "dct-abc"
+        assert rec["rank"] == 1
+    assert recs[0]["component"] == "trainer"
+    # NaN is scrubbed to a string: every line stays strict JSON.
+    assert recs[0]["val_loss"] == "nan"
+    assert json.loads(
+        open(path).readline(), parse_constant=lambda c: pytest.fail(c)
+    )
+
+
+def test_event_log_disabled_and_failure_paths(tmp_path):
+    disabled = EventLog(None, run_id="dct-x")
+    disabled.emit("trainer", "anything")  # no raise, no file
+    assert not disabled.enabled
+    blocker = tmp_path / "plainfile"
+    blocker.write_text("x")
+    broken = EventLog(
+        str(blocker / "events.jsonl"), run_id="dct-x"
+    )
+    broken.emit("trainer", "anything")  # OSError swallowed
+    assert not broken.enabled  # degraded for good
+
+
+# -- prometheus exposition --------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (-?[0-9.e+-]+|\+Inf|NaN)$'
+)
+
+
+def _parse_exposition(text: str) -> dict:
+    """Minimal 0.0.4 parser: every non-comment line must match the
+    sample grammar; returns {metric_name+labels: float}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"invalid exposition line: {line!r}"
+        value = float("inf") if m.group(3) == "+Inf" else float(m.group(3))
+        out[m.group(1) + (m.group(2) or "")] = value
+    return out
+
+
+def test_prometheus_render_and_parse():
+    hist = HistogramAccumulator(buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        hist.observe(v)
+    fams = [
+        MetricFamily("dct_requests_total", "counter", "Requests.")
+        .add(3, {"slot": "blue"})
+        .add(1, {"slot": 'we"ird\nslot'}),
+        MetricFamily("dct_latency_seconds", "histogram", "Latency."),
+    ]
+    hist.samples_into(fams[1], {"slot": "blue"})
+    text = render(fams)
+    assert text.endswith("\n")
+    samples = _parse_exposition(text)
+    assert samples['dct_requests_total{slot="blue"}'] == 3
+    # Escaped label values survive the round trip as single lines.
+    assert any('we\\"ird\\nslot' in k for k in samples)
+    # Cumulative buckets are monotone and +Inf equals _count.
+    b01 = samples['dct_latency_seconds_bucket{slot="blue",le="0.1"}']
+    b1 = samples['dct_latency_seconds_bucket{slot="blue",le="1"}']
+    binf = samples['dct_latency_seconds_bucket{slot="blue",le="+Inf"}']
+    assert b01 == 1 and b1 == 2 and binf == 3
+    assert samples['dct_latency_seconds_count{slot="blue"}'] == 3
+    assert samples['dct_latency_seconds_sum{slot="blue"}'] == pytest.approx(
+        5.55
+    )
+    # HELP/TYPE lines present for every family.
+    assert "# TYPE dct_requests_total counter" in text
+    assert "# TYPE dct_latency_seconds histogram" in text
+
+
+def test_slot_metrics_prometheus_text():
+    from dct_tpu.serving.server import _SlotMetrics
+
+    m = _SlotMetrics()
+    m.record("blue", 0.002, ok=True)
+    m.record("blue", 0.3, ok=False)
+    m.record("green", 0.004, ok=True)
+    samples = _parse_exposition(m.prometheus_text())
+    assert samples['dct_requests_total{slot="blue"}'] == 2
+    assert samples['dct_request_errors_total{slot="blue"}'] == 1
+    assert samples['dct_requests_total{slot="green"}'] == 1
+    assert samples['dct_request_errors_total{slot="green"}'] == 0
+    assert (
+        samples['dct_request_latency_seconds_count{slot="blue"}'] == 2
+    )
+    assert samples[
+        'dct_request_latency_seconds_bucket{slot="blue",le="+Inf"}'
+    ] == 2
+
+
+def test_train_metrics_prom_dump(tmp_path):
+    from dct_tpu.observability.dump import write_train_metrics_prom
+
+    led = GoodputLedger(clock=FakeClock())
+    path = str(tmp_path / "m" / "train_metrics.prom")
+    out = write_train_metrics_prom(
+        path, led.summary(), run_id="dct-q",
+        samples_per_sec=42.0, val_loss=0.5,
+    )
+    assert out == path
+    samples = _parse_exposition(open(path).read())
+    assert any("dct_train_goodput_fraction" in k for k in samples)
+    assert any('category="train_step"' in k for k in samples)
+    assert samples['dct_train_samples_per_sec{run_id="dct-q"}'] == 42.0
+    assert samples['dct_train_val_loss{run_id="dct-q"}'] == 0.5
+
+
+# -- correlation through the launcher ----------------------------------
+
+_RANK_SCRIPT = (
+    "import os, sys\n"
+    "out = os.environ['OUT_DIR']\n"
+    "rank = os.environ['NODE_RANK']\n"
+    "with open(os.path.join(out, f'rank_{rank}.txt'), 'w') as f:\n"
+    "    f.write(os.environ.get('DCT_RUN_ID', ''))\n"
+)
+
+
+def _launch_and_read_ids(tmp_path, env):
+    from dct_tpu.launch.launcher import LocalProcessLauncher
+
+    out_dir = tmp_path / "out"
+    out_dir.mkdir(exist_ok=True)
+    launcher = LocalProcessLauncher(stagger_seconds=0.0, timeout=60.0)
+    results = launcher.launch(
+        [sys.executable, "-c", _RANK_SCRIPT],
+        world_size=2,
+        env={**env, "OUT_DIR": str(out_dir)},
+    )
+    assert LocalProcessLauncher.all_succeeded(results), results
+    return [
+        (out_dir / f"rank_{r}.txt").read_text() for r in range(2)
+    ]
+
+
+def test_launcher_mints_one_run_id_for_all_ranks(tmp_path, monkeypatch):
+    monkeypatch.delenv("DCT_RUN_ID", raising=False)
+    events_dir = tmp_path / "ev"
+    ids = _launch_and_read_ids(
+        tmp_path, {"DCT_EVENTS_DIR": str(events_dir)}
+    )
+    assert ids[0] == ids[1]
+    assert ids[0].startswith("dct-")
+    # The launcher's own records carry the SAME id into the SAME log the
+    # ranks would write (rank null = orchestrator-side).
+    recs = [
+        json.loads(line)
+        for line in (events_dir / "events.jsonl").read_text().splitlines()
+    ]
+    assert {r["run_id"] for r in recs} == {ids[0]}
+    assert all(r["rank"] is None for r in recs)
+    by_event = {r["event"] for r in recs}
+    assert {"launch_start", "rank_exit", "launch_end"} <= by_event
+    end = [r for r in recs if r["event"] == "launch_end"][0]
+    assert end["success"] is True
+    assert end["returncodes"] == [0, 0]
+
+
+def test_launcher_respects_caller_run_id(tmp_path, monkeypatch):
+    monkeypatch.delenv("DCT_RUN_ID", raising=False)
+    ids = _launch_and_read_ids(
+        tmp_path,
+        {
+            "DCT_RUN_ID": "dct-pinned00001",
+            "DCT_EVENTS_DIR": str(tmp_path / "ev"),
+        },
+    )
+    assert ids == ["dct-pinned00001", "dct-pinned00001"]
+
+
+def test_launcher_reports_stalled_rank(tmp_path, monkeypatch, capfd):
+    """A rank whose heartbeat goes stale gets NAMED while the launcher
+    is still joined on it — the silent-wait failure mode the monitor
+    exists to kill."""
+    from dct_tpu.launch.launcher import LocalProcessLauncher
+    from dct_tpu.observability.heartbeat import HeartbeatWriter
+
+    monkeypatch.delenv("DCT_RUN_ID", raising=False)
+    hb_dir = tmp_path / "hb"
+    events_dir = tmp_path / "ev"
+    # Pre-write a heartbeat that is ALREADY stale for the pinned run id;
+    # the rank itself just sleeps (alive but never progressing).
+    stale_clock = FakeClock(0.0)
+    HeartbeatWriter(
+        str(hb_dir), 0, run_id="dct-stall", clock=stale_clock
+    ).beat(step=1, epoch=0)
+    launcher = LocalProcessLauncher(
+        stagger_seconds=0.0,
+        timeout=60.0,
+        heartbeat_dir=str(hb_dir),
+        heartbeat_stall_seconds=0.2,
+        heartbeat_scan_seconds=0.0,
+    )
+    results = launcher.launch(
+        [sys.executable, "-c", "import time; time.sleep(1.5)"],
+        world_size=1,
+        env={
+            "DCT_RUN_ID": "dct-stall",
+            "DCT_EVENTS_DIR": str(events_dir),
+        },
+    )
+    assert results[0].returncode == 0
+    recs = [
+        json.loads(line)
+        for line in (events_dir / "events.jsonl").read_text().splitlines()
+    ]
+    stalled = [r for r in recs if r["event"] == "rank_stalled"]
+    assert stalled and stalled[0]["flagged_rank"] == 0
+    assert "heartbeat stalled" in capfd.readouterr().err
+
+
+def test_spmd_launch_script_run_id_resolves_at_runtime(tmp_path):
+    """The generated launch block resolves the run-correlation ID when
+    it RUNS (Airflow renders bash_command at DAG-parse time — a
+    build-time mint would be shared across runs), and the resolved value
+    reaches every rank's env through the exec-template quoting contract
+    (one shlex-quoted token; $RUN_ID spliced outside it)."""
+    import subprocess
+
+    from dct_tpu.launch.launcher import build_spmd_launch_script
+
+    marker = tmp_path / "ids"
+    script = build_spmd_launch_script(
+        ["h0", "h1"],
+        f"sh -c 'echo $DCT_RUN_ID >> {marker}'",
+        exec_template="bash -c {cmd}",
+        stagger_seconds=0,
+    )
+    assert 'RUN_ID="${DCT_RUN_ID:-' in script  # runtime mint, env wins
+    # Two runs of the SAME rendered script get DIFFERENT ids; within a
+    # run both ranks share one.
+    for _ in range(2):
+        proc = subprocess.run(
+            ["bash", "-c", script], capture_output=True, text=True,
+            env={k: v for k, v in os.environ.items()
+                 if k != "DCT_RUN_ID"},
+        )
+        assert proc.returncode == 0, proc.stderr
+    ids = marker.read_text().split()
+    assert len(ids) == 4
+    assert ids[0] == ids[1] and ids[2] == ids[3]  # shared within a run
+    assert ids[0] != ids[2]  # fresh across runs of one rendered script
+    assert all(i.startswith("dct-") for i in ids)
+
+    # Pinning still works (an operator exporting a chosen id).
+    pinned = build_spmd_launch_script(
+        ["h0", "h1"], "python3 t.py", run_id="dct-dagrun01"
+    )
+    assert "RUN_ID=dct-dagrun01" in pinned
+    assert 'echo "run_id=$RUN_ID"' in pinned
+
+
+def test_observability_config_from_env(monkeypatch):
+    from dct_tpu.config import ObservabilityConfig
+
+    monkeypatch.setenv("DCT_OBSERVABILITY", "0")
+    monkeypatch.setenv("DCT_EVENTS_DIR", "/tmp/ev")
+    monkeypatch.setenv("DCT_RUN_ID", "dct-envid000001")
+    monkeypatch.setenv("DCT_HEARTBEAT_STALL_SECONDS", "33.5")
+    c = ObservabilityConfig.from_env()
+    assert c.enabled is False
+    assert c.events_dir == "/tmp/ev"
+    assert c.run_id == "dct-envid000001"
+    assert c.heartbeat_stall_seconds == 33.5
+
+
+def test_categories_are_the_documented_set():
+    """docs/observability.md documents this exact set; the summary must
+    carry every category even when untouched."""
+    led = GoodputLedger(clock=FakeClock())
+    assert set(led.summary()["categories"]) == set(CATEGORIES) == {
+        "train_step", "eval", "compile", "checkpoint", "data_wait",
+        "startup_recovery",
+    }

@@ -233,9 +233,21 @@ def test_compilation_cache_gating(tmp_path, monkeypatch):
     monkeypatch.setenv("DCT_JAX_CACHE", "force")
     import jax
 
+    # The force leg sets THREE process-global config values; capture and
+    # restore them all, or the min-compile-time/min-entry-size tuning
+    # leaks into every later test in the process (ADVICE r5).
+    prev = {
+        name: getattr(jax.config, name)
+        for name in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        )
+    }
     try:
         assert enable_compilation_cache(str(cache)) == str(cache)
         assert cache.is_dir()
         assert jax.config.jax_compilation_cache_dir == str(cache)
     finally:
-        jax.config.update("jax_compilation_cache_dir", None)
+        for name, value in prev.items():
+            jax.config.update(name, value)

@@ -258,6 +258,24 @@ def test_endpoint_server_rollout_routing(processed_dir, tmp_path):
         assert metrics["green"]["errors"] == 0
         assert metrics["green"]["p50_ms"] > 0
 
+        # The same series as Prometheus text exposition on /metrics
+        # (both slots' counters and latency histograms, every line
+        # grammar-valid).
+        from tests.test_observability import _parse_exposition
+
+        with urllib.request.urlopen(url + "/metrics") as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            prom = _parse_exposition(r.read().decode())
+        assert prom['dct_requests_total{slot="blue"}'] == (
+            metrics["blue"]["requests"]
+        )
+        assert prom['dct_requests_total{slot="green"}'] == (
+            metrics["green"]["requests"]
+        )
+        assert prom[
+            'dct_request_latency_seconds_count{slot="green"}'
+        ] == metrics["green"]["requests"]
+
         # No live traffic -> 503, not a crash.
         c2.set_traffic("weather-ep", {})
         with pytest.raises(urllib.error.HTTPError) as e:
