@@ -68,15 +68,38 @@ def _orchestrator():
     )
 
 
+def _task_span(task_id: str):
+    """Host-side span for one DAG task callable. Each task runs in its
+    own Airflow process with no env inheritance from the training
+    launch, so the span adopts the SHIPPED package's run-correlation ID
+    (same rule as the rollout stage events) — the dag.* spans land on
+    the same cycle trace as the deploy.* stages. Before the package
+    exists (prepare_package itself) there is nothing to adopt and the
+    process default applies."""
+    from dct_tpu.deploy.rollout import package_run_correlation_id
+    from dct_tpu.observability import spans
+
+    rec = spans.get_default().for_trace(
+        package_run_correlation_id(DEPLOY_DIR)
+    )
+    return rec.span(f"dag.{task_id}", component="dag")
+
+
 def prepare_package(**context):
     from dct_tpu.deploy.rollout import prepare_package as prep
+    from dct_tpu.observability import spans
 
-    info = prep(_tracker(), DEPLOY_DIR)
+    # No adoption here: this task CREATES the package (wiping the old
+    # one), so reading run_info.json up front would attach the span to
+    # the PREVIOUS cycle. The default recorder applies.
+    with spans.get_default().span("dag.prepare_package", component="dag"):
+        info = prep(_tracker(), DEPLOY_DIR)
     print(f"Package ready: run {info['run_id']} val_loss={info['val_loss']}")
 
 
 def deploy_new_slot(ti=None, **context):
-    new_slot, old_slot = _orchestrator().deploy_new_slot(DEPLOY_DIR)
+    with _task_span("deploy_new_slot"):
+        new_slot, old_slot = _orchestrator().deploy_new_slot(DEPLOY_DIR)
     if ti is not None:
         ti.xcom_push(key="new_slot", value=new_slot)
         ti.xcom_push(key="old_slot", value=old_slot or "")
@@ -91,11 +114,12 @@ def _slots(ti):
 
 def start_shadow(ti=None, **context):
     new_slot, old_slot = _slots(ti)
-    if old_slot is None:
-        print("First deployment — skipping shadow, going straight to 100%")
-        _orchestrator().full_rollout(new_slot, None)
-        return
-    _orchestrator().start_shadow(new_slot, old_slot)
+    with _task_span("start_shadow"):
+        if old_slot is None:
+            print("First deployment — skipping shadow, going straight to 100%")
+            _orchestrator().full_rollout(new_slot, None)
+            return
+        _orchestrator().start_shadow(new_slot, old_slot)
     print(f"Shadow: {old_slot} 100% live, {new_slot} mirroring 20%")
 
 
@@ -103,13 +127,15 @@ def start_canary(ti=None, **context):
     new_slot, old_slot = _slots(ti)
     if old_slot is None:
         return
-    _orchestrator().start_canary(new_slot, old_slot)
+    with _task_span("start_canary"):
+        _orchestrator().start_canary(new_slot, old_slot)
     print(f"Canary: {old_slot} 90% / {new_slot} 10%")
 
 
 def full_rollout(ti=None, **context):
     new_slot, old_slot = _slots(ti)
-    _orchestrator().full_rollout(new_slot, old_slot)
+    with _task_span("full_rollout"):
+        _orchestrator().full_rollout(new_slot, old_slot)
     print(f"Full rollout: {new_slot} at 100%, old slot removed")
 
 

@@ -63,13 +63,18 @@ default_args = {
 
 def print_training_summary(**context):
     """Run-metadata report (reference pipeline.py:17-27,242-246)."""
-    print("=" * 80)
-    print("DISTRIBUTED PIPELINE SUMMARY")
-    print(f"  execution date: {context.get('ds', 'n/a')}")
-    print(f"  run id:         {context.get('run_id', 'n/a')}")
-    print(f"  hosts:          {HOSTS}")
-    print(f"  models dir:     {MODELS_DIR}")
-    print("=" * 80)
+    from dct_tpu.observability import spans
+
+    with spans.get_default().span(
+        "dag.print_training_summary", component="dag"
+    ):
+        print("=" * 80)
+        print("DISTRIBUTED PIPELINE SUMMARY")
+        print(f"  execution date: {context.get('ds', 'n/a')}")
+        print(f"  run id:         {context.get('run_id', 'n/a')}")
+        print(f"  hosts:          {HOSTS}")
+        print(f"  models dir:     {MODELS_DIR}")
+        print("=" * 80)
     return "summary-complete"
 
 

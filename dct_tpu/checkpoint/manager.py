@@ -33,6 +33,7 @@ import numpy as np
 from flax import serialization
 
 from dct_tpu.observability import events as _events
+from dct_tpu.observability import spans as _spans
 
 
 def needs_cross_process_gather(tree) -> bool:
@@ -112,19 +113,24 @@ class BestLastCheckpointer:
         """Write last.ckpt; if monitor improved, replace the best file.
         Returns True when a new best was saved."""
         meta = {**meta, "epoch": int(epoch), **{k: float(v) for k, v in metrics.items()}}
-        save_checkpoint(self.last_path, params, meta)
+        with _spans.get_default().span(
+            "checkpoint.deploy_write", component="checkpoint",
+            epoch=int(epoch),
+        ) as sp:
+            save_checkpoint(self.last_path, params, meta)
 
-        value = float(metrics[self.monitor])
-        improved = self.best_value is None or self.sign * value < self.sign * self.best_value
-        if improved:
-            name = self.filename_template.format(epoch=epoch, **metrics) + ".ckpt"
-            new_path = os.path.join(self.dirpath, name)
-            save_checkpoint(new_path, params, meta)
-            if self.best_model_path and os.path.exists(self.best_model_path):
-                if os.path.abspath(self.best_model_path) != os.path.abspath(new_path):
-                    os.remove(self.best_model_path)
-            self.best_value = value
-            self.best_model_path = new_path
+            value = float(metrics[self.monitor])
+            improved = self.best_value is None or self.sign * value < self.sign * self.best_value
+            if improved:
+                name = self.filename_template.format(epoch=epoch, **metrics) + ".ckpt"
+                new_path = os.path.join(self.dirpath, name)
+                save_checkpoint(new_path, params, meta)
+                if self.best_model_path and os.path.exists(self.best_model_path):
+                    if os.path.abspath(self.best_model_path) != os.path.abspath(new_path):
+                        os.remove(self.best_model_path)
+                self.best_value = value
+                self.best_model_path = new_path
+            sp.set(improved=improved)
         _events.get_default().emit(
             "checkpoint", "best_saved" if improved else "last_saved",
             epoch=int(epoch),
@@ -247,6 +253,23 @@ class TrainStateCheckpointer:
 
     def _publish(self, entries: dict, meta: dict | None = None) -> str:
         """Write ``entries`` (+ meta) into state.next, then rotate."""
+        # Span from whichever thread publishes (save_async's worker
+        # included): the resume-save I/O window on the trace timeline.
+        # try/finally so a FAILED write (ENOSPC — exactly the window an
+        # operator opens the trace to diagnose) is still recorded.
+        span = _spans.get_default().start(
+            "checkpoint.resume_save", component="checkpoint",
+            epochs_completed=(meta or {}).get("epochs_completed"),
+        )
+        try:
+            return self._publish_inner(entries, meta)
+        except BaseException as e:
+            span.attrs["error"] = type(e).__name__
+            raise
+        finally:
+            span.end()
+
+    def _publish_inner(self, entries: dict, meta: dict | None = None) -> str:
         import shutil
 
         next_dir = self._dir(self._NEXT)
@@ -389,6 +412,12 @@ class TrainStateCheckpointer:
         shard-saved leaves are reassembled onto this process's devices
         under the template leaf's sharding."""
         self.wait()
+        with _spans.get_default().span(
+            "checkpoint.restore", component="checkpoint",
+        ):
+            return self._restore(state)
+
+    def _restore(self, state):
         candidates = self._restore_candidates()
         if not candidates:
             legacy = [

@@ -404,6 +404,18 @@ class ObservabilityConfig:
     heartbeat_stall_seconds: float = 120.0
     # End-of-run Prometheus text dump; "" = <events_dir>/train_metrics.prom.
     metrics_path: str = ""
+    # Distributed-tracing span files; "" = <events_dir>/spans. The
+    # trace_id is the run-correlation ID; parent spans propagate to
+    # child processes via DCT_SPAN_ID (observability/spans.py).
+    spans_dir: str = ""
+    # Training-health policy (observability/health.py): halt the run on
+    # a non-finite loss / on a loss-or-grad-norm spike, or (default)
+    # warn via health.* events and keep training. The z-score detector
+    # compares each step against a rolling window of recent history.
+    halt_on_nan: bool = False
+    halt_on_spike: bool = False
+    spike_zscore: float = 8.0
+    spike_window: int = 16
 
     @classmethod
     def from_env(cls) -> "ObservabilityConfig":
@@ -419,6 +431,11 @@ class ObservabilityConfig:
             "DCT_HEARTBEAT_STALL_SECONDS", c.heartbeat_stall_seconds, float
         )
         c.metrics_path = _env("DCT_METRICS_PROM", c.metrics_path, str)
+        c.spans_dir = _env("DCT_SPANS_DIR", c.spans_dir, str)
+        c.halt_on_nan = _env("DCT_HALT_ON_NAN", c.halt_on_nan, bool)
+        c.halt_on_spike = _env("DCT_HALT_ON_SPIKE", c.halt_on_spike, bool)
+        c.spike_zscore = _env("DCT_SPIKE_ZSCORE", c.spike_zscore, float)
+        c.spike_window = _env("DCT_SPIKE_WINDOW", c.spike_window, int)
         return c
 
 

@@ -172,6 +172,16 @@ class RolloutOrchestrator:
         # it from the package automatically when unset.
         self.run_id = run_id
 
+    def _stage_span(self, stage: str):
+        """Span for one rollout stage, on the SHIPPED training cycle's
+        trace (same adoption rule as the stage events): the deploy leg
+        appears on the same Perfetto timeline as the training run."""
+        from dct_tpu.observability import spans as _spans
+
+        return _spans.get_default().for_trace(self.run_id).span(
+            f"deploy.{stage}", component="deploy", endpoint=self.endpoint,
+        )
+
     # -- stages --------------------------------------------------------
     def ensure_endpoint(self) -> None:
         """Get-or-recreate, deleting a failed endpoint first
@@ -187,34 +197,49 @@ class RolloutOrchestrator:
     def deploy_new_slot(self, package_dir: str) -> tuple[str, str | None]:
         if self.run_id is None:
             self.run_id = package_run_correlation_id(package_dir)
-        self.ensure_endpoint()
-        new_slot, old_slot = choose_slot(self.client.get_traffic(self.endpoint))
-        self.client.deploy(self.endpoint, new_slot, package_dir)
-        if old_slot is None:
-            # First deployment: take 100% immediately (manual-deploy path,
-            # dags/azure_manual_deploy.py:164-167).
-            self.client.set_traffic(self.endpoint, {new_slot: 100})
-        self._record("deploy_new_slot")
+        with self._stage_span("deploy_new_slot"):
+            self.ensure_endpoint()
+            new_slot, old_slot = choose_slot(
+                self.client.get_traffic(self.endpoint)
+            )
+            self.client.deploy(self.endpoint, new_slot, package_dir)
+            if old_slot is None:
+                # First deployment: take 100% immediately (manual-deploy
+                # path, dags/azure_manual_deploy.py:164-167).
+                self.client.set_traffic(self.endpoint, {new_slot: 100})
+            self._record("deploy_new_slot")
         return new_slot, old_slot
 
     def start_shadow(self, new_slot: str, old_slot: str) -> None:
-        self.client.set_traffic(self.endpoint, {old_slot: 100, new_slot: 0})
-        self.client.set_mirror_traffic(self.endpoint, {new_slot: self.mirror_percent})
-        self._record("shadow")
+        with self._stage_span("shadow"):
+            self.client.set_traffic(
+                self.endpoint, {old_slot: 100, new_slot: 0}
+            )
+            self.client.set_mirror_traffic(
+                self.endpoint, {new_slot: self.mirror_percent}
+            )
+            self._record("shadow")
 
     def start_canary(self, new_slot: str, old_slot: str) -> None:
-        self.client.set_mirror_traffic(self.endpoint, {})
-        self.client.set_traffic(
-            self.endpoint,
-            {old_slot: 100 - self.canary_percent, new_slot: self.canary_percent},
-        )
-        self._record("canary")
+        with self._stage_span("canary"):
+            self.client.set_mirror_traffic(self.endpoint, {})
+            self.client.set_traffic(
+                self.endpoint,
+                {
+                    old_slot: 100 - self.canary_percent,
+                    new_slot: self.canary_percent,
+                },
+            )
+            self._record("canary")
 
     def full_rollout(self, new_slot: str, old_slot: str | None) -> None:
-        self.client.set_traffic(self.endpoint, {new_slot: 100})
-        if old_slot and old_slot in self.client.list_deployments(self.endpoint):
-            self.client.delete_deployment(self.endpoint, old_slot)
-        self._record("full_rollout")
+        with self._stage_span("full_rollout"):
+            self.client.set_traffic(self.endpoint, {new_slot: 100})
+            if old_slot and old_slot in self.client.list_deployments(
+                self.endpoint
+            ):
+                self.client.delete_deployment(self.endpoint, old_slot)
+            self._record("full_rollout")
 
     # -- the full machine ---------------------------------------------
     def run(self, package_dir: str) -> list[RolloutEvent]:

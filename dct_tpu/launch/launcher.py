@@ -40,6 +40,11 @@ from dct_tpu.observability.events import (
     observability_enabled,
 )
 from dct_tpu.observability.heartbeat import HeartbeatMonitor
+from dct_tpu.observability.spans import (
+    SpanRecorder,
+    span_file_name,
+    spans_dir_from,
+)
 
 
 def _launcher_event_log(env: dict) -> EventLog:
@@ -53,6 +58,33 @@ def _launcher_event_log(env: dict) -> EventLog:
         run_id=env["DCT_RUN_ID"],
         rank=None,
     )
+
+
+def _launcher_span_recorder(env: dict) -> SpanRecorder:
+    """Orchestrator-side span recorder over the same env the ranks
+    inherit: the launch span and every rank's trainer spans share one
+    trace (trace_id = the run-correlation ID)."""
+    directory = (
+        spans_dir_from(
+            env.get("DCT_EVENTS_DIR", "logs/events"),
+            env.get("DCT_SPANS_DIR", ""),
+        )
+        if observability_enabled(env)
+        else None
+    )
+    rec = SpanRecorder(
+        os.path.join(directory, span_file_name(None)) if directory else None,
+        trace_id=env["DCT_RUN_ID"],
+        rank=None,
+    )
+    # Parent from the SAME merged env the ranks inherit, not bare
+    # os.environ: a caller passing DCT_SPAN_ID through launch(env=...)
+    # (a DAG task parenting its launch) must see the launch span attach
+    # under it.
+    from dct_tpu.observability.spans import env_parent_span_id
+
+    rec.root_parent = env_parent_span_id(env)
+    return rec
 
 
 def remote_command(exec_template: str, host: str, command: str) -> str:
@@ -303,6 +335,15 @@ class LocalProcessLauncher:
             "launcher", "launch_start",
             world_size=world_size, argv=list(argv),
         )
+        # Trace: one span for the whole launch; every rank gets its own
+        # child span (spawn -> reap), and DCT_SPAN_ID hands the launch
+        # span to the ranks so their trainer.fit spans nest under it
+        # across the process boundary.
+        tracer = _launcher_span_recorder(base_env)
+        launch_span = tracer.open(
+            "launcher.launch", component="launcher", world_size=world_size,
+        )
+        rank_spans: dict[int, object] = {}
         # Default to the SAME dir ObservabilityConfig defaults the ranks
         # to (they inherit this cwd): out of the box the monitor is
         # ARMED, not waiting for an operator to remember a knob.
@@ -334,6 +375,11 @@ class LocalProcessLauncher:
                     MASTER_PORT=str(self.coordinator_port),
                     NODE_RANK=str(rank),
                     WORLD_SIZE=str(world_size),
+                    DCT_SPAN_ID=launch_span.span_id,
+                )
+                rank_spans[rank] = tracer.start(
+                    "launcher.rank", component="launcher",
+                    parent_id=launch_span.span_id, launched_rank=rank,
                 )
                 # Own process group per rank so a fail-fast kill reaches the
                 # whole rank tree, not just the direct child.
@@ -360,6 +406,7 @@ class LocalProcessLauncher:
                         continue
                     codes[rank] = rc
                     progressed = True
+                    rank_spans[rank].end(returncode=rc)
                     events.emit(
                         "launcher", "rank_exit", exited_rank=rank,
                         returncode=rc,
@@ -382,9 +429,11 @@ class LocalProcessLauncher:
             for rank, p in enumerate(procs):
                 if rank not in codes:  # deadline hit
                     # Final poll: a rank that finished during the last
-                    # sleep window keeps its real exit code.
+                    # sleep window keeps its real exit code (and is NOT
+                    # labelled timed-out — trace and event log agree).
                     rc = p.poll()
-                    if rc is None:
+                    timed_out = rc is None
+                    if timed_out:
                         _kill_group(p)
                         p.wait()
                         rc = -signal.SIGKILL
@@ -393,12 +442,16 @@ class LocalProcessLauncher:
                             exited_rank=rank,
                         )
                     codes[rank] = rc
+                    rank_spans[rank].end(returncode=rc, timeout=timed_out)
             skew = monitor.report() if monitor is not None else {}
             events.emit(
                 "launcher", "launch_end",
                 returncodes=[codes[r] for r in range(world_size)],
                 success=all(codes[r] == 0 for r in range(world_size)),
                 **{k: skew[k] for k in ("epoch_skew", "step_skew") if k in skew},
+            )
+            launch_span.end(
+                success=all(codes[r] == 0 for r in range(world_size)),
             )
             return [
                 RankResult(rank=r, returncode=codes[r])
@@ -408,6 +461,12 @@ class LocalProcessLauncher:
             for p in procs:
                 if p.poll() is None:
                     _kill_group(p)
+            # A launch that raised (Popen failure, monitor error) must
+            # still record its spans — end() is idempotent, so on the
+            # success path (everything already ended) this is a no-op.
+            for sp in rank_spans.values():
+                sp.end(error=True)
+            launch_span.end(error=True)
 
     def _flag_heartbeats(
         self,

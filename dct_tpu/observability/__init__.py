@@ -17,6 +17,16 @@ plane built on four pillars:
   on join.
 - :mod:`prometheus` — text-exposition (0.0.4) rendering for the serving
   server's ``GET /metrics`` and the trainer's end-of-run metrics dump.
+- :mod:`spans` — cross-process distributed tracing: per-process span
+  JSONL sharing the run-correlation ID as trace_id, parent spans
+  propagated to children via ``DCT_SPAN_ID``.
+- :mod:`trace_export` — deterministic merge of all ranks' span files
+  into one Perfetto-loadable Chrome-trace-event ``trace.json``.
+- :mod:`health` — training-health telemetry: NaN/Inf-loss guard,
+  loss-spike and grad-norm z-score detectors, warn-or-halt policy.
+- :mod:`inspect` — the run-inspector CLI
+  (``python -m dct_tpu.observability.inspect <run_dir>``) joining
+  events + spans + goodput + heartbeats into a cycle report.
 
 Everything here is dependency-free, failure-isolated (a full disk or an
 unwritable dir degrades telemetry to a no-op, never fails training), and

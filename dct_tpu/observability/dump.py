@@ -24,6 +24,7 @@ def write_train_metrics_prom(
     run_id: str,
     samples_per_sec: float = 0.0,
     val_loss: float | None = None,
+    health: dict | None = None,
 ) -> str | None:
     """Write the run's final metrics at ``path`` (tmp+rename so a
     shipping agent never reads a torn file). Returns the path, or None
@@ -64,6 +65,25 @@ def write_train_metrics_prom(
                 "Final validation loss of the run.",
             ).add(val_loss, labels)
         )
+    if health is not None:
+        # Training-health surface (observability.health.HealthMonitor
+        # summary): incident counts by kind + the last grad global norm.
+        incidents = MetricFamily(
+            "dct_train_health_events_total", "counter",
+            "Training-health incidents (nan_loss / loss_spike / "
+            "grad_norm_spike) observed by this run.",
+        )
+        for kind, n in sorted((health.get("events") or {}).items()):
+            incidents.add(n, {**labels, "kind": kind})
+        fams.append(incidents)
+        gn = health.get("last_grad_norm")
+        if gn is not None and math.isfinite(gn):
+            fams.append(
+                MetricFamily(
+                    "dct_train_grad_norm", "gauge",
+                    "Last observed gradient global norm.",
+                ).add(gn, labels)
+            )
     tmp = path + ".tmp"
     try:
         parent = os.path.dirname(path)

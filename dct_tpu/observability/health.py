@@ -1,0 +1,246 @@
+"""Training-health telemetry: NaN/Inf guard, loss-spike and grad-norm
+drift detection, with a configurable halt-or-warn policy.
+
+The trainer previously noticed a NaN loss only at best-checkpoint
+selection time (a NaN epoch simply never improved ``val_loss``) — the
+run kept burning accelerator time on a diverged model. Here every
+per-step training loss and gradient global norm flows through a
+:class:`HealthMonitor` that:
+
+- flags non-finite losses immediately (``health.nan_loss``);
+- flags loss spikes by z-score against a rolling window of recent
+  finite losses (``health.loss_spike``) — the standard divergence
+  tripwire of large-run babysitting;
+- flags gradient-norm blowups the same way (``health.grad_norm_spike``)
+  using the global norm the train step already computes;
+- emits every finding to the structured event log (and the findings
+  feed the end-of-run Prometheus dump), so health incidents are
+  greppable by run-correlation ID like everything else;
+- optionally HALTS the run (``halt_on_nan`` / ``halt_on_spike`` on
+  ``ObservabilityConfig``): the trainer raises
+  :class:`TrainingHealthError` before completing the epoch's
+  bookkeeping, so a diverged run fails fast instead of training
+  garbage to its epoch budget.
+
+Spike detection details: z = (x - mean(window)) / std(window) over the
+last ``window`` finite values, requiring ``MIN_HISTORY`` points; only
+UPWARD deviations count (a falling loss is the goal, not an incident),
+and a relative floor on the deviation (10% of |mean|) suppresses
+z-blowups on near-constant histories where std ~ 0. Detection state is
+host-side and cheap — no device work beyond the norm the step already
+computed.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass
+
+#: Minimum finite history before the z-score detector arms.
+MIN_HISTORY = 5
+
+#: Per-kind cap on emitted events: a run with a thousand NaN steps gets
+#: the first few named, then a final suppressed-count note, not a
+#: thousand-line event log.
+MAX_EVENTS_PER_KIND = 10
+
+KINDS = ("nan_loss", "loss_spike", "grad_norm_spike")
+
+
+class TrainingHealthError(RuntimeError):
+    """Raised by the trainer when a halting health policy trips."""
+
+
+@dataclass
+class Finding:
+    kind: str  # one of KINDS
+    value: float
+    step: int | None = None
+    epoch: int | None = None
+    zscore: float | None = None
+    halt: bool = False
+
+
+class _SpikeDetector:
+    """Rolling-window upward z-score detector for one scalar stream."""
+
+    def __init__(self, window: int, zscore: float):
+        # Floor at MIN_HISTORY: a smaller maxlen could never satisfy
+        # the arming gate below and would silently disable detection.
+        self.window = deque(maxlen=max(MIN_HISTORY, int(window)))
+        self.zscore = float(zscore)
+
+    def observe(self, x: float) -> float | None:
+        """Returns the z-score when ``x`` is an upward spike, else None;
+        finite values enter the window AFTER the check (the spike itself
+        must not raise the baseline it is judged against)."""
+        z = None
+        n = len(self.window)
+        if n >= MIN_HISTORY:
+            mean = sum(self.window) / n
+            var = sum((v - mean) ** 2 for v in self.window) / n
+            std = math.sqrt(var)
+            dev = x - mean
+            if (
+                std > 0.0
+                and dev / std >= self.zscore
+                and dev >= 0.1 * max(abs(mean), 1e-8)
+            ):
+                z = dev / std
+        if math.isfinite(x):
+            self.window.append(x)
+        return z
+
+
+class HealthMonitor:
+    """Per-run health state machine; feed it every step's loss (and
+    grad norm when available) and emit what it finds.
+
+    ``emit`` is an event-log callable ``(component, event, **fields)``
+    (pass ``EventLog.emit``); None disables emission but keeps counts.
+    """
+
+    def __init__(
+        self,
+        *,
+        spike_window: int = 16,
+        spike_zscore: float = 8.0,
+        halt_on_nan: bool = False,
+        halt_on_spike: bool = False,
+        emit=None,
+    ):
+        self.halt_on_nan = bool(halt_on_nan)
+        self.halt_on_spike = bool(halt_on_spike)
+        self._loss = _SpikeDetector(spike_window, spike_zscore)
+        self._gnorm = _SpikeDetector(spike_window, spike_zscore)
+        self._emit = emit
+        self.counts: dict[str, int] = dict.fromkeys(KINDS, 0)
+        self.last_loss: float | None = None
+        self.last_grad_norm: float | None = None
+
+    @classmethod
+    def from_config(cls, obs_cfg, *, emit=None) -> "HealthMonitor":
+        """Build from an ``ObservabilityConfig`` (its health knobs)."""
+        return cls(
+            spike_window=obs_cfg.spike_window,
+            spike_zscore=obs_cfg.spike_zscore,
+            halt_on_nan=obs_cfg.halt_on_nan,
+            halt_on_spike=obs_cfg.halt_on_spike,
+            emit=emit,
+        )
+
+    # -- observation ---------------------------------------------------
+    def _found(self, finding: Finding) -> Finding:
+        self.counts[finding.kind] += 1
+        if self._emit is not None and (
+            self.counts[finding.kind] <= MAX_EVENTS_PER_KIND
+        ):
+            fields = {
+                "value": finding.value,
+                "step": finding.step,
+                "epoch": finding.epoch,
+                "halt": finding.halt,
+            }
+            if finding.zscore is not None:
+                fields["zscore"] = round(finding.zscore, 3)
+            if self.counts[finding.kind] == MAX_EVENTS_PER_KIND:
+                fields["note"] = (
+                    "further events of this kind are suppressed"
+                )
+            self._emit("health", f"health.{finding.kind}", **fields)
+        return finding
+
+    def observe_step(
+        self,
+        loss: float,
+        *,
+        grad_norm: float | None = None,
+        step: int | None = None,
+        epoch: int | None = None,
+    ) -> Finding | None:
+        """One training step's scalars -> the most severe finding (or
+        None). NaN outranks spikes; a halting finding is returned even
+        when a non-halting one also fired (both are counted/emitted)."""
+        loss = float(loss)
+        self.last_loss = loss
+        worst: Finding | None = None
+        if not math.isfinite(loss):
+            worst = self._found(
+                Finding(
+                    "nan_loss", loss, step=step, epoch=epoch,
+                    halt=self.halt_on_nan,
+                )
+            )
+            if grad_norm is not None:
+                gn = float(grad_norm)
+                self.last_grad_norm = gn
+                # A non-finite grad norm is still ITS OWN finding: with
+                # only halt_on_spike set, the grad-norm policy must be
+                # able to halt a NaN-loss step (the nan_loss finding
+                # alone would not).
+                if not math.isfinite(gn):
+                    f = self._found(
+                        Finding(
+                            "grad_norm_spike", gn, step=step,
+                            epoch=epoch, halt=self.halt_on_spike,
+                        )
+                    )
+                    if f.halt and not worst.halt:
+                        worst = f
+            return worst
+        z = self._loss.observe(loss)
+        if z is not None:
+            worst = self._found(
+                Finding(
+                    "loss_spike", loss, step=step, epoch=epoch,
+                    zscore=z, halt=self.halt_on_spike,
+                )
+            )
+        if grad_norm is not None:
+            gn = float(grad_norm)
+            self.last_grad_norm = gn
+            if not math.isfinite(gn):
+                f = self._found(
+                    Finding(
+                        "grad_norm_spike", gn, step=step, epoch=epoch,
+                        halt=self.halt_on_spike,
+                    )
+                )
+                worst = worst or f
+            else:
+                gz = self._gnorm.observe(gn)
+                if gz is not None:
+                    f = self._found(
+                        Finding(
+                            "grad_norm_spike", gn, step=step,
+                            epoch=epoch, zscore=gz,
+                            halt=self.halt_on_spike,
+                        )
+                    )
+                    worst = worst or f
+        return worst
+
+    # -- reporting -----------------------------------------------------
+    @property
+    def total_findings(self) -> int:
+        return sum(self.counts.values())
+
+    def summary(self) -> dict:
+        """JSON-able run-end record (feeds the Prometheus dump and the
+        trainer's fit_end event)."""
+        return {
+            "events": dict(self.counts),
+            "last_loss": self.last_loss,
+            "last_grad_norm": self.last_grad_norm,
+        }
+
+    @staticmethod
+    def raise_on(finding: Finding | None) -> None:
+        """The halt policy's teeth: raise for a halting finding."""
+        if finding is not None and finding.halt:
+            raise TrainingHealthError(
+                f"training halted by health policy: {finding.kind} "
+                f"(value={finding.value!r}, step={finding.step}, "
+                f"epoch={finding.epoch})"
+            )

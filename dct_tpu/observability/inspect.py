@@ -1,0 +1,307 @@
+"""Run-inspector CLI: join events + spans + goodput + heartbeats into a
+human-readable cycle report and write the Perfetto trace export.
+
+Usage::
+
+    python -m dct_tpu.observability.inspect <run_dir> [--run-id ID]
+        [--out trace.json] [--no-trace]
+
+``run_dir`` is any directory holding a run's observability artifacts —
+the events dir itself, or a parent containing ``events.jsonl``,
+``spans/*.jsonl`` and ``rank_*.json`` heartbeat files anywhere below it
+(the layouts the trainer/launcher produce by default). The report:
+
+1. resolves the run-correlation ID (``--run-id`` pins one; otherwise
+   the newest ID seen in the event log);
+2. reconstructs the cycle timeline: launch window, per-rank training
+   windows, per-epoch metrics, checkpoint saves, deploy stages;
+3. names every rank's final heartbeat state and progress;
+4. prints the goodput/badput breakdown from the run-end summary event;
+5. lists health incidents (``health.*`` events);
+6. merges all span files into ``trace.json`` (Chrome-trace-event JSON,
+   Perfetto-loadable) and prints how to open it.
+
+Everything is read-only over the artifacts; missing surfaces degrade to
+"(none found)" lines, never errors — the inspector must work on partial
+runs, which is exactly when an operator reaches for it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from dct_tpu.observability.trace_export import export_run
+
+
+def _find_files(root: str, name_filter) -> list[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            if name_filter(fn, dirpath):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def load_events(run_dir: str) -> list[dict]:
+    from dct_tpu.observability.trace_export import read_jsonl
+
+    recs = []
+    for path in _find_files(
+        run_dir, lambda fn, d: fn == "events.jsonl"
+    ):
+        recs.extend(read_jsonl(path, require_key="event"))
+    recs.sort(key=lambda r: r.get("ts", 0.0))
+    return recs
+
+
+def load_heartbeats(run_dir: str) -> list[dict]:
+    out = []
+    for path in _find_files(
+        run_dir,
+        lambda fn, d: fn.startswith("rank_") and fn.endswith(".json"),
+    ):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict) and "rank" in rec and "phase" in rec:
+            out.append(rec)
+    out.sort(key=lambda r: int(r.get("rank", 0)))
+    return out
+
+
+def pick_run_id(events: list[dict], explicit: str | None) -> str | None:
+    if explicit:
+        return explicit
+    latest: str | None = None
+    latest_ts = -1.0
+    for r in events:
+        rid = r.get("run_id")
+        if rid and r.get("ts", 0.0) >= latest_ts:
+            latest, latest_ts = rid, r.get("ts", 0.0)
+    return latest
+
+
+def _fmt_ts(ts: float | None, t0: float | None) -> str:
+    if ts is None or t0 is None:
+        return "      ?"
+    return f"+{ts - t0:7.2f}s"
+
+
+def _fmt_num(v) -> str:
+    if isinstance(v, (int, float)):
+        return f"{v:.4f}" if isinstance(v, float) else str(v)
+    return str(v)
+
+
+def build_report(
+    events: list[dict],
+    heartbeats: list[dict],
+    spans: list[dict],
+    run_id: str | None,
+    trace_path: str | None,
+) -> str:
+    """The cycle report as one printable string (pure function of the
+    artifacts — unit-testable without capturing stdout)."""
+    lines: list[str] = []
+    ev = [e for e in events if run_id is None or e.get("run_id") == run_id]
+    hb = [
+        h for h in heartbeats
+        if run_id is None or h.get("run_id") in (None, run_id)
+    ]
+    sp = [s for s in spans if run_id is None or s.get("trace_id") == run_id]
+    t0 = ev[0]["ts"] if ev else (sp[0]["t0"] if sp else None)
+    lines.append("=" * 72)
+    lines.append(f"dct_tpu run inspector — run_id {run_id or '(unknown)'}")
+    lines.append("=" * 72)
+    lines.append(
+        f"events: {len(ev)}   spans: {len(sp)}   "
+        f"heartbeats: {len(hb)} rank file(s)"
+    )
+
+    # -- cycle timeline ------------------------------------------------
+    lines.append("")
+    lines.append("Cycle timeline (selected events):")
+    interesting = {
+        "launch_start", "launch_end", "fit_start", "fit_end",
+        "fit_failed", "goodput_summary", "best_saved",
+        "resume_state_saved", "run_start", "run_end",
+        "deploy_new_slot", "shadow", "canary", "full_rollout",
+        "rank_exit", "rank_stalled", "rank_missing",
+    }
+    shown = 0
+    for r in ev:
+        name = r.get("event", "?")
+        if name not in interesting and not name.startswith("health."):
+            continue
+        who = (
+            f"rank {r['rank']}" if r.get("rank") is not None else "host"
+        )
+        extra = ""
+        if name == "launch_end":
+            extra = f" returncodes={r.get('returncodes')}"
+        if name.startswith("health."):
+            extra = (
+                f" value={r.get('value')} step={r.get('step')}"
+                f" halt={r.get('halt')}"
+            )
+        lines.append(
+            f"  {_fmt_ts(r.get('ts'), t0)}  "
+            f"{r.get('component', '?'):10s} {who:8s} {name}{extra}"
+        )
+        shown += 1
+    if not shown:
+        lines.append("  (none found)")
+
+    # -- per-epoch metrics ---------------------------------------------
+    epochs = [r for r in ev if r.get("event") == "epoch_end"]
+    lines.append("")
+    lines.append("Epochs:")
+    if epochs:
+        for r in epochs:
+            lines.append(
+                f"  epoch {r.get('epoch')}: "
+                f"train_loss={_fmt_num(r.get('train_loss'))} "
+                f"val_loss={_fmt_num(r.get('val_loss'))} "
+                f"val_acc={_fmt_num(r.get('val_acc'))} "
+                f"goodput={_fmt_num(r.get('goodput_fraction'))}"
+            )
+    else:
+        lines.append("  (none found)")
+
+    # -- ranks ---------------------------------------------------------
+    lines.append("")
+    lines.append("Ranks (final heartbeat):")
+    if hb:
+        for h in hb:
+            lines.append(
+                f"  rank {h.get('rank')}: phase={h.get('phase')} "
+                f"epoch={h.get('epoch')} step={h.get('step')} "
+                f"pid={h.get('pid')}"
+            )
+    else:
+        span_ranks = sorted(
+            {s.get("rank") for s in sp if s.get("rank") is not None}
+        )
+        if span_ranks:
+            for r in span_ranks:
+                n = sum(1 for s in sp if s.get("rank") == r)
+                lines.append(f"  rank {r}: {n} span(s), no heartbeat file")
+        else:
+            lines.append("  (none found)")
+
+    # -- goodput -------------------------------------------------------
+    lines.append("")
+    lines.append("Goodput:")
+    summaries = [r for r in ev if r.get("event") == "goodput_summary"]
+    if summaries:
+        s = summaries[-1]
+        lines.append(
+            f"  wall {_fmt_num(s.get('wall_seconds'))}s, "
+            f"goodput_fraction {_fmt_num(s.get('goodput_fraction'))}"
+        )
+        for cat, sec in sorted((s.get("categories") or {}).items()):
+            lines.append(f"    {cat:18s} {_fmt_num(sec)}s")
+        ua = s.get("unattributed_seconds")
+        if ua is not None:
+            lines.append(f"    {'unattributed':18s} {_fmt_num(ua)}s")
+    else:
+        lines.append("  (no goodput_summary event)")
+
+    # -- health --------------------------------------------------------
+    lines.append("")
+    lines.append("Health:")
+    health = [
+        r for r in ev if str(r.get("event", "")).startswith("health.")
+    ]
+    if health:
+        for r in health:
+            lines.append(
+                f"  {r['event']}: value={r.get('value')} "
+                f"step={r.get('step')} epoch={r.get('epoch')} "
+                f"halt={r.get('halt')}"
+            )
+    else:
+        lines.append("  (no health events — clean run)")
+
+    # -- spans / trace -------------------------------------------------
+    lines.append("")
+    lines.append("Spans by component:")
+    if sp:
+        by_comp: dict[str, int] = {}
+        for s in sp:
+            by_comp[s.get("component", "?")] = (
+                by_comp.get(s.get("component", "?"), 0) + 1
+            )
+        for comp in sorted(by_comp):
+            lines.append(f"  {comp:12s} {by_comp[comp]}")
+    else:
+        lines.append("  (none found)")
+    if trace_path:
+        lines.append("")
+        lines.append(f"Perfetto trace written: {trace_path}")
+        lines.append(
+            "  open https://ui.perfetto.dev and drag the file in "
+            "(or chrome://tracing > Load)"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dct_tpu.observability.inspect",
+        description=(
+            "Join a run's events, spans, goodput and heartbeats into a "
+            "cycle report; write the Perfetto trace export."
+        ),
+    )
+    parser.add_argument("run_dir", help="directory holding the run's logs")
+    parser.add_argument(
+        "--run-id", default=None,
+        help="pin a run-correlation ID (default: newest in the event log)",
+    )
+    parser.add_argument(
+        "--out", default=None,
+        help="trace output path (default: <run_dir>/trace.json)",
+    )
+    parser.add_argument(
+        "--no-trace", action="store_true",
+        help="report only; skip the trace.json export",
+    )
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"error: {args.run_dir} is not a directory", file=sys.stderr)
+        return 2
+
+    events = load_events(args.run_dir)
+    heartbeats = load_heartbeats(args.run_dir)
+    if not heartbeats:
+        # Default layout: heartbeats live in a SIBLING of the events
+        # dir (logs/events vs logs/heartbeats), so the documented
+        # `inspect logs/events` invocation must still find them.
+        sibling = os.path.join(
+            os.path.dirname(os.path.normpath(args.run_dir)), "heartbeats"
+        )
+        if os.path.isdir(sibling):
+            heartbeats = load_heartbeats(sibling)
+    run_id = pick_run_id(events, args.run_id)
+    trace_path = None
+    if args.no_trace:
+        from dct_tpu.observability.trace_export import read_spans
+
+        spans = read_spans(args.run_dir, trace_id=run_id)
+    else:
+        trace_path, spans = export_run(
+            args.run_dir, out_path=args.out, trace_id=run_id
+        )
+    print(build_report(events, heartbeats, spans, run_id, trace_path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

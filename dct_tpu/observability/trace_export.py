@@ -1,0 +1,187 @@
+"""Chrome-trace-event export: merge all ranks' span files into one
+Perfetto-loadable ``trace.json``.
+
+The span runtime (:mod:`spans`) leaves one JSONL file per process in a
+spans directory; this module joins them into the Chrome Trace Event
+format (the JSON object form, ``{"traceEvents": [...]}``) that
+``ui.perfetto.dev`` and ``chrome://tracing`` load directly — the
+platform-level timeline (launcher / per-rank epochs / checkpoints /
+deploy) that complements the per-device ``jax.profiler`` trace.
+
+Mapping:
+
+- every completed span becomes one complete event (``"ph": "X"``) with
+  microsecond ``ts``/``dur``;
+- ``pid`` is the *track group*: rank processes map to ``pid = rank``,
+  orchestrator-side processes (launcher, DAG tasks, serving) to stable
+  ids above ``ORCHESTRATOR_PID_BASE``, each named by a ``process_name``
+  metadata event ("rank 0", "launcher/host pid 4242");
+- ``tid`` is the recorder's small per-thread id;
+- span/parent IDs and attrs ride in ``args``, so the parent/child tree
+  is recoverable from the exported file alone.
+
+The merge is DETERMINISTIC: events are ordered by (start time, span id)
+and metadata by pid, so exporting the same span files twice yields
+byte-identical JSON — diffable artifacts, stable test fixtures.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+#: Orchestrator-side (rank-less) processes get pids from here upward so
+#: they can never collide with rank pids.
+ORCHESTRATOR_PID_BASE = 100000
+
+
+def find_span_files(root: str) -> list[str]:
+    """Span JSONL files under ``root``: the directory itself if it holds
+    ``*.jsonl``, else any ``spans/*.jsonl`` found by a bounded walk
+    (run dirs nest the spans dir under the events dir)."""
+    direct = sorted(glob.glob(os.path.join(root, "*.jsonl")))
+    if os.path.basename(os.path.normpath(root)) == "spans" and direct:
+        return direct
+    out = []
+    for dirpath, dirnames, _ in os.walk(root):
+        dirnames.sort()
+        if os.path.basename(dirpath) == "spans":
+            out.extend(sorted(glob.glob(os.path.join(dirpath, "*.jsonl"))))
+            dirnames[:] = []
+    if not out and direct:
+        # A bare directory of span files (no spans/ nesting).
+        return direct
+    return out
+
+
+def read_jsonl(path: str, *, require_key: str) -> list[dict]:
+    """Tolerant JSONL read shared by the exporter and the inspector:
+    torn lines (a crash mid-append) and non-dict/foreign records are
+    skipped — one bad line must not poison the whole artifact."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return []
+    out = []
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and require_key in rec:
+            out.append(rec)
+    return out
+
+
+def read_spans(root: str, *, trace_id: str | None = None) -> list[dict]:
+    """All span records under ``root`` (optionally filtered to one
+    trace), sorted by (t0, span_id) for deterministic downstream use."""
+    recs: list[dict] = []
+    for path in find_span_files(root):
+        for rec in read_jsonl(path, require_key="span_id"):
+            if trace_id and rec.get("trace_id") != trace_id:
+                continue
+            recs.append(rec)
+    recs.sort(key=lambda r: (r.get("t0", 0.0), r.get("span_id", "")))
+    return recs
+
+
+def _pid_for(rec: dict, orch_pids: dict) -> int:
+    rank = rec.get("rank")
+    if rank is not None:
+        return int(rank)
+    pid = rec.get("pid", 0)
+    if pid not in orch_pids:
+        orch_pids[pid] = ORCHESTRATOR_PID_BASE + len(orch_pids)
+    return orch_pids[pid]
+
+
+def to_chrome_trace(spans: list[dict]) -> dict:
+    """Span records -> Chrome Trace Event JSON object (Perfetto-ready).
+
+    ``spans`` need not be pre-sorted; the output event order (and
+    therefore the serialized bytes) depends only on the record set.
+    """
+    spans = sorted(
+        spans, key=lambda r: (r.get("t0", 0.0), r.get("span_id", ""))
+    )
+    orch_pids: dict = {}
+    events: list[dict] = []
+    seen_pids: dict[int, str] = {}
+    for rec in spans:
+        pid = _pid_for(rec, orch_pids)
+        if pid not in seen_pids:
+            rank = rec.get("rank")
+            seen_pids[pid] = (
+                f"rank {rank}"
+                if rank is not None
+                else f"{rec.get('component', 'host')}/host pid "
+                f"{rec.get('pid', '?')}"
+            )
+        t0 = float(rec.get("t0", 0.0))
+        t1 = float(rec.get("t1", t0))
+        args = {
+            "span_id": rec.get("span_id"),
+            "parent_id": rec.get("parent_id"),
+            "trace_id": rec.get("trace_id"),
+        }
+        attrs = rec.get("attrs")
+        if isinstance(attrs, dict):
+            args.update(attrs)
+        events.append(
+            {
+                "name": rec.get("name", "span"),
+                "cat": rec.get("component", "span"),
+                "ph": "X",
+                "ts": round(t0 * 1e6, 3),
+                "dur": round(max(0.0, t1 - t0) * 1e6, 3),
+                "pid": pid,
+                "tid": int(rec.get("tid", 0)),
+                "args": args,
+            }
+        )
+    meta = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": seen_pids[pid]},
+        }
+        for pid in sorted(seen_pids)
+    ]
+    trace_ids = sorted({r.get("trace_id") for r in spans if r.get("trace_id")})
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_ids": trace_ids},
+    }
+
+
+def write_trace(trace: dict, out_path: str) -> str:
+    """Serialize (strict JSON, stable key order) with tmp+rename so a
+    concurrent reader never sees a torn file."""
+    parent = os.path.dirname(out_path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(trace, f, allow_nan=False, sort_keys=True)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def export_run(
+    run_dir: str,
+    *,
+    out_path: str | None = None,
+    trace_id: str | None = None,
+) -> tuple[str, list[dict]]:
+    """Merge every span file under ``run_dir`` into
+    ``<run_dir>/trace.json`` (or ``out_path``). Returns (path, spans)."""
+    spans = read_spans(run_dir, trace_id=trace_id)
+    path = out_path or os.path.join(run_dir, "trace.json")
+    write_trace(to_chrome_trace(spans), path)
+    return path, spans

@@ -35,6 +35,29 @@ def initialize_from_env(cfg: DistributedConfig | None = None) -> DistributedConf
             "WORLD_SIZE > 1 but no coordinator address: set MASTER_ADDR "
             "(+ MASTER_PORT) or DCT_COORDINATOR_ADDRESS"
         )
+    # Multi-process CPU rigs (the two-container test bed, CI) need the
+    # gloo cross-host collective backend; the default CPU backend
+    # refuses multiprocess computations outright. Must be set BEFORE
+    # initialize — config.update is authoritative where the env var is
+    # not reliably honored. Platform is read from config/env, NOT
+    # jax.default_backend(): that call would initialize the backends
+    # ahead of jax.distributed.initialize.
+    import os as _os
+
+    platforms = (
+        getattr(jax.config, "jax_platforms", None)
+        or _os.environ.get("JAX_PLATFORMS", "")
+        or ""
+    )
+    if platforms.split(",")[0].strip().lower() == "cpu":
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo"
+            )
+        except (AttributeError, ValueError):
+            # jax without the flag (or without gloo built in): keep the
+            # historical behavior rather than failing the launch.
+            pass
     try:
         jax.distributed.initialize(
             coordinator_address=cfg.coordinator_address,

@@ -26,6 +26,13 @@ from jax import lax
 
 _HAS_STABLE = hasattr(jax, "shard_map")
 
+#: Partial-manual (``axis_names``) shard_map capability: the 0.4.x
+#: experimental API's ``auto=`` translation exists but its lowering
+#: rejects the pipeline's programs (NotImplementedError for several
+#: collectives under partial-auto). Tests that REQUIRE partial-auto
+#: gate on this instead of failing on old rigs.
+PARTIAL_AUTO_SHARD_MAP = _HAS_STABLE
+
 
 def shard_map(
     f,

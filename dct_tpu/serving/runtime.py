@@ -374,7 +374,12 @@ def validate_payload(meta: dict, data) -> np.ndarray:
     non-numeric rows, wrong shape, non-finite values after float32
     conversion) — callers can map exactly this to an HTTP 400 while
     treating any later forward-pass failure as a server defect."""
-    x = np.asarray(data, dtype=np.float32)
+    # A huge JSON number overflowing the float32 cast is the REQUEST's
+    # fault, reported below as a clean 400 via the finiteness check —
+    # numpy's "overflow encountered in cast" RuntimeWarning would only
+    # leak noise into the server log for a condition already handled.
+    with np.errstate(over="ignore", invalid="ignore"):
+        x = np.asarray(data, dtype=np.float32)
     expected = int(meta["input_dim"])
     family = meta.get("model", "weather_mlp")
     if family in _SEQUENCE_FAMILIES:

@@ -54,6 +54,47 @@ from dct_tpu.serving.runtime import (
 )
 
 
+_untraced_recorder = None
+
+
+def _serve_recorder():
+    """Serving request spans are OPT-IN (``DCT_SERVE_TRACE=1``): a
+    per-request disk append (plus a shared recorder lock) has no place
+    on the default hot path of a heavy-traffic server — ``/metrics``
+    stays the always-on serving surface. With tracing off a disabled
+    recorder (no file, no lock contention on emission) is returned."""
+    global _untraced_recorder
+    from dct_tpu.config import _env
+    from dct_tpu.observability import spans as _spans
+
+    # THE bool cast (config._env): serving trace enablement must parse
+    # every spelling exactly like the other DCT_* boolean knobs.
+    if _env("DCT_SERVE_TRACE", False, bool):
+        return _spans.get_default()
+    if _untraced_recorder is None:
+        _untraced_recorder = _spans.SpanRecorder(None, trace_id="untraced")
+    return _untraced_recorder
+
+
+_pkg_trace_ids: dict = {}
+
+
+def _package_trace_id(package_dir: str | None) -> str | None:
+    """The shipped training cycle's run-correlation ID for a deployed
+    package (memoized — packages are immutable once written): endpoint
+    serving spans adopt it so the serving leg lands on the SAME cycle
+    trace as the training run, like the rollout stage spans do."""
+    if not package_dir:
+        return None
+    if package_dir not in _pkg_trace_ids:
+        from dct_tpu.deploy.rollout import package_run_correlation_id
+
+        _pkg_trace_ids[package_dir] = package_run_correlation_id(
+            package_dir
+        )
+    return _pkg_trace_ids[package_dir]
+
+
 class _JsonHandler(BaseHTTPRequestHandler):
     """Shared JSON plumbing: strict replies, quiet logs, envelope parse."""
 
@@ -100,34 +141,46 @@ class _JsonHandler(BaseHTTPRequestHandler):
             return None
         return payload["data"]
 
-    def _score(self, weights: dict, meta: dict, data):
+    def _score(self, weights: dict, meta: dict, data,
+               slot: str = "default", trace_id: str | None = None):
         """validate (400) -> forward (500) -> probabilities dict.
 
         Returns (result_or_None, server_fault): a None result with
         server_fault=False was the request's fault (400 already sent);
         with server_fault=True a 500 was sent — callers tracking
-        per-slot health must count only the latter as slot errors."""
-        try:
-            x = validate_payload(meta, data)
-        except (ValueError, TypeError) as e:
-            self._reply(400, {"error": str(e)})
-            return None, False
-        try:
-            probs = softmax_numpy(forward_numpy(weights, meta, x))
-            import numpy as _np
+        per-slot health must count only the latter as slot errors.
 
-            if not _np.isfinite(probs).all():
-                # Finite validated input producing NaN probabilities is
-                # a broken checkpoint; surface it as the 500 it is
-                # rather than letting the strict-JSON backstop downgrade
-                # the reply after the fact.
-                raise ArithmeticError("non-finite probabilities")
-        except Exception as e:  # noqa: BLE001 — past validation, ANY
-            # failure (incl. a shape-mismatched weight raising ValueError
-            # in a matmul) is a broken checkpoint/export: a SERVER error.
-            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
-            return None, True
-        return {"probabilities": probs.tolist()}, False
+        Each call records a ``serving.score`` span (the request-handling
+        leg of the cycle trace, status-attributed) when serving traces
+        are enabled via ``DCT_SERVE_TRACE``."""
+        with _serve_recorder().for_trace(trace_id).span(
+            "serving.score", component="serving", slot=slot,
+        ) as sp:
+            try:
+                x = validate_payload(meta, data)
+            except (ValueError, TypeError) as e:
+                self._reply(400, {"error": str(e)})
+                sp.set(status=400)
+                return None, False
+            try:
+                probs = softmax_numpy(forward_numpy(weights, meta, x))
+                import numpy as _np
+
+                if not _np.isfinite(probs).all():
+                    # Finite validated input producing NaN probabilities
+                    # is a broken checkpoint; surface it as the 500 it
+                    # is rather than letting the strict-JSON backstop
+                    # downgrade the reply after the fact.
+                    raise ArithmeticError("non-finite probabilities")
+            except Exception as e:  # noqa: BLE001 — past validation, ANY
+                # failure (incl. a shape-mismatched weight raising
+                # ValueError in a matmul) is a broken checkpoint/export:
+                # a SERVER error.
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                sp.set(status=500)
+                return None, True
+            sp.set(status=200, rows=int(x.shape[0]))
+            return {"probabilities": probs.tolist()}, False
 
 
 class ScoreHandler(_JsonHandler):
@@ -435,7 +488,12 @@ class EndpointScoreHandler(_JsonHandler):
             )
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
             return
-        result, server_fault = self._score(weights, meta, data)
+        result, server_fault = self._score(
+            weights, meta, data, slot=slot,
+            trace_id=_package_trace_id(
+                client.endpoints[name].deployments[slot].package_dir
+            ),
+        )
         # Only SERVER faults count against the slot: a client's bad
         # payload (400) must not spike the canary's error series and
         # trigger a rollback of a healthy deployment.

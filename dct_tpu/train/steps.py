@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import optax
 
 from dct_tpu.ops.losses import (
     masked_accuracy,
@@ -69,7 +70,10 @@ def _train_body(state: TrainState, x, y, weight):
         return loss
 
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
-    return state.apply_gradients(grads), loss
+    # Gradient global norm: the health monitor's drift signal. One fused
+    # reduction over leaves XLA already has resident — and dead-code
+    # eliminated entirely by factories that do not emit it.
+    return state.apply_gradients(grads), loss, optax.global_norm(grads)
 
 
 def _eval_body(state: TrainState, x, y, weight):
@@ -135,26 +139,39 @@ def _train_accum_body(state: TrainState, x, y, weight, accum_steps: int):
     (grads, loss, _), _ = jax.lax.scan(
         body, (zeros, jnp.zeros(()), jnp.zeros((), jnp.int32)), (xs, ys, ws)
     )
-    return state.apply_gradients(grads), loss
+    # Norm of the ACCUMULATED gradient — the update the optimizer sees.
+    return state.apply_gradients(grads), loss, optax.global_norm(grads)
 
 
-def make_train_step(donate: bool = True, accum_steps: int = 1):
+def make_train_step(donate: bool = True, accum_steps: int = 1,
+                    with_grad_norm: bool = False):
     """Per-batch jitted step: (state, x, y, weight) -> (state, metrics).
     ``accum_steps`` > 1 splits the batch into that many microbatches and
-    accumulates gradients before the single optimizer update."""
+    accumulates gradients before the single optimizer update.
+    ``with_grad_norm=True`` adds ``metrics["grad_norm"]`` (the health
+    monitor's signal); the default keeps the historical metrics dict so
+    bench/step-time consumers measure the exact prior program."""
 
     def train_step(state: TrainState, x, y, weight):
         if accum_steps > 1:
-            new_state, loss = _train_accum_body(state, x, y, weight, accum_steps)
+            new_state, loss, gnorm = _train_accum_body(
+                state, x, y, weight, accum_steps
+            )
         else:
-            new_state, loss = _train_body(state, x, y, weight)
-        return new_state, {"train_loss": loss}
+            new_state, loss, gnorm = _train_body(state, x, y, weight)
+        metrics = {"train_loss": loss}
+        if with_grad_norm:
+            metrics["grad_norm"] = gnorm
+        return new_state, metrics
 
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
 
 
 def _epoch_train_scan(state: TrainState, xs, ys, ws, accum_steps: int):
-    """Shared whole-epoch train scan body (see make_epoch_train_step)."""
+    """Shared whole-epoch train scan body (see make_epoch_train_step):
+    -> (state, losses[S'], grad_norms[S']) with S' = optimizer updates.
+    The stacked grad norms are free for callers that drop them (XLA
+    DCEs unused scan outputs at lowering)."""
     if accum_steps > 1:
         s, b = xs.shape[0], xs.shape[1]
         xs = xs.reshape(s // accum_steps, accum_steps * b, *xs.shape[2:])
@@ -164,12 +181,15 @@ def _epoch_train_scan(state: TrainState, xs, ys, ws, accum_steps: int):
         ws = ws.reshape(s // accum_steps, accum_steps * b)
 
         def body(st, batch):
-            return _train_accum_body(st, *batch, accum_steps)
+            st, loss, gnorm = _train_accum_body(st, *batch, accum_steps)
+            return st, (loss, gnorm)
     else:
         def body(st, batch):
-            return _train_body(st, *batch)
+            st, loss, gnorm = _train_body(st, *batch)
+            return st, (loss, gnorm)
 
-    return jax.lax.scan(body, state, (xs, ys, ws))
+    state, (losses, gnorms) = jax.lax.scan(body, state, (xs, ys, ws))
+    return state, losses, gnorms
 
 
 def _epoch_eval_scan(state: TrainState, xs, ys, ws):
@@ -185,7 +205,8 @@ def _epoch_eval_scan(state: TrainState, xs, ys, ws):
     return sums
 
 
-def make_epoch_train_step(donate: bool = True, accum_steps: int = 1):
+def make_epoch_train_step(donate: bool = True, accum_steps: int = 1,
+                          with_grad_norms: bool = False):
     """Whole-epoch training as one XLA program: ``lax.scan`` of
     ``_train_body`` over the stacked batches [S, B, ...].
 
@@ -200,10 +221,20 @@ def make_epoch_train_step(donate: bool = True, accum_steps: int = 1):
     ``accum_steps`` > 1 groups every ``accum_steps`` consecutive stacked
     batches into ONE optimizer update (gradient accumulation); S must be
     divisible (the Trainer truncates the remainder).
+
+    ``with_grad_norms=True`` appends the per-update gradient global
+    norms ``[S']`` to the outputs (the health monitor's drift signal);
+    the default keeps the historical (state, losses) signature, and the
+    unemitted norms are DCE'd at lowering.
     """
 
     def epoch_train(state: TrainState, xs, ys, ws):
-        return _epoch_train_scan(state, xs, ys, ws, accum_steps)
+        state, losses, gnorms = _epoch_train_scan(
+            state, xs, ys, ws, accum_steps
+        )
+        if with_grad_norms:
+            return state, losses, gnorms
+        return state, losses
 
     return jax.jit(epoch_train, donate_argnums=(0,) if donate else ())
 
@@ -222,7 +253,8 @@ def _epoch_donate(donate: bool, donate_stacks: bool) -> tuple:
 
 
 def make_epoch_train_eval_step(donate: bool = True, accum_steps: int = 1,
-                               donate_stacks: bool = False):
+                               donate_stacks: bool = False,
+                               with_grad_norms: bool = False):
     """Train epoch + full validation pass as ONE XLA program — one host
     dispatch per epoch where train-then-eval would cost two. On a slow
     control plane (tunneled TPU) the saved round trip is most of an
@@ -231,13 +263,19 @@ def make_epoch_train_eval_step(donate: bool = True, accum_steps: int = 1,
     (eval runs on the post-epoch state).
 
     Returns (state, losses[S], the 6 eval sums (val_loss_sum,
-    val_acc_sum, val_count, tp, fp, fn)). The validation stacks are NOT
-    donated — they are reused every epoch.
+    val_acc_sum, val_count, tp, fp, fn)); ``with_grad_norms=True``
+    appends the per-update grad global norms [S]. The validation stacks
+    are NOT donated — they are reused every epoch.
     """
 
     def epoch_fused(state: TrainState, xs, ys, ws, vxs, vys, vws):
-        state, losses = _epoch_train_scan(state, xs, ys, ws, accum_steps)
-        return state, losses, _epoch_eval_scan(state, vxs, vys, vws)
+        state, losses, gnorms = _epoch_train_scan(
+            state, xs, ys, ws, accum_steps
+        )
+        sums = _epoch_eval_scan(state, vxs, vys, vws)
+        if with_grad_norms:
+            return state, losses, sums, gnorms
+        return state, losses, sums
 
     donate_argnums = _epoch_donate(donate, donate_stacks)
     return jax.jit(epoch_fused, donate_argnums=donate_argnums)
@@ -245,7 +283,8 @@ def make_epoch_train_eval_step(donate: bool = True, accum_steps: int = 1,
 
 def make_multi_epoch_train_eval_step(donate: bool = True,
                                      accum_steps: int = 1,
-                                     donate_stacks: bool = False):
+                                     donate_stacks: bool = False,
+                                     with_grad_norms: bool = False):
     """K training epochs, each followed by a full validation pass, as ONE
     XLA program — an outer ``lax.scan`` over epochs of the fused
     epoch-train+eval body. Numerically identical to K sequential calls of
@@ -258,7 +297,8 @@ def make_multi_epoch_train_eval_step(donate: bool = True,
     xs/ys/ws: [K, S, B, ...]; the validation stacks [S_v, B, ...] are
     shared (fixed order) across epochs and NOT donated.
 
-    Returns (state, losses[K, S], val_sums = 6-tuple of [K] arrays).
+    Returns (state, losses[K, S], val_sums = 6-tuple of [K] arrays);
+    ``with_grad_norms=True`` appends the grad global norms [K, S].
     The sums come back as a TUPLE (the scan stacks each leaf separately)
     rather than one jnp.stack'd [K, 6] array, so every sum keeps its own
     dtype — a single f32 stack would silently coerce any future integer
@@ -272,13 +312,17 @@ def make_multi_epoch_train_eval_step(donate: bool = True,
     def multi_epoch(state: TrainState, xs, ys, ws, vxs, vys, vws):
         def epoch_body(st, stacks):
             exs, eys, ews = stacks
-            st, losses = _epoch_train_scan(st, exs, eys, ews, accum_steps)
+            st, losses, gnorms = _epoch_train_scan(
+                st, exs, eys, ews, accum_steps
+            )
             sums = _epoch_eval_scan(st, vxs, vys, vws)
-            return st, (losses, sums)
+            return st, (losses, gnorms, sums)
 
-        state, (losses, val_sums) = jax.lax.scan(
+        state, (losses, gnorms, val_sums) = jax.lax.scan(
             epoch_body, state, (xs, ys, ws)
         )
+        if with_grad_norms:
+            return state, losses, val_sums, gnorms
         return state, losses, val_sums
 
     return jax.jit(

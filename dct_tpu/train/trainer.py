@@ -54,7 +54,9 @@ from dct_tpu.parallel.sharding_rules import (
 )
 from dct_tpu.observability.events import event_log_from_config
 from dct_tpu.observability.goodput import GoodputLedger
+from dct_tpu.observability.health import HealthMonitor
 from dct_tpu.observability.heartbeat import HeartbeatWriter
+from dct_tpu.observability.spans import recorder_from_config
 from dct_tpu.tracking.client import get_tracker
 from dct_tpu.train.state import create_train_state
 from dct_tpu.utils.profiling import EpochTimer, Profiler, annotate
@@ -159,6 +161,9 @@ class TrainResult:
     # the run-correlation ID every event record of this run carries.
     goodput: dict = field(default_factory=dict)
     run_correlation_id: str | None = None
+    # Training-health summary (observability.health.HealthMonitor):
+    # nan/spike event counts and the last loss/grad-norm observed.
+    health: dict = field(default_factory=dict)
 
 
 class Trainer:
@@ -182,6 +187,19 @@ class Trainer:
         events = event_log_from_config(
             cfg.obs, rank=jax.process_index()
         )
+        # Span runtime: this rank's spans join the cycle-wide trace
+        # (trace_id = run-correlation ID; if a launcher spawned us, its
+        # DCT_SPAN_ID makes fit a child of the launch span).
+        tracer = recorder_from_config(cfg.obs, rank=jax.process_index())
+        fit_span = tracer.open(
+            "trainer.fit", component="trainer",
+            model=cfg.model.name, epochs=cfg.train.epochs,
+            world_size=jax.process_count(),
+        )
+        # Training-health telemetry: every step's loss (and grad global
+        # norm) flows through the monitor; findings become health.*
+        # events and, under a halting policy, stop the run.
+        health = HealthMonitor.from_config(cfg.obs, emit=events.emit)
         ledger = GoodputLedger()
         ledger.start()
         heartbeat = None
@@ -199,6 +217,7 @@ class Trainer:
             resume=cfg.train.resume, world_size=jax.process_count(),
         )
         _t_startup = ledger.clock()
+        startup_span = tracer.start("trainer.startup", component="trainer")
         if data is None:
             data = load_processed_dataset(
                 cfg.data.processed_dir,
@@ -428,10 +447,13 @@ class Trainer:
             # before the step's activations peak.
             if max(1, cfg.train.epoch_chunk) == 1:
                 epoch_fused = make_epoch_train_eval_step(
-                    accum_steps=accum, donate_stacks=True
+                    accum_steps=accum, donate_stacks=True,
+                    with_grad_norms=True,
                 )
         else:
-            train_step = make_train_step(accum_steps=accum)
+            train_step = make_train_step(
+                accum_steps=accum, with_grad_norm=True
+            )
             eval_step = make_eval_step()
 
         # Self-describing checkpoint meta: the FULL model config (whichever
@@ -509,7 +531,8 @@ class Trainer:
             from dct_tpu.train.steps import make_multi_epoch_train_eval_step
 
             multi_fused = make_multi_epoch_train_eval_step(
-                accum_steps=accum, donate_stacks=True
+                accum_steps=accum, donate_stacks=True,
+                with_grad_norms=True,
             )
 
         # Epoch-ahead input pipeline (scan path): the next span's host
@@ -556,19 +579,35 @@ class Trainer:
             )
         # Everything up to here — dataset load, model init, state
         # creation/sharding, resume restore, validation staging — is the
-        # run's startup/recovery cost in the goodput ledger.
+        # run's startup/recovery cost in the goodput ledger (and the
+        # trainer.startup span: the ledger's window, on the timeline).
         ledger.add("startup_recovery", ledger.clock() - _t_startup)
+        startup_span.end(resumed=start_epoch > 0)
         completed = False
+        # In-flight phase spans, tracked so a crash mid-epoch still
+        # records them (Span.end is idempotent: the success path's own
+        # end() wins and the crash-path sweep becomes a no-op).
+        epoch_span = dispatch_span = ckpt_span = None
         try:
             epoch = start_epoch
             while epoch < target_epochs:
                 k = min(chunk, target_epochs - epoch) if use_scan else 1
                 profiler.maybe_start_span(epoch, k)
+                # One span per dispatch unit: the trace's "trainer
+                # epochs" row. Pushed so the phase spans (data_wait /
+                # dispatch / checkpoint) nest under it.
+                epoch_span = tracer.open(
+                    "trainer.epoch", component="trainer",
+                    epoch=epoch, k=k,
+                )
                 timer.start()
                 if use_scan:
                     # Goodput: joining the prefetch future (or assembling
                     # inline) is time the DEVICE spends waiting on data.
-                    with ledger.span("data_wait"):
+                    with ledger.span("data_wait"), tracer.span(
+                        "trainer.data_wait", component="trainer",
+                        epoch=epoch,
+                    ):
                         if prefetched is not None:
                             n_steps, globs = prefetched.result()
                         else:
@@ -592,12 +631,16 @@ class Trainer:
                     # span are DIFFERENT XLA programs, so the ledger's
                     # compile detection keys on k.
                     _t_dispatch = ledger.clock()
+                    dispatch_span = tracer.start(
+                        "trainer.dispatch", component="trainer",
+                        epoch=epoch, k=k, key=f"scan_k{k}",
+                    )
                     if multi_fused is not None:
-                        state, losses, val_sums = multi_fused(
+                        state, losses, val_sums, gnorms = multi_fused(
                             state, *globs, *val_global
                         )
                     else:
-                        state, losses, val_sums = epoch_fused(
+                        state, losses, val_sums, gnorms = epoch_fused(
                             state, *globs, *val_global
                         )
                     # Prefetch the next span UNLESS early stopping is
@@ -625,6 +668,7 @@ class Trainer:
                         "train_step", f"scan_k{k}",
                         ledger.clock() - _t_dispatch,
                     )
+                    dispatch_span.end()
                     # The fused program runs the validation pass(es)
                     # inside the timed window; credit them to MFU.
                     epoch_stats = timer.stop(
@@ -643,6 +687,7 @@ class Trainer:
                         # (exact for integral weights up to 2^24 per
                         # epoch, steps.py).
                         losses_host = _np.asarray(jax.device_get(losses))
+                        gnorms_host = _np.asarray(jax.device_get(gnorms))
                         val_host = _np.stack(
                             [
                                 _np.asarray(v, dtype=_np.float64)
@@ -653,6 +698,9 @@ class Trainer:
                     else:  # [S] / 6-tuple — the k == 1 parity layout
                         losses_host = _np.asarray(
                             jax.device_get(losses)
+                        )[None]
+                        gnorms_host = _np.asarray(
+                            jax.device_get(gnorms)
                         )[None]
                         val_host = _np.asarray(
                             [float(v) for v in jax.device_get(val_sums)]
@@ -665,6 +713,28 @@ class Trainer:
                                 step=global_step + i + 1,
                             )
                     global_step += flat.size
+                    # Health pass over the span's per-step losses and
+                    # grad norms BEFORE any epoch bookkeeping: under a
+                    # halting policy the run stops here — no epoch_end,
+                    # no checkpoint of the diverged state.
+                    gflat = gnorms_host.reshape(-1)
+                    per_epoch_upd = max(1, flat.size // k)
+                    halt_finding = None
+                    for i in range(flat.size):
+                        f = health.observe_step(
+                            float(flat[i]),
+                            grad_norm=float(gflat[i]),
+                            step=global_step - flat.size + i + 1,
+                            epoch=epoch + i // per_epoch_upd,
+                        )
+                        if halt_finding is None and f is not None and f.halt:
+                            halt_finding = f
+                    if halt_finding is not None:
+                        # Close the epoch span BEFORE raising: the
+                        # halted epoch is exactly the one the operator
+                        # opens the trace to inspect.
+                        epoch_span.end(halted=halt_finding.kind)
+                    HealthMonitor.raise_on(halt_finding)
                     # Reference parity: the logged train_loss is the
                     # EPOCH-AGGREGATED mean (Lightning epoch aggregation of
                     # jobs/train_lightning_ddp.py:70), not the last batch —
@@ -712,10 +782,21 @@ class Trainer:
                         # sync point — include it in the dispatch window.
                         with ledger.dispatch("train_step", key="eager_step"):
                             state, metrics = train_step(state, x, y, w)
-                            loss_host = float(
-                                jax.device_get(metrics["train_loss"])
-                            )
+                            m_host = jax.device_get(metrics)
+                            loss_host = float(m_host["train_loss"])
                         global_step += 1
+                        # Per-step health: a halting policy stops the
+                        # run MID-epoch on the eager path (epoch span
+                        # closed first so the halted epoch is on the
+                        # trace).
+                        finding = health.observe_step(
+                            loss_host,
+                            grad_norm=float(m_host["grad_norm"]),
+                            step=global_step, epoch=epoch,
+                        )
+                        if finding is not None and finding.halt:
+                            epoch_span.end(halted=finding.kind)
+                        HealthMonitor.raise_on(finding)
                         n_steps += accum
                         n_updates += 1
                         loss_sum += loss_host
@@ -736,7 +817,11 @@ class Trainer:
                     epoch_loss = loss_sum / n_updates if n_updates else None
 
                 if not use_scan:
-                    with ledger.dispatch("eval", key="eager_eval"):
+                    with ledger.dispatch("eval", key="eager_eval"), \
+                            tracer.span(
+                                "trainer.eval", component="trainer",
+                                epoch=epoch,
+                            ):
                         val_loss, val_acc, (tp, fp, fn) = self._evaluate(
                             state, eval_step, val_loader
                         )
@@ -833,6 +918,10 @@ class Trainer:
                 # join; in the common fully-addressable case only the
                 # coordinator pays the device-to-host copy.
                 _t_ckpt = ledger.clock()
+                ckpt_span = tracer.open(
+                    "trainer.checkpoint", component="trainer",
+                    epoch=epoch + k - 1,
+                )
                 if params_cross_process or self.coordinator:
                     host_params = to_host(state.params)
                 if self.coordinator:
@@ -880,6 +969,8 @@ class Trainer:
                 # deploy-tier writes, the resume snapshot's device->host
                 # copy; the npz write itself overlaps on a worker thread).
                 ledger.add("checkpoint", ledger.clock() - _t_ckpt)
+                ckpt_span.end()
+                epoch_span.end(val_loss=sub_epochs[-1][1])
                 epoch += k
                 if stop_early:
                     break
@@ -910,7 +1001,29 @@ class Trainer:
                                 force=True,
                             )
                         if not completed:
-                            events.emit("trainer", "fit_failed")
+                            events.emit(
+                                "trainer", "fit_failed",
+                                health=health.summary()["events"],
+                            )
+                            # The crashing epoch is exactly the window
+                            # the operator opens the trace to inspect:
+                            # record any span still in flight.
+                            for _sp in (dispatch_span, ckpt_span,
+                                        epoch_span):
+                                if _sp is not None:
+                                    _sp.end(error=True)
+                        # Fit span closes HERE, success or failure: a
+                        # post-training tail error (artifact upload,
+                        # tracker teardown) must not orphan the whole
+                        # rank's span tree from its recorded root.
+                        fit_span.end(
+                            completed=completed,
+                            epochs_run=len(history),
+                            val_loss=(
+                                history[-1]["val_loss"]
+                                if history else None
+                            ),
+                        )
 
         # Rank-0 post-train artifact upload, mirroring
         # jobs/train_lightning_ddp.py:146-164 (best, else last.ckpt fallback).
@@ -972,6 +1085,7 @@ class Trainer:
                 run_id=events.run_id,
                 samples_per_sec=timer.samples_per_sec,
                 val_loss=final_vl,
+                health=health.summary(),
             )
         self.tracker.end_run()
 
@@ -980,11 +1094,13 @@ class Trainer:
             if shadow:
                 print(shadow, file=sys.stderr, flush=True)
         final = history[-1] if history else {"val_loss": float("nan"), "val_acc": float("nan")}
+        health_summary = health.summary()
         events.emit(
             "trainer", "fit_end",
             val_loss=final["val_loss"], val_acc=final["val_acc"],
             epochs_run=len(history),
             goodput_fraction=goodput_summary["goodput_fraction"],
+            health=health_summary["events"],
         )
         steady = timer.history[1:] if len(timer.history) > 1 else timer.history
         return TrainResult(
@@ -1002,6 +1118,7 @@ class Trainer:
             state=state,
             goodput=goodput_summary,
             run_correlation_id=events.run_id,
+            health=health_summary,
         )
 
     # ------------------------------------------------------------------

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Export the newest run's platform trace and print how to view it.
+#
+# Usage: scripts/trace_view.sh [run_dir]
+#
+# With no argument, picks the directory holding the newest span file
+# under ./logs (the default observability root: the trainer/launcher
+# write logs/events/spans/*.jsonl). Runs the inspect CLI, which writes
+# the Perfetto-loadable trace.json and prints the cycle report.
+set -euo pipefail
+
+ROOT="${1:-}"
+if [ -z "$ROOT" ]; then
+    # ls -t for mtime ordering: portable (BSD/macOS find has no -printf).
+    newest=$(find logs -path '*/spans/*.jsonl' -type f -exec ls -t {} + \
+                 2>/dev/null | head -1)
+    if [ -z "$newest" ]; then
+        echo "No span files under ./logs — pass a run dir explicitly:" >&2
+        echo "  scripts/trace_view.sh <run_dir>" >&2
+        exit 1
+    fi
+    # <run_dir>/spans/<file>.jsonl -> <run_dir> (the events dir).
+    ROOT=$(dirname "$(dirname "$newest")")
+fi
+
+echo "Inspecting run dir: $ROOT"
+python3 -m dct_tpu.observability.inspect "$ROOT"
+echo
+echo "To view the timeline: open https://ui.perfetto.dev and drag in"
+echo "  $ROOT/trace.json"
