@@ -48,6 +48,9 @@ TRAIN_CMD = os.environ.get("DCT_TRAIN_COMMAND", f"python3 {_REPO}/jobs/train_tpu
 # Continuous training: resume the optimizer trajectory each run
 # (see dags/training_dag.py for the full rationale).
 RESUME = os.environ.get("DCT_RESUME", "1")
+# Supervised relaunch-and-resume budget (dct_tpu.resilience; see
+# dags/training_dag.py for the contract). 0 = bare launch.
+MAX_RESTARTS = os.environ.get("DCT_MAX_RESTARTS", "2")
 RAW = _abs(os.environ.get("DCT_RAW_CSV", "data/raw/weather.csv"))
 PROCESSED = _abs(os.environ.get("DCT_PROCESSED_DIR", "data/processed"))
 MODELS_DIR = _abs(os.environ.get("DCT_MODELS_DIR", "data/models"))
@@ -134,7 +137,13 @@ with DAG(
             bash_command=(
                 f"cd {_REPO} && "
                 'DCT_RUN_ID="${DCT_RUN_ID:-dct-$(date +%s)-$$}" '
-                f"DCT_RESUME={RESUME} {TRAIN_CMD}"
+                f"DCT_RESUME={RESUME} "
+                + (
+                    f"python3 -m dct_tpu.resilience.supervise "
+                    f"--max-restarts {MAX_RESTARTS} -- {TRAIN_CMD}"
+                    if MAX_RESTARTS != "0"
+                    else TRAIN_CMD
+                )
             ),
             execution_timeout=timedelta(hours=3),
         )

@@ -57,6 +57,21 @@ LOCAL_MODE = HOSTS == ["local"]
 # ckpt_path, reference jobs/train_lightning_ddp.py:143). Set DCT_RESUME=0
 # to restore scratch-daily behavior.
 RESUME = os.environ.get("DCT_RESUME", "1")
+# Supervised relaunch-and-resume (dct_tpu.resilience): in local mode the
+# launch runs under `python -m dct_tpu.resilience.supervise`, which
+# classifies failures (crash / hang / preempted / health-halt), kills the
+# world with SIGTERM->SIGKILL escalation, and relaunches with resume +
+# exponential backoff up to DCT_MAX_RESTARTS. 0 disables supervision
+# (the bare reference-parity launch). In script mode the same healing
+# comes from Airflow's task retries: the launch script exits 75
+# (EXIT_PREEMPTED) when the world was preempted gracefully and the
+# cleanup/healthcheck tasks exit 22/21 for infra faults, so a red task's
+# code already names the failure family.
+MAX_RESTARTS = os.environ.get("DCT_MAX_RESTARTS", "2")
+# Chaos drills: an exported fault plan reaches the ranks in both modes.
+_RANK_EXTRA_ENV = {"DCT_RESUME": RESUME}
+if os.environ.get("DCT_FAULT_SPEC"):
+    _RANK_EXTRA_ENV["DCT_FAULT_SPEC"] = os.environ["DCT_FAULT_SPEC"]
 
 default_args = {
     "owner": "dct-tpu",
@@ -92,11 +107,20 @@ with DAG(
             # Run-correlation ID minted at TASK runtime (fresh per DAG
             # run, unlike script-build-time minting): every event record
             # of this training cycle — trainer, checkpoint, tracking —
-            # carries it. An externally exported DCT_RUN_ID wins.
+            # carries it. An externally exported DCT_RUN_ID wins. The
+            # supervisor wrapper relaunches-and-resumes crashed/hung/
+            # preempted runs (DCT_MAX_RESTARTS=0 restores the bare
+            # launch).
             bash_command=(
                 f"cd {_REPO} && "
                 'DCT_RUN_ID="${DCT_RUN_ID:-dct-$(date +%s)-$$}" '
-                f"DCT_RESUME={RESUME} {TRAIN_CMD}"
+                f"DCT_RESUME={RESUME} "
+                + (
+                    f"python3 -m dct_tpu.resilience.supervise "
+                    f"--max-restarts {MAX_RESTARTS} -- {TRAIN_CMD}"
+                    if MAX_RESTARTS != "0"
+                    else TRAIN_CMD
+                )
             ),
             execution_timeout=timedelta(hours=3),
         )
@@ -115,7 +139,7 @@ with DAG(
             task_id="tpu_spmd_training",
             bash_command=build_spmd_launch_script(
                 HOSTS, TRAIN_CMD, exec_template=EXEC,
-                extra_env={"DCT_RESUME": RESUME},
+                extra_env=_RANK_EXTRA_ENV,
             ),
             execution_timeout=timedelta(hours=3),
         )

@@ -34,6 +34,7 @@ from flax import serialization
 
 from dct_tpu.observability import events as _events
 from dct_tpu.observability import spans as _spans
+from dct_tpu.resilience import faults as _faults
 
 
 def needs_cross_process_gather(tree) -> bool:
@@ -68,13 +69,25 @@ def to_host(tree):
 
 
 def save_checkpoint(path: str, params: Any, meta: dict) -> str:
-    """Serialize {meta, params} to a single msgpack file."""
+    """Serialize {meta, params} to a single msgpack file.
+
+    Write-to-temp + ``os.replace``: a crash anywhere in the window (now
+    injectable — ``slow_save`` widens it, ``crash_save`` dies inside it)
+    can never publish a torn best/last file; at worst ``*.tmp`` debris
+    remains and the previous publish stays intact. The temp name is
+    pid-suffixed so concurrent writers (another rank, a stale zombie)
+    cannot tear each other's in-flight temp.
+    """
     payload = {"meta": dict(meta), "params": to_host(params)}
     data = serialization.msgpack_serialize(payload)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = path + ".tmp"
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(data)
+    # Fault hook INSIDE the vulnerable window: tmp written, final not
+    # yet renamed — the exact instant a preemption would tear a
+    # non-atomic write.
+    _faults.get_default().maybe_fire("save", save_kind="deploy", path=path)
     os.replace(tmp, path)  # atomic: no torn ckpt if a rank dies mid-write
     return path
 
@@ -282,6 +295,14 @@ class TrainStateCheckpointer:
         tmp = final + ".tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **entries)
+        # Fault hook between the shard write and its atomic rename: a
+        # ``crash_save`` here leaves state.next holding only *.tmp
+        # debris — the torn dir _restore_candidates must skip so the
+        # previous publish restores (``slow_save`` widens the window for
+        # kill-based tests instead).
+        _faults.get_default().maybe_fire(
+            "save", save_kind="resume_state", dir=next_dir
+        )
         os.replace(tmp, final)
         if meta is not None:
             import json

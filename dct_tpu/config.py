@@ -440,6 +440,72 @@ class ObservabilityConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Self-healing knobs (dct_tpu.resilience; docs/ROBUSTNESS.md):
+    supervised relaunch-and-resume, graceful preemption, fault
+    injection, and transient-network retry policy.
+
+    The supervisor-side knobs (``max_restarts``, backoff) govern
+    whoever babysits the world — :meth:`LocalProcessLauncher.supervise`
+    or the ``python -m dct_tpu.resilience.supervise`` CLI; the rank-side
+    knobs (``graceful_preemption``, ``fault_spec``) govern the trainer.
+    ``startup_debt_s`` is supervisor-set plumbing
+    (``DCT_STARTUP_RECOVERY_DEBT_S``): the wall clock lost to the failed
+    attempts, booked by the relaunched trainer as ``startup_recovery``
+    badput so the cycle's goodput accounting stays honest.
+    """
+
+    max_restarts: int = 2
+    restart_backoff_s: float = 5.0
+    restart_backoff_factor: float = 2.0
+    restart_jitter: float = 0.1
+    preempt_grace_s: float = 30.0
+    # Honor SIGTERM cooperatively: finish the in-flight step, save a
+    # resume checkpoint, exit EXIT_PREEMPTED (75). Off = die like the
+    # reference does.
+    graceful_preemption: bool = True
+    # Deterministic fault plan (resilience.faults grammar), e.g.
+    # "crash@rank1:epoch2,slow_save". Empty = no faults.
+    fault_spec: str = ""
+    fault_sleep_s: float = 3.0
+    # Transient-network retry policy (tracking client, deploy rollout).
+    retry_max_attempts: int = 3
+    retry_backoff_s: float = 0.5
+    # Supervisor-set: lost wall clock to book as startup_recovery badput.
+    startup_debt_s: float = 0.0
+
+    @classmethod
+    def from_env(cls) -> "ResilienceConfig":
+        c = cls()
+        c.max_restarts = _env("DCT_MAX_RESTARTS", c.max_restarts, int)
+        c.restart_backoff_s = _env(
+            "DCT_RESTART_BACKOFF_S", c.restart_backoff_s, float
+        )
+        c.restart_backoff_factor = _env(
+            "DCT_RESTART_BACKOFF_FACTOR", c.restart_backoff_factor, float
+        )
+        c.restart_jitter = _env("DCT_RESTART_JITTER", c.restart_jitter, float)
+        c.preempt_grace_s = _env(
+            "DCT_PREEMPT_GRACE_S", c.preempt_grace_s, float
+        )
+        c.graceful_preemption = _env(
+            "DCT_GRACEFUL_PREEMPTION", c.graceful_preemption, bool
+        )
+        c.fault_spec = _env("DCT_FAULT_SPEC", c.fault_spec, str)
+        c.fault_sleep_s = _env("DCT_FAULT_SLEEP_S", c.fault_sleep_s, float)
+        c.retry_max_attempts = _env(
+            "DCT_RETRY_MAX_ATTEMPTS", c.retry_max_attempts, int
+        )
+        c.retry_backoff_s = _env(
+            "DCT_RETRY_BACKOFF_S", c.retry_backoff_s, float
+        )
+        c.startup_debt_s = _env(
+            "DCT_STARTUP_RECOVERY_DEBT_S", c.startup_debt_s, float
+        )
+        return c
+
+
+@dataclass
 class RunConfig:
     """Top-level bundle passed to the Trainer."""
 
@@ -451,6 +517,7 @@ class RunConfig:
     tracking: TrackingConfig = field(default_factory=TrackingConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     obs: ObservabilityConfig = field(default_factory=ObservabilityConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     @classmethod
     def from_env(cls) -> "RunConfig":
@@ -463,6 +530,7 @@ class RunConfig:
             tracking=TrackingConfig.from_env(),
             profile=ProfileConfig.from_env(),
             obs=ObservabilityConfig.from_env(),
+            resilience=ResilienceConfig.from_env(),
         )
 
     def to_dict(self) -> dict:

@@ -159,7 +159,11 @@ class RolloutOrchestrator:
         soak_seconds: float = 30.0,
         sleep_fn=time.sleep,
         run_id: str | None = None,
+        retry_max_attempts: int | None = None,
+        retry_backoff_s: float | None = None,
     ):
+        from dct_tpu.resilience.retry import Retrier
+
         self.client = client
         self.endpoint = endpoint
         self.mirror_percent = mirror_percent
@@ -171,6 +175,21 @@ class RolloutOrchestrator:
         # package's (package_run_correlation_id); deploy_new_slot adopts
         # it from the package automatically when unset.
         self.run_id = run_id
+        # Transient control-plane flakes retry with backoff instead of
+        # aborting the rollout; when retries exhaust mid-canary the
+        # stage auto-reverts to the prior deployment (`rollback`).
+        # Policy defaults come from the same DCT_RETRY_* env contract
+        # the tracking client honors; explicit ctor args win.
+        overrides: dict = {"sleep_fn": sleep_fn}
+        if retry_max_attempts is not None:
+            overrides["max_attempts"] = retry_max_attempts
+        if retry_backoff_s is not None:
+            overrides["backoff_s"] = retry_backoff_s
+        self._retry = Retrier.from_env(**overrides)
+
+    def _call(self, fn, *args, op: str):
+        """One endpoint-control call under the retry policy."""
+        return self._retry(lambda: fn(*args), op=f"deploy.{op}")
 
     def _stage_span(self, stage: str):
         """Span for one rollout stage, on the SHIPPED training cycle's
@@ -187,12 +206,15 @@ class RolloutOrchestrator:
         """Get-or-recreate, deleting a failed endpoint first
         (dags/azure_manual_deploy.py:141-150)."""
         c = self.client
-        if c.endpoint_exists(self.endpoint):
-            if c.provisioning_state(self.endpoint).lower() == "failed":
-                c.delete_endpoint(self.endpoint)
-                c.create_endpoint(self.endpoint)
+        if self._call(c.endpoint_exists, self.endpoint, op="endpoint_exists"):
+            state = self._call(
+                c.provisioning_state, self.endpoint, op="provisioning_state"
+            )
+            if state.lower() == "failed":
+                self._call(c.delete_endpoint, self.endpoint, op="delete_endpoint")
+                self._call(c.create_endpoint, self.endpoint, op="create_endpoint")
         else:
-            c.create_endpoint(self.endpoint)
+            self._call(c.create_endpoint, self.endpoint, op="create_endpoint")
 
     def deploy_new_slot(self, package_dir: str) -> tuple[str, str | None]:
         if self.run_id is None:
@@ -200,46 +222,97 @@ class RolloutOrchestrator:
         with self._stage_span("deploy_new_slot"):
             self.ensure_endpoint()
             new_slot, old_slot = choose_slot(
-                self.client.get_traffic(self.endpoint)
+                self._call(self.client.get_traffic, self.endpoint,
+                           op="get_traffic")
             )
-            self.client.deploy(self.endpoint, new_slot, package_dir)
+            self._call(self.client.deploy, self.endpoint, new_slot,
+                       package_dir, op="deploy")
             if old_slot is None:
                 # First deployment: take 100% immediately (manual-deploy
                 # path, dags/azure_manual_deploy.py:164-167).
-                self.client.set_traffic(self.endpoint, {new_slot: 100})
+                self._call(self.client.set_traffic, self.endpoint,
+                           {new_slot: 100}, op="set_traffic")
             self._record("deploy_new_slot")
         return new_slot, old_slot
 
     def start_shadow(self, new_slot: str, old_slot: str) -> None:
         with self._stage_span("shadow"):
-            self.client.set_traffic(
-                self.endpoint, {old_slot: 100, new_slot: 0}
-            )
-            self.client.set_mirror_traffic(
-                self.endpoint, {new_slot: self.mirror_percent}
-            )
-            self._record("shadow")
+            try:
+                self._call(self.client.set_traffic, self.endpoint,
+                           {old_slot: 100, new_slot: 0}, op="set_traffic")
+                self._call(self.client.set_mirror_traffic, self.endpoint,
+                           {new_slot: self.mirror_percent},
+                           op="set_mirror_traffic")
+                # _record is inside the guard: its traffic reads can
+                # flake too, and by now the mirror is live.
+                self._record("shadow")
+            except Exception:
+                self.rollback(new_slot, old_slot, stage="shadow")
+                raise
 
     def start_canary(self, new_slot: str, old_slot: str) -> None:
         with self._stage_span("canary"):
-            self.client.set_mirror_traffic(self.endpoint, {})
-            self.client.set_traffic(
-                self.endpoint,
-                {
-                    old_slot: 100 - self.canary_percent,
-                    new_slot: self.canary_percent,
-                },
-            )
-            self._record("canary")
+            try:
+                self._call(self.client.set_mirror_traffic, self.endpoint,
+                           {}, op="set_mirror_traffic")
+                self._call(
+                    self.client.set_traffic, self.endpoint,
+                    {
+                        old_slot: 100 - self.canary_percent,
+                        new_slot: self.canary_percent,
+                    },
+                    op="set_traffic",
+                )
+                # Inside the guard: a flake here would otherwise abort
+                # the rollout with canary traffic still live.
+                self._record("canary")
+            except Exception:
+                # Retries exhausted mid-canary: auto-revert to the prior
+                # deployment, THEN surface the failure (the task goes
+                # red, the endpoint stays safe on the old model).
+                self.rollback(new_slot, old_slot, stage="canary")
+                raise
 
     def full_rollout(self, new_slot: str, old_slot: str | None) -> None:
         with self._stage_span("full_rollout"):
-            self.client.set_traffic(self.endpoint, {new_slot: 100})
-            if old_slot and old_slot in self.client.list_deployments(
-                self.endpoint
+            try:
+                self._call(self.client.set_traffic, self.endpoint,
+                           {new_slot: 100}, op="set_traffic")
+            except Exception:
+                # The flip itself failed: revert. (A failure AFTER the
+                # flip — old-slot deletion — does not revert: the new
+                # model is live and healthy; the lingering old slot is
+                # an operator cleanup, not a rollback.)
+                self.rollback(new_slot, old_slot, stage="full_rollout")
+                raise
+            if old_slot and old_slot in self._call(
+                self.client.list_deployments, self.endpoint,
+                op="list_deployments",
             ):
-                self.client.delete_deployment(self.endpoint, old_slot)
+                self._call(self.client.delete_deployment, self.endpoint,
+                           old_slot, op="delete_deployment")
             self._record("full_rollout")
+
+    def rollback(self, new_slot: str, old_slot: str | None, *, stage: str) -> None:
+        """Auto-revert to the prior deployment: old slot back to 100%
+        live, mirror cleared. Best-effort single-shot calls (no retry
+        loop: the control plane just proved flaky, and every failed
+        revert attempt is more time the canary serves traffic) — the
+        ``deploy.rollback`` event records the attempt either way."""
+        reverted = False
+        if old_slot:
+            try:
+                self.client.set_mirror_traffic(self.endpoint, {})
+                self.client.set_traffic(self.endpoint, {old_slot: 100})
+                reverted = True
+            except Exception:  # noqa: BLE001 — recorded below, then re-raised by caller
+                pass
+        self.events.append(RolloutEvent(stage="rollback"))
+        self._cycle_log().emit(
+            "deploy", "deploy.rollback", endpoint=self.endpoint,
+            failed_stage=stage, new_slot=new_slot, old_slot=old_slot,
+            reverted=reverted,
+        )
 
     # -- the full machine ---------------------------------------------
     def run(self, package_dir: str) -> list[RolloutEvent]:
@@ -252,23 +325,28 @@ class RolloutOrchestrator:
         self.full_rollout(new_slot, old_slot)
         return self.events
 
-    def _record(self, stage: str) -> None:
-        ev = RolloutEvent(
-            stage=stage,
-            traffic=dict(self.client.get_traffic(self.endpoint)),
-            mirror=dict(self.client.get_mirror_traffic(self.endpoint)),
-        )
-        self.events.append(ev)
-        # Stage events adopt the SHIPPED training cycle's
-        # run-correlation ID (from the package's run_info.json / ctor)
-        # so one grep spans train -> deploy; a standalone rollout falls
-        # back to the process default.
+    def _cycle_log(self):
+        """Event log stamped with the SHIPPED training cycle's
+        run-correlation ID (from the package's run_info.json / ctor) so
+        one grep spans train -> deploy; a standalone rollout falls back
+        to the process default."""
         from dct_tpu.observability import events as _events
 
         log = _events.get_default()
         if self.run_id and self.run_id != log.run_id:
             log = _events.EventLog(log.path, run_id=self.run_id, rank=log.rank)
-        log.emit(
+        return log
+
+    def _record(self, stage: str) -> None:
+        ev = RolloutEvent(
+            stage=stage,
+            traffic=dict(self._call(self.client.get_traffic, self.endpoint,
+                                    op="get_traffic")),
+            mirror=dict(self._call(self.client.get_mirror_traffic,
+                                   self.endpoint, op="get_mirror_traffic")),
+        )
+        self.events.append(ev)
+        self._cycle_log().emit(
             "deploy", stage, endpoint=self.endpoint,
             traffic=ev.traffic, mirror=ev.mirror,
         )

@@ -26,20 +26,28 @@ hand it to something that parses a shell command string:
 
 from __future__ import annotations
 
+import json
 import os
 import shlex
 import signal
 import subprocess
 import sys
+import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from dct_tpu.observability.events import (
     EventLog,
     mint_run_id,
     observability_enabled,
 )
-from dct_tpu.observability.heartbeat import HeartbeatMonitor
+from dct_tpu.observability.heartbeat import HeartbeatMonitor, heartbeat_path
+from dct_tpu.resilience.supervisor import (
+    EXIT_INFRA_CLEANUP,
+    EXIT_INFRA_HEALTHCHECK,
+    RestartPolicy,
+    classify_failure,
+)
 from dct_tpu.observability.spans import (
     SpanRecorder,
     span_file_name,
@@ -101,14 +109,25 @@ def build_zombie_cleanup_script(
     settle_seconds: int = 2,
 ) -> str:
     """Kill stale ranks on every host before relaunch (the reference's
-    rendezvous-port hygiene, dags/2_pytorch_training.py:29-38)."""
+    rendezvous-port hygiene, dags/2_pytorch_training.py:29-38).
+
+    "No zombies matched" is success (the remote ``|| true``), but a dead
+    exec TRANSPORT (ssh/docker unreachable) exits ``EXIT_INFRA_CLEANUP``
+    — distinct from a training failure, so the supervisor/operator sees
+    "the control plane is broken", not "training crashed again".
+    """
     lines = ["echo 'Cleaning up zombie training processes...'"]
     # Bracket the first char so the pattern cannot match the shell that
     # carries it (pkill -f would otherwise kill its own wrapping bash).
     safe_pattern = f"[{pattern[0]}]{pattern[1:]}" if pattern else pattern
     for host in hosts:
         kill = f"pkill -9 -f {shlex.quote(safe_pattern)} || true"
-        lines.append(remote_command(exec_template, host, kill))
+        lines.append(
+            remote_command(exec_template, host, kill)
+            + " || { echo "
+            + shlex.quote(f"Cleanup exec transport failed on {host}")
+            + f"; exit {EXIT_INFRA_CLEANUP}; }}"
+        )
     lines.append(f"sleep {settle_seconds}")
     lines.append("echo 'Cleanup complete'")
     return "\n".join(lines)
@@ -124,11 +143,22 @@ def build_healthcheck_script(
     (analog of the per-node ``import torch`` check,
     dags/2_pytorch_training.py:40-46). ``set -e`` makes any host's failed
     check fail the whole task — without it bash returns the LAST command's
-    status and a broken host would slip through to the SPMD launch."""
+    status and a broken host would slip through to the SPMD launch.
+
+    A failed check exits ``EXIT_INFRA_HEALTHCHECK`` (not the remote
+    command's arbitrary status): the supervisor's classifier must see
+    "a host is unhealthy" as infra, never as a training crash to burn
+    restart budget on.
+    """
     lines = ["set -e"]
     for host in hosts:
         lines.append(f"echo 'Checking {host}...'")
-        lines.append(remote_command(exec_template, host, check_command))
+        lines.append(
+            remote_command(exec_template, host, check_command)
+            + " || { echo "
+            + shlex.quote(f"Healthcheck failed on {host}")
+            + f"; exit {EXIT_INFRA_HEALTHCHECK}; }}"
+        )
     lines.append("echo 'All hosts healthy'")
     return "\n".join(lines)
 
@@ -249,11 +279,27 @@ def build_spmd_launch_script(
     )
     lines.append("done")
     conj = " && ".join(f'[ "$RC{r}" -eq 0 ]' for r in ranks)
+    # Exit-code classification (resilience.supervisor contract): a rank
+    # that exited 75 (EXIT_PREEMPTED) was preempted gracefully; 143 is
+    # our own fail-fast SIGTERM (kill_survivors) reaping survivors of
+    # the first failure. 137 (SIGKILL) is NOT ours — this script never
+    # escalates past SIGTERM — so an OOM-killed rank counts as a hard
+    # failure. Only when NO rank failed hard does the script itself exit
+    # 75, so Airflow retries (the script-level supervisor) see "resume
+    # me" distinctly from "training crashed".
+    lines.append("HARD=0; PRE=0")
+    for r in ranks:
+        lines.append(
+            f'case "$RC{r}" in 0|143) ;; 75) PRE=1 ;; *) HARD=1 ;; esac'
+        )
     lines.append(
         f'if {conj}; then echo "All {world} ranks finished successfully"; '
         f'else echo "Training failed: rank exit codes: '
         + " ".join(f"$RC{r}" for r in ranks)
-        + '"; exit 1; fi'
+        + '"; '
+        + 'if [ "$HARD" -eq 0 ] && [ "$PRE" -eq 1 ]; '
+        + 'then echo "World preempted - resumable"; exit 75; fi; '
+        + "exit 1; fi"
     )
     return "\n".join(lines)
 
@@ -267,10 +313,50 @@ def _kill_group(p: "subprocess.Popen") -> None:
         p.kill()
 
 
+def _term_group(p: "subprocess.Popen") -> None:
+    """SIGTERM a rank's whole process group — the graceful half of the
+    SIGTERM -> SIGKILL escalation: a healthy rank's PreemptionGuard gets
+    its chance to save a resume checkpoint and exit EXIT_PREEMPTED; a
+    wedged one is SIGKILLed when the grace window expires."""
+    try:
+        os.killpg(p.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError, OSError):
+        p.terminate()
+
+
 @dataclass
 class RankResult:
     rank: int
     returncode: int
+
+
+@dataclass
+class AttemptRecord:
+    """One supervised launch attempt and how it ended."""
+
+    attempt: int
+    results: list
+    classification: str
+    wall_seconds: float
+
+
+@dataclass
+class SuperviseResult:
+    """Outcome of :meth:`LocalProcessLauncher.supervise`."""
+
+    results: list
+    attempts: list = field(default_factory=list)
+    restarts: int = 0
+    success: bool = False
+    classification: str = "crash"
+
+
+class SupervisorTerminated(Exception):
+    """The supervisor itself received SIGTERM/SIGINT: raised from the
+    signal handler so launch()'s finally-block teardown runs — the
+    ranks live in their own sessions (start_new_session), so a
+    supervisor that dies on the default signal disposition would orphan
+    them past any task-level process-group kill."""
 
 
 class LocalProcessLauncher:
@@ -300,6 +386,8 @@ class LocalProcessLauncher:
         heartbeat_dir: str | None = None,
         heartbeat_stall_seconds: float = 120.0,
         heartbeat_scan_seconds: float = 5.0,
+        preempt_grace_s: float = 15.0,
+        stall_kill: bool = False,
     ):
         self.coordinator_port = coordinator_port
         self.stagger_seconds = stagger_seconds
@@ -309,6 +397,18 @@ class LocalProcessLauncher:
         self.heartbeat_dir = heartbeat_dir
         self.heartbeat_stall_seconds = heartbeat_stall_seconds
         self.heartbeat_scan_seconds = heartbeat_scan_seconds
+        # SIGTERM -> SIGKILL escalation window: how long a rank being
+        # torn down (fail-fast, stall-kill) gets to honor its
+        # PreemptionGuard (finish the step, save, exit 75) before the
+        # group is SIGKILLed.
+        self.preempt_grace_s = preempt_grace_s
+        # Kill the world when a rank's heartbeat goes stalled/missing
+        # (supervision mode): a PID-alive rank wedged in a collective
+        # blocks every peer; detection-only reporting stays the default.
+        self.stall_kill = stall_kill
+        # What the last launch() observed, for supervise()'s classifier.
+        self._stall_killed = False
+        self._timed_out = False
 
     def cleanup_zombies(self, pattern: str) -> None:
         subprocess.run(["pkill", "-9", "-f", pattern], check=False)
@@ -322,6 +422,8 @@ class LocalProcessLauncher:
         env: dict[str, str] | None = None,
     ) -> list[RankResult]:
         procs: list[subprocess.Popen] = []
+        self._stall_killed = False
+        self._timed_out = False
         base_env = dict(os.environ)
         base_env.update(env or {})
         # Correlation: one run ID for the whole launch, minted here (the
@@ -395,6 +497,20 @@ class LocalProcessLauncher:
             # dags/2_pytorch_training.py:62-75).
             codes: dict[int, int] = {}
             killed = False
+            kill_deadline = None
+            escalated = False
+
+            def _teardown_world() -> None:
+                """Graceful half of the escalation: SIGTERM every
+                surviving group so healthy ranks can save-and-exit-75;
+                the poll loop SIGKILLs whatever outlives the grace."""
+                nonlocal killed, kill_deadline
+                killed = True
+                kill_deadline = time.monotonic() + self.preempt_grace_s
+                for q in procs:
+                    if q.poll() is None:
+                        _term_group(q)
+
             deadline = time.monotonic() + self.timeout
             while len(codes) < world_size and time.monotonic() < deadline:
                 progressed = False
@@ -412,10 +528,14 @@ class LocalProcessLauncher:
                         returncode=rc,
                     )
                     if rc != 0 and self.fail_fast and not killed:
-                        killed = True
-                        for q in procs:
-                            if q.poll() is None:
-                                _kill_group(q)
+                        _teardown_world()
+                if killed and not escalated and (
+                    time.monotonic() >= kill_deadline
+                ):
+                    escalated = True
+                    for q in procs:
+                        if q.poll() is None:
+                            _kill_group(q)
                 # Liveness beyond PIDs: a rank can be alive and wedged in
                 # a collective. Scan heartbeats on a slow cadence and
                 # NAME stalled/missing ranks while still joined.
@@ -423,7 +543,25 @@ class LocalProcessLauncher:
                     time.monotonic() - last_scan >= self.heartbeat_scan_seconds
                 ):
                     last_scan = time.monotonic()
-                    self._flag_heartbeats(monitor, codes, flagged, events)
+                    wedged = self._flag_heartbeats(
+                        monitor, codes, flagged, events
+                    )
+                    if wedged and self.stall_kill and not killed:
+                        # Supervision mode: a stalled rank blocks every
+                        # peer's collectives — kill the world (escalating)
+                        # and let the supervisor relaunch from checkpoint.
+                        self._stall_killed = True
+                        events.emit(
+                            "launcher", "restart.stall_kill",
+                            stalled_ranks=wedged,
+                            stall_seconds=self.heartbeat_stall_seconds,
+                        )
+                        print(
+                            f"[launcher] stall-kill: ranks {wedged} wedged "
+                            "— terminating the world for relaunch",
+                            file=sys.stderr, flush=True,
+                        )
+                        _teardown_world()
                 if not progressed and len(codes) < world_size:
                     time.sleep(self.poll_seconds)
             for rank, p in enumerate(procs):
@@ -434,6 +572,7 @@ class LocalProcessLauncher:
                     rc = p.poll()
                     timed_out = rc is None
                     if timed_out:
+                        self._timed_out = True
                         _kill_group(p)
                         p.wait()
                         rc = -signal.SIGKILL
@@ -458,9 +597,31 @@ class LocalProcessLauncher:
                 for r in range(world_size)
             ]
         finally:
-            for p in procs:
-                if p.poll() is None:
-                    _kill_group(p)
+            live = [p for p in procs if p.poll() is None]
+            if live:
+                # Exception-path teardown (supervisor terminated, monitor
+                # error) uses the SAME SIGTERM -> grace -> SIGKILL
+                # escalation as fail-fast: a healthy rank's
+                # PreemptionGuard gets its chance to save-and-exit-75
+                # before the hard kill. On the normal path every rank is
+                # already reaped and this costs nothing.
+                for p in live:
+                    _term_group(p)
+                grace_deadline = time.monotonic() + self.preempt_grace_s
+                while any(p.poll() is None for p in live) and (
+                    time.monotonic() < grace_deadline
+                ):
+                    time.sleep(0.1)
+                for p in live:
+                    if p.poll() is None:
+                        _kill_group(p)
+                    # Reap: nobody polls again after this, and an
+                    # unreaped kill leaves a zombie per rank in a
+                    # long-lived supervisor.
+                    try:
+                        p.wait(timeout=5)
+                    except (subprocess.TimeoutExpired, OSError):
+                        pass
             # A launch that raised (Popen failure, monitor error) must
             # still record its spans — end() is idempotent, so on the
             # success path (everything already ended) this is a no-op.
@@ -474,14 +635,18 @@ class LocalProcessLauncher:
         codes: dict[int, int],
         flagged: set,
         events: EventLog,
-    ) -> None:
+    ) -> list[int]:
         """One monitor pass: warn (stderr + event) once per (rank, state)
         for stalled/missing ranks that have not exited, and once per new
-        epoch-skew level when ranks visibly diverge."""
+        epoch-skew level when ranks visibly diverge. Returns the ranks
+        currently stalled/missing (alive but not progressing) so a
+        stall-kill supervisor can act on them."""
+        wedged: list[int] = []
         statuses = monitor.scan()
         for s in statuses:
             if s.rank in codes or s.state not in ("stalled", "missing"):
                 continue
+            wedged.append(s.rank)
             key = (s.rank, s.state)
             if key in flagged:
                 continue
@@ -507,6 +672,229 @@ class LocalProcessLauncher:
                 file=sys.stderr, flush=True,
             )
             events.emit("launcher", "rank_skew", **skew)
+        return wedged
+
+    # ------------------------------------------------------------------
+    def supervise(
+        self,
+        argv: list[str],
+        *,
+        world_size: int,
+        env: dict[str, str] | None = None,
+        max_restarts: int = 2,
+        backoff_s: float = 1.0,
+        backoff_factor: float = 2.0,
+        jitter: float = 0.1,
+        max_attempts: int = 50,
+        sleep_fn=time.sleep,
+        clock=time.monotonic,
+    ) -> SuperviseResult:
+        """Supervised relaunch-and-resume: run :meth:`launch` until the
+        world succeeds, classifying every failure
+        (:func:`dct_tpu.resilience.supervisor.classify_failure`) and
+        relaunching resumable ones with exponential backoff.
+
+        Healing semantics per classification:
+
+        - ``preempted`` — routine (the ranks saved resume checkpoints and
+          exited 75): relaunch immediately, no restart budget consumed,
+          bounded only by ``max_attempts``;
+        - ``crash`` / ``hang`` / ``infra`` — relaunch with backoff, up to
+          ``max_restarts`` times;
+        - ``health_halt`` — deterministic (a NaN'd trajectory re-diverges
+          from the same checkpoint): give up immediately.
+
+        Every relaunch sets ``DCT_RESUME=1`` so the retried world resumes
+        from the last published train-state checkpoint
+        (:class:`TrainStateCheckpointer` skips torn rotation dirs), and
+        exports the wall clock actually LOST so far as
+        ``DCT_STARTUP_RECOVERY_DEBT_S`` — the relaunched trainer books it
+        as ``startup_recovery`` badput, so the cycle's goodput accounting
+        is honest about what the failure cost. "Lost" means the window
+        since the attempt's last durable resume checkpoint (read from its
+        ``resume_state_saved`` events): checkpointed progress is RETAINED
+        by the resume, not lost — in particular a graceful preemption
+        after hours of training costs ~nothing. Stale heartbeat files from
+        the dead attempt are cleared so the fresh monitor does not
+        stall-kill the new world on yesterday's beats.
+
+        The supervisor also forwards its OWN termination: ranks run in
+        their own sessions (``start_new_session``), so a supervisor dying
+        on the default SIGTERM disposition would orphan them past any
+        task-level process-group kill (Airflow ``execution_timeout``).
+        SIGTERM/SIGINT raise :class:`SupervisorTerminated` instead, which
+        unwinds through launch()'s finally-block world teardown.
+        """
+        base_env = dict(env or {})
+        merged = dict(os.environ)
+        merged.update(base_env)
+        # One run-correlation ID across every attempt: the relaunches ARE
+        # the story of this cycle, and one grep must reconstruct it.
+        run_id = merged.get("DCT_RUN_ID") or mint_run_id()
+        base_env["DCT_RUN_ID"] = merged["DCT_RUN_ID"] = run_id
+        events = _launcher_event_log(merged)
+        policy = RestartPolicy(
+            max_restarts=max_restarts, backoff_s=backoff_s,
+            backoff_factor=backoff_factor, jitter=jitter,
+        )
+        events.emit(
+            "launcher", "supervise_start",
+            world_size=world_size, max_restarts=max_restarts,
+            argv=list(argv),
+        )
+        attempts: list[AttemptRecord] = []
+        restarts = 0
+        debt = 0.0
+
+        def _raise_terminated(signum, frame):
+            raise SupervisorTerminated(f"signal {signum}")
+
+        prev_handlers = {}
+        if threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    prev_handlers[sig] = signal.signal(sig, _raise_terminated)
+                except (ValueError, OSError):
+                    pass
+        try:
+            while True:
+                t0 = clock()
+                t0_wall = time.time()
+                results = self.launch(
+                    argv, world_size=world_size, env=base_env
+                )
+                wall = clock() - t0
+                cls = classify_failure(
+                    [r.returncode for r in results],
+                    stall_killed=self._stall_killed,
+                    timed_out=self._timed_out,
+                )
+                attempts.append(
+                    AttemptRecord(len(attempts) + 1, results, cls, wall)
+                )
+                if cls == "success":
+                    events.emit(
+                        "launcher", "restart.recovered" if restarts or
+                        len(attempts) > 1 else "supervise_end",
+                        attempts=len(attempts), restarts_used=restarts,
+                        lost_wall_s=round(debt, 3),
+                    )
+                    return SuperviseResult(
+                        results=results, attempts=attempts,
+                        restarts=restarts, success=True, classification=cls,
+                    )
+                if not policy.allows(restarts, cls) or (
+                    len(attempts) >= max_attempts
+                ):
+                    events.emit(
+                        "launcher", "restart.gave_up",
+                        classification=cls, restarts_used=restarts,
+                        attempts=len(attempts),
+                        returncodes=[r.returncode for r in results],
+                    )
+                    return SuperviseResult(
+                        results=results, attempts=attempts,
+                        restarts=restarts, success=False,
+                        classification=cls,
+                    )
+                consume = cls != "preempted"
+                delay = policy.delay(restarts) if consume else 0.0
+                if consume:
+                    restarts += 1
+                debt += self._attempt_lost_seconds(
+                    merged, run_id, cls, t0_wall, wall
+                ) + delay
+                self._clear_heartbeats(merged, world_size)
+                events.emit(
+                    "launcher", "restart.relaunch",
+                    attempt=len(attempts) + 1, classification=cls,
+                    backoff_s=round(delay, 3), lost_wall_s=round(debt, 3),
+                    restarts_used=restarts,
+                    returncodes=[r.returncode for r in results],
+                )
+                # The retried run RESUMES at the last published step
+                # rather than epoch 0, and books the lost window as
+                # badput.
+                base_env["DCT_RESUME"] = "1"
+                base_env["DCT_STARTUP_RECOVERY_DEBT_S"] = f"{debt:.3f}"
+                # Fault plans are per-CYCLE drills: the spec applies to
+                # the first launch, the healed relaunch runs clean —
+                # otherwise a resumed world restarting at the trigger
+                # epoch re-fires the same fault forever and the drill can
+                # never demonstrate recovery.
+                base_env["DCT_FAULT_SPEC"] = ""
+                if delay > 0:
+                    sleep_fn(delay)
+        except SupervisorTerminated:
+            # launch()'s finally already tore the world down; put the
+            # cause on the record and report resumable-not-failed (a
+            # task retry with DCT_RESUME=1 picks the cycle back up).
+            events.emit(
+                "launcher", "supervise_terminated",
+                attempts=len(attempts), restarts_used=restarts,
+            )
+            return SuperviseResult(
+                results=attempts[-1].results if attempts else [],
+                attempts=attempts, restarts=restarts, success=False,
+                classification="preempted",
+            )
+        finally:
+            for sig, prev in prev_handlers.items():
+                try:
+                    signal.signal(sig, prev)
+                except (ValueError, OSError):
+                    pass
+
+    @staticmethod
+    def _attempt_lost_seconds(
+        env: dict, run_id: str, classification: str,
+        t0_wall: float, wall: float,
+    ) -> float:
+        """Wall clock the failed attempt actually LOST: the window since
+        its last durable resume checkpoint (``resume_state_saved``
+        events), because checkpointed progress is retained by the
+        resume. A graceful preemption saved at the boundary by contract
+        — zero. No readable events / no save seen -> the full attempt
+        wall (conservative: nothing provably survived)."""
+        if classification == "preempted":
+            return 0.0
+        path = os.path.join(
+            env.get("DCT_EVENTS_DIR") or "logs/events", "events.jsonl"
+        )
+        last_save = None
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (
+                        rec.get("run_id") == run_id
+                        and rec.get("event") == "resume_state_saved"
+                        and rec.get("ts", 0.0) >= t0_wall
+                    ):
+                        last_save = max(last_save or 0.0, rec["ts"])
+        except OSError:
+            return wall
+        if last_save is None:
+            return wall
+        return min(wall, max(0.0, t0_wall + wall - last_save))
+
+    def _clear_heartbeats(self, env: dict, world_size: int) -> None:
+        """Drop the dead attempt's heartbeat files: they carry the SAME
+        run ID as the relaunch (one cycle, one correlation ID), so the
+        fresh monitor would read them as instantly-stalled ranks."""
+        hb_dir = (
+            env.get("DCT_HEARTBEAT_DIR")
+            or self.heartbeat_dir
+            or "logs/heartbeats"
+        )
+        for rank in range(world_size):
+            try:
+                os.remove(heartbeat_path(hb_dir, rank))
+            except OSError:
+                pass
 
     @staticmethod
     def all_succeeded(results: list[RankResult]) -> bool:

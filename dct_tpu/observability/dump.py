@@ -25,6 +25,7 @@ def write_train_metrics_prom(
     samples_per_sec: float = 0.0,
     val_loss: float | None = None,
     health: dict | None = None,
+    resilience: dict | None = None,
 ) -> str | None:
     """Write the run's final metrics at ``path`` (tmp+rename so a
     shipping agent never reads a torn file). Returns the path, or None
@@ -84,6 +85,24 @@ def write_train_metrics_prom(
                     "Last observed gradient global norm.",
                 ).add(gn, labels)
             )
+    if resilience is not None:
+        # Resilience surface (dct_tpu.resilience): injected-fault count
+        # and the supervised-relaunch debt this run was handed
+        # (restart.* counters live with the supervisor's events; the
+        # debt itself is also inside the startup_recovery category).
+        fams.append(
+            MetricFamily(
+                "dct_train_faults_injected_total", "counter",
+                "Faults the DCT_FAULT_SPEC plan fired in this run.",
+            ).add(resilience.get("faults_injected", 0), labels)
+        )
+        fams.append(
+            MetricFamily(
+                "dct_train_startup_recovery_debt_seconds", "gauge",
+                "Wall seconds lost to failed attempts before this run "
+                "(booked as startup_recovery badput).",
+            ).add(resilience.get("startup_debt_s", 0.0), labels)
+        )
     tmp = path + ".tmp"
     try:
         parent = os.path.dirname(path)

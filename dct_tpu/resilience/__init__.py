@@ -1,0 +1,50 @@
+"""Self-healing training cycles: fault injection, supervised
+relaunch-and-resume, and graceful preemption.
+
+PRs 1-2 gave the platform *senses* (heartbeats, stall/straggler flags,
+NaN/spike health halts); this package adds the *reflexes*. Podracer-style
+TPU fleets (PAPERS: "Podracer architectures for scalable Reinforcement
+Learning") and large pjit jobs (PAPERS: "Scalable Training of Language
+Models using JAX pjit and TPUv4") treat preemption and rank loss as
+routine events handled by supervised relaunch from checkpoint — none of
+which is testable without deterministic failures, so the package leads
+with fault injection:
+
+- :mod:`faults`     — ``DCT_FAULT_SPEC``-driven fault plan consulted at
+  well-defined hook points in the trainer, the data staging path, and
+  both checkpoint tiers (``crash@rank1:epoch2``, ``hang@rank0:step10``,
+  ``nan@rank1:epoch1``, ``slow_save``, ``crash_save``, ``slow_epoch``);
+- :mod:`supervisor` — the exit-code contract, the failure classifier
+  (crash / hang / preempted / infra / health_halt), and the exponential
+  restart-backoff policy the launcher's supervision loop runs on;
+- :mod:`preempt`    — the rank-side SIGTERM contract: finish the
+  in-flight step, make the resume checkpoint durable, exit
+  ``EXIT_PREEMPTED`` so the supervisor treats the rank as
+  resumable-not-failed;
+- :mod:`retry`      — ``with_retries`` (backoff + jitter + transient
+  classification) for the tracking client's network ops and the
+  rollout's endpoint calls;
+- :mod:`supervise`  — ``python -m dct_tpu.resilience.supervise`` CLI
+  wrapping :meth:`LocalProcessLauncher.supervise` for DAG launch blocks.
+
+See docs/ROBUSTNESS.md for the failure model and the fault-spec grammar.
+"""
+
+from dct_tpu.resilience.faults import (  # noqa: F401
+    FAULT_CRASH_EXIT,
+    FaultClause,
+    FaultPlan,
+)
+from dct_tpu.resilience.preempt import (  # noqa: F401
+    PreemptedError,
+    PreemptionGuard,
+)
+from dct_tpu.resilience.retry import Retrier, with_retries  # noqa: F401
+from dct_tpu.resilience.supervisor import (  # noqa: F401
+    EXIT_HEALTH_HALT,
+    EXIT_INFRA_CLEANUP,
+    EXIT_INFRA_HEALTHCHECK,
+    EXIT_PREEMPTED,
+    RestartPolicy,
+    classify_failure,
+)

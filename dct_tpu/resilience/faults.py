@@ -1,0 +1,260 @@
+"""Deterministic fault injection: the ``DCT_FAULT_SPEC`` fault plan.
+
+Every failure mode the supervisor must heal — a crashed rank, a wedged
+collective, a NaN'd loss, a save torn mid-write — needs a reproducible
+trigger before the healing is testable. The plan is parsed from one env
+var so the SAME spec drives a unit test, a launched multi-process rig,
+and a chaos job in CI.
+
+Spec grammar (comma-separated clauses)::
+
+    DCT_FAULT_SPEC = clause[,clause...]
+    clause         = ACTION[@rankR][:TRIGGER]
+    TRIGGER        = epochN | stepN | saveN
+
+Actions and the hook points that consult them:
+
+===========  =========  ====================================================
+action       point      behavior when fired
+===========  =========  ====================================================
+crash        epoch/step ``os._exit(FAULT_CRASH_EXIT)`` — a hard rank death
+                        (no atexit, no finally; the launcher sees a nonzero
+                        exit). Epoch-trigger crashes first join any pending
+                        resume-checkpoint write (the ``pre_exit`` hook) so
+                        the resume point is deterministic; use
+                        ``crash_save`` to exercise torn-write recovery.
+hang         epoch/step sleep forever — the rank stays PID-alive but stops
+                        beating, exactly the wedged-collective case the
+                        heartbeat monitor (and the supervisor's stall-kill)
+                        exists for.
+nan          data       the caller poisons the staged batch with NaN, so
+                        the loss goes non-finite through the REAL compute
+                        path (health.py then warns or halts per policy).
+slow_save    save       sleep ``DCT_FAULT_SLEEP_S`` inside the checkpoint
+                        write window (tmp written, final not yet renamed) —
+                        widens the torn-write window so a test can kill the
+                        process mid-save.
+crash_save   save       ``os._exit`` inside the same window — the torn
+                        save itself: only ``*.tmp`` debris may remain.
+slow_epoch   epoch      sleep ``DCT_FAULT_SLEEP_S`` at epoch start — makes
+                        "SIGTERM arrives mid-epoch" deterministic in tests.
+===========  =========  ====================================================
+
+Trigger semantics: ``epochN`` fires when epoch index N starts; ``stepN``
+fires at the first step hook with global step >= N; ``saveN`` fires on
+the Nth call of the save hook in this process (both checkpoint tiers
+share the counter); no trigger = the first opportunity. ``@rankR``
+restricts the clause to one rank (default: every rank). Each clause
+fires at most once per process.
+
+Like the rest of the observability plane, the default plan is resolved
+lazily from the environment (:func:`get_default`) so layers without
+config plumbing (the checkpoint manager) consult the same plan the
+trainer installed. An empty/unset spec is a no-op plan.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from dataclasses import dataclass, field
+
+from dct_tpu.observability import events as _events
+from dct_tpu.observability.events import _rank_from_env
+
+#: Exit code of an injected ``crash`` — distinct from real failures so
+#: the event log names the death as injected; the supervisor still
+#: classifies it as an ordinary crash (that is the point of the drill).
+FAULT_CRASH_EXIT = 117
+
+#: action -> hook points allowed to fire it.
+_ACTION_POINTS = {
+    "crash": ("epoch", "step"),
+    "hang": ("epoch", "step"),
+    "nan": ("data",),
+    "slow_save": ("save",),
+    "crash_save": ("save",),
+    "slow_epoch": ("epoch",),
+}
+
+_CLAUSE_RE = re.compile(
+    r"^(?P<action>[a-z_]+)"
+    r"(?:@rank(?P<rank>\d+))?"
+    r"(?::(?P<trigger>epoch|step|save)(?P<at>\d+))?$"
+)
+
+
+@dataclass
+class FaultClause:
+    action: str
+    rank: int | None = None      # None = any rank
+    trigger: str | None = None   # epoch | step | save | None (= first)
+    at: int | None = None
+    raw: str = ""
+    fired: bool = False
+
+    def matches(self, point: str, rank: int | None, coords: dict) -> bool:
+        if self.fired or point not in _ACTION_POINTS[self.action]:
+            return False
+        if self.rank is not None and rank is not None and self.rank != rank:
+            return False
+        if self.trigger is None:
+            return True
+        value = coords.get(self.trigger)
+        if value is None:
+            return False
+        # step triggers fire on REACHING the step (the exact value may
+        # be skipped by accumulation/span granularity); epoch and save
+        # ordinals are exact.
+        if self.trigger == "step":
+            return int(value) >= self.at
+        return int(value) == self.at
+
+
+class FaultPlan:
+    """The parsed plan, bound to one rank. ``check`` matches without
+    side effects beyond the fired flag + the ``fault.injected`` event;
+    ``maybe_fire`` also executes self-executing actions (crash / hang /
+    the sleeps)."""
+
+    def __init__(
+        self,
+        clauses: list[FaultClause] | None = None,
+        *,
+        rank: int | None = None,
+        sleep_s: float = 3.0,
+        sleep_fn=time.sleep,
+    ):
+        self.clauses = list(clauses or [])
+        self.rank = rank
+        self.sleep_s = float(sleep_s)
+        self._sleep = sleep_fn
+        self._counts: dict[str, int] = {}
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def parse(
+        cls, spec: str, *, rank: int | None = None, sleep_s: float = 3.0
+    ) -> "FaultPlan":
+        clauses = []
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            m = _CLAUSE_RE.match(part)
+            if m is None or m.group("action") not in _ACTION_POINTS:
+                raise ValueError(
+                    f"Unparseable fault clause {part!r}; grammar: "
+                    "ACTION[@rankR][:epochN|stepN|saveN] with ACTION in "
+                    f"{sorted(_ACTION_POINTS)}"
+                )
+            clauses.append(
+                FaultClause(
+                    action=m.group("action"),
+                    rank=int(m.group("rank")) if m.group("rank") else None,
+                    trigger=m.group("trigger"),
+                    at=int(m.group("at")) if m.group("at") else None,
+                    raw=part,
+                )
+            )
+        return cls(clauses, rank=rank, sleep_s=sleep_s)
+
+    @classmethod
+    def from_env(cls, env=None) -> "FaultPlan":
+        env = env if env is not None else os.environ
+        return cls.parse(
+            env.get("DCT_FAULT_SPEC", ""),
+            rank=_rank_from_env(),
+            sleep_s=float(env.get("DCT_FAULT_SLEEP_S") or 3.0),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.clauses)
+
+    @property
+    def fired_count(self) -> int:
+        return sum(1 for c in self.clauses if c.fired)
+
+    # -- hook surface ---------------------------------------------------
+    def check(self, point: str, **coords) -> FaultClause | None:
+        """Match (and mark fired) the first armed clause for ``point``.
+        ``save`` ordinals are counted here so callers stay stateless."""
+        if not self.clauses:
+            return None
+        if point == "save":
+            self._counts["save"] = self._counts.get("save", 0) + 1
+            coords.setdefault("save", self._counts["save"])
+        for clause in self.clauses:
+            if clause.matches(point, self.rank, coords):
+                clause.fired = True
+                # On the record BEFORE the fault acts: a crash must not
+                # be able to outrun its own evidence.
+                _events.get_default().emit(
+                    "fault", "fault.injected",
+                    action=clause.action, point=point, spec=clause.raw,
+                    injected_rank=self.rank,
+                    **{k: v for k, v in coords.items() if v is not None},
+                )
+                return clause
+        return None
+
+    def maybe_fire(self, point: str, *, pre_exit=None, **coords):
+        """``check`` + execute. ``pre_exit`` runs before a ``crash``
+        exits (the trainer joins its in-flight resume save so the crash
+        leaves a deterministic resume point). Returns the clause for
+        caller-executed actions (``nan``), None otherwise."""
+        clause = self.check(point, **coords)
+        if clause is None:
+            return None
+        if clause.action == "crash":
+            if pre_exit is not None:
+                try:
+                    pre_exit()
+                except Exception:  # noqa: BLE001 — exit anyway: it's a crash
+                    pass
+            os._exit(FAULT_CRASH_EXIT)
+        if clause.action == "crash_save":
+            os._exit(FAULT_CRASH_EXIT)
+        if clause.action == "hang":
+            while True:  # PID-alive, progress-dead: the monitor's case
+                self._sleep(60.0)
+        if clause.action in ("slow_save", "slow_epoch"):
+            self._sleep(self.sleep_s)
+            return None
+        return clause  # nan: the caller poisons its staged arrays
+
+
+# ----------------------------------------------------------------------
+# Process-default plan, mirroring events.get_default(): the trainer
+# installs its config-built plan; layers without config plumbing (the
+# checkpoint manager) resolve the same instance so save ordinals and
+# fired flags are shared. Standalone processes parse the env lazily.
+
+_explicit: FaultPlan | None = None
+_cached: tuple[tuple, FaultPlan] | None = None
+
+_ENV_KEYS = ("DCT_FAULT_SPEC", "DCT_FAULT_SLEEP_S", "DCT_PROCESS_ID", "NODE_RANK")
+
+
+def set_default(plan: FaultPlan | None) -> None:
+    global _explicit
+    _explicit = plan
+
+
+def get_default() -> FaultPlan:
+    global _cached
+    if _explicit is not None:
+        return _explicit
+    key = tuple(os.environ.get(k) for k in _ENV_KEYS)
+    if _cached is not None and _cached[0] == key:
+        return _cached[1]
+    try:
+        plan = FaultPlan.from_env()
+    except ValueError:
+        # A malformed ambient spec must not crash layers that merely
+        # consult the plan; the trainer's explicit parse stays loud.
+        plan = FaultPlan()
+    _cached = (key, plan)
+    return plan

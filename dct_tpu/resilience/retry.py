@@ -1,0 +1,133 @@
+"""``with_retries``: backoff + jitter + transient-vs-fatal classification.
+
+A continuous-training cycle talks to services that flake independently
+of the training itself — the tracking/registry server, the deploy
+control plane. The reference aborts the whole Airflow task on the first
+``requests`` hiccup; here every network op is wrapped in one shared
+retry helper so a transient flake costs a backoff sleep instead of a
+cycle.
+
+Only *transient* failures retry: the ``classify`` predicate decides
+(default :func:`is_transient` — connection/timeout error types plus a
+name/message heuristic for SDK-wrapped 5xx/throttle errors). A fatal
+error (auth failure, 404, programming error) raises immediately —
+retrying those only delays the operator's page.
+
+Every retry is on the record: ``retry.attempt`` events carry the op
+name, attempt number and error, and ``retry.exhausted`` precedes the
+final raise, so "the registry was down for 40 s at 03:12" is a grep,
+not a reconstruction.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from dct_tpu.observability import events as _events
+
+#: Substrings (lowercased ``TypeName: message``) that mark an exception
+#: transient when its type alone does not — SDKs wrap timeouts and 5xxs
+#: in their own exception classes (mlflow's RestException, requests'
+#: wrappers), so the type check cannot be exhaustive.
+_TRANSIENT_MARKERS = (
+    "timeout", "timed out", "connection", "unavailable", "temporar",
+    "reset by peer", "refused", "bad gateway", "too many requests",
+    "throttl", "503", "502", "504", "econnreset", "broken pipe",
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Default classifier: retry network-ish failures, nothing else."""
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(m in text for m in _TRANSIENT_MARKERS)
+
+
+class Retrier:
+    """A reusable retry policy: ``retrier(fn, op="log_metrics")`` calls
+    ``fn()`` up to ``max_attempts`` times with exponential backoff."""
+
+    def __init__(
+        self,
+        *,
+        max_attempts: int = 3,
+        backoff_s: float = 0.5,
+        backoff_factor: float = 2.0,
+        jitter: float = 0.1,
+        classify=is_transient,
+        sleep_fn=time.sleep,
+        rng=random.random,
+    ):
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff_s = float(backoff_s)
+        self.backoff_factor = float(backoff_factor)
+        self.jitter = float(jitter)
+        self.classify = classify
+        self.sleep_fn = sleep_fn
+        self.rng = rng
+
+    @classmethod
+    def from_env(cls, env=None, **overrides) -> "Retrier":
+        """Policy from ``DCT_RETRY_MAX_ATTEMPTS`` / ``DCT_RETRY_BACKOFF_S``
+        (for layers without config plumbing, e.g. the tracking client)."""
+        import os
+
+        env = env if env is not None else os.environ
+        kw = dict(
+            max_attempts=int(env.get("DCT_RETRY_MAX_ATTEMPTS") or 3),
+            backoff_s=float(env.get("DCT_RETRY_BACKOFF_S") or 0.5),
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based failed attempts)."""
+        base = self.backoff_s * self.backoff_factor ** (attempt - 1)
+        return base * (1.0 + self.jitter * self.rng())
+
+    def __call__(self, fn, *, op: str = "call"):
+        last: BaseException | None = None
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn()
+            except BaseException as e:  # noqa: BLE001 — classified below
+                last = e
+                if not self.classify(e) or attempt >= self.max_attempts:
+                    if attempt > 1 or self.classify(e):
+                        _events.get_default().emit(
+                            "retry", "retry.exhausted",
+                            op=op, attempts=attempt, error=repr(e),
+                        )
+                    raise
+                pause = self.delay(attempt)
+                _events.get_default().emit(
+                    "retry", "retry.attempt",
+                    op=op, attempt=attempt, backoff_s=round(pause, 3),
+                    error=repr(e),
+                )
+                self.sleep_fn(pause)
+        raise last  # unreachable; keeps type-checkers honest
+
+
+def with_retries(
+    fn,
+    *,
+    op: str = "call",
+    max_attempts: int = 3,
+    backoff_s: float = 0.5,
+    backoff_factor: float = 2.0,
+    jitter: float = 0.1,
+    classify=is_transient,
+    sleep_fn=time.sleep,
+):
+    """One-shot form: run ``fn()`` under a fresh :class:`Retrier`."""
+    return Retrier(
+        max_attempts=max_attempts,
+        backoff_s=backoff_s,
+        backoff_factor=backoff_factor,
+        jitter=jitter,
+        classify=classify,
+        sleep_fn=sleep_fn,
+    )(fn, op=op)

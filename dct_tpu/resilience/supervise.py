@@ -1,0 +1,99 @@
+"""``python -m dct_tpu.resilience.supervise [opts] -- cmd...``: the
+supervised launch block as a CLI.
+
+Wraps :meth:`LocalProcessLauncher.supervise` so a DAG's BashOperator (or
+an operator's shell) gets crash/hang/preemption healing without writing
+Python: the command is launched ``--world-size`` times with coordinator
+env, babysat via heartbeats (stall-kill armed), and relaunched with
+resume + backoff per the restart policy. Defaults come from the same
+``DCT_*`` env contract as everything else, so the DAG needs no new
+plumbing to tune it.
+
+Exit code: 0 on (possibly healed) success; ``EXIT_PREEMPTED`` when the
+final state is a graceful preemption (Airflow retries see "resume me");
+1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from dct_tpu.resilience.supervisor import EXIT_PREEMPTED
+
+
+def _env_default(name: str, fallback: str) -> str:
+    return os.environ.get(name) or fallback
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dct_tpu.resilience.supervise",
+        description="Supervised relaunch-and-resume for SPMD training "
+        "(docs/ROBUSTNESS.md).",
+    )
+    parser.add_argument(
+        "--world-size", type=int,
+        default=int(_env_default("DCT_WORLD_SIZE", "1")),
+    )
+    parser.add_argument(
+        "--max-restarts", type=int,
+        default=int(_env_default("DCT_MAX_RESTARTS", "2")),
+    )
+    parser.add_argument(
+        "--backoff", type=float,
+        default=float(_env_default("DCT_RESTART_BACKOFF_S", "5")),
+    )
+    parser.add_argument(
+        "--backoff-factor", type=float,
+        default=float(_env_default("DCT_RESTART_BACKOFF_FACTOR", "2")),
+    )
+    parser.add_argument(
+        "--jitter", type=float,
+        default=float(_env_default("DCT_RESTART_JITTER", "0.1")),
+    )
+    parser.add_argument(
+        "--timeout", type=float,
+        default=float(_env_default("DCT_LAUNCH_TIMEOUT_S", "10800")),
+    )
+    parser.add_argument(
+        "--stall-seconds", type=float,
+        default=float(_env_default("DCT_HEARTBEAT_STALL_SECONDS", "120")),
+    )
+    parser.add_argument(
+        "--grace", type=float,
+        default=float(_env_default("DCT_PREEMPT_GRACE_S", "30")),
+    )
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="-- then the rank command")
+    args = parser.parse_args(argv)
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no command given (append: -- python3 jobs/train_tpu.py)")
+
+    from dct_tpu.launch.launcher import LocalProcessLauncher
+
+    launcher = LocalProcessLauncher(
+        timeout=args.timeout,
+        heartbeat_stall_seconds=args.stall_seconds,
+        preempt_grace_s=args.grace,
+        stall_kill=True,
+    )
+    res = launcher.supervise(
+        cmd,
+        world_size=args.world_size,
+        max_restarts=args.max_restarts,
+        backoff_s=args.backoff,
+        backoff_factor=args.backoff_factor,
+        jitter=args.jitter,
+    )
+    if res.success:
+        return 0
+    return EXIT_PREEMPTED if res.classification == "preempted" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

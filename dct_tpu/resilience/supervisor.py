@@ -1,0 +1,117 @@
+"""The supervisor's failure model: exit-code contract, classifier,
+restart policy.
+
+The reference's only failure story is "the Airflow task goes red" —
+every nonzero exit looks identical, so the orchestrator cannot tell a
+preempted host (relaunch immediately, nothing is wrong) from a NaN'd
+run (relaunching re-diverges deterministically) from a broken ssh
+control plane (retrying the *training* fixes nothing). The contract
+here gives each failure family a distinct exit code, and
+:func:`classify_failure` maps a world's exit codes (+ what the launcher
+observed: stall-kills, timeouts) to a restart decision.
+
+Exit-code contract (chosen outside the shell's reserved ranges; 75 is
+BSD ``EX_TEMPFAIL`` — "temporary failure, retry"):
+
+=======================  ====  ==============================================
+constant                 code  meaning
+=======================  ====  ==============================================
+EXIT_PREEMPTED            75   graceful preemption: the rank saved a resume
+                               checkpoint and exited on SIGTERM (resumable,
+                               does NOT consume restart budget)
+EXIT_HEALTH_HALT          76   training-health halt (NaN/spike under a
+                               halting policy): deterministic — relaunching
+                               from the same checkpoint re-diverges, so the
+                               supervisor gives up immediately
+EXIT_INFRA_HEALTHCHECK    21   a host failed the pre-launch healthcheck
+                               (launcher scripts) — infra, not training
+EXIT_INFRA_CLEANUP        22   the zombie-cleanup exec transport failed
+                               (ssh/docker unreachable) — infra
+faults.FAULT_CRASH_EXIT  117   an injected ``crash`` — classified as an
+                               ordinary crash (that is the point of drills)
+=======================  ====  ==============================================
+
+Negative return codes are signal deaths — normally the launcher's own
+fail-fast/stall-kill escalation (SIGTERM -> SIGKILL) reaping survivors
+of the real failure, so they never dominate classification.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+EXIT_PREEMPTED = 75
+EXIT_HEALTH_HALT = 76
+EXIT_INFRA_HEALTHCHECK = 21
+EXIT_INFRA_CLEANUP = 22
+
+#: Classifications whose failures a supervisor may relaunch-and-resume.
+RESUMABLE = ("preempted", "crash", "hang", "infra")
+
+#: Classifications that do not consume the restart budget: routine
+#: events (Podracer-style fleets treat preemption as weather, not
+#: failure), bounded instead by the supervisor's absolute attempt cap.
+FREE_RESTARTS = ("preempted",)
+
+
+def classify_failure(
+    returncodes,
+    *,
+    stall_killed: bool = False,
+    timed_out: bool = False,
+) -> str:
+    """One world -> one classification.
+
+    Priority: infra > health_halt > hang > crash > preempted. A real
+    positive failure code dominates the peers our own escalation killed
+    (negative codes) and any rank that managed a graceful 75 on the way
+    down — the world crashed, not preempted.
+    """
+    codes = [int(c) for c in returncodes]
+    if codes and all(c == 0 for c in codes):
+        return "success"
+    if any(c in (EXIT_INFRA_HEALTHCHECK, EXIT_INFRA_CLEANUP) for c in codes):
+        return "infra"
+    if any(c == EXIT_HEALTH_HALT for c in codes):
+        return "health_halt"
+    if stall_killed or timed_out:
+        return "hang"
+    hard = [c for c in codes if c > 0 and c != EXIT_PREEMPTED]
+    if hard:
+        return "crash"
+    if any(c == EXIT_PREEMPTED for c in codes):
+        return "preempted"
+    # Only signal deaths and no observed cause: treat as a crash (an
+    # external OOM-killer / operator kill is a crash from our seat).
+    return "crash"
+
+
+@dataclass
+class RestartPolicy:
+    """Exponential backoff between supervised relaunches.
+
+    ``delay(n)`` is the pause before the (n+1)-th restart (n = restarts
+    already consumed): ``backoff_s * factor**n``, stretched by up to
+    ``jitter`` fractional random slack so a fleet of supervisors
+    recovering from one fabric event does not thundering-herd the
+    coordinator port.
+    """
+
+    max_restarts: int = 2
+    backoff_s: float = 1.0
+    backoff_factor: float = 2.0
+    jitter: float = 0.1
+    rng: object = field(default=random.random, repr=False)
+
+    def delay(self, restarts_used: int) -> float:
+        base = self.backoff_s * self.backoff_factor ** max(0, restarts_used)
+        return base * (1.0 + self.jitter * self.rng())
+
+    def allows(self, restarts_used: int, classification: str) -> bool:
+        """May the supervisor relaunch after this failure?"""
+        if classification not in RESUMABLE:
+            return False
+        if classification in FREE_RESTARTS:
+            return True
+        return restarts_used < self.max_restarts

@@ -187,22 +187,37 @@ class LocalTracking:
 
 
 class MlflowTracking:
-    """Thin adapter over a real MLflow server (import gated)."""
+    """Thin adapter over a real MLflow server (import gated).
+
+    Every network op runs under :class:`~dct_tpu.resilience.retry.Retrier`
+    (``DCT_RETRY_MAX_ATTEMPTS`` / ``DCT_RETRY_BACKOFF_S``): the tracking
+    server is the model-selection database of the platform, but a
+    transient registry flake must cost a backoff sleep, not the training
+    cycle. Fatal errors (auth, bad request) still raise immediately.
+    """
 
     def __init__(self, tracking_uri: str, experiment: str = "weather_forecasting"):
         import mlflow  # gated: present on training-host images, not required here
 
+        from dct_tpu.resilience.retry import Retrier
+
         self._mlflow = mlflow
+        self._retry = Retrier.from_env()
         mlflow.set_tracking_uri(tracking_uri)
-        mlflow.set_experiment(experiment)
+        self._retry(
+            lambda: mlflow.set_experiment(experiment), op="set_experiment"
+        )
         self.experiment = experiment
         self._run = None
 
     def start_run(self, params: dict | None = None) -> str:
-        self._run = self._mlflow.start_run()
+        self._run = self._retry(self._mlflow.start_run, op="start_run")
         if params:
-            self._mlflow.log_params(
-                {k: v for k, v in params.items() if v is not None}
+            self._retry(
+                lambda: self._mlflow.log_params(
+                    {k: v for k, v in params.items() if v is not None}
+                ),
+                op="log_params",
             )
         log = _events.get_default()
         try:
@@ -220,27 +235,45 @@ class MlflowTracking:
         return self._run.info.run_id
 
     def log_metrics(self, metrics: dict, step: int) -> None:
-        self._mlflow.log_metrics({k: float(v) for k, v in metrics.items()}, step=step)
+        self._retry(
+            lambda: self._mlflow.log_metrics(
+                {k: float(v) for k, v in metrics.items()}, step=step
+            ),
+            op="log_metrics",
+        )
 
     def log_artifact(self, local_path: str, artifact_path: str) -> None:
-        self._mlflow.log_artifact(local_path, artifact_path=artifact_path)
+        self._retry(
+            lambda: self._mlflow.log_artifact(
+                local_path, artifact_path=artifact_path
+            ),
+            op="log_artifact",
+        )
 
     def end_run(self, status: str = "FINISHED") -> None:
         run_id = self._run.info.run_id if self._run is not None else None
-        self._mlflow.end_run(status=status)
+        self._retry(
+            lambda: self._mlflow.end_run(status=status), op="end_run"
+        )
         _events.get_default().emit(
             "tracking", "run_end", tracking_run_id=run_id, status=status,
         )
 
     def search_best_run(self, metric: str = "val_loss", mode: str = "min") -> RunInfo | None:
         order = "ASC" if mode == "min" else "DESC"
-        exp = self._mlflow.get_experiment_by_name(self.experiment)
+        exp = self._retry(
+            lambda: self._mlflow.get_experiment_by_name(self.experiment),
+            op="get_experiment",
+        )
         if exp is None:
             return None
-        runs = self._mlflow.search_runs(
-            experiment_ids=[exp.experiment_id],
-            order_by=[f"metrics.{metric} {order}"],
-            max_results=1,
+        runs = self._retry(
+            lambda: self._mlflow.search_runs(
+                experiment_ids=[exp.experiment_id],
+                order_by=[f"metrics.{metric} {order}"],
+                max_results=1,
+            ),
+            op="search_runs",
         )
         if len(runs) == 0:
             return None
@@ -262,8 +295,11 @@ class MlflowTracking:
         # 2.x API is mlflow.artifacts.download_artifacts (keyword-only).
         from mlflow import artifacts
 
-        return artifacts.download_artifacts(
-            run_id=run_id, artifact_path=artifact_path, dst_path=dst
+        return self._retry(
+            lambda: artifacts.download_artifacts(
+                run_id=run_id, artifact_path=artifact_path, dst_path=dst
+            ),
+            op="download_artifacts",
         )
 
 

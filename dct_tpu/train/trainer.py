@@ -54,9 +54,11 @@ from dct_tpu.parallel.sharding_rules import (
 )
 from dct_tpu.observability.events import event_log_from_config
 from dct_tpu.observability.goodput import GoodputLedger
-from dct_tpu.observability.health import HealthMonitor
+from dct_tpu.observability.health import HealthMonitor, TrainingHealthError
 from dct_tpu.observability.heartbeat import HeartbeatWriter
 from dct_tpu.observability.spans import recorder_from_config
+from dct_tpu.resilience import faults as _faults
+from dct_tpu.resilience.preempt import PreemptedError, PreemptionGuard
 from dct_tpu.tracking.client import get_tracker
 from dct_tpu.train.state import create_train_state
 from dct_tpu.utils.profiling import EpochTimer, Profiler, annotate
@@ -200,8 +202,28 @@ class Trainer:
         # norm) flows through the monitor; findings become health.*
         # events and, under a halting policy, stop the run.
         health = HealthMonitor.from_config(cfg.obs, emit=events.emit)
+        # Resilience plane: the deterministic fault plan (installed as
+        # the process default so the checkpoint tiers consult the SAME
+        # instance — shared save ordinals and fired flags), and the
+        # graceful-preemption guard. The SIGTERM handler only sets a
+        # flag; the trainer honors it at the next step/span boundary.
+        plan = _faults.FaultPlan.parse(
+            cfg.resilience.fault_spec,
+            rank=jax.process_index(),
+            sleep_s=cfg.resilience.fault_sleep_s,
+        )
+        _faults.set_default(plan)
+        guard = PreemptionGuard()
+        if cfg.resilience.graceful_preemption:
+            guard.install()
         ledger = GoodputLedger()
         ledger.start()
+        # Supervised-relaunch accounting: the wall clock the failed
+        # attempts (and backoff) cost this cycle, booked as
+        # startup_recovery badput so the healed run's goodput fraction
+        # reflects what the failure actually cost.
+        if cfg.resilience.startup_debt_s > 0:
+            ledger.add("startup_recovery", cfg.resilience.startup_debt_s)
         heartbeat = None
         if cfg.obs.enabled and cfg.obs.heartbeat_dir:
             heartbeat = HeartbeatWriter(
@@ -548,6 +570,15 @@ class Trainer:
                 per = []
                 for e in range(e0, e0 + k):
                     xs, ys, ws = self._stack_epoch(train_loader, e)
+                    # Data-pipeline fault hook: a `nan` clause poisons
+                    # this epoch's staged features, so the non-finite
+                    # loss arrives through the REAL compute path and the
+                    # health policy (warn/halt) is exercised end-to-end.
+                    if plan.enabled and plan.check("data", epoch=e):
+                        import numpy as _np
+
+                        xs = _np.array(xs, copy=True)
+                        xs[0, ...] = _np.nan
                     if accum > 1:
                         # Whole accumulation groups only; the ragged tail
                         # (< accum batches) is dropped, like drop_last on
@@ -584,6 +615,7 @@ class Trainer:
         ledger.add("startup_recovery", ledger.clock() - _t_startup)
         startup_span.end(resumed=start_epoch > 0)
         completed = False
+        preempted = False
         # In-flight phase spans, tracked so a crash mid-epoch still
         # records them (Span.end is idempotent: the success path's own
         # end() wins and the crash-path sweep becomes a no-op).
@@ -591,6 +623,15 @@ class Trainer:
         try:
             epoch = start_epoch
             while epoch < target_epochs:
+                # Trainer fault hook at the epoch boundary (`crash` /
+                # `hang` / `slow_epoch` clauses). A crash first joins
+                # any in-flight resume-snapshot write so the death
+                # leaves a deterministic resume point — torn-write
+                # recovery has its own injector (`crash_save`).
+                if plan.enabled:
+                    plan.maybe_fire(
+                        "epoch", epoch=epoch, pre_exit=state_ckptr.wait
+                    )
                 k = min(chunk, target_epochs - epoch) if use_scan else 1
                 profiler.maybe_start_span(epoch, k)
                 # One span per dispatch unit: the trace's "trainer
@@ -713,6 +754,14 @@ class Trainer:
                                 step=global_step + i + 1,
                             )
                     global_step += flat.size
+                    # Step-trigger faults on the scan path fire at the
+                    # span boundary — steps inside a fused dispatch are
+                    # not individually interruptible from the host.
+                    if plan.enabled:
+                        plan.maybe_fire(
+                            "step", step=global_step,
+                            pre_exit=state_ckptr.wait,
+                        )
                     # Health pass over the span's per-step losses and
                     # grad norms BEFORE any epoch bookkeeping: under a
                     # halting policy the run stops here — no epoch_end,
@@ -758,6 +807,11 @@ class Trainer:
                     loss_sum = 0.0
                     n_steps = 0
                     n_updates = 0
+                    # Data-pipeline fault hook (eager path): poison the
+                    # epoch's first staged group.
+                    poison = plan.enabled and bool(
+                        plan.check("data", epoch=epoch)
+                    )
                     pending: list = []
                     for batch in train_loader.epoch(epoch):
                         pending.append(batch)
@@ -776,6 +830,10 @@ class Trainer:
                                     pending[0].x, pending[0].y,
                                     pending[0].weight,
                                 )
+                            if poison:
+                                poison = False
+                                bx = _np.array(bx, copy=True)
+                                bx[0, ...] = _np.nan
                             x, y, w = make_global_batch(self.mesh, bx, by, bw)
                         pending = []
                         # The device_get of the loss is the step's real
@@ -785,6 +843,16 @@ class Trainer:
                             m_host = jax.device_get(metrics)
                             loss_host = float(m_host["train_loss"])
                         global_step += 1
+                        # Step-trigger faults (`crash@...:stepN` /
+                        # `hang@...:stepN`): fired after the step's sync
+                        # point, before this step's heartbeat — a hung
+                        # rank stops beating exactly here, which is what
+                        # the stall monitor exists to see.
+                        if plan.enabled:
+                            plan.maybe_fire(
+                                "step", step=global_step,
+                                pre_exit=state_ckptr.wait,
+                            )
                         # Per-step health: a halting policy stops the
                         # run MID-epoch on the eager path (epoch span
                         # closed first so the halted epoch is on the
@@ -809,6 +877,23 @@ class Trainer:
                         if global_step % cfg.train.log_every_n_steps == 0:
                             self.tracker.log_metrics(
                                 {"train_loss": loss_host}, step=global_step
+                            )
+                        # Graceful preemption (eager path): the in-flight
+                        # step just finished and synced — save a resume
+                        # checkpoint NOW (epochs_completed = the last
+                        # full epoch: resume restarts this one, losing
+                        # under one epoch of progress) and exit
+                        # PREEMPTED via the entry point.
+                        if guard.requested:
+                            epoch_span.end(preempted=True)
+                            self._preempt_exit(
+                                guard, events, state_ckptr,
+                                state=jax.device_put(
+                                    state, declared_shardings
+                                ),
+                                epochs_completed=epoch,
+                                target_epochs=target_epochs,
+                                opt_identity=opt_identity,
                             )
                     # A ragged tail (< accum batches) is dropped, matching
                     # the scan path's group-granular drop_last.
@@ -972,10 +1057,33 @@ class Trainer:
                 ckpt_span.end()
                 epoch_span.end(val_loss=sub_epochs[-1][1])
                 epoch += k
+                # Graceful preemption at the span boundary: the span's
+                # resume snapshot (epochs_completed = epoch) was just
+                # submitted — join it so the checkpoint is durable, then
+                # exit PREEMPTED. With epoch_chunk=1 at most one epoch
+                # of progress is in flight when SIGTERM lands, so the
+                # resume loses at most that epoch.
+                if guard.requested:
+                    self._preempt_exit(
+                        guard, events, state_ckptr, epochs_completed=epoch
+                    )
                 if stop_early:
                     break
             completed = True
 
+        except PreemptedError:
+            preempted = True
+            # Cooperative exit: close the tracking run (a preempt+resume
+            # fleet would otherwise accumulate one phantom RUNNING run on
+            # the MLflow server per preemption). Best-effort — closing
+            # the books must never mask the preemption itself.
+            self._end_tracking_quietly("KILLED")
+            raise
+        except TrainingHealthError:
+            # Also a controlled raise (HealthMonitor.raise_on): mark the
+            # run failed instead of leaking it as RUNNING.
+            self._end_tracking_quietly("FAILED")
+            raise
         finally:
             # Crash-path hygiene: never leave a jax.profiler session open,
             # a resume-state write un-joined, or the prefetch thread
@@ -991,33 +1099,47 @@ class Trainer:
                         if prefetch_pool is not None:
                             prefetch_pool.shutdown(wait=True)
                     finally:
+                        # The SIGTERM contract ends here either way:
+                        # restore the previous handler so post-training
+                        # code (and whatever embeds us) keeps its own
+                        # semantics.
+                        guard.uninstall()
                         # Terminal heartbeat: "done" stops the monitor
-                        # ageing this rank; "failed" names a crash that
-                        # an exit code alone cannot (the rank may be
-                        # killed by fail-fast before it can exit).
+                        # ageing this rank; "preempted" and "failed"
+                        # name ends an exit code alone cannot (the rank
+                        # may be killed by fail-fast before it can exit).
                         if heartbeat is not None:
                             heartbeat.beat(
-                                phase="done" if completed else "failed",
+                                phase="done" if completed else (
+                                    "preempted" if preempted else "failed"
+                                ),
                                 force=True,
                             )
-                        if not completed:
+                        if preempted:
+                            events.emit(
+                                "trainer", "fit_preempted",
+                                epochs_run=len(history),
+                            )
+                        elif not completed:
                             events.emit(
                                 "trainer", "fit_failed",
                                 health=health.summary()["events"],
                             )
-                            # The crashing epoch is exactly the window
-                            # the operator opens the trace to inspect:
-                            # record any span still in flight.
+                        if not completed:
+                            # The crashing/preempted epoch is exactly
+                            # the window the operator opens the trace to
+                            # inspect: record any span still in flight.
                             for _sp in (dispatch_span, ckpt_span,
                                         epoch_span):
                                 if _sp is not None:
-                                    _sp.end(error=True)
+                                    _sp.end(error=not preempted)
                         # Fit span closes HERE, success or failure: a
                         # post-training tail error (artifact upload,
                         # tracker teardown) must not orphan the whole
                         # rank's span tree from its recorded root.
                         fit_span.end(
                             completed=completed,
+                            preempted=preempted,
                             epochs_run=len(history),
                             val_loss=(
                                 history[-1]["val_loss"]
@@ -1086,6 +1208,10 @@ class Trainer:
                 samples_per_sec=timer.samples_per_sec,
                 val_loss=final_vl,
                 health=health.summary(),
+                resilience={
+                    "faults_injected": plan.fired_count,
+                    "startup_debt_s": cfg.resilience.startup_debt_s,
+                },
             )
         self.tracker.end_run()
 
@@ -1119,6 +1245,57 @@ class Trainer:
             goodput=goodput_summary,
             run_correlation_id=events.run_id,
             health=health_summary,
+        )
+
+    # ------------------------------------------------------------------
+    def _end_tracking_quietly(self, status: str) -> None:
+        try:
+            self.tracker.end_run(status=status)
+        except Exception:  # noqa: BLE001 — bookkeeping must not mask the exit
+            pass
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _preempt_exit(
+        guard,
+        events,
+        ckptr,
+        *,
+        epochs_completed: int,
+        state=None,
+        target_epochs: int | None = None,
+        opt_identity: dict | None = None,
+    ):
+        """Honor a SIGTERM: make the resume checkpoint durable, put the
+        preemption on the record, raise :class:`PreemptedError` (the
+        entry point maps it to ``EXIT_PREEMPTED``).
+
+        ``state=None`` means the span boundary just submitted the right
+        snapshot asynchronously — joining it is the synchronous save;
+        the eager path passes the live state for an explicit save.
+        """
+        if state is not None:
+            ckptr.save(
+                state,
+                meta={
+                    "epochs_completed": int(epochs_completed),
+                    "target_epochs": int(target_epochs),
+                    "optimizer": opt_identity,
+                },
+            )
+        else:
+            ckptr.wait()
+        events.emit(
+            "trainer", "preempt.signal_received",
+            signal_time=guard.signal_time,
+        )
+        events.emit(
+            "trainer", "preempt.checkpoint_saved",
+            epochs_completed=int(epochs_completed), dir=ckptr.dirpath,
+        )
+        raise PreemptedError(
+            f"SIGTERM honored: resume checkpoint durable at "
+            f"epochs_completed={int(epochs_completed)}"
         )
 
     # ------------------------------------------------------------------

@@ -53,8 +53,27 @@ def main() -> int:
         jax.devices()[0].platform,
     )
 
+    from dct_tpu.observability.health import TrainingHealthError
+    from dct_tpu.resilience import (
+        EXIT_HEALTH_HALT,
+        EXIT_PREEMPTED,
+        PreemptedError,
+    )
+
     trainer = Trainer(cfg)
-    result = trainer.fit()
+    try:
+        result = trainer.fit()
+    except PreemptedError as e:
+        # Graceful preemption: the resume checkpoint is durable. The
+        # distinct code tells the supervisor "resumable, not failed" —
+        # relaunch with DCT_RESUME=1, no restart budget consumed.
+        log.warning("preempted: %s", e)
+        return EXIT_PREEMPTED
+    except TrainingHealthError as e:
+        # Health halt: deterministic — a relaunch from the same
+        # checkpoint re-diverges, so the supervisor must NOT retry.
+        log.error("training-health halt: %s", e)
+        return EXIT_HEALTH_HALT
 
     log.info(
         "done: val_loss=%.4f val_acc=%.4f samples/sec=%.1f best=%s",

@@ -1,0 +1,624 @@
+"""Resilience unit tests: fault-spec grammar, retry policy, failure
+classification, restart policy, preemption guard, atomic checkpoint
+publishes under injected crashes, infra exit codes, and the supervised
+relaunch loop (with fake ranks — the real trainer rig is
+test_resilience_e2e.py)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from dct_tpu.resilience.faults import FAULT_CRASH_EXIT, FaultPlan
+from dct_tpu.resilience.preempt import PreemptionGuard
+from dct_tpu.resilience.retry import Retrier, is_transient
+from dct_tpu.resilience.supervisor import (
+    EXIT_HEALTH_HALT,
+    EXIT_INFRA_CLEANUP,
+    EXIT_INFRA_HEALTHCHECK,
+    EXIT_PREEMPTED,
+    RestartPolicy,
+    classify_failure,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- fault-spec grammar -------------------------------------------------
+
+
+def test_fault_spec_parses_the_documented_grammar():
+    plan = FaultPlan.parse(
+        "crash@rank1:epoch2,hang@rank0:step10,nan@rank1:epoch1,slow_save"
+    )
+    assert [(c.action, c.rank, c.trigger, c.at) for c in plan.clauses] == [
+        ("crash", 1, "epoch", 2),
+        ("hang", 0, "step", 10),
+        ("nan", 1, "epoch", 1),
+        ("slow_save", None, None, None),
+    ]
+
+
+def test_fault_spec_rejects_unknown_clauses():
+    with pytest.raises(ValueError, match="grammar"):
+        FaultPlan.parse("explode@rank0:epoch1")
+    with pytest.raises(ValueError, match="grammar"):
+        FaultPlan.parse("crash@rank0:minute5")
+
+
+def test_empty_spec_is_inert():
+    plan = FaultPlan.parse("")
+    assert not plan.enabled
+    assert plan.check("epoch", epoch=0) is None
+    assert plan.from_env({}).enabled is False
+
+
+def test_rank_filter_and_single_fire():
+    plan = FaultPlan.parse("nan@rank1:epoch1", rank=0)
+    assert plan.check("data", epoch=1) is None  # wrong rank
+    plan = FaultPlan.parse("nan@rank1:epoch1", rank=1)
+    assert plan.check("data", epoch=0) is None  # wrong epoch
+    clause = plan.check("data", epoch=1)
+    assert clause is not None and clause.action == "nan"
+    assert plan.check("data", epoch=1) is None  # fires at most once
+    assert plan.fired_count == 1
+
+
+def test_step_trigger_fires_on_reaching_the_step():
+    plan = FaultPlan.parse("nan:epoch0,hang:step10")
+    # step hooks may skip the exact value (span granularity) — >= fires.
+    assert plan.clauses[1].matches("step", None, {"step": 12})
+    assert not plan.clauses[1].matches("step", None, {"step": 9})
+    # actions only fire at their own hook points.
+    assert plan.check("step", step=3) is None
+    assert plan.check("data", epoch=0).action == "nan"
+
+
+def test_save_ordinals_counted_by_the_plan(tmp_path, monkeypatch):
+    from dct_tpu.observability import events as _events
+
+    # An earlier in-process trainer may have pinned its own event log as
+    # the process default; fall back to the env-built one for this test.
+    monkeypatch.setattr(_events, "_explicit", None)
+    monkeypatch.setenv("DCT_EVENTS_DIR", str(tmp_path / "ev"))
+    monkeypatch.setenv("DCT_RUN_ID", "dct-faulttest")
+    plan = FaultPlan.parse("slow_save:save2", sleep_s=0.01)
+    sleeps = []
+    plan._sleep = sleeps.append
+    assert plan.maybe_fire("save") is None  # save 1: no match
+    assert plan.maybe_fire("save") is None  # save 2: slow_save sleeps
+    assert sleeps == [0.01]
+    # The injection is on the record.
+    recs = [
+        json.loads(line)
+        for line in open(tmp_path / "ev" / "events.jsonl")
+    ]
+    assert [(r["component"], r["event"]) for r in recs] == [
+        ("fault", "fault.injected")
+    ]
+    assert recs[0]["action"] == "slow_save" and recs[0]["save"] == 2
+
+
+# -- retry policy -------------------------------------------------------
+
+
+def test_retry_recovers_from_transient_flakes():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("registry reset by peer")
+        return "ok"
+
+    r = Retrier(max_attempts=3, backoff_s=0.1, jitter=0.0,
+                sleep_fn=sleeps.append)
+    assert r(flaky, op="t") == "ok"
+    assert sleeps == [0.1, 0.2]  # exponential
+
+
+def test_retry_exhausted_reraises():
+    r = Retrier(max_attempts=2, backoff_s=0.0, jitter=0.0,
+                sleep_fn=lambda _s: None)
+    with pytest.raises(TimeoutError):
+        r(lambda: (_ for _ in ()).throw(TimeoutError("boom")), op="t")
+
+
+def test_fatal_errors_do_not_retry():
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise KeyError("not transient")
+
+    r = Retrier(max_attempts=5, backoff_s=0.0, sleep_fn=lambda _s: None)
+    with pytest.raises(KeyError):
+        r(fatal, op="t")
+    assert calls["n"] == 1
+
+
+def test_transient_classifier():
+    assert is_transient(ConnectionError("x"))
+    assert is_transient(TimeoutError("x"))
+    assert is_transient(RuntimeError("503 Service Unavailable"))
+    assert is_transient(OSError("Connection reset by peer"))
+    assert not is_transient(KeyError("val_loss"))
+    assert not is_transient(ValueError("bad payload"))
+
+
+# -- failure classification + restart policy ----------------------------
+
+
+@pytest.mark.parametrize(
+    "codes,kw,expect",
+    [
+        ([0, 0], {}, "success"),
+        ([0, 7], {}, "crash"),
+        ([0, FAULT_CRASH_EXIT], {}, "crash"),
+        ([-9, 1], {}, "crash"),  # real failure dominates our kill
+        ([EXIT_PREEMPTED, EXIT_PREEMPTED], {}, "preempted"),
+        ([EXIT_PREEMPTED, -9], {}, "preempted"),  # escalation reaped peer
+        ([EXIT_PREEMPTED, 7], {}, "crash"),  # a crash is a crash
+        ([0, EXIT_HEALTH_HALT], {}, "health_halt"),
+        ([EXIT_INFRA_HEALTHCHECK], {}, "infra"),
+        ([EXIT_INFRA_CLEANUP], {}, "infra"),
+        ([-9, -9], {}, "crash"),  # killed externally, cause unknown
+        ([-9, 0], {"stall_killed": True}, "hang"),
+        ([-9, 0], {"timed_out": True}, "hang"),
+    ],
+)
+def test_classify_failure(codes, kw, expect):
+    assert classify_failure(codes, **kw) == expect
+
+
+def test_restart_policy_backoff_and_budget():
+    p = RestartPolicy(max_restarts=2, backoff_s=1.0, backoff_factor=2.0,
+                      jitter=0.0)
+    assert [p.delay(i) for i in range(3)] == [1.0, 2.0, 4.0]
+    assert p.allows(0, "crash") and p.allows(1, "hang")
+    assert not p.allows(2, "crash")  # budget spent
+    assert p.allows(99, "preempted")  # preemption never consumes budget
+    assert not p.allows(0, "health_halt")  # deterministic: never retry
+    assert not p.allows(0, "success")
+
+
+# -- preemption guard ---------------------------------------------------
+
+
+def test_preemption_guard_flags_sigterm_and_restores_handler():
+    prev = signal.getsignal(signal.SIGTERM)
+    guard = PreemptionGuard().install()
+    try:
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.requested
+        assert guard.signal_time is not None
+    finally:
+        guard.uninstall()
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+# -- infra exit codes in the generated scripts --------------------------
+
+
+def test_healthcheck_failure_exits_infra_code():
+    from dct_tpu.launch.launcher import build_healthcheck_script
+
+    script = build_healthcheck_script(
+        ["h0", "h1"], exec_template="bash -c {cmd}", check_command="false"
+    )
+    proc = subprocess.run(["bash", "-c", script], capture_output=True,
+                          text=True)
+    assert proc.returncode == EXIT_INFRA_HEALTHCHECK
+    assert "Healthcheck failed on h0" in proc.stdout
+
+
+def test_cleanup_transport_failure_exits_infra_code():
+    from dct_tpu.launch.launcher import build_zombie_cleanup_script
+
+    # An exec transport that always fails (ssh unreachable analog).
+    script = build_zombie_cleanup_script(
+        ["h0"], exec_template="false {host} {cmd}", pattern="train_tpu.py"
+    )
+    proc = subprocess.run(["bash", "-c", script], capture_output=True,
+                          text=True)
+    assert proc.returncode == EXIT_INFRA_CLEANUP
+    assert "transport failed on h0" in proc.stdout
+
+
+def test_cleanup_no_zombies_still_succeeds():
+    from dct_tpu.launch.launcher import build_zombie_cleanup_script
+
+    script = build_zombie_cleanup_script(
+        ["h0"], exec_template="bash -c {cmd}",
+        pattern="no_such_process_pattern_xyz", settle_seconds=0,
+    )
+    proc = subprocess.run(["bash", "-c", script], capture_output=True,
+                          text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_launch_script_propagates_preemption_distinctly():
+    from dct_tpu.launch.launcher import build_spmd_launch_script
+
+    script = build_spmd_launch_script(
+        ["h0", "h1"],
+        f"sh -c 'exit {EXIT_PREEMPTED}'",
+        exec_template="bash -c {cmd}",
+        stagger_seconds=0,
+        fail_fast_poll_seconds=1,
+    )
+    proc = subprocess.run(["bash", "-c", script], capture_output=True,
+                          text=True)
+    assert proc.returncode == EXIT_PREEMPTED
+    assert "resumable" in proc.stdout
+    # ...but a hard failure still dominates a graceful peer. Rank 0
+    # lingers so rank 1's hard exit is the first one reaped — otherwise
+    # the orderings race and either rank can be the fail-fast trigger.
+    script = build_spmd_launch_script(
+        ["h0", "h1"],
+        f"sh -c 'if [ $NODE_RANK -eq 1 ]; then exit 7; "
+        f"else sleep 10; exit {EXIT_PREEMPTED}; fi'",
+        exec_template="bash -c {cmd}",
+        stagger_seconds=0,
+        fail_fast_poll_seconds=1,
+    )
+    proc = subprocess.run(["bash", "-c", script], capture_output=True,
+                          text=True)
+    assert proc.returncode == 1
+
+
+# -- atomic checkpoint publishes under injected crashes -----------------
+
+
+def _run_py(code: str, env: dict) -> subprocess.CompletedProcess:
+    full = dict(os.environ)
+    full.update(env)
+    full["PYTHONPATH"] = REPO + os.pathsep + full.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-c", code], env=full, capture_output=True,
+        text=True, timeout=120,
+    )
+
+
+def test_deploy_tier_crash_mid_write_never_publishes_torn_file(tmp_path):
+    """A crash inside the write window (injected via crash_save) leaves
+    only tmp debris; the previous publish stays intact and loadable."""
+    target = tmp_path / "models" / "last.ckpt"
+    code = (
+        "import numpy as np\n"
+        "from dct_tpu.checkpoint.manager import save_checkpoint\n"
+        f"save_checkpoint({str(target)!r}, "
+        "{'w': np.ones(3, np.float32)}, {'epoch': 0})\n"
+    )
+    assert _run_py(code, {"DCT_FAULT_SPEC": "", "JAX_PLATFORMS": "cpu"}
+                   ).returncode == 0
+    first = target.read_bytes()
+
+    code2 = (
+        "import numpy as np\n"
+        "from dct_tpu.checkpoint.manager import save_checkpoint\n"
+        f"save_checkpoint({str(target)!r}, "
+        "{'w': np.zeros(3, np.float32)}, {'epoch': 1})\n"
+    )
+    proc = _run_py(
+        code2,
+        {"DCT_FAULT_SPEC": "crash_save", "JAX_PLATFORMS": "cpu",
+         "DCT_OBSERVABILITY": "0"},
+    )
+    assert proc.returncode == FAULT_CRASH_EXIT, proc.stderr
+    # The published file is byte-identical to the previous publish; the
+    # torn write exists only as tmp debris.
+    assert target.read_bytes() == first
+    debris = [p for p in target.parent.iterdir() if ".tmp" in p.name]
+    assert debris
+
+    from dct_tpu.checkpoint.manager import load_checkpoint
+
+    params, meta = load_checkpoint(str(target))
+    assert meta["epoch"] == 0
+
+
+def test_torn_rotation_dir_skipped_on_restore(tmp_path):
+    """Satellite: kill between the shard write and its rename (save 2),
+    then assert _restore_candidates skips the torn state.next and the
+    PREVIOUS publish restores."""
+    state_dir = tmp_path / "train_state" / "p0"
+    code = (
+        "import numpy as np\n"
+        "from dct_tpu.checkpoint.manager import TrainStateCheckpointer\n"
+        "class S:\n"
+        "    def __init__(self, v):\n"
+        "        self.step = np.asarray(v)\n"
+        "        self.params = {'w': np.full(4, float(v), np.float32)}\n"
+        "        self.opt_state = ()\n"
+        "        self.rng = np.zeros(2, np.uint32)\n"
+        f"c = TrainStateCheckpointer({str(state_dir)!r})\n"
+        "c.save(S(1), meta={'epochs_completed': 1})\n"
+        "c.save(S(2), meta={'epochs_completed': 2})\n"  # crashes mid-write
+    )
+    proc = _run_py(
+        code,
+        {"DCT_FAULT_SPEC": "crash_save:save2", "JAX_PLATFORMS": "cpu",
+         "DCT_OBSERVABILITY": "0"},
+    )
+    assert proc.returncode == FAULT_CRASH_EXIT, proc.stderr
+    # The torn dir holds only tmp debris; the live dir holds save 1.
+    next_dir = state_dir / "state.next"
+    assert next_dir.is_dir()
+    assert all(n.endswith(".tmp") for n in os.listdir(next_dir))
+    assert (state_dir / "state" / "state.npz").exists()
+
+    from dct_tpu.checkpoint.manager import TrainStateCheckpointer
+
+    ckptr = TrainStateCheckpointer(str(state_dir))
+    assert ckptr._dir_is_torn(str(next_dir))
+    assert str(next_dir) not in ckptr._restore_candidates()
+    assert ckptr.exists()
+    assert ckptr.load_meta()["epochs_completed"] == 1
+
+    import numpy as np
+
+    class S:
+        def __init__(self):
+            self.step = np.asarray(0)
+            self.params = {"w": np.zeros(4, np.float32)}
+            self.opt_state = ()
+            self.rng = np.zeros(2, np.uint32)
+
+        def replace(self, **kw):
+            for k, v in kw.items():
+                setattr(self, k, v)
+            return self
+
+    restored = ckptr.restore(S())
+    assert float(np.asarray(restored.step)) == 1.0
+    assert restored.params["w"].tolist() == [1.0] * 4
+
+
+# -- supervised relaunch (fake ranks) -----------------------------------
+
+
+def _supervise(tmp_path, script_env, rank_code, **kw):
+    from dct_tpu.launch.launcher import LocalProcessLauncher
+
+    env = {
+        "DCT_EVENTS_DIR": str(tmp_path / "events"),
+        "DCT_HEARTBEAT_DIR": str(tmp_path / "hb"),
+        "DCT_RUN_ID": "",
+        **script_env,
+    }
+    launcher = LocalProcessLauncher(
+        stagger_seconds=0.0, timeout=60.0, poll_seconds=0.05,
+        preempt_grace_s=2.0,
+    )
+    kw.setdefault("max_restarts", 2)
+    kw.setdefault("backoff_s", 0.05)
+    kw.setdefault("jitter", 0.0)
+    res = launcher.supervise(
+        [sys.executable, "-c", rank_code], world_size=1, env=env, **kw
+    )
+    events = []
+    path = tmp_path / "events" / "events.jsonl"
+    if path.exists():
+        events = [json.loads(line) for line in open(path)]
+    return res, events
+
+
+def test_supervise_relaunches_crash_with_resume_and_debt(tmp_path):
+    marker = tmp_path / "marker"
+    code = (
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').write('first')\n"
+        "    sys.exit(7)\n"
+        "open(m + '.relaunch', 'w').write(\n"
+        "    os.environ.get('DCT_RESUME', '') + ';'\n"
+        "    + os.environ.get('DCT_STARTUP_RECOVERY_DEBT_S', ''))\n"
+        "sys.exit(0)\n"
+    )
+    res, events = _supervise(tmp_path, {}, code)
+    assert res.success and res.restarts == 1
+    assert [a.classification for a in res.attempts] == ["crash", "success"]
+    # The relaunch resumed (DCT_RESUME=1) and carried the lost-wall debt.
+    resume, debt = (marker.parent / "marker.relaunch").read_text().split(";")
+    assert resume == "1"
+    assert float(debt) > 0
+    names = [e["event"] for e in events]
+    assert "restart.relaunch" in names
+    relaunch = next(e for e in events if e["event"] == "restart.relaunch")
+    assert relaunch["classification"] == "crash"
+    assert relaunch["lost_wall_s"] > 0
+    assert "restart.recovered" in names
+
+
+def test_supervise_gives_up_after_budget(tmp_path):
+    res, events = _supervise(
+        tmp_path, {}, "import sys; sys.exit(7)", max_restarts=1
+    )
+    assert not res.success
+    assert res.restarts == 1 and len(res.attempts) == 2
+    assert res.classification == "crash"
+    assert any(e["event"] == "restart.gave_up" for e in events)
+
+
+def test_supervise_never_retries_health_halt(tmp_path):
+    res, events = _supervise(
+        tmp_path, {}, f"import sys; sys.exit({EXIT_HEALTH_HALT})"
+    )
+    assert not res.success and len(res.attempts) == 1
+    assert res.classification == "health_halt"
+    assert any(e["event"] == "restart.gave_up" for e in events)
+
+
+def test_supervise_preemption_is_a_free_restart(tmp_path):
+    marker = tmp_path / "marker"
+    code = (
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').write('x')\n"
+        f"    sys.exit({EXIT_PREEMPTED})\n"
+        "sys.exit(0)\n"
+    )
+    res, events = _supervise(tmp_path, {}, code, max_restarts=0)
+    # max_restarts=0 would forbid any crash retry; preemption relaunches
+    # anyway and consumes no budget.
+    assert res.success and res.restarts == 0 and len(res.attempts) == 2
+    relaunch = next(e for e in events if e["event"] == "restart.relaunch")
+    assert relaunch["classification"] == "preempted"
+    assert relaunch["backoff_s"] == 0
+
+
+def test_supervisor_termination_tears_down_ranks(tmp_path):
+    """SIGTERM to the SUPERVISOR must not orphan the ranks: they run in
+    their own sessions (start_new_session), so only the supervisor's
+    explicit teardown can reach them once the task's process-group kill
+    misses (Airflow execution_timeout scenario)."""
+    import threading
+    import time as _time
+
+    from dct_tpu.launch.launcher import LocalProcessLauncher
+
+    pidfile = tmp_path / "rank_pid"
+    code = (
+        "import os, time\n"
+        f"open({str(pidfile)!r}, 'w').write(str(os.getpid()))\n"
+        "time.sleep(120)\n"
+    )
+    launcher = LocalProcessLauncher(
+        stagger_seconds=0.0, timeout=120.0, poll_seconds=0.05,
+        preempt_grace_s=1.0,
+    )
+    timer = threading.Timer(
+        1.5, lambda: os.kill(os.getpid(), signal.SIGTERM)
+    )
+    timer.start()
+    try:
+        res = launcher.supervise(
+            [sys.executable, "-c", code], world_size=1,
+            env={"DCT_EVENTS_DIR": str(tmp_path / "ev"), "DCT_RUN_ID": ""},
+            max_restarts=1, backoff_s=0.05, jitter=0.0,
+        )
+    finally:
+        timer.cancel()
+    assert not res.success
+    assert res.classification == "preempted"  # resumable-not-failed
+    # The rank died with the supervisor instead of being orphaned.
+    pid = int(pidfile.read_text())
+    for _ in range(100):
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        _time.sleep(0.1)
+    else:
+        os.kill(pid, signal.SIGKILL)
+        pytest.fail("rank survived the supervisor's termination")
+    events_path = tmp_path / "ev" / "events.jsonl"
+    names = [json.loads(line)["event"] for line in open(events_path)]
+    assert "supervise_terminated" in names
+
+
+def test_canary_retry_exhaustion_auto_reverts(tmp_path, monkeypatch):
+    """Transient control-plane flakes retry; when retries exhaust
+    mid-canary the rollout reverts to the prior deployment and the
+    endpoint keeps serving the OLD model."""
+    import jax
+    import jax.numpy as jnp
+
+    from dct_tpu.checkpoint.manager import save_checkpoint
+    from dct_tpu.config import ModelConfig
+    from dct_tpu.deploy.local import LocalEndpointClient
+    from dct_tpu.deploy.rollout import RolloutOrchestrator
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.serving.score_gen import generate_score_package
+
+    monkeypatch.setenv("DCT_EVENTS_DIR", str(tmp_path / "ev"))
+    from dct_tpu.observability import events as _events
+
+    monkeypatch.setattr(_events, "_explicit", None)
+
+    model = get_model(ModelConfig(), input_dim=5)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 5)))
+    meta = {"model": "weather_mlp", "input_dim": 5, "hidden_dim": 64,
+            "num_classes": 2, "dropout": 0.2, "feature_names": ["a"] * 5}
+    ckpt = save_checkpoint(str(tmp_path / "m.ckpt"), params, meta)
+    pkg = tmp_path / "pkg"
+    generate_score_package(ckpt, str(pkg))
+
+    class CanaryDiesClient(LocalEndpointClient):
+        """set_traffic fails transiently forever once a canary split is
+        requested; the plain 100/0 maps (rollback included) work."""
+
+        def set_traffic(self, endpoint, traffic):
+            if any(0 < v < 100 for v in traffic.values()):
+                raise ConnectionError("control plane reset by peer")
+            super().set_traffic(endpoint, traffic)
+
+    client = CanaryDiesClient()
+    orch = RolloutOrchestrator(
+        client, "ep", soak_seconds=0.0, sleep_fn=lambda _s: None,
+        retry_max_attempts=2, retry_backoff_s=0.0, run_id="dct-rollback",
+    )
+    # Install blue as the live slot, then roll out green up to the canary.
+    new1, old1 = orch.deploy_new_slot(str(pkg))
+    assert (new1, old1) == ("blue", None)
+    new2, old2 = orch.deploy_new_slot(str(pkg))
+    assert (new2, old2) == ("green", "blue")
+    orch.start_shadow(new2, old2)
+    with pytest.raises(ConnectionError):
+        orch.start_canary(new2, old2)
+    # Reverted: old slot back at 100%, mirror cleared, rollback recorded.
+    assert client.get_traffic("ep") == {"blue": 100}
+    assert client.get_mirror_traffic("ep") == {}
+    assert orch.events[-1].stage == "rollback"
+    recs = [
+        json.loads(line)
+        for line in open(tmp_path / "ev" / "events.jsonl")
+    ]
+    names = [r["event"] for r in recs]
+    assert "retry.attempt" in names and "retry.exhausted" in names
+    rollback = next(r for r in recs if r["event"] == "deploy.rollback")
+    assert rollback["failed_stage"] == "canary"
+    assert rollback["reverted"] is True
+    assert rollback["run_id"] == "dct-rollback"
+
+
+def test_prom_dump_carries_resilience_counters(tmp_path):
+    from dct_tpu.observability.dump import write_train_metrics_prom
+
+    path = write_train_metrics_prom(
+        str(tmp_path / "m.prom"),
+        {"goodput_fraction": 0.5, "wall_seconds": 10.0,
+         "categories": {"train_step": 5.0}, "epochs": 2,
+         "unattributed_seconds": 0.0},
+        run_id="dct-x",
+        resilience={"faults_injected": 3, "startup_debt_s": 7.5},
+    )
+    text = open(path).read()
+    assert 'dct_train_faults_injected_total{run_id="dct-x"} 3' in text
+    assert (
+        'dct_train_startup_recovery_debt_seconds{run_id="dct-x"} 7.5'
+        in text
+    )
+
+
+def test_supervise_cli_smoke(tmp_path):
+    from dct_tpu.resilience.supervise import main
+
+    rc = main([
+        "--world-size", "1", "--max-restarts", "0", "--",
+        sys.executable, "-c", "import sys; sys.exit(0)",
+    ])
+    assert rc == 0
+    rc = main([
+        "--world-size", "1", "--max-restarts", "0", "--",
+        sys.executable, "-c", "import sys; sys.exit(9)",
+    ])
+    assert rc == 1
