@@ -1049,9 +1049,9 @@ def _prior_onchip_evidence(
                 candidates.append(
                     (_capture_ts(rec, path), os.path.basename(path), rec)
                 )
+    stash_candidate = None
     if (
-        latest is None
-        and isinstance(stashed_partial, tuple)
+        isinstance(stashed_partial, tuple)
         and isinstance(stashed_partial[0], dict)
         and stashed_partial[0].get("platform") == "tpu"
     ):
@@ -1059,13 +1059,13 @@ def _prior_onchip_evidence(
         # flush overwrote the file — using the file's current mtime here
         # would stamp a days-old stash as captured "now" and let it
         # outrank a fresher BENCH_ONCHIP_LATEST.json.
-        candidates.append(
-            (
-                stashed_partial[1],
-                "BENCH_PARTIAL.json (pre-run stash)",
-                stashed_partial[0],
-            )
+        stash_candidate = (
+            stashed_partial[1],
+            "BENCH_PARTIAL.json (pre-run stash)",
+            stashed_partial[0],
         )
+        if latest is None:
+            candidates.append(stash_candidate)
 
     out: dict = {}
     if candidates:
@@ -1077,6 +1077,23 @@ def _prior_onchip_evidence(
             ),
             record=rec,
         )
+        if (
+            latest is not None
+            and stash_candidate is not None
+            and stash_candidate[0] > mtime
+        ):
+            # A complete LATEST still wins the headline `record` slot
+            # (complete > partial), but a pre-run stash measured AFTER
+            # it is real on-chip evidence a stale committed LATEST in a
+            # fresh checkout would otherwise erase (ADVICE r5): embed it
+            # alongside, provenance-labeled, instead of dropping it.
+            out["newer_partial"] = {
+                "source": stash_candidate[1],
+                "captured_utc": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime(stash_candidate[0])
+                ),
+                "record": stash_candidate[2],
+            }
 
     # Campaign lines measured on TPU (the jsonl can interleave CPU smoke
     # runs — DCT_CAMPAIGN_ALLOW_CPU=1 — with real ones; the per-run

@@ -1,11 +1,18 @@
 """DAG 5: ``azure_automated_rollout`` — blue/green + shadow + canary.
 
 Parity with reference dags/azure_auto_deploy.py (same DAG id, :188-196):
-unscheduled; chain prepare_package -> deploy_new_slot -> start_shadow ->
-soak -> start_canary -> soak -> full_rollout, with the reference's stage
-parameters (mirror 20%, canary 10%, 30 s soaks, :152-197). Slot state flows
-between tasks via XCom exactly like the reference (:148-149) when running
-under real Airflow; the compat layer passes a shared ``ti`` dict.
+unscheduled; chain prepare_package -> evaluate_challenger ->
+deploy_new_slot -> start_shadow -> soak -> start_canary -> soak ->
+full_rollout, with the reference's stage parameters (mirror 20%, canary
+10%, 30 s soaks, :152-197). Slot state flows between tasks via XCom
+exactly like the reference (:148-149) when running under real Airflow;
+the compat layer passes a shared ``ti`` dict.
+
+Beyond parity: ``evaluate_challenger`` runs the champion/challenger
+offline eval harness (dct_tpu.evaluation, docs/EVALUATION.md) and the
+stage transitions consult a statistical PromotionGate — a challenger
+that regresses against the deployed champion is blocked and the
+endpoint auto-reverts, instead of walking to 100% on a timer.
 
 Fixed vs reference: env vars are read individually (no ``client_id``
 clobber, :15-19), and the machine itself lives in
@@ -48,10 +55,24 @@ def _client():
 
     # File-backed state: each stage runs in its own Airflow task process,
     # so the slot/traffic state must outlive any single _client() instance.
-    # Lives BESIDE the package dir — prepare_package wipes DEPLOY_DIR.
+    # DCT_LOCAL_ENDPOINT_STATE pins it explicitly — REQUIRED when cycles
+    # use versioned DEPLOY_DIRs (docs/EVALUATION.md), or each cycle would
+    # derive a fresh empty endpoint and the gate would never see a
+    # champion. Default: beside the package dir (prepare_package wipes
+    # DEPLOY_DIR itself).
     return LocalEndpointClient(
-        state_path=DEPLOY_DIR.rstrip("/") + "_endpoint_state.json"
+        state_path=os.environ.get("DCT_LOCAL_ENDPOINT_STATE")
+        or DEPLOY_DIR.rstrip("/") + "_endpoint_state.json"
     )
+
+
+def _gate():
+    """Promotion gate for the rollout stages (DCT_GATE=0 restores the
+    reference's ungated timer walk). Constructed fresh per task process
+    like the client — all state lives in the package dir / ledger."""
+    from dct_tpu.evaluation.gates import PromotionGate
+
+    return PromotionGate.from_env()
 
 
 def _orchestrator():
@@ -65,6 +86,7 @@ def _orchestrator():
     return RolloutOrchestrator(
         _client(), ENDPOINT_NAME, soak_seconds=SOAK_SECONDS,
         run_id=package_run_correlation_id(DEPLOY_DIR),
+        gate=_gate(),
     )
 
 
@@ -95,6 +117,56 @@ def prepare_package(**context):
     with spans.get_default().span("dag.prepare_package", component="dag"):
         info = prep(_tracker(), DEPLOY_DIR)
     print(f"Package ready: run {info['run_id']} val_loss={info['val_loss']}")
+
+
+def evaluate_challenger(**context):
+    """Offline champion/challenger evaluation (dct_tpu.evaluation): run
+    the harness ONCE here — the per-stage gate consults reuse the
+    report cached in the package — and log the eval report to tracking
+    as an artifact (its own run, tagged kind=evaluation; it logs no
+    ``val_loss``, so the best-run selection query cannot see it)."""
+    gate = _gate()
+    with _task_span("evaluate_challenger"):
+        if gate is None:
+            print("Promotion gate disabled (DCT_GATE=0) — skipping eval")
+            return
+        champion = None
+        client = _client()
+        try:
+            if client.endpoint_exists(ENDPOINT_NAME):
+                traffic = client.get_traffic(ENDPOINT_NAME)
+                live = {k: v for k, v in traffic.items() if v > 0}
+                if live:
+                    resolver = getattr(client, "deployment_package_dir", None)
+                    if resolver is not None:
+                        champion = resolver(
+                            ENDPOINT_NAME, max(live, key=live.get)
+                        )
+        except Exception as e:  # noqa: BLE001 — champion resolution is
+            print(f"Champion resolution failed: {e}")  # best-effort here;
+            # the per-stage gates re-resolve and fail closed themselves.
+        if not champion or os.path.abspath(champion) == os.path.abspath(
+            DEPLOY_DIR
+        ):
+            print("No distinct deployed champion — first rollout is ungated")
+            return
+        from dct_tpu.evaluation.harness import EvalError
+
+        try:
+            report = gate.offline_eval(DEPLOY_DIR, champion)
+        except EvalError as e:
+            print(f"Offline eval unavailable: {e}")
+            return
+        print(
+            f"Eval: champion loss={report['champion']['loss_mean']:.4f} "
+            f"challenger loss={report['challenger']['loss_mean']:.4f} "
+            f"mean_delta={report['mean_delta']:.4f}"
+        )
+        from dct_tpu.evaluation.gates import log_eval_report
+
+        log_eval_report(
+            _tracker(), report, os.path.join(DEPLOY_DIR, "eval_report.json")
+        )
 
 
 def deploy_new_slot(ti=None, **context):
@@ -148,6 +220,9 @@ with DAG(
     tags=["deploy", "tpu-pipeline"],
 ) as dag:
     t_prepare = PythonOperator(task_id="prepare_package", python_callable=prepare_package)
+    t_eval = PythonOperator(
+        task_id="evaluate_challenger", python_callable=evaluate_challenger
+    )
     t_deploy = PythonOperator(task_id="deploy_new_slot", python_callable=deploy_new_slot)
     t_shadow = PythonOperator(task_id="start_shadow", python_callable=start_shadow)
     t_soak1 = BashOperator(task_id="shadow_soak", bash_command=f"sleep {SOAK_SECONDS}")
@@ -155,4 +230,4 @@ with DAG(
     t_soak2 = BashOperator(task_id="canary_soak", bash_command=f"sleep {SOAK_SECONDS}")
     t_full = PythonOperator(task_id="full_rollout", python_callable=full_rollout)
 
-    t_prepare >> t_deploy >> t_shadow >> t_soak1 >> t_canary >> t_soak2 >> t_full
+    t_prepare >> t_eval >> t_deploy >> t_shadow >> t_soak1 >> t_canary >> t_soak2 >> t_full

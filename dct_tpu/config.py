@@ -506,6 +506,102 @@ class ResilienceConfig:
 
 
 @dataclass
+class EvaluationConfig:
+    """Continuous-evaluation knobs (dct_tpu.evaluation; docs/EVALUATION.md):
+    the champion/challenger offline eval harness, the statistical
+    promotion gates between rollout stages, and the drift detectors.
+
+    The gate's null hypothesis is "the challenger is NOT worse": by
+    default a cycle promotes unless the evidence says it regressed
+    (``require_improvement`` flips that to "promote only on proven
+    improvement"). All stochastic machinery (the paired bootstrap) is
+    seeded from ``seed`` so a gate decision is reproducible from its
+    evidence. ``DCT_DRIFT_THRESHOLD`` (the ETL-side stats gate in
+    etl/preprocess.py) is a different, older knob; the deploy-side
+    detectors here use PSI/KS against the snapshot stamped into the
+    deploy package.
+    """
+
+    # Consult a PromotionGate between rollout stages (shadow -> canary
+    # -> full). Off = the reference's timer-only walk.
+    gate_enabled: bool = True
+    # Mean per-example loss delta (champion - challenger) the challenger
+    # must exceed to count as an improvement.
+    min_improvement: float = 0.0
+    # Mean regression tolerated before the gate blocks (challenger mean
+    # loss may exceed champion's by at most this).
+    max_regression: float = 0.0
+    # One-sided confidence required of the paired bootstrap before a
+    # delta counts as evidence (0.95 = the regression must be outside
+    # the bootstrap's 95% band).
+    confidence: float = 0.95
+    bootstrap_samples: int = 1000
+    # Bootstrap RNG seed: gate decisions must be deterministic.
+    seed: int = 42
+    # Worst tolerated per-slice loss regression (e.g. the rain slice may
+    # not get this much worse even if the aggregate improved).
+    max_slice_regression: float = 0.25
+    # Promote only on statistically-significant improvement (default:
+    # promote unless significantly worse — continuous-training default).
+    require_improvement: bool = False
+    # Examples per forward pass in the offline harness.
+    eval_batch: int = 1024
+    # 'numpy' = the serving twin (identical math to the deployed
+    # score.py); 'jax' = jitted batched apply sharded over the mesh
+    # data axis (the training-side inference path, for dataset-scale
+    # eval splits on accelerator rigs).
+    engine: str = "numpy"
+    # Missing prerequisites (no champion, no eval data, unreadable
+    # package): promote with a warning (True) or hold (False). A real
+    # failing evaluation always blocks regardless.
+    fail_open: bool = True
+    # Gate-decision ledger consumed by /metrics; "" = <events_dir>/
+    # gate_ledger.json.
+    ledger_path: str = ""
+    # Drift detectors: PSI above this flags a feature (industry rule of
+    # thumb: 0.1 moderate, 0.2 major); KS D-statistic threshold; bins
+    # for the stamped quantile snapshot; shadow-stage prediction
+    # disagreement rate above which the shadow->canary gate holds.
+    psi_threshold: float = 0.2
+    ks_threshold: float = 0.15
+    drift_bins: int = 10
+    max_disagreement: float = 0.25
+
+    @classmethod
+    def from_env(cls) -> "EvaluationConfig":
+        c = cls()
+        c.gate_enabled = _env("DCT_GATE", c.gate_enabled, bool)
+        c.min_improvement = _env(
+            "DCT_GATE_MIN_IMPROVEMENT", c.min_improvement, float
+        )
+        c.max_regression = _env(
+            "DCT_GATE_MAX_REGRESSION", c.max_regression, float
+        )
+        c.confidence = _env("DCT_GATE_CONFIDENCE", c.confidence, float)
+        c.bootstrap_samples = _env(
+            "DCT_GATE_BOOTSTRAP", c.bootstrap_samples, int
+        )
+        c.seed = _env("DCT_GATE_SEED", c.seed, int)
+        c.max_slice_regression = _env(
+            "DCT_GATE_MAX_SLICE_REGRESSION", c.max_slice_regression, float
+        )
+        c.require_improvement = _env(
+            "DCT_GATE_REQUIRE_IMPROVEMENT", c.require_improvement, bool
+        )
+        c.eval_batch = _env("DCT_GATE_EVAL_BATCH", c.eval_batch, int)
+        c.engine = _env("DCT_GATE_ENGINE", c.engine, str).strip().lower()
+        c.fail_open = _env("DCT_GATE_FAIL_OPEN", c.fail_open, bool)
+        c.ledger_path = _env("DCT_GATE_LEDGER", c.ledger_path, str)
+        c.psi_threshold = _env("DCT_DRIFT_PSI", c.psi_threshold, float)
+        c.ks_threshold = _env("DCT_DRIFT_KS", c.ks_threshold, float)
+        c.drift_bins = _env("DCT_DRIFT_BINS", c.drift_bins, int)
+        c.max_disagreement = _env(
+            "DCT_DRIFT_MAX_DISAGREEMENT", c.max_disagreement, float
+        )
+        return c
+
+
+@dataclass
 class RunConfig:
     """Top-level bundle passed to the Trainer."""
 
@@ -518,6 +614,7 @@ class RunConfig:
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     obs: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    evaluation: EvaluationConfig = field(default_factory=EvaluationConfig)
 
     @classmethod
     def from_env(cls) -> "RunConfig":
@@ -531,6 +628,7 @@ class RunConfig:
             profile=ProfileConfig.from_env(),
             obs=ObservabilityConfig.from_env(),
             resilience=ResilienceConfig.from_env(),
+            evaluation=EvaluationConfig.from_env(),
         )
 
     def to_dict(self) -> dict:

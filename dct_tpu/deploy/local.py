@@ -144,6 +144,44 @@ class LocalEndpointClient:
     def list_deployments(self, endpoint: str) -> list[str]:
         return list(self.endpoints[endpoint].deployments)
 
+    def deployment_package_dir(self, endpoint: str, slot: str) -> str | None:
+        """The package dir backing a deployed slot (None for an unknown
+        endpoint/slot) — how the promotion gate locates the champion's
+        and challenger's artifacts."""
+        ep = self.endpoints.get(endpoint)
+        dep = ep.deployments.get(slot) if ep else None
+        return dep.package_dir if dep else None
+
+    # -- mirror capture (evaluation.drift's shadow-stage evidence) -----
+    @property
+    def mirror_capture_path(self) -> str | None:
+        """Where mirrored request/response pairs are captured as JSONL:
+        ``DCT_MIRROR_CAPTURE`` wins; with persistent state the default
+        is a sibling of the state file; in-memory clients don't capture
+        unless told to."""
+        explicit = os.environ.get("DCT_MIRROR_CAPTURE")
+        if explicit:
+            return explicit
+        if self.state_path:
+            return self.state_path + "_mirror.jsonl"
+        return None
+
+    def append_mirror_record(self, record: dict) -> None:
+        """Append one mirrored-pair record (single-line JSON, O_APPEND-
+        atomic under PIPE_BUF like the event log). Best-effort: capture
+        is evaluation telemetry, never a serving failure."""
+        path = self.mirror_capture_path
+        if not path:
+            return
+        try:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except (OSError, ValueError, TypeError):
+            pass
+
     # -- data plane (what Azure's scoring URI does) --------------------
     def load_slot(self, endpoint: str, slot: str) -> tuple[dict, dict]:
         """(weights, meta) of a deployed slot; KeyError for an unknown
@@ -152,7 +190,13 @@ class LocalEndpointClient:
 
     def score(self, endpoint: str, payload: dict, *, slot: str | None = None) -> dict:
         """Route a request like the live endpoint would: to the given slot,
-        or to the max-live-traffic slot."""
+        or to the max-live-traffic slot. With mirror traffic configured
+        and a capture path set, the request is ALSO scored against each
+        shadow slot and the paired responses land in the mirror-capture
+        JSONL (the shadow-stage disagreement evidence). Unlike the HTTP
+        endpoint server, this in-process surface captures EVERY request
+        (no percent sampling): it is the test/local path, and the
+        evaluation wants deterministic evidence."""
         from dct_tpu.serving.runtime import score_payload
 
         ep = self.endpoints[endpoint]
@@ -162,4 +206,24 @@ class LocalEndpointClient:
                 raise RuntimeError(f"Endpoint {endpoint} has no live traffic")
             slot = max(live, key=live.get)
         weights, meta = ep.deployments[slot].load()
-        return score_payload(weights, meta, payload["data"])
+        result = score_payload(weights, meta, payload["data"])
+        if self.mirror_capture_path:
+            import time as _time
+
+            for shadow, pct in ep.mirror_traffic.items():
+                if pct <= 0 or shadow == slot or shadow not in ep.deployments:
+                    continue
+                try:
+                    w_s, m_s = ep.deployments[shadow].load()
+                    shadow_result = score_payload(w_s, m_s, payload["data"])
+                except Exception:  # noqa: BLE001 — a broken shadow is itself
+                    continue  # a signal, but never a live-path failure
+                self.append_mirror_record({
+                    "ts": round(_time.time(), 6),
+                    "endpoint": endpoint,
+                    "live_slot": slot,
+                    "shadow_slot": shadow,
+                    "live_probs": result["probabilities"],
+                    "shadow_probs": shadow_result["probabilities"],
+                })
+        return result

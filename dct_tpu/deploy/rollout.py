@@ -49,7 +49,9 @@ class EndpointClient(Protocol):
     def list_deployments(self, endpoint: str) -> list[str]: ...
 
 
-def prepare_package(tracker, deploy_dir: str) -> dict:
+def prepare_package(
+    tracker, deploy_dir: str, *, data_dir: str | None = None
+) -> dict:
     """Best-run query -> deploy package. Returns package info.
 
     Mirrors the reference flow (wipe deploy dir, find best run, download
@@ -83,7 +85,10 @@ def prepare_package(tracker, deploy_dir: str) -> dict:
     # rollout stage runs in its own Airflow task process with no env
     # inheritance from the training launch, and the package dir is the
     # one artifact every stage shares — so it carries the training
-    # cycle's run-correlation ID for the stage events to adopt.
+    # cycle's run-correlation ID for the stage events to adopt, the
+    # selected run's FULL final metrics (what the promotion gates — and
+    # humans — compare the next challenger against), and a
+    # training-data snapshot for the deploy-side drift detectors.
     import json
 
     with open(os.path.join(deploy_dir, "run_info.json"), "w") as f:
@@ -92,6 +97,19 @@ def prepare_package(tracker, deploy_dir: str) -> dict:
                 "tracking_run_id": best.run_id,
                 "run_correlation_id": best.run_correlation_id,
                 "val_loss": best.metrics.get("val_loss"),
+                "metrics": {
+                    k: v for k, v in best.metrics.items()
+                    if isinstance(v, (int, float))
+                },
+                "data_snapshot": _training_data_snapshot(data_dir),
+                # The split the shipped model was validated on. The
+                # eval harness must rebuild EXACTLY this split, and the
+                # gate runs in a DAG task process with no env
+                # inheritance from the training launch — so the split
+                # parameters travel in the artifact. The seed comes
+                # from the training run's OWN logged params when
+                # available (authoritative), env otherwise.
+                "split": _split_params(best.params),
             },
             f,
             indent=2,
@@ -100,9 +118,65 @@ def prepare_package(tracker, deploy_dir: str) -> dict:
         "run_id": best.run_id,
         "run_correlation_id": best.run_correlation_id,
         "val_loss": best.metrics.get("val_loss"),
+        "metrics": dict(best.metrics),
         "deploy_dir": deploy_dir,
         "model_meta": meta,
     }
+
+
+def _split_params(run_params: dict | None) -> dict:
+    """The validation-split parameters to stamp into the manifest: both
+    from the training run's OWN logged params when present
+    (authoritative — the packaging process's env need not match the
+    training launch's), env fallback for runs logged before the trainer
+    recorded them."""
+    from dct_tpu.config import DataConfig, TrainConfig
+
+    params = run_params or {}
+    try:
+        seed = int(params["seed"])
+    except (KeyError, TypeError, ValueError):
+        seed = TrainConfig.from_env().seed
+    try:
+        val_fraction = float(params["val_fraction"])
+    except (KeyError, TypeError, ValueError):
+        val_fraction = DataConfig.from_env().val_fraction
+    return {"seed": seed, "val_fraction": val_fraction}
+
+
+def _training_data_snapshot(data_dir: str | None) -> dict | None:
+    """Quantile snapshot of the processed training data, stamped into
+    the package manifest so the NEXT cycle's drift detectors can
+    compare their ETL output against what THIS model learned from.
+    Best-effort: a packaging host without the data ships None, never a
+    failed deploy."""
+    from dct_tpu.config import EvaluationConfig
+
+    data_dir = data_dir or os.environ.get("DCT_PROCESSED_DIR", "data/processed")
+    try:
+        from dct_tpu.data.dataset import load_processed_dataset
+        from dct_tpu.evaluation.drift import snapshot_features
+
+        data = load_processed_dataset(data_dir)
+        return snapshot_features(
+            data.features, data.feature_names,
+            bins=EvaluationConfig.from_env().drift_bins,
+        )
+    except Exception:  # noqa: BLE001 — snapshotting is provenance, not a gate
+        return None
+
+
+def package_manifest(package_dir: str) -> dict:
+    """The full ``run_info.json`` manifest of a deploy package ({} for
+    pre-observability packages or any read failure)."""
+    import json
+
+    try:
+        with open(os.path.join(package_dir, "run_info.json")) as f:
+            manifest = json.load(f)
+        return manifest if isinstance(manifest, dict) else {}
+    except (OSError, ValueError):
+        return {}
 
 
 def package_run_correlation_id(package_dir: str) -> str | None:
@@ -161,6 +235,7 @@ class RolloutOrchestrator:
         run_id: str | None = None,
         retry_max_attempts: int | None = None,
         retry_backoff_s: float | None = None,
+        gate=None,
     ):
         from dct_tpu.resilience.retry import Retrier
 
@@ -171,6 +246,11 @@ class RolloutOrchestrator:
         self.soak_seconds = soak_seconds
         self.sleep_fn = sleep_fn
         self.events: list[RolloutEvent] = []
+        # Promotion gate (dct_tpu.evaluation.gates.PromotionGate, or any
+        # object with its evaluate() signature): consulted between
+        # stages — shadow -> canary and canary -> full rollout. None =
+        # the reference's ungated timer walk.
+        self.gate = gate
         # Run-correlation ID for stage events: pass the shipped
         # package's (package_run_correlation_id); deploy_new_slot adopts
         # it from the package automatically when unset.
@@ -236,6 +316,18 @@ class RolloutOrchestrator:
         return new_slot, old_slot
 
     def start_shadow(self, new_slot: str, old_slot: str) -> None:
+        # Fresh evidence window: the capture file carries the PREVIOUS
+        # cycle's mirrored pairs (a held challenger's disagreements, a
+        # promoted one's agreements) — either would contaminate THIS
+        # challenger's shadow->canary disagreement score. The gate also
+        # filters by shadow slot, but a blocked cycle's record must not
+        # keep punishing (or excusing) every cycle after it.
+        capture = getattr(self.client, "mirror_capture_path", None)
+        if capture:
+            try:
+                os.remove(capture)
+            except OSError:
+                pass
         with self._stage_span("shadow"):
             try:
                 self._call(self.client.set_traffic, self.endpoint,
@@ -250,7 +342,87 @@ class RolloutOrchestrator:
                 self.rollback(new_slot, old_slot, stage="shadow")
                 raise
 
+    # -- promotion gates ----------------------------------------------
+    def _slot_package_dir(self, slot: str | None) -> str | None:
+        """The package dir backing a deployed slot, when the client can
+        say (the local client exposes ``deployment_package_dir``; cloud
+        clients that cannot resolve it return None and the gate treats
+        the champion as unresolvable)."""
+        if slot is None:
+            return None
+        resolver = getattr(self.client, "deployment_package_dir", None)
+        if resolver is None:
+            return None
+        try:
+            return resolver(self.endpoint, slot)
+        except Exception:  # noqa: BLE001 — unresolvable, not fatal
+            return None
+
+    def _consult_gate(self, to_stage: str, new_slot: str, old_slot: str | None) -> None:
+        """Gatekeeper between stages: evaluate the challenger (new
+        slot's package) against the champion (old slot's), put the
+        decision on the record (``deploy.gate`` event + span + metrics
+        ledger), and on anything but promote revert traffic to the
+        champion and raise :class:`GateRejection`.
+
+        A gate CONSULT failure (the gate itself crashing) blocks the
+        rollout too — a safety mechanism that breaks must fail closed.
+        """
+        if self.gate is None or old_slot is None:
+            return
+        from dct_tpu.evaluation.gates import (
+            GateDecision, GateRejection, record_decision,
+        )
+
+        challenger_dir = self._slot_package_dir(new_slot)
+        champion_dir = self._slot_package_dir(old_slot)
+        mirror_capture = getattr(self.client, "mirror_capture_path", None)
+        with self._stage_span(f"gate_{to_stage}") as sp:
+            if challenger_dir is None:
+                # Cannot even locate what we'd be promoting: fail open
+                # only if the gate says so.
+                decision = GateDecision(
+                    "promote" if self.gate.cfg.fail_open else "hold",
+                    to_stage, "no_challenger_package",
+                )
+            else:
+                try:
+                    decision = self.gate.evaluate(
+                        challenger_dir=challenger_dir,
+                        champion_dir=champion_dir,
+                        stage=to_stage,
+                        mirror_capture=mirror_capture,
+                        shadow_slot=new_slot,
+                    )
+                except Exception as e:  # noqa: BLE001 — fail closed
+                    decision = GateDecision(
+                        "hold", to_stage, f"gate_error: {type(e).__name__}: {e}"
+                    )
+            sp.set(decision=decision.decision, reason=decision.reason)
+        ev = decision.evidence or {}
+        self.events.append(RolloutEvent(stage=f"gate_{to_stage}"))
+        self._cycle_log().emit(
+            "deploy", "deploy.gate", endpoint=self.endpoint,
+            stage=to_stage, decision=decision.decision,
+            reason=decision.reason, new_slot=new_slot, old_slot=old_slot,
+            mean_delta=ev.get("mean_delta"),
+            champion_loss=ev.get("champion_loss"),
+            challenger_loss=ev.get("challenger_loss"),
+            drift=ev.get("drift"), disagreement=ev.get("disagreement"),
+        )
+        record_decision(
+            decision, ledger_path=getattr(self.gate.cfg, "ledger_path", ""),
+        )
+        if not decision.promoted:
+            self.rollback(new_slot, old_slot, stage=f"gate:{to_stage}")
+            raise GateRejection(decision)
+
     def start_canary(self, new_slot: str, old_slot: str) -> None:
+        # Shadow -> canary is the first gated transition: offline
+        # champion/challenger eval + drift + shadow-traffic
+        # disagreement. A failing gate reverts BEFORE any live traffic
+        # reaches the challenger.
+        self._consult_gate("canary", new_slot, old_slot)
         with self._stage_span("canary"):
             try:
                 self._call(self.client.set_mirror_traffic, self.endpoint,
@@ -274,6 +446,9 @@ class RolloutOrchestrator:
                 raise
 
     def full_rollout(self, new_slot: str, old_slot: str | None) -> None:
+        # Canary -> full is the second gated transition (old_slot=None —
+        # a first deployment — has no champion and passes ungated).
+        self._consult_gate("full_rollout", new_slot, old_slot)
         with self._stage_span("full_rollout"):
             try:
                 self._call(self.client.set_traffic, self.endpoint,

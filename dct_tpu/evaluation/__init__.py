@@ -1,0 +1,32 @@
+"""Continuous evaluation: champion/challenger harness, statistical
+promotion gates, and drift detection for the rollout path.
+
+The layer between training and deploy that the reference lacks
+entirely: "best val_loss wins" becomes gated promotion — an offline
+eval harness (:mod:`harness`) scores champion and challenger over the
+same held-out split, statistical gates (:mod:`gates`) turn the paired
+per-example loss deltas into a promote/hold/rollback decision, drift
+detectors (:mod:`drift`) compare the serving-time world against the
+training-data snapshot stamped into the deploy package, and
+``python -m dct_tpu.evaluation.report`` pretty-prints the evidence.
+See docs/EVALUATION.md.
+"""
+
+from dct_tpu.evaluation.gates import (  # noqa: F401
+    GateDecision,
+    GateRejection,
+    PromotionGate,
+    paired_bootstrap,
+    record_decision,
+    render_gate_metrics,
+    sign_test,
+)
+from dct_tpu.evaluation.harness import (  # noqa: F401
+    EvalError,
+    EvalResult,
+    PairedEval,
+    evaluate_model,
+    evaluate_pair,
+    load_eval_split,
+    load_model,
+)

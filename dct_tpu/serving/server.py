@@ -114,10 +114,18 @@ class _JsonHandler(BaseHTTPRequestHandler):
 
     def _reply_metrics(self) -> None:
         """``GET /metrics``: Prometheus text exposition of the server's
-        slot metrics (scrapers require the versioned content type)."""
+        slot metrics (scrapers require the versioned content type),
+        plus the promotion-gate counters (``dct_deploy_gate_decisions_
+        total`` / ``dct_drift_psi``) when a gate ledger exists — the
+        gate runs in DAG task processes, so the long-lived serving
+        process is the natural scrape surface for its decisions."""
+        from dct_tpu.evaluation.gates import render_gate_metrics
         from dct_tpu.observability.prometheus import CONTENT_TYPE
 
-        body = self.server.slot_metrics.prometheus_text().encode()
+        body = (
+            self.server.slot_metrics.prometheus_text()
+            + render_gate_metrics()
+        ).encode()
         self.send_response(200)
         self.send_header("Content-Type", CONTENT_TYPE)
         self.send_header("Content-Length", str(len(body)))
@@ -524,6 +532,20 @@ class EndpointScoreHandler(_JsonHandler):
                         forward_numpy(w_s, m_s, validate_payload(m_s, data))
                     )
                     shadow_ok = bool(_np.isfinite(p_s).all())
+                    if shadow_ok and result is not None:
+                        # Mirror capture: the paired live/shadow
+                        # responses are the prediction-disagreement
+                        # evidence the shadow->canary promotion gate
+                        # scores (evaluation.drift). Append-only JSONL,
+                        # best-effort, after the live reply flushed.
+                        client.append_mirror_record({
+                            "ts": round(time.time(), 6),
+                            "endpoint": name,
+                            "live_slot": slot,
+                            "shadow_slot": shadow,
+                            "live_probs": result["probabilities"],
+                            "shadow_probs": p_s.tolist(),
+                        })
                 except Exception:  # noqa: BLE001 — shadow failures are
                     shadow_ok = False  # invisible to the caller by design
                 self.server.slot_metrics.record(

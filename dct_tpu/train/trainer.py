@@ -494,6 +494,14 @@ class Trainer:
                                                 "batch_size": cfg.train.batch_size,
                                                 "epochs": cfg.train.epochs,
                                                 "seed": cfg.train.seed,
+                                                # The split this run was
+                                                # validated on: the deploy
+                                                # side's eval harness must
+                                                # rebuild EXACTLY it
+                                                # (prepare_package stamps
+                                                # both into the package
+                                                # manifest).
+                                                "val_fraction": cfg.data.val_fraction,
                                                 "global_batch": global_batch})
 
         history: list[dict] = []

@@ -76,6 +76,45 @@ def test_deadline_fraction_gates(bench_mod, monkeypatch):
     assert bench_mod._over_deadline("x", frac=0.55) is False
 
 
+def test_prior_onchip_newer_stash_embedded_beside_latest(
+    bench_mod, tmp_path, monkeypatch
+):
+    """ADVICE r5: a complete BENCH_ONCHIP_LATEST.json wins the headline
+    `record` slot, but a pre-run partial stash captured AFTER it must be
+    embedded alongside (`newer_partial`) instead of dropped — and an
+    OLDER stash must not be."""
+    monkeypatch.setattr(bench_mod, "_REPO_ROOT", str(tmp_path))
+    latest = {
+        "platform": "tpu", "samples": 1.0,
+        "generated_utc": "2026-01-01T00:00:00Z",
+    }
+    with open(tmp_path / "BENCH_ONCHIP_LATEST.json", "w") as f:
+        json.dump(latest, f)
+    import calendar
+
+    # Same UTC arithmetic as bench._prior_onchip_evidence's _capture_ts.
+    latest_ts = calendar.timegm(time.strptime(
+        "2026-01-01T00:00:00Z", "%Y-%m-%dT%H:%M:%SZ"
+    ))
+
+    newer_stash = {"platform": "tpu", "samples": 2.0}
+    out = bench_mod._prior_onchip_evidence((newer_stash, latest_ts + 86400))
+    assert out["source"] == "BENCH_ONCHIP_LATEST.json"
+    assert out["record"] == latest  # complete record keeps the headline
+    assert out["newer_partial"]["record"] == newer_stash
+    assert "pre-run stash" in out["newer_partial"]["source"]
+
+    older = bench_mod._prior_onchip_evidence((newer_stash, latest_ts - 86400))
+    assert older["record"] == latest
+    assert "newer_partial" not in older
+
+    # No LATEST: the stash competes for the headline slot as before.
+    os.remove(tmp_path / "BENCH_ONCHIP_LATEST.json")
+    alone = bench_mod._prior_onchip_evidence((newer_stash, latest_ts))
+    assert alone["record"] == newer_stash
+    assert "newer_partial" not in alone
+
+
 def test_flush_survives_numpy_scalars(bench_mod):
     """A np scalar leaking into a leg value must not raise FROM the
     hedge (a TypeError here would kill the section it protects)."""

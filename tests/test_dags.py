@@ -142,6 +142,7 @@ def test_auto_deploy_stage_chain(dags):
     order = dag.topological_order()
     assert order == [
         "prepare_package",
+        "evaluate_challenger",
         "deploy_new_slot",
         "start_shadow",
         "shadow_soak",
@@ -206,6 +207,12 @@ def test_auto_deploy_dag_executes_against_local_endpoint(tmp_path, monkeypatch):
     def run_dag_once():
         ti = _FakeTI()
         mod.prepare_package()
+        # Both DAG runs reuse ONE package dir (DEPLOY_DIR), so the
+        # challenger overwrote the champion's package: the gate has no
+        # distinct champion to compare against and promotes ungated
+        # (docs/EVALUATION.md documents versioned package dirs as the
+        # way to arm it).
+        mod.evaluate_challenger()
         mod.deploy_new_slot(ti=ti)
         mod.start_shadow(ti=ti)
         mod.start_canary(ti=ti)

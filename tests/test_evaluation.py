@@ -1,0 +1,792 @@
+"""Unit tests for the continuous-evaluation subsystem
+(dct_tpu.evaluation): statistical gates, drift detectors, the offline
+harness, mirror capture, the gate ledger/metrics surface, and the
+gate-driven rollback wiring in the rollout orchestrator."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dct_tpu.checkpoint.manager import save_checkpoint
+from dct_tpu.config import EvaluationConfig, ModelConfig
+from dct_tpu.deploy.local import LocalEndpointClient
+from dct_tpu.deploy.rollout import RolloutOrchestrator, prepare_package
+from dct_tpu.evaluation import drift, gates, harness
+from dct_tpu.evaluation.gates import (
+    GateDecision,
+    GateRejection,
+    PromotionGate,
+    paired_bootstrap,
+    sign_test,
+)
+from dct_tpu.models.registry import get_model
+from dct_tpu.serving.score_gen import generate_score_package
+from dct_tpu.tracking.client import LocalTracking
+
+FEATURES = [f"f{i}" for i in range(5)]
+
+
+@pytest.fixture(autouse=True)
+def _env_built_observability():
+    """Earlier suites' Trainer runs install THEIR config-built event
+    log/span recorder as the process defaults; clear them so the tests
+    here that monkeypatch DCT_EVENTS_DIR see an env-built sink."""
+    from dct_tpu.observability import events as _events_mod
+    from dct_tpu.observability import spans as _spans_mod
+
+    _events_mod.set_default(None)
+    _spans_mod.set_default(None)
+    yield
+
+
+def _package(tmp_path, name="pkg", seed=0):
+    model = get_model(ModelConfig(), input_dim=5)
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 5)))
+    meta = {"model": "weather_mlp", "input_dim": 5, "hidden_dim": 64,
+            "num_classes": 2, "dropout": 0.2, "feature_names": FEATURES}
+    ckpt = save_checkpoint(str(tmp_path / f"{name}.ckpt"), params, meta)
+    deploy = str(tmp_path / name)
+    generate_score_package(ckpt, deploy)
+    return deploy
+
+
+# ----------------------------------------------------------------------
+# Statistics.
+
+def test_paired_bootstrap_deterministic_and_directional():
+    rng = np.random.default_rng(0)
+    d = rng.normal(0.2, 1.0, 400)
+    a = paired_bootstrap(d, seed=7)
+    b = paired_bootstrap(d, seed=7)
+    assert a == b  # acceptance: deterministic under a fixed seed
+    assert paired_bootstrap(d, seed=8) != a  # the seed is load-bearing
+    assert a["p_better"] > 0.95
+    flipped = paired_bootstrap(-d, seed=7)
+    assert flipped["p_better"] < 0.05
+    assert flipped["mean_delta"] == pytest.approx(-a["mean_delta"])
+
+
+def test_paired_bootstrap_empty():
+    out = paired_bootstrap(np.zeros(0))
+    assert out["n"] == 0 and out["p_better"] == 0.5
+
+
+def test_paired_bootstrap_chunking_invariant(monkeypatch):
+    """The chunked resampling (bounded memory at dataset-scale splits)
+    must be bit-identical to the one-shot matrix for a given seed."""
+    rng = np.random.default_rng(2)
+    d = rng.normal(0.05, 1.0, 10_000)  # forces multiple chunks
+    out = paired_bootstrap(d, n_boot=500, seed=9)
+    # Reference: explicit one-shot resampling with the same stream.
+    ref_rng = np.random.default_rng(9)
+    ref_means = ref_rng.integers(0, len(d), size=(500, len(d)))
+    ref_means = d[ref_means].mean(axis=1)
+    assert out["p_better"] == pytest.approx(float((ref_means > 0).mean()))
+    lo, hi = np.quantile(ref_means, [0.05, 0.95])
+    assert out["ci_low"] == pytest.approx(float(lo))
+    assert out["ci_high"] == pytest.approx(float(hi))
+
+
+def test_sign_test_exact_and_approx():
+    # Exact binomial: 9 wins of 10 -> P(>=9 | p=.5) = 11/1024.
+    d = np.array([1.0] * 9 + [-1.0])
+    out = sign_test(d)
+    assert out["wins"] == 9 and out["losses"] == 1
+    assert out["p_value"] == pytest.approx(11 / 1024)
+    # Ties carry no information.
+    assert sign_test(np.zeros(10))["p_value"] == 1.0
+    # Normal-approx regime agrees in direction with the exact one.
+    rng = np.random.default_rng(1)
+    big = rng.normal(0.3, 1.0, 1000)
+    assert sign_test(big)["p_value"] < 0.01
+
+
+# ----------------------------------------------------------------------
+# Decision logic (pure, no packages needed).
+
+def _report(mean_delta, *, n=400, slices=None, drift_rep=None, seed=3):
+    rng = np.random.default_rng(seed)
+    deltas = rng.normal(mean_delta, 0.5, n)
+    rep = {
+        "mean_delta": float(deltas.mean()),
+        "paired": True,
+        "champion": {"loss_mean": 0.5},
+        "challenger": {"loss_mean": 0.5 - float(deltas.mean())},
+        "slice_regressions": slices or {},
+        "bootstrap": paired_bootstrap(deltas, seed=42),
+        "sign_test": sign_test(deltas),
+    }
+    if drift_rep is not None:
+        rep["drift"] = drift_rep
+    return rep
+
+
+def test_decide_rollback_on_significant_regression():
+    g = PromotionGate(EvaluationConfig())
+    dec = g.decide(_report(-0.4), stage="canary")
+    assert dec.decision == "rollback"
+    assert dec.reason == "challenger_regression"
+    assert dec.evidence["bootstrap"]["p_better"] <= 0.05
+
+
+def test_decide_promotes_without_regression():
+    g = PromotionGate(EvaluationConfig())
+    assert g.decide(_report(0.3), stage="canary").promoted
+    # Statistically flat is NOT a regression: continuous training
+    # promotes the fresh cycle unless it is demonstrably worse.
+    assert g.decide(_report(0.0), stage="canary").promoted
+
+
+def test_decide_unpaired_regression_still_blocks():
+    """Family upgrades have no per-example pairing, but the aggregate
+    mean comparison must still catch a regression."""
+    g = PromotionGate(EvaluationConfig())
+    worse = {
+        "mean_delta": -0.4, "paired": False,
+        "champion": {"loss_mean": 0.3}, "challenger": {"loss_mean": 0.7},
+        "slice_regressions": {},
+    }
+    assert g.decide(worse, stage="canary").decision == "rollback"
+    better = {**worse, "mean_delta": 0.2,
+              "challenger": {"loss_mean": 0.1}}
+    assert g.decide(better, stage="canary").promoted
+
+
+def test_unpaired_mean_delta_is_aggregate_difference():
+    """PairedEval.mean_delta must not collapse to 0 when pairing is
+    impossible — the gates' mean thresholds read it."""
+    res_a = harness.EvalResult("champion", 10, 0.8, 0.5,
+                               np.zeros(0), np.zeros(0))
+    res_b = harness.EvalResult("challenger", 10, 0.3, 0.7,
+                               np.zeros(0), np.zeros(0))
+    pair = harness.PairedEval(res_a, res_b, np.zeros(0), paired=False)
+    assert pair.mean_delta == pytest.approx(0.5)
+    assert pair.to_dict()["mean_delta"] == pytest.approx(0.5)
+
+
+def test_decide_sign_test_catches_outlier_dragged_mean():
+    """The challenger loses slightly on 99% of examples while a handful
+    of huge champion outlier losses drag the mean positive but NOT
+    significantly so: the per-example win count flags it — hold. (A
+    mean improvement the bootstrap does call significant still
+    promotes: fixing catastrophic champion failures is a real win.)"""
+    n = 400
+    deltas = np.full(n, -0.05)          # challenger a bit worse everywhere
+    deltas[:4] = 8.0                    # ...except 4 champion blowups
+    assert deltas.mean() > 0
+    boot = paired_bootstrap(deltas, seed=42)
+    assert boot["p_better"] < 0.95      # mean improvement inconclusive
+    rep = {
+        "mean_delta": float(deltas.mean()), "paired": True,
+        "champion": {"loss_mean": 1.0},
+        "challenger": {"loss_mean": 1.0 - float(deltas.mean())},
+        "slice_regressions": {},
+        "bootstrap": boot,
+        "sign_test": sign_test(deltas),
+    }
+    g = PromotionGate(EvaluationConfig())
+    dec = g.decide(rep, stage="canary")
+    assert dec.decision == "hold"
+    assert dec.reason == "per_example_regression"
+    assert dec.evidence["sign_test"]["p_worse"] < 0.05
+
+
+def test_sign_test_p_worse_tail():
+    d = np.array([-1.0] * 9 + [1.0])
+    out = sign_test(d)
+    assert out["p_worse"] == pytest.approx(11 / 1024)
+    assert out["p_value"] == pytest.approx(1023 / 1024)
+
+
+def test_decide_slice_regression_blocks_aggregate_win():
+    g = PromotionGate(EvaluationConfig(max_slice_regression=0.2))
+    dec = g.decide(
+        _report(0.3, slices={"label_rain": 0.5, "label_no_rain": -0.1}),
+        stage="canary",
+    )
+    assert dec.decision == "rollback"
+    assert dec.reason == "slice_regression"
+
+
+def test_decide_holds_on_drift():
+    g = PromotionGate(EvaluationConfig())
+    dec = g.decide(
+        _report(0.1, drift_rep={"max_psi": 0.8, "any_drift": True}),
+        stage="canary",
+    )
+    assert dec.decision == "hold"
+    assert dec.reason == "data_drift"
+    assert dec.evidence["drift"]["max_psi"] == 0.8
+
+
+def test_decide_holds_on_shadow_disagreement():
+    g = PromotionGate(EvaluationConfig())
+    dec = g.decide(
+        _report(0.1), stage="canary",
+        disagreement={"n": 50, "rate": 0.6, "mean_tv": 0.4,
+                      "exceeded": True},
+    )
+    assert dec.decision == "hold"
+    assert dec.reason == "shadow_disagreement"
+
+
+def test_decide_require_improvement():
+    g = PromotionGate(EvaluationConfig(require_improvement=True))
+    assert g.decide(_report(0.0), stage="canary").decision == "hold"
+    promoted = g.decide(_report(0.4), stage="canary")
+    assert promoted.promoted and promoted.reason == "improvement"
+
+
+# ----------------------------------------------------------------------
+# Drift detectors (acceptance: flag a shifted mean, stay quiet on an
+# i.i.d. resample).
+
+def test_drift_flags_shift_quiet_on_iid_resample():
+    rng = np.random.default_rng(0)
+    train = rng.normal(0.0, 1.0, (4000, 5)).astype(np.float32)
+    snap = drift.snapshot_features(train, FEATURES)
+    # The snapshot must survive the JSON round trip it takes through
+    # the package manifest.
+    snap = json.loads(json.dumps(snap))
+
+    iid = rng.normal(0.0, 1.0, (1500, 5)).astype(np.float32)
+    quiet = drift.feature_drift(snap, iid, FEATURES)
+    assert not quiet["any_drift"]
+    assert quiet["max_psi"] < 0.1
+
+    shifted = iid.copy()
+    shifted[:, 2] += 1.0  # one sigma of mean shift
+    loud = drift.feature_drift(snap, shifted, FEATURES)
+    assert loud["any_drift"]
+    assert loud["features"]["f2"]["drifted"]
+    assert loud["features"]["f2"]["psi"] > 0.2
+    assert loud["features"]["f2"]["ks"] > 0.15
+    # The untouched features stay quiet.
+    assert not loud["features"]["f0"]["drifted"]
+    assert loud["max_psi"] == loud["features"]["f2"]["psi"]
+
+
+def test_drift_schema_change_is_drift():
+    rng = np.random.default_rng(0)
+    snap = drift.snapshot_features(
+        rng.normal(0, 1, (500, 2)).astype(np.float32), ["a", "b"]
+    )
+    # 'b' renamed to 'c' with the column count unchanged: the added
+    # name AND the removed name both read as drift — never a silent
+    # positional comparison against the wrong snapshot entry.
+    rep = drift.feature_drift(
+        snap, rng.normal(0, 1, (500, 2)).astype(np.float32), ["a", "c"]
+    )
+    assert rep["any_drift"]
+    assert rep["features"]["c"]["missing_in_snapshot"]
+    assert rep["features"]["b"]["missing_in_current"]
+    assert not rep["features"]["a"]["drifted"]
+
+
+def test_drift_discrete_features_use_psi_not_ks():
+    """Binary/low-cardinality features: an i.i.d. resample must stay
+    quiet (the bin-uniform KS reconstruction would read D~0.5), while a
+    real rate shift is caught by PSI over per-value bins."""
+    rng = np.random.default_rng(0)
+    binary = (rng.random((4000, 1)) < 0.3).astype(np.float32)
+    snap = drift.snapshot_features(binary, ["flag"])
+    assert snap["features"]["flag"]["discrete"]
+
+    resample = (rng.random((1500, 1)) < 0.3).astype(np.float32)
+    quiet = drift.feature_drift(snap, resample, ["flag"])
+    assert not quiet["any_drift"], quiet
+
+    shifted = (rng.random((1500, 1)) < 0.85).astype(np.float32)
+    loud = drift.feature_drift(snap, shifted, ["flag"])
+    assert loud["features"]["flag"]["drifted"]
+    assert loud["features"]["flag"]["psi"] > 0.2
+
+
+def test_drift_constant_feature_any_change_is_drift():
+    const = np.full((500, 1), 3.0, np.float32)
+    snap = drift.snapshot_features(const, ["c"])
+    quiet = drift.feature_drift(snap, const[:100], ["c"])
+    assert not quiet["any_drift"]
+    moved = np.full((100, 1), 3.5, np.float32)
+    assert drift.feature_drift(snap, moved, ["c"])["features"]["c"]["drifted"]
+
+
+def test_ks_statistic_bounds():
+    rng = np.random.default_rng(0)
+    a = rng.normal(0, 1, 500)
+    assert drift.ks_statistic(a, a) == 0.0
+    assert drift.ks_statistic(a, a + 100.0) == 1.0
+
+
+def test_prediction_disagreement():
+    live = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
+    agree = drift.prediction_disagreement(live, live)
+    assert agree["rate"] == 0.0 and agree["mean_tv"] == 0.0
+    flipped = live[:, ::-1]
+    total = drift.prediction_disagreement(live, flipped)
+    assert total["rate"] == 1.0
+    assert drift.prediction_disagreement(np.zeros((0, 2)), np.zeros((0, 2)))["n"] == 0
+
+
+# ----------------------------------------------------------------------
+# Harness.
+
+def test_per_example_nll_matches_mean_ce():
+    rng = np.random.default_rng(0)
+    probs = rng.dirichlet(np.ones(2), size=64)
+    labels = rng.integers(0, 2, 64)
+    losses = harness.per_example_nll(probs, labels)
+    expected = -np.log(probs[np.arange(64), labels])
+    np.testing.assert_allclose(losses, expected, rtol=1e-12)
+
+
+def test_evaluate_pair_paired_deltas_and_slices(tmp_path, processed_dir):
+    champ = harness.load_model(_package(tmp_path, "a", seed=0))
+    chall = harness.load_model(_package(tmp_path, "b", seed=1))
+    pair = harness.evaluate_pair(champ, chall, processed_dir)
+    assert pair.paired
+    assert len(pair.deltas) == pair.champion.n == pair.challenger.n
+    assert pair.mean_delta == pytest.approx(
+        pair.champion.loss_mean - pair.challenger.loss_mean, abs=1e-9
+    )
+    # The reference task's rain/no-rain slices exist and partition n.
+    slices = pair.challenger.slices
+    assert {"label_rain", "label_no_rain"} <= set(slices)
+    assert sum(s["n"] for s in slices.values()) == pair.challenger.n
+    regs = pair.slice_regressions()
+    assert set(regs) == set(slices)
+    # Identical models pair to exactly zero deltas.
+    same = harness.evaluate_pair(champ, champ, processed_dir)
+    assert float(np.abs(same.deltas).max()) == 0.0
+
+
+def test_harness_engines_agree(tmp_path, processed_dir):
+    w, m = harness.load_model(_package(tmp_path, "eng", seed=2))
+    x, y = harness.load_eval_split(processed_dir, m)
+    p_np = harness.batched_probs(w, m, x, engine="numpy", batch_size=64)
+    p_jax = harness.batched_probs(w, m, x, engine="jax", batch_size=64)
+    np.testing.assert_allclose(p_np, p_jax, atol=2e-6)
+
+
+def test_harness_eval_errors(tmp_path):
+    with pytest.raises(harness.EvalError):
+        harness.model_from_package(str(tmp_path / "missing"))
+    with pytest.raises(harness.EvalError):
+        harness.load_eval_split(
+            str(tmp_path / "nodata"), {"model": "weather_mlp"}
+        )
+
+
+# ----------------------------------------------------------------------
+# prepare_package manifest (satellite): full metrics + data snapshot.
+
+def test_prepare_package_persists_metrics_and_snapshot(
+    tmp_path, monkeypatch, processed_dir
+):
+    monkeypatch.delenv("DCT_RUN_ID", raising=False)
+    store = LocalTracking(root=str(tmp_path / "runs"))
+    model = get_model(ModelConfig(), input_dim=5)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 5)))
+    meta = {"model": "weather_mlp", "input_dim": 5, "hidden_dim": 64,
+            "num_classes": 2, "dropout": 0.2, "feature_names": FEATURES}
+    ckpt = save_checkpoint(str(tmp_path / "w" / "weather-best-00-0.30.ckpt"),
+                           params, meta)
+    store.start_run()
+    store.log_metrics(
+        {"val_loss": 0.3, "val_acc": 0.85, "val_f1": 0.8}, step=1
+    )
+    store.log_artifact(ckpt, "best_checkpoints")
+    store.end_run()
+
+    info = prepare_package(
+        store, str(tmp_path / "deploy"), data_dir=processed_dir
+    )
+    assert info["metrics"]["val_acc"] == pytest.approx(0.85)
+    with open(tmp_path / "deploy" / "run_info.json") as f:
+        manifest = json.load(f)
+    # The selected run's FULL final metrics are in the manifest — what
+    # gates (and humans) read back about what was promoted.
+    assert manifest["metrics"] == {
+        "val_loss": 0.3, "val_acc": 0.85, "val_f1": 0.8,
+    }
+    # Plus the training-data snapshot the drift detectors compare
+    # future ETL output against.
+    snap = manifest["data_snapshot"]
+    assert snap["rows"] > 0
+    assert set(snap["features"]) == {f + "_norm" for f in
+                                     ["Temperature", "Humidity", "Wind_Speed",
+                                      "Cloud_Cover", "Pressure"]}
+    for feat in snap["features"].values():
+        assert len(feat["counts"]) == len(feat["edges"]) - 1
+    # A packaging host without the data ships None, never a failure.
+    info2 = prepare_package(
+        store, str(tmp_path / "deploy2"), data_dir=str(tmp_path / "nope")
+    )
+    with open(tmp_path / "deploy2" / "run_info.json") as f:
+        assert json.load(f)["data_snapshot"] is None
+    assert info2["val_loss"] == pytest.approx(0.3)
+
+
+def test_manifest_stamps_split_and_gate_honors_it(
+    tmp_path, monkeypatch, processed_dir
+):
+    """The gate must rebuild the TRAINING run's split from the package
+    manifest — the gate process has no env inheritance from the
+    training launch, so env parity cannot be assumed."""
+    monkeypatch.delenv("DCT_RUN_ID", raising=False)
+    store = LocalTracking(root=str(tmp_path / "runs"))
+    model = get_model(ModelConfig(), input_dim=5)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 5)))
+    meta = {"model": "weather_mlp", "input_dim": 5, "hidden_dim": 64,
+            "num_classes": 2, "dropout": 0.2, "feature_names": FEATURES}
+    ckpt = save_checkpoint(str(tmp_path / "w" / "weather-best-00-0.30.ckpt"),
+                           params, meta)
+    # The run trained under seed 7 with a 0.1 val split (both logged by
+    # the trainer; the packaging process's env says 42/0.2).
+    store.start_run(params={"seed": 7, "val_fraction": 0.1})
+    store.log_metrics({"val_loss": 0.3}, step=1)
+    store.log_artifact(ckpt, "best_checkpoints")
+    store.end_run()
+    prepare_package(store, str(tmp_path / "deploy"), data_dir=processed_dir)
+    with open(tmp_path / "deploy" / "run_info.json") as f:
+        split = json.load(f)["split"]
+    assert split["seed"] == 7
+    assert split["val_fraction"] == pytest.approx(0.1)
+    # The gate reads the stamped split even though ITS env says 42/0.2.
+    gate = PromotionGate(EvaluationConfig(), processed_dir=processed_dir)
+    assert gate._split_for(str(tmp_path / "deploy")) == (0.1, 7)
+    # No stamp -> env fallback, never a crash.
+    assert gate._split_for(str(tmp_path / "nope")) == (
+        gate.val_fraction, gate.split_seed,
+    )
+
+
+def test_log_eval_report_never_leaks_running_run(tmp_path):
+    class _FlakyTracker(LocalTracking):
+        def log_artifact(self, local_path, artifact_path):
+            raise OSError("artifact store down")
+
+    store = _FlakyTracker(root=str(tmp_path / "runs"))
+    report_path = tmp_path / "eval_report.json"
+    report_path.write_text(json.dumps({
+        "champion": {"loss_mean": 0.3}, "challenger": {"loss_mean": 0.2},
+        "mean_delta": 0.1,
+    }))
+    with pytest.raises(OSError):
+        gates.log_eval_report(
+            store, json.loads(report_path.read_text()), str(report_path)
+        )
+    # The half-logged run was closed as FAILED, not leaked RUNNING.
+    run_dir = tmp_path / "runs" / "weather_forecasting"
+    metas = list(run_dir.glob("*/meta.json"))
+    assert metas, "run was never created"
+    assert json.loads(metas[0].read_text())["status"] == "FAILED"
+
+
+# ----------------------------------------------------------------------
+# Mirror capture on the local endpoint client.
+
+def test_mirror_capture_records_paired_probs(tmp_path, monkeypatch):
+    capture = str(tmp_path / "mirror.jsonl")
+    monkeypatch.setenv("DCT_MIRROR_CAPTURE", capture)
+    client = LocalEndpointClient()
+    ro = RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None)
+    ro.run(_package(tmp_path, "v1", seed=0))
+    ro2 = RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None)
+    new_slot, old_slot = ro2.deploy_new_slot(_package(tmp_path, "v2", seed=9))
+    ro2.start_shadow(new_slot, old_slot)
+    for i in range(4):
+        client.score("ep", {"data": [[float(i)] * 5]})
+    live, shadow = drift.read_mirror_capture(capture)
+    assert live.shape == shadow.shape == (4, 2)
+    rep = drift.disagreement_report(capture, max_disagreement=0.25)
+    assert rep is not None and rep["n"] == 4
+    with open(capture) as f:
+        rec = json.loads(f.readline())
+    assert rec["live_slot"] == old_slot and rec["shadow_slot"] == new_slot
+    # No capture file -> no evidence (never fabricated agreement).
+    assert drift.disagreement_report(str(tmp_path / "none.jsonl")) is None
+
+
+def test_mirror_capture_scoped_to_current_shadow(tmp_path, monkeypatch):
+    """A new shadow stage truncates the capture file, and the reader
+    filters by shadow slot — cycle 1's disagreements must not keep
+    holding (or excusing) cycle 2's challenger."""
+    capture = str(tmp_path / "mirror.jsonl")
+    monkeypatch.setenv("DCT_MIRROR_CAPTURE", capture)
+    with open(capture, "w") as f:  # stale record from a previous cycle
+        f.write(json.dumps({
+            "shadow_slot": "green", "live_slot": "blue",
+            "live_probs": [[1.0, 0.0]], "shadow_probs": [[0.0, 1.0]],
+        }) + "\n")
+    client = LocalEndpointClient()
+    ro = RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None)
+    ro.run(_package(tmp_path, "v1", seed=0))
+    ro2 = RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None)
+    new_slot, old_slot = ro2.deploy_new_slot(_package(tmp_path, "v2", seed=1))
+    ro2.start_shadow(new_slot, old_slot)
+    # The stale record is gone; only fresh pairs remain.
+    client.score("ep", {"data": [[0.5] * 5]})
+    live, _ = drift.read_mirror_capture(capture)
+    assert live.shape == (1, 2)
+    # Slot filtering: records for other shadow slots are invisible.
+    none_live, _ = drift.read_mirror_capture(capture, shadow_slot="nope")
+    assert len(none_live) == 0
+    scoped = drift.disagreement_report(capture, shadow_slot=new_slot)
+    assert scoped is not None and scoped["n"] == 1
+    assert scoped["shadow_slot"] == new_slot
+
+
+def test_mirror_capture_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("DCT_MIRROR_CAPTURE", raising=False)
+    client = LocalEndpointClient()
+    assert client.mirror_capture_path is None
+    # With persistent state, capture defaults beside the state file.
+    client2 = LocalEndpointClient(state_path=str(tmp_path / "s.json"))
+    assert client2.mirror_capture_path == str(tmp_path / "s.json") + "_mirror.jsonl"
+
+
+# ----------------------------------------------------------------------
+# Gate ledger -> /metrics text.
+
+def test_record_decision_ledger_and_metrics_text(tmp_path):
+    ledger = str(tmp_path / "ledger.json")
+    gates.record_decision(
+        GateDecision("rollback", "canary", "challenger_regression",
+                     {"drift": {"max_psi": 0.42}}),
+        ledger_path=ledger,
+    )
+    gates.record_decision(
+        GateDecision("promote", "full_rollout", "no_regression"),
+        ledger_path=ledger,
+    )
+    text = gates.render_gate_metrics(ledger)
+    assert 'dct_deploy_gate_decisions_total{decision="rollback"} 1' in text
+    assert 'dct_deploy_gate_decisions_total{decision="promote"} 1' in text
+    assert 'dct_deploy_gate_decisions_total{decision="hold"} 0' in text
+    assert "dct_drift_psi 0.42" in text
+    # The textfile twin landed next to the ledger.
+    prom = tmp_path / "deploy_gate.prom"
+    assert prom.exists()
+    assert "dct_deploy_gate_decisions_total" in prom.read_text()
+    # No ledger -> no series, no error.
+    assert gates.render_gate_metrics(str(tmp_path / "none.json")) == ""
+
+
+# ----------------------------------------------------------------------
+# Gate-driven rollback wiring (satellite): the orchestrator reverts and
+# records on a blocking decision; a promote gate is invisible.
+
+class _StubGate:
+    """Any object with .cfg and .evaluate() is a valid gate."""
+
+    def __init__(self, decision):
+        self.cfg = EvaluationConfig()
+        self._decision = decision
+        self.calls = []
+
+    def evaluate(self, *, challenger_dir, champion_dir, stage,
+                 mirror_capture=None, shadow_slot=None):
+        self.calls.append((stage, challenger_dir, champion_dir))
+        return GateDecision(self._decision, stage, "stub")
+
+
+def _events_at(events_dir):
+    path = os.path.join(events_dir, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_gate_rollback_reverts_endpoint(tmp_path, monkeypatch):
+    monkeypatch.setenv("DCT_EVENTS_DIR", str(tmp_path / "events"))
+    monkeypatch.setenv("DCT_GATE_LEDGER", str(tmp_path / "ledger.json"))
+    client = LocalEndpointClient()
+    RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None).run(
+        _package(tmp_path, "v1", seed=0)
+    )
+    gate = _StubGate("rollback")
+    ro = RolloutOrchestrator(
+        client, "ep", sleep_fn=lambda s: None, gate=gate
+    )
+    new_slot, old_slot = ro.deploy_new_slot(_package(tmp_path, "v2", seed=1))
+    ro.start_shadow(new_slot, old_slot)
+    assert client.get_mirror_traffic("ep") == {new_slot: 20}
+    with pytest.raises(GateRejection) as exc:
+        ro.start_canary(new_slot, old_slot)
+    assert exc.value.decision.decision == "rollback"
+    # Auto-revert: old slot back to 100% live, mirror cleared; the
+    # challenger never saw live traffic.
+    assert client.get_traffic("ep") == {old_slot: 100}
+    assert client.get_mirror_traffic("ep") == {}
+    # The gate saw the real package dirs.
+    assert gate.calls[0][0] == "canary"
+    assert gate.calls[0][1].endswith("v2") and gate.calls[0][2].endswith("v1")
+    # On the record: deploy.gate (decision) then deploy.rollback.
+    events = _events_at(str(tmp_path / "events"))
+    gate_evs = [e for e in events if e["event"] == "deploy.gate"]
+    rb_evs = [e for e in events if e["event"] == "deploy.rollback"]
+    assert gate_evs and gate_evs[-1]["decision"] == "rollback"
+    assert gate_evs[-1]["stage"] == "canary"
+    assert rb_evs and rb_evs[-1]["failed_stage"] == "gate:canary"
+    assert rb_evs[-1]["reverted"] is True
+    # And in the metrics ledger.
+    text = gates.render_gate_metrics(str(tmp_path / "ledger.json"))
+    assert 'decision="rollback"} 1' in text
+
+
+def test_gate_hold_also_blocks_and_reverts(tmp_path, monkeypatch):
+    monkeypatch.setenv("DCT_EVENTS_DIR", str(tmp_path / "events"))
+    monkeypatch.setenv("DCT_GATE_LEDGER", str(tmp_path / "ledger.json"))
+    client = LocalEndpointClient()
+    RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None).run(
+        _package(tmp_path, "v1", seed=0)
+    )
+    ro = RolloutOrchestrator(
+        client, "ep", sleep_fn=lambda s: None, gate=_StubGate("hold")
+    )
+    with pytest.raises(GateRejection):
+        ro.run(_package(tmp_path, "v2", seed=1))
+    assert client.get_traffic("ep") == {"blue": 100}
+
+
+def test_gate_promote_walks_to_full_rollout(tmp_path, monkeypatch):
+    monkeypatch.setenv("DCT_EVENTS_DIR", str(tmp_path / "events"))
+    monkeypatch.setenv("DCT_GATE_LEDGER", str(tmp_path / "ledger.json"))
+    client = LocalEndpointClient()
+    RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None).run(
+        _package(tmp_path, "v1", seed=0)
+    )
+    gate = _StubGate("promote")
+    ro = RolloutOrchestrator(
+        client, "ep", sleep_fn=lambda s: None, gate=gate
+    )
+    events = ro.run(_package(tmp_path, "v2", seed=1))
+    assert client.get_traffic("ep") == {"green": 100}
+    # Both transitions were gated.
+    assert [c[0] for c in gate.calls] == ["canary", "full_rollout"]
+    assert [e.stage for e in events] == [
+        "deploy_new_slot", "shadow", "gate_canary", "canary",
+        "gate_full_rollout", "full_rollout",
+    ]
+
+
+def test_gate_first_deployment_ungated(tmp_path):
+    client = LocalEndpointClient()
+    gate = _StubGate("rollback")  # would block anything it sees
+    ro = RolloutOrchestrator(
+        client, "ep", sleep_fn=lambda s: None, gate=gate
+    )
+    ro.run(_package(tmp_path, "v1", seed=0))
+    assert client.get_traffic("ep") == {"blue": 100}
+    assert gate.calls == []  # no champion, nothing to consult
+
+
+def test_gate_consult_crash_fails_closed(tmp_path, monkeypatch):
+    monkeypatch.setenv("DCT_EVENTS_DIR", str(tmp_path / "events"))
+    monkeypatch.setenv("DCT_GATE_LEDGER", str(tmp_path / "ledger.json"))
+
+    class _Exploding:
+        cfg = EvaluationConfig()
+
+        def evaluate(self, **kw):
+            raise RuntimeError("gate infrastructure down")
+
+    client = LocalEndpointClient()
+    RolloutOrchestrator(client, "ep", sleep_fn=lambda s: None).run(
+        _package(tmp_path, "v1", seed=0)
+    )
+    ro = RolloutOrchestrator(
+        client, "ep", sleep_fn=lambda s: None, gate=_Exploding()
+    )
+    with pytest.raises(GateRejection) as exc:
+        ro.run(_package(tmp_path, "v2", seed=1))
+    assert exc.value.decision.decision == "hold"
+    assert "gate_error" in exc.value.decision.reason
+    assert client.get_traffic("ep") == {"blue": 100}
+
+
+# ----------------------------------------------------------------------
+# Offline-eval caching + determinism through the real gate.
+
+def test_offline_eval_cached_and_deterministic(tmp_path, processed_dir):
+    champ = _package(tmp_path, "champ", seed=0)
+    chall = _package(tmp_path, "chall", seed=1)
+    gate = PromotionGate(EvaluationConfig(), processed_dir=processed_dir)
+    r1 = gate.offline_eval(chall, champ)
+    cache = os.path.join(chall, "eval_report.json")
+    assert os.path.exists(cache)
+    r2 = gate.offline_eval(chall, champ)  # cache hit
+    assert r1 == r2
+    os.remove(cache)
+    r3 = gate.offline_eval(chall, champ)  # full recompute
+    assert r3["bootstrap"] == r1["bootstrap"]  # seeded: bit-identical
+    assert r3["mean_delta"] == r1["mean_delta"]
+    # A different champion invalidates the cache.
+    other = _package(tmp_path, "other", seed=2)
+    r4 = gate.offline_eval(chall, other)
+    assert r4["champion_dir"] == other
+
+
+def test_gate_evaluate_no_champion_promotes(tmp_path, processed_dir):
+    chall = _package(tmp_path, "chall", seed=1)
+    gate = PromotionGate(EvaluationConfig(), processed_dir=processed_dir)
+    for champ in (None, str(tmp_path / "gone"), chall):
+        dec = gate.evaluate(
+            challenger_dir=chall, champion_dir=champ, stage="canary"
+        )
+        assert dec.promoted and dec.reason == "no_champion"
+
+
+def test_gate_evaluate_no_data_fail_open_vs_closed(tmp_path):
+    champ = _package(tmp_path, "champ", seed=0)
+    chall = _package(tmp_path, "chall", seed=1)
+    nodata = str(tmp_path / "nodata")
+    open_gate = PromotionGate(
+        EvaluationConfig(fail_open=True), processed_dir=nodata
+    )
+    dec = open_gate.evaluate(
+        challenger_dir=chall, champion_dir=champ, stage="canary"
+    )
+    assert dec.promoted and dec.reason.startswith("no_eval_evidence")
+    closed_gate = PromotionGate(
+        EvaluationConfig(fail_open=False), processed_dir=nodata
+    )
+    dec = closed_gate.evaluate(
+        challenger_dir=chall, champion_dir=champ, stage="canary"
+    )
+    assert dec.decision == "hold"
+
+
+# ----------------------------------------------------------------------
+# Report CLI renderers.
+
+def test_report_renderers(tmp_path, processed_dir, capsys):
+    champ = _package(tmp_path, "champ", seed=0)
+    chall = _package(tmp_path, "chall", seed=1)
+    gate = PromotionGate(EvaluationConfig(), processed_dir=processed_dir)
+    gate.offline_eval(chall, champ)
+
+    from dct_tpu.evaluation import report as report_cli
+
+    events_file = tmp_path / "events" / "events.jsonl"
+    events_file.parent.mkdir()
+    events_file.write_text(json.dumps({
+        "run_id": "dct-x", "component": "deploy", "event": "deploy.gate",
+        "stage": "canary", "decision": "promote", "reason": "no_regression",
+        "mean_delta": 0.01,
+    }) + "\n")
+    rc = report_cli.main([str(tmp_path), "--events", str(events_file.parent)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "champion" in out and "challenger" in out
+    assert "mean paired delta" in out
+    assert "label_rain" in out
+    assert "decision=promote" in out
+    # Missing root is a clean exit code, not a traceback.
+    assert report_cli.main([str(tmp_path / "missing")]) == 2

@@ -1,0 +1,299 @@
+"""E2E acceptance for the continuous-evaluation subsystem: two full
+local training cycles against a deployed champion.
+
+Cycle A (degraded challenger — trained on label-shuffled data, the
+"silently broken ETL" failure mode): the promotion gate stops it at
+shadow -> canary and the endpoint auto-reverts (old slot back to 100%,
+mirror cleared), with ``deploy.gate`` + ``deploy.rollback`` events on
+record.
+
+Cycle B (genuinely better challenger — same data, more epochs): passes
+all gates to full rollout.
+
+Both outcomes are visible as a tracking-logged eval report and as
+``dct_deploy_gate_decisions_total`` on the serving server's
+``GET /metrics``.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dct_tpu.config import (
+    DataConfig,
+    EvaluationConfig,
+    ModelConfig,
+    RunConfig,
+    TrainConfig,
+)
+from dct_tpu.deploy.local import LocalEndpointClient
+from dct_tpu.deploy.rollout import (
+    RolloutOrchestrator,
+    package_manifest,
+    prepare_package,
+)
+from dct_tpu.evaluation.gates import GateRejection, PromotionGate
+from dct_tpu.tracking.client import LocalTracking
+from dct_tpu.train.trainer import Trainer
+
+
+def _train_cycle(work, processed_dir, *, epochs, data=None, seed=42):
+    """One full local training cycle -> (tracker, TrainResult)."""
+    cfg = RunConfig(
+        data=DataConfig(
+            processed_dir=processed_dir, models_dir=str(work / "models")
+        ),
+        model=ModelConfig(),
+        train=TrainConfig(epochs=epochs, batch_size=4, bf16_compute=False,
+                          seed=seed),
+    )
+    tracker = LocalTracking(
+        root=str(work / "mlruns"), experiment="weather_forecasting"
+    )
+    result = Trainer(cfg, tracker=tracker).fit(data=data)
+    return tracker, result
+
+
+@pytest.fixture(scope="module")
+def rig(tmp_path_factory, request):
+    """Champion deployed at 100%, plus packaged good and bad challengers
+    (each from its own full train->track->package cycle)."""
+    processed_dir = request.getfixturevalue("processed_dir")
+    root = tmp_path_factory.mktemp("eval_e2e")
+
+    champ_tracker, champ = _train_cycle(
+        root / "champ", processed_dir, epochs=2
+    )
+    champ_pkg = str(root / "pkg_champion")
+    prepare_package(champ_tracker, champ_pkg, data_dir=processed_dir)
+
+    # Degraded challenger: a full cycle on label-shuffled data — the
+    # model trains to confident noise, exactly what a silently broken
+    # upstream label join would ship.
+    from dct_tpu.data.dataset import WeatherArrays, load_processed_dataset
+
+    data = load_processed_dataset(processed_dir)
+    rng = np.random.default_rng(0)
+    shuffled = WeatherArrays(
+        features=data.features,
+        labels=rng.permutation(data.labels),
+        feature_names=data.feature_names,
+    )
+    bad_tracker, _ = _train_cycle(
+        root / "bad", processed_dir, epochs=2, data=shuffled
+    )
+    bad_pkg = str(root / "pkg_bad")
+    prepare_package(bad_tracker, bad_pkg, data_dir=processed_dir)
+
+    # Better challenger: the same trajectory trained further.
+    good_tracker, good = _train_cycle(
+        root / "good", processed_dir, epochs=6
+    )
+    good_pkg = str(root / "pkg_good")
+    prepare_package(good_tracker, good_pkg, data_dir=processed_dir)
+    assert good.val_loss <= champ.val_loss + 0.05
+
+    return {
+        "root": root,
+        "processed_dir": processed_dir,
+        "champ_pkg": champ_pkg,
+        "bad_pkg": bad_pkg,
+        "good_pkg": good_pkg,
+        "good_tracker": good_tracker,
+    }
+
+
+@pytest.fixture()
+def gated_endpoint(rig, tmp_path, monkeypatch):
+    """A fresh endpoint serving the champion, observability redirected
+    into tmp, and a real PromotionGate over the rig's eval data."""
+    monkeypatch.setenv("DCT_EVENTS_DIR", str(tmp_path / "events"))
+    monkeypatch.setenv("DCT_GATE_LEDGER", str(tmp_path / "ledger.json"))
+    # The rig's Trainer runs installed THEIR config-built logs as the
+    # process defaults; clear them so the deploy side rebuilds from the
+    # env redirected above.
+    from dct_tpu.observability import events as _events_mod
+    from dct_tpu.observability import spans as _spans_mod
+
+    _events_mod.set_default(None)
+    _spans_mod.set_default(None)
+    state = str(tmp_path / "endpoint_state.json")
+    monkeypatch.setenv("DCT_LOCAL_ENDPOINT_STATE", state)
+    client = LocalEndpointClient(state_path=state)
+    RolloutOrchestrator(client, "weather-ep", sleep_fn=lambda s: None).run(
+        rig["champ_pkg"]
+    )
+    assert client.get_traffic("weather-ep") == {"blue": 100}
+    gate = PromotionGate(
+        EvaluationConfig(ledger_path=str(tmp_path / "ledger.json")),
+        processed_dir=rig["processed_dir"],
+    )
+    return client, gate, tmp_path
+
+
+def _events(tmp_path):
+    path = tmp_path / "events" / "events.jsonl"
+    if not path.exists():
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_degraded_challenger_blocked_and_reverted(rig, gated_endpoint):
+    client, gate, tmp_path = gated_endpoint
+    ro = RolloutOrchestrator(
+        client, "weather-ep", sleep_fn=lambda s: None, gate=gate
+    )
+    new_slot, old_slot = ro.deploy_new_slot(rig["bad_pkg"])
+    ro.start_shadow(new_slot, old_slot)
+    # Shadow traffic flows: live requests answered by the champion,
+    # mirrored to the challenger, pairs captured for the disagreement
+    # detector.
+    for i in range(6):
+        client.score("weather-ep", {"data": [[0.1 * i] * 5]})
+    assert os.path.exists(client.mirror_capture_path)
+
+    with pytest.raises(GateRejection) as exc:
+        ro.start_canary(new_slot, old_slot)
+    decision = exc.value.decision
+    assert decision.decision == "rollback"
+    assert decision.reason == "challenger_regression"
+    # The evidence names the regression: champion beats challenger.
+    ev = decision.evidence
+    assert ev["challenger_loss"] > ev["champion_loss"]
+    assert ev["mean_delta"] < 0
+    assert ev["bootstrap"]["p_better"] < 0.05
+
+    # Auto-revert: champion back at 100%, mirror cleared, challenger
+    # never served live traffic.
+    assert client.get_traffic("weather-ep") == {old_slot: 100}
+    assert client.get_mirror_traffic("weather-ep") == {}
+
+    events = _events(tmp_path)
+    gate_evs = [e for e in events if e["event"] == "deploy.gate"]
+    assert gate_evs and gate_evs[-1]["decision"] == "rollback"
+    assert gate_evs[-1]["stage"] == "canary"
+    rb = [e for e in events if e["event"] == "deploy.rollback"]
+    assert rb and rb[-1]["failed_stage"] == "gate:canary"
+    assert rb[-1]["reverted"] is True
+
+    # The offline eval report was cached into the challenger package —
+    # the operator-facing evidence trail.
+    with open(os.path.join(rig["bad_pkg"], "eval_report.json")) as f:
+        report = json.load(f)
+    assert report["challenger"]["loss_mean"] > report["champion"]["loss_mean"]
+    # Same data distribution -> the drift detectors stayed quiet (the
+    # labels were shuffled, not the features).
+    assert report["drift"] is not None and not report["drift"]["any_drift"]
+
+
+def test_better_challenger_promotes_to_full_rollout(rig, gated_endpoint):
+    client, gate, tmp_path = gated_endpoint
+    ro = RolloutOrchestrator(
+        client, "weather-ep", sleep_fn=lambda s: None, gate=gate
+    )
+    stages = [e.stage for e in ro.run(rig["good_pkg"])]
+    assert stages == [
+        "deploy_new_slot", "shadow", "gate_canary", "canary",
+        "gate_full_rollout", "full_rollout",
+    ]
+    assert client.get_traffic("weather-ep") == {"green": 100}
+    assert client.list_deployments("weather-ep") == ["green"]
+    events = _events(tmp_path)
+    decisions = [
+        (e["stage"], e["decision"])
+        for e in events if e["event"] == "deploy.gate"
+    ]
+    assert decisions == [("canary", "promote"), ("full_rollout", "promote")]
+    # Gate determinism (acceptance): re-evaluating the same pair under
+    # the same config reproduces the decision and its statistics.
+    os.remove(os.path.join(rig["good_pkg"], "eval_report.json"))
+    d1 = gate.evaluate(
+        challenger_dir=rig["good_pkg"],
+        champion_dir=rig["champ_pkg"], stage="canary",
+    )
+    os.remove(os.path.join(rig["good_pkg"], "eval_report.json"))
+    d2 = gate.evaluate(
+        challenger_dir=rig["good_pkg"],
+        champion_dir=rig["champ_pkg"], stage="canary",
+    )
+    assert d1.promoted and d2.promoted
+    assert d1.evidence["bootstrap"] == d2.evidence["bootstrap"]
+
+
+def test_gate_decisions_on_serving_metrics(rig, gated_endpoint):
+    """Both outcomes surface as dct_deploy_gate_decisions_total on the
+    endpoint server's GET /metrics."""
+    import threading
+
+    client, gate, tmp_path = gated_endpoint
+    ro = RolloutOrchestrator(
+        client, "weather-ep", sleep_fn=lambda s: None, gate=gate
+    )
+    with pytest.raises(GateRejection):
+        ro.run(rig["bad_pkg"])
+    ro2 = RolloutOrchestrator(
+        client, "weather-ep", sleep_fn=lambda s: None, gate=gate
+    )
+    ro2.run(rig["good_pkg"])
+
+    from dct_tpu.serving.server import make_endpoint_server
+
+    server = make_endpoint_server(
+        "weather-ep", state_path=client.state_path
+    )
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+    finally:
+        server.shutdown()
+    assert 'dct_deploy_gate_decisions_total{decision="rollback"} 1' in text
+    assert 'dct_deploy_gate_decisions_total{decision="promote"} 2' in text
+    assert "dct_drift_psi" in text
+    # The per-slot serving series still render beside the gate series.
+    assert "dct_requests_total" in text
+
+
+def test_eval_report_logged_to_tracking(rig, gated_endpoint):
+    """The eval report lands in the tracking store as an artifact (its
+    own kind=evaluation run, invisible to best-run selection)."""
+    client, gate, tmp_path = gated_endpoint
+    report = gate.offline_eval(rig["good_pkg"], rig["champ_pkg"])
+    tracker = rig["good_tracker"]
+    best_before = tracker.search_best_run("val_loss", "min")
+
+    from dct_tpu.evaluation.gates import log_eval_report
+
+    run_id = log_eval_report(
+        tracker, report,
+        os.path.join(rig["good_pkg"], "eval_report.json"),
+    )
+    assert run_id is not None
+    art = tracker.download_artifacts(
+        run_id, "evaluation", str(tmp_path / "dl")
+    )
+    with open(os.path.join(art, "eval_report.json")) as f:
+        logged = json.load(f)
+    assert logged["mean_delta"] == report["mean_delta"]
+    # The evaluation run logs no val_loss: best-run selection unchanged.
+    assert tracker.search_best_run(
+        "val_loss", "min"
+    ).run_id == best_before.run_id
+
+
+def test_manifest_carries_champion_metrics(rig):
+    """Satellite: the package manifest persists the promoted run's full
+    final metrics, not just a printed val_loss."""
+    manifest = package_manifest(rig["champ_pkg"])
+    assert "val_loss" in manifest["metrics"]
+    assert "val_acc" in manifest["metrics"]
+    assert manifest["data_snapshot"]["rows"] > 0
+    assert package_manifest(str(rig["root"] / "nope")) == {}
