@@ -143,6 +143,17 @@ def bench_tpu(data) -> tuple[float, float]:
     return samples / dt / n_chips, float(jax.device_get(losses)[-1])
 
 
+def _bench_prefetch_spans() -> int:
+    """ONE parse of DCT_PREFETCH_SPANS for the bench: the trainer-loop
+    legs build their TrainConfig with it and the trainer_gap stanza
+    stamps the same value, so the recorded provenance can never diverge
+    from the mode that was actually measured."""
+    try:
+        return int(os.environ.get("DCT_PREFETCH_SPANS", "1") or 1)
+    except ValueError:
+        return 1
+
+
 def bench_trainer_loop(data, tmp: str, epoch_chunk: int = 1) -> float:
     """The PRODUCT number: Trainer.fit() at parity config — eval,
     best/last checkpointing, resume-state saves, logging, per-epoch
@@ -164,6 +175,11 @@ def bench_trainer_loop(data, tmp: str, epoch_chunk: int = 1) -> float:
     # (K' < K) would compile a SECOND program inside the steady window
     # and measure compilation, not throughput.
     epochs = (1 + TIMED_EPOCHS) if epoch_chunk == 1 else 2 * epoch_chunk
+    # Honor DCT_PREFETCH_SPANS here even though the config is built
+    # directly (not from_env): the record's trainer_gap stanza stamps
+    # this knob as the measured run's provenance, and an operator's
+    # serial-vs-pipelined A/B must actually measure the mode it reports.
+    prefetch = _bench_prefetch_spans()
     cfg = RunConfig(
         data=DataConfig(
             # The serving section reads bench_models/ (the chunk=1 leg's
@@ -176,6 +192,7 @@ def bench_trainer_loop(data, tmp: str, epoch_chunk: int = 1) -> float:
         ),
         train=TrainConfig(
             epochs=epochs, batch_size=BATCH, epoch_chunk=epoch_chunk,
+            prefetch_spans=prefetch,
         ),
         tracking=TrackingConfig(experiment="bench"),
     )
@@ -906,6 +923,13 @@ _BENCH_T0 = time.perf_counter()
 # mid-run (which both loses the record and wedges the TPU relay).
 _DEADLINE = float(os.environ.get("DCT_BENCH_DEADLINE", "1500"))
 
+# Wall seconds the backend probe consumed before any measurement could
+# start (set by main() once ensure_live_backend returns). Subtracted
+# from every gate's elapsed clock: a dead relay costs its 750 s probe
+# ONCE instead of silently cancelling every frac-gated leg downstream —
+# r05 lost trainer_loop_chunked exactly this way (VERDICT r5 item 3).
+_PROBE_ELAPSED = 0.0
+
 
 def _over_deadline(name: str, frac: float = 1.0) -> bool:
     """``frac`` < 1 carves out budget for the sections BEHIND this one:
@@ -913,7 +937,7 @@ def _over_deadline(name: str, frac: float = 1.0) -> bool:
     (tunnel compiles), and at frac=1 they starve the MoE/serving
     sections the record also needs (the E>=16 sorted_speedup is a
     driver-record deliverable, not a nice-to-have)."""
-    elapsed = time.perf_counter() - _BENCH_T0
+    elapsed = time.perf_counter() - _BENCH_T0 - _PROBE_ELAPSED
     budget = _DEADLINE * frac
     if _DEADLINE > 0 and elapsed > budget:
         print(
@@ -977,6 +1001,153 @@ def _flush_partial(record: dict) -> None:
         except OSError:
             pass
     print(f"[bench] partial: {payload}", file=sys.stderr, flush=True)
+
+
+def _stdout_record(record: dict) -> dict:
+    """The driver machine-parses the final JSON line from a 2,000-byte
+    stdout tail; r05's line grew to 2,578 B (prior_onchip + val_parity
+    stanzas) and shipped ``parsed: null`` for the first time in five
+    rounds (VERDICT r5 item 1). This builds the PRINTED record: the
+    verbatim carry-forward stays on disk (``BENCH_PARTIAL.json`` /
+    ``BENCH_ONCHIP_LATEST.json``) while stdout gets a ~250 B digest of
+    prior_onchip's headline numbers and a val_parity with the ~140 B
+    protocol prose reduced to its BASELINE.md pointer. Everything else
+    passes through unchanged. tests/test_bench_record.py pins the
+    worst-case fully-populated line at <= 1,800 B."""
+    out = dict(record)
+    po = out.get("prior_onchip")
+    if isinstance(po, dict):
+        rec = po.get("record") or {}
+        digest = {
+            "source": po.get("source"),
+            "captured_utc": po.get("captured_utc"),
+            "platform": rec.get("platform"),
+            "value": rec.get("value"),
+            "vs_baseline": rec.get("vs_baseline"),
+            "mfu": rec.get("mfu"),
+        }
+        camp = po.get("campaign")
+        if isinstance(camp, dict):
+            digest["campaign_items"] = camp.get("tpu_item_count")
+        newer = po.get("newer_partial")
+        if isinstance(newer, dict):
+            nrec = newer.get("record") or {}
+            digest["newer_partial_utc"] = newer.get("captured_utc")
+            digest["newer_partial_value"] = nrec.get("value")
+        out["prior_onchip"] = digest
+    vp = out.get("val_parity")
+    if isinstance(vp, dict) and "protocol" in vp:
+        vp = dict(vp)
+        vp["protocol"] = "BASELINE.md row 1"
+        out["val_parity"] = vp
+
+    def _cfg_digest(cfg: dict) -> str:
+        """One short provenance string for a size config dict (the full
+        dict stays in the partial; the knobs are env-reconstructible)."""
+        short = {"d_model": "d", "n_heads": "h", "n_layers": "L",
+                 "d_ff": "ff", "seq_len": "T", "n_experts": "E",
+                 "batch": "b", "scan_len": "scan"}
+        parts = [f"{short[k]}{cfg[k]}" for k in short if k in cfg]
+        parts += [
+            (k if cfg[k] else f"no-{k}") if isinstance(cfg[k], bool)
+            else f"{k}={cfg[k]}"
+            for k in cfg
+            if k not in short and not isinstance(cfg[k], (dict, list))
+        ]
+        return " ".join(parts)
+
+    for key in ("scaled", "moe"):
+        sec = out.get(key)
+        if isinstance(sec, dict) and isinstance(sec.get("config"), dict):
+            sec = dict(sec)
+            sec["config"] = _cfg_digest(sec["config"])
+            out[key] = sec
+    # The chunked-leg caveat is prose for humans; BENCH_NOTES.md and the
+    # partial keep it — the driver tail does not need to.
+    out.pop("trainer_loop_chunked_note", None)
+    return _shrink_to_budget(out)
+
+
+#: Printed-line budget, with headroom under the driver's 2,000-byte
+#: stdout tail (the line must parse even if a stray warning shares the
+#: tail). test_bench_record.py asserts the worst case stays <= 1,800.
+_STDOUT_BUDGET = 1750
+
+
+def _shrink_to_budget(out: dict) -> dict:
+    """Guarantee the printed line fits the driver tail: collapse the
+    least-headline stanzas to their core numbers, one at a time, until
+    the encoded record is under :data:`_STDOUT_BUDGET`. In a typical
+    round nothing here fires — the provenance digests alone fit; this
+    ladder exists so a maximally-populated record (every section AND
+    the carry-forward AND skip markers at once, the r05 failure shape)
+    can never push the line past the tail again. The verbatim record
+    always survives in ``BENCH_PARTIAL.json``."""
+    def fits() -> bool:
+        return (
+            len(json.dumps(out, default=_json_default).encode())
+            <= _STDOUT_BUDGET
+        )
+
+    if fits():
+        return out
+
+    def _keep(key: str, fields: tuple) -> None:
+        sec = out.get(key)
+        if isinstance(sec, dict):
+            kept = {k: sec[k] for k in fields if k in sec}
+            if len(kept) < len(sec):
+                kept["more"] = "BENCH_PARTIAL.json"
+            out[key] = kept
+
+    # Least headline first; each rung re-checks the budget.
+    ladder = (
+        ("host_dataplane", ("rows_speedup", "windows_speedup")),
+        ("serving", ()),
+        ("probe", ("platform", "attempts", "fallback_reason")),
+        ("val_parity", ("protocol", "torch_val_loss", "jax_val_loss",
+                        "abs_diff")),
+        ("moe", ("config", "sorted_ms", "einsum_ms", "sorted_speedup",
+                 "deadline_skipped")),
+        ("scaled", ("config", "step_time_ms", "step_time_dispatch_ms",
+                    "attn_blockwise_ms", "attn_flash_ms", "mfu",
+                    "chip_peak_bf16_tflops", "tflops_per_sec",
+                    "deadline_skipped")),
+        ("prior_onchip", ("source", "captured_utc", "platform", "value",
+                          "vs_baseline", "mfu")),
+    )
+    for key, fields in ladder:
+        if key == "serving":
+            srv = out.get("serving")
+            if isinstance(srv, dict) and "error" not in srv:
+                out["serving"] = {
+                    label: leg.get("speedup")
+                    for label, leg in srv.items()
+                    if isinstance(leg, dict)
+                }
+        else:
+            _keep(key, fields)
+        if fits():
+            return out
+
+    # Last rung: no stanza may carry a multi-KB string — error text from
+    # XLA/Mosaic (attn_*_error, a section-level {"error": ...}) can run
+    # to kilobytes and none of the field-keep rungs above touch string
+    # values. Progressively harder truncation until the line fits;
+    # stderr and the partial keep the full text.
+    def _truncate(obj, limit):
+        if isinstance(obj, dict):
+            return {k: _truncate(v, limit) for k, v in obj.items()}
+        if isinstance(obj, str) and len(obj) > limit:
+            return obj[:limit]
+        return obj
+
+    for limit in (200, 100, 48):
+        for key in list(out):
+            out[key] = _truncate(out[key], limit)
+        if fits():
+            return out
+    return out
 
 
 def _prior_onchip_evidence(
@@ -1198,6 +1369,18 @@ def main():
         # each scan program costs ~5-7 min to compile.
         _plat.enable_compilation_cache()
     finally:
+        # Deadline gates measure from AFTER the probe: its cost (up to
+        # half the deadline on a dead relay) must not eat the legs'
+        # budgets (VERDICT r5 item 3). The credit is capped at half the
+        # deadline — the probe's own default budget — so the bench's
+        # worst-case wall stays bounded at 1.5x DCT_BENCH_DEADLINE even
+        # if an env override let the probe run longer; operators sizing
+        # an external kill window should size it to that.
+        global _PROBE_ELAPSED
+        _PROBE_ELAPSED = min(
+            time.perf_counter() - _BENCH_T0,
+            _DEADLINE / 2 if _DEADLINE > 0 else float("inf"),
+        )
         if _plat.LAST_PROBE:
             record["probe"] = dict(_plat.LAST_PROBE)
             if _plat.LAST_PROBE.get("platform") != "tpu":
@@ -1218,6 +1401,19 @@ def main():
     skip_scaled = os.environ.get("DCT_BENCH_SCALED", "1").strip().lower() in (
         "0", "false", "no"
     )
+
+    def _gate(name: str, frac: float = 1.0) -> bool:
+        """Deadline gate that leaves a trace: every skipped leg names
+        itself in the record's top-level ``deadline_skipped`` list —
+        r05's trainer_loop_chunked vanished with stderr-only evidence
+        (VERDICT r5 item 3)."""
+        if _over_deadline(name, frac=frac):
+            skipped = record.setdefault("deadline_skipped", [])
+            if name not in skipped:
+                skipped.append(name)
+            _flush_partial(record)
+            return True
+        return False
 
     with tempfile.TemporaryDirectory() as tmp:
         data = _section("prepare_data", _prepare_data, tmp)
@@ -1243,12 +1439,34 @@ def main():
             trainer_loop, 1
         )
         record["trainer_loop_vs_baseline"] = round(trainer_loop / baseline, 2)
+        # The dispatch-gap tracker (ISSUE 5 tentpole): fused-epoch vs
+        # the production Trainer.fit() loop on the IDENTICAL config,
+        # data, and host, as a ratio recorded EVERY round — CPU or TPU —
+        # so the gap the host loop leaves on the table is tracked even
+        # when the relay is dead. fit() additionally pays the per-epoch
+        # validation pass, both checkpoint tiers, and telemetry; the
+        # ratio is the price of being the product, and driving it toward
+        # 1.0 is the trainer's standing perf objective (BENCH_NOTES.md
+        # has the same-host pre/post-PR5 accounting).
+        record["trainer_gap"] = {
+            # Units: samples/sec/chip (the record's headline unit).
+            "fused": record["value"],
+            "fit": round(trainer_loop, 1),
+            "fused_over_fit": (
+                round(ours / trainer_loop, 2) if trainer_loop else None
+            ),
+            "prefetch_spans": _bench_prefetch_spans(),
+        }
         _flush_partial(record)
 
         def _optional(name: str, fn, *args):
             """Optional sections degrade to an error marker instead of
             killing the sections after them — the driver's end-of-round
-            run must always reach the final JSON line."""
+            run must always reach the final JSON line. The record's
+            error string is truncated: XLA/Mosaic messages run to
+            multiple KB, and one of them riding the record would blow
+            the 2,000-byte driver tail exactly the way r05's
+            carry-forward stanzas did (stderr gets the full text)."""
             try:
                 return _section(name, fn, *args)
             except Exception as e:  # noqa: BLE001
@@ -1256,7 +1474,7 @@ def main():
                     f"[bench] {name} FAILED ({type(e).__name__}: {e})",
                     file=sys.stderr, flush=True,
                 )
-                return {"error": f"{type(e).__name__}: {e}"}
+                return {"error": f"{type(e).__name__}: {e}"[:200]}
 
         # Same product loop with all timed epochs in ONE dispatch
         # (TrainConfig.epoch_chunk): the delta to the leg above is the
@@ -1267,7 +1485,7 @@ def main():
         # of the multi-epoch program — on a slow tunnel an ungated run
         # here can push scaled_transformer over its own deadline gate,
         # trading the record's primary deliverable for a secondary number.
-        if not _over_deadline("trainer_loop_chunked", frac=0.3):
+        if not _gate("trainer_loop_chunked", frac=0.3):
             # K >= 2 always: at DCT_BENCH_EPOCHS=1 a chunk of 1 would
             # silently re-measure the unchunked path into the same dirs.
             chunked = _optional(
@@ -1289,17 +1507,19 @@ def main():
                     # extra program structure can then measure slower.
                     # The tunneled-chip case (~80 ms RTT of an ~81 ms
                     # epoch) is the target regime.
+                    # Disk-record only: _stdout_record pops this key
+                    # before printing (the full story is in
+                    # BENCH_NOTES.md).
                     record["trainer_loop_chunked_note"] = (
-                        "chunked < per-epoch is expected on local CPU: "
-                        "the per-epoch dispatch RTT this path removes "
-                        "is ~0 here; target regime is a slow control "
-                        "plane (see BENCH_NOTES.md)"
+                        "chunked<per-epoch expected on local CPU "
+                        "(dispatch RTT ~0); target is a slow control "
+                        "plane — BENCH_NOTES.md"
                     )
             else:
                 record["trainer_loop_chunked_samples_per_sec_per_chip"] = None
             _flush_partial(record)
 
-        if not (skip_scaled or _over_deadline("scaled_transformer")):
+        if not (skip_scaled or _gate("scaled_transformer")):
             scaled = _optional(
                 "scaled_transformer", bench_scaled_transformer
             )
@@ -1313,7 +1533,7 @@ def main():
             record["mfu"] = scaled.get("mfu")
             _flush_partial(record)
 
-        if not (skip_scaled or _over_deadline("scaled_moe")):
+        if not (skip_scaled or _gate("scaled_moe")):
             record["moe"] = _optional("scaled_moe", bench_scaled_moe)
             if isinstance(record["moe"], dict) and "error" not in record["moe"]:
                 legs = record.get("scaled_legs")
@@ -1328,7 +1548,7 @@ def main():
         # this leg's torch side runs on the host CPU regardless of relay
         # state) but gated so the record's ONE JSON line still lands:
         # the north-star val-loss parity (BASELINE.md protocol row 1).
-        if not _over_deadline("val_parity", frac=0.85):
+        if not _gate("val_parity", frac=0.85):
             record["val_parity"] = _optional(
                 "val_parity", bench_val_parity, data, tmp
             )
@@ -1344,11 +1564,11 @@ def main():
                         record.pop("scaled_legs", None)
             _flush_partial(record)
 
-        if not _over_deadline("serving"):
+        if not _gate("serving"):
             record["serving"] = _optional("serving", bench_serving, tmp)
             _flush_partial(record)
 
-        if not _over_deadline("host_dataplane"):
+        if not _gate("host_dataplane"):
             dataplane = _optional(
                 "host_dataplane", bench_host_dataplane
             )
@@ -1372,7 +1592,9 @@ def main():
     _flush_partial(record)
     # Same crash-proof serialization as the partials: the ONE deliverable
     # line must not die on a numpy scalar that leaked into a leg value.
-    print(json.dumps(record, default=_json_default))
+    # Printed via _stdout_record: the digest keeps the line inside the
+    # driver's 2,000-byte tail; the verbatim record is the partial above.
+    print(json.dumps(_stdout_record(record), default=_json_default))
 
 
 if __name__ == "__main__":

@@ -230,6 +230,22 @@ class TrainConfig:
     # batches are resident in HBM at once (the current span plus the
     # span-ahead prefetch).
     epoch_chunk: int = 1
+    # Spans kept in flight ahead of the host loop (scan path only).
+    # 1 (default): the next span's host assembly + H2D staging runs on a
+    # worker thread while the current span computes, AND the previous
+    # span's bookkeeping (metric device_gets, health pass, tracker/event
+    # logging, checkpoint writes) overlaps the current span's compute —
+    # the dispatched-span results are consumed one span late, so at most
+    # one span of device work is in flight past the bookkeeping. Costs
+    # one extra resident copy of the train state (the fused step cannot
+    # donate its input state while the checkpoint tier still reads it).
+    # 0: strictly serial — assemble, dispatch, block, bookkeep, repeat
+    # (restores state donation; use when HBM is the binding constraint).
+    # Values above 1 clamp to 1 (deeper pipelines would let early-stop /
+    # health decisions trail arbitrarily far behind the device).
+    # Auto-disabled while a DCT_FAULT_SPEC is armed so fault-injection
+    # drills observe the exact serial crash/checkpoint ordering.
+    prefetch_spans: int = 1
 
     @classmethod
     def from_env(cls) -> "TrainConfig":
@@ -262,6 +278,7 @@ class TrainConfig:
             "DCT_EARLY_STOP_MIN_DELTA", c.early_stop_min_delta, float
         )
         c.epoch_chunk = _env("DCT_EPOCH_CHUNK", c.epoch_chunk, int)
+        c.prefetch_spans = _env("DCT_PREFETCH_SPANS", c.prefetch_spans, int)
         return c
 
 
@@ -416,6 +433,17 @@ class ObservabilityConfig:
     halt_on_spike: bool = False
     spike_zscore: float = 8.0
     spike_window: int = 16
+    # Telemetry write batching (events + spans; observability/buffered.py).
+    # 0 = write-through: every record reaches the OS before emit returns
+    # (the historical per-record durability, minus the open()-per-record
+    # syscall tax — a persistent handle is kept either way). > 0 = batch
+    # appends for up to this many seconds (or telemetry_flush_records
+    # lines), flushed on trainer exit paths, fault firing, and atexit;
+    # a SIGKILL can cost at most that window of telemetry. Heartbeat
+    # files are NEVER buffered — a buffered liveness signal is a dead
+    # one — they are throttled by heartbeat_interval instead.
+    telemetry_flush_s: float = 0.25
+    telemetry_flush_records: int = 128
 
     @classmethod
     def from_env(cls) -> "ObservabilityConfig":
@@ -436,6 +464,12 @@ class ObservabilityConfig:
         c.halt_on_spike = _env("DCT_HALT_ON_SPIKE", c.halt_on_spike, bool)
         c.spike_zscore = _env("DCT_SPIKE_ZSCORE", c.spike_zscore, float)
         c.spike_window = _env("DCT_SPIKE_WINDOW", c.spike_window, int)
+        c.telemetry_flush_s = _env(
+            "DCT_TELEMETRY_FLUSH_S", c.telemetry_flush_s, float
+        )
+        c.telemetry_flush_records = _env(
+            "DCT_TELEMETRY_FLUSH_RECORDS", c.telemetry_flush_records, int
+        )
         return c
 
 

@@ -1,0 +1,186 @@
+"""Buffered JSONL appending shared by the event log and span recorder.
+
+The hot-loop cost of telemetry was never the bytes — it was the
+``open()``/``close()`` pair around every record (the event log and the
+span recorder each re-opened their file per emit, a syscall tax the
+trainer's dispatch gap work made visible). This module gives both
+writers one appender that:
+
+- holds ONE persistent ``O_APPEND`` handle per file, and flushes each
+  buffered line as its own small append ``write()`` — the practical
+  per-record append atomicity concurrent writers (ranks sharing one
+  ``events.jsonl``) relied on with per-record opens is preserved;
+- optionally batches lines for up to ``flush_interval`` seconds (or
+  ``max_records`` lines, whichever first) before writing — the trainer
+  enables this via ``DCT_TELEMETRY_FLUSH_S`` so a busy span emits one
+  ``write()`` instead of dozens;
+- flushes on ``flush()``/``close()``, and registers every live appender
+  for an ``atexit`` sweep, so a normal or ``sys.exit`` teardown never
+  strands buffered records. Paths that bypass atexit (``os._exit`` in
+  the fault injector's ``crash`` clauses) must call
+  :func:`flush_all_appenders` first — :mod:`dct_tpu.resilience.faults`
+  does.
+
+Durability contract: with ``flush_interval <= 0`` (the constructor
+default) every append reaches the OS before returning — identical
+guarantees to the historical open-per-record behavior, minus the
+syscalls. With a positive interval, at most ``flush_interval`` seconds
+(or ``max_records`` lines) of telemetry is at risk to a SIGKILL; every
+cooperative exit path flushes.
+
+Failure contract (same as the writers it serves): any OS error kills
+the appender for the rest of the process — telemetry degrades to
+silence, never raises into training code.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+import weakref
+
+_live: "weakref.WeakSet[BufferedAppender]" = weakref.WeakSet()
+_live_lock = threading.Lock()
+
+
+def flush_all_appenders() -> None:
+    """Flush every live appender (atexit hook; also called by code that
+    is about to hard-exit the process, e.g. injected ``crash`` faults)."""
+    with _live_lock:
+        appenders = list(_live)
+    for app in appenders:
+        try:
+            app.flush()
+        except Exception:  # noqa: BLE001 — a dying appender must not
+            pass  # block the others (or the exit) from flushing
+
+
+atexit.register(flush_all_appenders)
+
+
+class BufferedAppender:
+    """Append-only line writer with a persistent handle and bounded
+    buffering. Thread-safe; one instance per (writer, path)."""
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        flush_interval: float = 0.0,
+        max_records: int = 128,
+        clock=time.monotonic,
+    ):
+        self.path = path
+        self.flush_interval = max(0.0, float(flush_interval))
+        self.max_records = max(1, int(max_records))
+        self._clock = clock
+        self._buf: list[str] = []
+        self._last_flush = clock()
+        self._fh = None
+        self._lock = threading.Lock()
+        self._dead = False
+        self._timer: threading.Timer | None = None
+        with _live_lock:
+            _live.add(self)
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    @property
+    def pending(self) -> int:
+        """Buffered-but-unwritten line count (for tests/introspection)."""
+        with self._lock:
+            return len(self._buf)
+
+    def append(self, line: str) -> bool:
+        """Queue one newline-terminated line; returns False once the
+        appender is dead (the caller should stop emitting)."""
+        with self._lock:
+            if self._dead:
+                return False
+            self._buf.append(line)
+            if (
+                self.flush_interval <= 0.0
+                or len(self._buf) >= self.max_records
+                or self._clock() - self._last_flush >= self.flush_interval
+            ):
+                return self._flush_locked()
+            # Buffered: arm a one-shot daemon timer so the record's
+            # time-at-risk is bounded by flush_interval even if no
+            # further append ever arrives to piggyback the flush on.
+            if self._timer is None:
+                self._timer = threading.Timer(
+                    self.flush_interval, self._timer_flush
+                )
+                self._timer.daemon = True
+                self._timer.start()
+            return True
+
+    def flush(self) -> bool:
+        with self._lock:
+            return self._flush_locked()
+
+    def set_write_through(self) -> None:
+        """Flush and drop to interval 0 (every future append is
+        synchronous). The trainer calls this when its hot loop ends so
+        post-run emitters through the same process-default writer get
+        read-after-emit visibility back."""
+        with self._lock:
+            self.flush_interval = 0.0
+            self._flush_locked()
+
+    def close(self) -> None:
+        """Flush and release the handle (the appender stays usable: the
+        next append reopens — close is for ordered teardown, not end of
+        life)."""
+        with self._lock:
+            self._flush_locked()
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    def _timer_flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    # -- internals -----------------------------------------------------
+    def _flush_locked(self) -> bool:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if self._dead:
+            self._buf.clear()
+            return False
+        if not self._buf:
+            self._last_flush = self._clock()
+            return True
+        try:
+            if self._fh is None:
+                parent = os.path.dirname(self.path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                self._fh = open(self.path, "a")
+            # One write()+flush PER LINE, not one blob for the batch:
+            # several ranks share one events.jsonl, and concurrent
+            # multi-KB appends can interleave mid-record on filesystems
+            # without large-append atomicity (NFS-class shared log
+            # dirs). A small single-line O_APPEND write keeps the
+            # practical per-record append atomicity the old
+            # open-per-record writers had; the batching still amortizes
+            # everything else (open/close, locking, the emit-side work).
+            for line in self._buf:
+                self._fh.write(line)
+                self._fh.flush()
+        except (OSError, ValueError):
+            self._dead = True
+            self._buf.clear()
+            return False
+        self._buf.clear()
+        self._last_flush = self._clock()
+        return True

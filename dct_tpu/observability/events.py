@@ -86,7 +86,15 @@ def _rank_from_env() -> int | None:
 
 class EventLog:
     """Append-only JSONL emitter; ``path=None`` disables (all emits
-    no-op but ``run_id`` stays resolvable for stamping other records)."""
+    no-op but ``run_id`` stays resolvable for stamping other records).
+
+    Writes go through one persistent-handle appender
+    (:class:`dct_tpu.observability.buffered.BufferedAppender`) instead of
+    an ``open()`` per record. ``flush_interval`` > 0 additionally batches
+    records for up to that many seconds (bounded by ``max_records``);
+    every cooperative exit path must then :meth:`flush`/:meth:`close` —
+    the trainer does, and an ``atexit`` sweep backstops normal exits.
+    The default (0) keeps per-record durability exactly as before."""
 
     def __init__(
         self,
@@ -95,13 +103,21 @@ class EventLog:
         run_id: str,
         rank: int | None = None,
         clock=time.time,
+        flush_interval: float = 0.0,
+        max_records: int = 128,
     ):
         self.path = path
         self.run_id = run_id
         self.rank = rank
         self._clock = clock
-        self._lock = threading.Lock()
         self._dead = False
+        self._appender = None
+        if path:
+            from dct_tpu.observability.buffered import BufferedAppender
+
+            self._appender = BufferedAppender(
+                path, flush_interval=flush_interval, max_records=max_records
+            )
 
     @property
     def enabled(self) -> bool:
@@ -120,16 +136,30 @@ class EventLog:
         rec.update(fields)
         try:
             line = json.dumps(_jsonable(rec), allow_nan=False) + "\n"
-            with self._lock:
-                parent = os.path.dirname(self.path)
-                if parent:
-                    os.makedirs(parent, exist_ok=True)
-                with open(self.path, "a") as f:
-                    f.write(line)
-        except (OSError, ValueError):
+        except ValueError:
+            self._dead = True
+            return
+        if not self._appender.append(line):
             # Full disk / unwritable dir / closed fd: telemetry degrades
             # to silence, training continues.
             self._dead = True
+
+    def flush(self) -> None:
+        """Drain any buffered records to disk (no-op when disabled)."""
+        if self._appender is not None:
+            self._appender.flush()
+
+    def close(self) -> None:
+        """Flush and release the file handle (the log stays usable)."""
+        if self._appender is not None:
+            self._appender.close()
+
+    def set_write_through(self) -> None:
+        """Flush and disable batching for the rest of the process (the
+        trainer calls this when its hot loop ends: later emitters through
+        the installed default get read-after-emit visibility back)."""
+        if self._appender is not None:
+            self._appender.set_write_through()
 
 
 def observability_enabled(env=None) -> bool:
@@ -153,9 +183,40 @@ def event_log_from_config(cfg, *, rank: int | None = None) -> "EventLog":
         if cfg.enabled and cfg.events_dir
         else None
     )
-    log = EventLog(path, run_id=rid, rank=rank)
+    log = EventLog(
+        path,
+        run_id=rid,
+        rank=rank,
+        flush_interval=getattr(cfg, "telemetry_flush_s", 0.0),
+        max_records=getattr(cfg, "telemetry_flush_records", 128),
+    )
     set_default(log)
     return log
+
+
+def env_flush_interval(env=None) -> float:
+    """THE parse of ``DCT_TELEMETRY_FLUSH_S`` for env-built writers —
+    shared with spans.get_default so the two sinks buffer alike."""
+    raw = (env if env is not None else os.environ).get(
+        "DCT_TELEMETRY_FLUSH_S"
+    )
+    try:
+        return max(0.0, float(raw)) if raw else 0.0
+    except ValueError:
+        return 0.0
+
+
+def env_flush_records(env=None) -> int:
+    """THE parse of ``DCT_TELEMETRY_FLUSH_RECORDS`` for env-built
+    writers: the operator's telemetry-at-risk cap must bind every
+    process of the run, not only the config-plumbed trainer."""
+    raw = (env if env is not None else os.environ).get(
+        "DCT_TELEMETRY_FLUSH_RECORDS"
+    )
+    try:
+        return max(1, int(raw)) if raw else 128
+    except ValueError:
+        return 128
 
 
 # ----------------------------------------------------------------------
@@ -176,6 +237,8 @@ _ENV_KEYS = (
     "DCT_RUN_ID",
     "DCT_PROCESS_ID",
     "NODE_RANK",
+    "DCT_TELEMETRY_FLUSH_S",
+    "DCT_TELEMETRY_FLUSH_RECORDS",
 )
 
 
@@ -199,6 +262,8 @@ def get_default() -> EventLog:
             os.path.join(events_dir, "events.jsonl") if enabled else None,
             run_id=rid,
             rank=_rank_from_env(),
+            flush_interval=env_flush_interval(),
+            max_records=env_flush_records(),
         )
         _cached = (key, log)
         return log

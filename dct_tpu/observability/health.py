@@ -92,6 +92,46 @@ class _SpikeDetector:
             self.window.append(x)
         return z
 
+    def screen(self, values) -> bool:
+        """Vectorized conservative spike screen over a FINITE sequence:
+        True if any element COULD be an upward spike when fed through
+        :meth:`observe` one at a time (callers then replay sequentially
+        for exact semantics), False only when provably none can.
+
+        Replicates the rolling mean/std with prefix sums (float64),
+        including the carried-over window state, but applies the z and
+        relative-deviation thresholds with a 10% safety margin — cumsum
+        arithmetic and the per-step windowed sums can differ in the last
+        float bits, and a borderline decision must fall to the exact
+        path, never be screened away."""
+        import numpy as np
+
+        values = np.asarray(values, dtype=np.float64).reshape(-1)
+        if values.size == 0:
+            return False
+        prior = np.asarray(self.window, dtype=np.float64)
+        seq = np.concatenate([prior, values])
+        w = self.window.maxlen
+        c1 = np.concatenate([[0.0], np.cumsum(seq)])
+        c2 = np.concatenate([[0.0], np.cumsum(seq * seq)])
+        j = np.arange(prior.size, seq.size)
+        lo = np.maximum(0, j - w)
+        n = (j - lo).astype(np.float64)
+        armed = n >= MIN_HISTORY
+        n_safe = np.maximum(n, 1.0)
+        mean = (c1[j] - c1[lo]) / n_safe
+        var = np.maximum((c2[j] - c2[lo]) / n_safe - mean * mean, 0.0)
+        std = np.sqrt(var)
+        dev = values - mean
+        with np.errstate(divide="ignore", invalid="ignore"):
+            z = np.where(std > 0.0, dev / np.maximum(std, 1e-300), np.inf)
+        candidate = (
+            armed
+            & (dev >= 0.09 * np.maximum(np.abs(mean), 1e-8))
+            & (z >= 0.9 * self.zscore)
+        )
+        return bool(candidate.any())
+
 
 class HealthMonitor:
     """Per-run health state machine; feed it every step's loss (and
@@ -220,6 +260,77 @@ class HealthMonitor:
                     )
                     worst = worst or f
         return worst
+
+    def observe_span(
+        self,
+        losses,
+        grad_norms=None,
+        *,
+        start_step: int = 0,
+        epoch: int = 0,
+        steps_per_epoch: int | None = None,
+    ) -> Finding | None:
+        """A whole span's per-step scalars in one call — the scan path's
+        health pass. Semantically identical to calling
+        :meth:`observe_step` for each index ``i`` with
+        ``step=start_step+i+1`` and ``epoch=epoch+i//steps_per_epoch``,
+        but the healthy common case (every value finite, nothing near a
+        spike threshold) is screened with a few vectorized reductions
+        instead of ``len(losses)`` Python iterations — on the parity
+        config that Python loop was costing more host time per epoch
+        than the epoch's device compute. Any non-finite value or
+        near-threshold z-score candidate falls back to the exact
+        sequential path for the whole span, so findings, event caps,
+        and halt decisions match the per-step API bit-for-bit. Returns
+        the FIRST halting finding (the one the trainer raises), else
+        None; non-halting findings are counted/emitted as always."""
+        import numpy as np
+
+        losses = np.asarray(losses, dtype=np.float64).reshape(-1)
+        gnorms = (
+            None
+            if grad_norms is None
+            else np.asarray(grad_norms, dtype=np.float64).reshape(-1)
+        )
+        per_epoch = max(1, int(steps_per_epoch or losses.size or 1))
+        fast = bool(np.isfinite(losses).all()) and (
+            gnorms is None or bool(np.isfinite(gnorms).all())
+        )
+        if fast:
+            fast = not self._loss.screen(losses)
+        if fast and gnorms is not None:
+            fast = not self._gnorm.screen(gnorms)
+        if fast:
+            # No candidate anywhere: advance the detector state exactly
+            # as the sequential path would. Only the last window's worth
+            # can survive a maxlen-bounded deque, so extending with the
+            # tail alone is equivalent — and keeps this path free of a
+            # per-step Python iteration (the defect it exists to fix).
+            self._loss.window.extend(
+                float(v) for v in losses[-self._loss.window.maxlen:]
+            )
+            if losses.size:
+                self.last_loss = float(losses[-1])
+            if gnorms is not None:
+                self._gnorm.window.extend(
+                    float(v) for v in gnorms[-self._gnorm.window.maxlen:]
+                )
+                if gnorms.size:
+                    self.last_grad_norm = float(gnorms[-1])
+            return None
+        halt_finding: Finding | None = None
+        for i in range(losses.size):
+            f = self.observe_step(
+                float(losses[i]),
+                grad_norm=(
+                    float(gnorms[i]) if gnorms is not None else None
+                ),
+                step=start_step + i + 1,
+                epoch=epoch + i // per_epoch,
+            )
+            if halt_finding is None and f is not None and f.halt:
+                halt_finding = f
+        return halt_finding
 
     # -- reporting -----------------------------------------------------
     @property

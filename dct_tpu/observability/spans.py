@@ -120,6 +120,9 @@ class SpanRecorder:
         trace_id: str,
         rank: int | None = None,
         clock=time.time,
+        flush_interval: float = 0.0,
+        max_records: int = 128,
+        _appender=None,
     ):
         self.path = path
         self.trace_id = trace_id
@@ -127,6 +130,16 @@ class SpanRecorder:
         self._clock = clock
         self._lock = threading.Lock()
         self._dead = False
+        # One persistent-handle appender per file (see buffered.py);
+        # for_trace() clones share it so two recorders over one file
+        # never hold two competing buffers.
+        self._appender = _appender
+        if path and self._appender is None:
+            from dct_tpu.observability.buffered import BufferedAppender
+
+            self._appender = BufferedAppender(
+                path, flush_interval=flush_interval, max_records=max_records
+            )
         self._local = threading.local()
         # Parent for spans opened with no enclosing span on their thread:
         # the launching process's exported span, else the trace root.
@@ -253,7 +266,8 @@ class SpanRecorder:
         if not trace_id or trace_id == self.trace_id:
             return self
         other = SpanRecorder(
-            self.path, trace_id=trace_id, rank=self.rank, clock=self._clock
+            self.path, trace_id=trace_id, rank=self.rank, clock=self._clock,
+            _appender=self._appender,
         )
         other.root_parent = None  # foreign trace: no local parent
         return other
@@ -278,14 +292,26 @@ class SpanRecorder:
             rec["attrs"] = _jsonable(span.attrs)
         try:
             line = json.dumps(rec, allow_nan=False) + "\n"
-            with self._lock:
-                parent = os.path.dirname(self.path)
-                if parent:
-                    os.makedirs(parent, exist_ok=True)
-                with open(self.path, "a") as f:
-                    f.write(line)
-        except (OSError, ValueError):
+        except ValueError:
+            self._dead = True
+            return
+        if not self._appender.append(line):
             self._dead = True  # tracing degrades to silence, never raises
+
+    def flush(self) -> None:
+        """Drain buffered span records to disk (no-op when disabled)."""
+        if self._appender is not None:
+            self._appender.flush()
+
+    def close(self) -> None:
+        """Flush and release the file handle (the recorder stays usable)."""
+        if self._appender is not None:
+            self._appender.close()
+
+    def set_write_through(self) -> None:
+        """Flush and disable batching for the rest of the process."""
+        if self._appender is not None:
+            self._appender.set_write_through()
 
 
 # ----------------------------------------------------------------------
@@ -324,6 +350,8 @@ def recorder_from_config(cfg, *, rank: int | None = None) -> SpanRecorder:
         os.path.join(directory, span_file_name(rank)) if directory else None,
         trace_id=trace_id,
         rank=rank,
+        flush_interval=getattr(cfg, "telemetry_flush_s", 0.0),
+        max_records=getattr(cfg, "telemetry_flush_records", 128),
     )
     set_default(rec)
     return rec
@@ -341,6 +369,8 @@ _ENV_KEYS = (
     SPAN_ENV,
     "DCT_PROCESS_ID",
     "NODE_RANK",
+    "DCT_TELEMETRY_FLUSH_S",
+    "DCT_TELEMETRY_FLUSH_RECORDS",
 )
 
 
@@ -369,6 +399,11 @@ def get_default() -> SpanRecorder:
             if observability_enabled()
             else None
         )
+        from dct_tpu.observability.events import (
+            env_flush_interval,
+            env_flush_records,
+        )
+
         rank = _rank_from_env()
         rec = SpanRecorder(
             os.path.join(directory, span_file_name(rank))
@@ -376,6 +411,8 @@ def get_default() -> SpanRecorder:
             else None,
             trace_id=trace_id,
             rank=rank,
+            flush_interval=env_flush_interval(),
+            max_records=env_flush_records(),
         )
         _cached = (key, rec)
         return rec

@@ -208,6 +208,19 @@ class FaultPlan:
         clause = self.check(point, **coords)
         if clause is None:
             return None
+        if clause.action in ("crash", "crash_save", "hang"):
+            # ``os._exit`` skips atexit and a hang never reaches it:
+            # drain buffered telemetry NOW so the fault.injected record
+            # (and every record before it) survives the fault it
+            # precedes — the buffered-writer durability contract.
+            try:
+                from dct_tpu.observability.buffered import (
+                    flush_all_appenders,
+                )
+
+                flush_all_appenders()
+            except Exception:  # noqa: BLE001 — the fault must still fire
+                pass
         if clause.action == "crash":
             if pre_exit is not None:
                 try:

@@ -146,6 +146,29 @@ def optimizer_identity(train_cfg) -> dict:
 
 
 @dataclass
+class _SpanInFlight:
+    """One dispatched span awaiting host bookkeeping (the pipelined
+    loop's unit of deferral): its device result futures, the output
+    state both checkpoint tiers will read, and the open trace spans the
+    crash sweep must be able to close."""
+
+    epoch0: int
+    k: int
+    n_steps: int
+    state: object
+    losses: object = None
+    val_sums: object = None
+    gnorms: object = None
+    t_dispatch: float = 0.0
+    # Host seconds the dispatch call itself blocked (jit tracing + XLA
+    # compile on a program's first span, ~enqueue cost after). Pipelined
+    # billing uses it: see _consume_span's ledger note.
+    dispatch_elapsed: float = 0.0
+    dispatch_span: object = None
+    epoch_span: object = None
+
+
+@dataclass
 class TrainResult:
     val_loss: float
     val_acc: float
@@ -461,14 +484,34 @@ class Trainer:
             )
         use_scan = cfg.train.use_scan
         accum = max(1, cfg.train.grad_accum_steps)
+        # Span pipelining (the dispatch-gap work): with prefetch_spans
+        # >= 1, span e+1 is DISPATCHED before span e's bookkeeping runs,
+        # so metric device_gets, the health pass, tracker/event logging,
+        # and both checkpoint tiers' writes all overlap device compute
+        # instead of serializing the hot loop. Bounded to ONE span in
+        # flight past the bookkeeping (early-stop and health decisions
+        # trail the device by at most that span — see _consume_span).
+        # Auto-disabled under an armed fault plan: the injection drills
+        # assert the exact serial crash/checkpoint ordering.
+        pipelined = (
+            use_scan
+            and cfg.train.prefetch_spans >= 1
+            and not plan.enabled
+        )
         if use_scan:
             # Built only for the per-epoch path: with epoch_chunk > 1
             # every span (including k == 1 remainders) dispatches the
             # multi-epoch program instead. Span stacks are single-use in
             # the trainer, so donating them frees a full span of HBM
-            # before the step's activations peak.
+            # before the step's activations peak. The STATE is donated
+            # only in serial mode: pipelined bookkeeping still reads the
+            # previous span's output state (checkpoint gather + resume
+            # snapshot) while the next span computes from it, so that
+            # buffer must survive the dispatch — one extra resident
+            # state copy is the documented price of the overlap.
             if max(1, cfg.train.epoch_chunk) == 1:
                 epoch_fused = make_epoch_train_eval_step(
+                    donate=not pipelined,
                     accum_steps=accum, donate_stacks=True,
                     with_grad_norms=True,
                 )
@@ -561,6 +604,7 @@ class Trainer:
             from dct_tpu.train.steps import make_multi_epoch_train_eval_step
 
             multi_fused = make_multi_epoch_train_eval_step(
+                donate=not pipelined,
                 accum_steps=accum, donate_stacks=True,
                 with_grad_norms=True,
             )
@@ -610,7 +654,7 @@ class Trainer:
 
         prefetch_pool = None
         prefetched = None
-        if use_scan:
+        if use_scan and cfg.train.prefetch_spans >= 1:
             from concurrent.futures import ThreadPoolExecutor
 
             prefetch_pool = ThreadPoolExecutor(
@@ -628,14 +672,340 @@ class Trainer:
         # records them (Span.end is idempotent: the success path's own
         # end() wins and the crash-path sweep becomes a no-op).
         epoch_span = dispatch_span = ckpt_span = None
+        # Pipelined mode: the one dispatched-but-unbookkept span. Its
+        # results are consumed one iteration late, while the NEXT span
+        # computes on device; the crash sweep also closes its spans.
+        pending = None
+        consumed_through = start_epoch
+        timer_running = False
+
+        def _bookkeep_span(sp, sub_epochs, epoch_stats, span_updates):
+            """Every host-side consequence of a finished span: goodput
+            report, per-epoch history/tracker/event records, early-stop
+            updates, and BOTH checkpoint tiers. Shared by the scan
+            path's consume (where, pipelined, it all overlaps the next
+            span's device compute) and the eager path. Returns
+            ``stop_early``."""
+            nonlocal es_best, es_stale, span_end_vl_min
+            nonlocal consumed_through, ckpt_span
+            e0, k = sp.epoch0, sp.k
+            # Per-span goodput: category deltas since the previous
+            # report, logged to the tracker next to val_loss so a
+            # goodput regression is queryable like an accuracy one.
+            span_goodput = ledger.epoch_report()
+            if heartbeat is not None:
+                heartbeat.beat(
+                    step=global_step, epoch=e0 + k - 1, phase="train"
+                )
+            # Per-epoch bookkeeping for every epoch in the span; with
+            # k > 1 the chunk is the dispatch unit, so wall time is
+            # span-amortized and the metric step is reconstructed per
+            # epoch from the update count.
+            per_epoch_updates = span_updates // k if k else 0
+            last_rec = None
+            stop_early = False
+            for i, (epoch_loss, val_loss, val_acc, (tp, fp, fn)) in (
+                enumerate(sub_epochs)
+            ):
+                epoch_rec = {
+                    "epoch": e0 + i,
+                    "train_loss": epoch_loss if epoch_loss is not None else float("nan"),
+                    "val_loss": val_loss,
+                    "val_acc": val_acc,
+                }
+                epoch_metrics = {
+                    "train_loss_epoch": epoch_rec["train_loss"],
+                    "val_loss": val_loss,
+                    "val_acc": val_acc,
+                    "epoch_time": epoch_stats.seconds / k,
+                    "samples_per_sec": epoch_stats.samples_per_sec,
+                    "samples_per_sec_per_chip": epoch_stats.samples_per_sec_per_chip,
+                    # Span-level fraction (the span is the dispatch
+                    # unit; every epoch in it shares the value).
+                    "goodput_fraction": span_goodput["goodput_fraction"],
+                }
+                if cfg.model.num_classes == 2:
+                    # Positive class 1 = "rain" (the reference's label
+                    # encoding, jobs/preprocess.py:23-25). One-vs-rest
+                    # counts would mislead for num_classes > 2, so the
+                    # P/R/F1 surface is binary-only.
+                    val_precision, val_recall, val_f1 = precision_recall_f1(
+                        tp, fp, fn
+                    )
+                    epoch_rec["val_f1"] = val_f1
+                    epoch_metrics.update(
+                        val_precision=val_precision,
+                        val_recall=val_recall,
+                        val_f1=val_f1,
+                    )
+                history.append(epoch_rec)
+                if epoch_stats.mfu is not None:
+                    epoch_metrics["mfu"] = epoch_stats.mfu
+                metric_step = (
+                    global_step - span_updates
+                    + (i + 1) * per_epoch_updates
+                    if use_scan else global_step
+                )
+                self.tracker.log_metrics(epoch_metrics, step=metric_step)
+                events.emit(
+                    "trainer", "epoch_end",
+                    epoch=e0 + i,
+                    train_loss=epoch_rec["train_loss"],
+                    val_loss=val_loss, val_acc=val_acc,
+                    goodput_fraction=span_goodput["goodput_fraction"],
+                )
+                last_rec = epoch_rec
+                # Early stopping (monitor val_loss, min mode — the
+                # companion of the reference's ModelCheckpoint
+                # policy). val_loss is a globally-reduced scalar, so
+                # every SPMD rank takes the same branch; a nan never
+                # counts as an improvement (including as the first
+                # es_best). Inside a span the epochs already ran on
+                # device; the stop takes effect at the span boundary,
+                # and the es state freezes at the trigger point.
+                if cfg.train.early_stop_patience > 0 and not stop_early:
+                    es_best, es_stale, stop_early = early_stop_update(
+                        val_loss, es_best, es_stale,
+                        patience=cfg.train.early_stop_patience,
+                        min_delta=cfg.train.early_stop_min_delta,
+                    )
+            _span_end_vl = sub_epochs[-1][1]
+            if not math.isnan(_span_end_vl):
+                span_end_vl_min = min(span_end_vl_min, _span_end_vl)
+            profiler.maybe_stop_span(e0, k)
+            # Host-gather BEFORE the coordinator gate: with TP/SP
+            # spanning processes this is a collective every rank must
+            # join; in the common fully-addressable case only the
+            # coordinator pays the device-to-host copy. Pipelined: the
+            # gathered state is the NEXT span's live input — valid
+            # because the fused step does not donate it in that mode.
+            _t_ckpt = ledger.clock()
+            # open (stack-pushed), not start: the checkpoint manager's
+            # own spans (checkpoint.deploy_write) parent implicitly to
+            # this thread's stack top, and they belong under the
+            # trainer.checkpoint window. Safe under pipelining — the
+            # whole push/end window is synchronous inside this consume,
+            # nothing else touches the stack in between.
+            ckpt_span = tracer.open(
+                "trainer.checkpoint", component="trainer",
+                epoch=e0 + k - 1, parent_id=sp.epoch_span.span_id,
+            )
+            if params_cross_process or self.coordinator:
+                host_params = to_host(sp.state.params)
+            if self.coordinator:
+                # Deploy-checkpoint policy at span granularity: only
+                # the span-end params exist on device, so best/last
+                # selection sees the span-end epoch's metrics (k == 1
+                # reduces to the per-epoch policy exactly).
+                _, last_vl, last_va, _ = sub_epochs[-1]
+                ckpt_metrics = {"val_loss": last_vl, "val_acc": last_va}
+                if "val_f1" in last_rec:
+                    ckpt_metrics["val_f1"] = last_rec["val_f1"]
+                ckptr.update(
+                    epoch=e0 + k - 1,
+                    metrics=ckpt_metrics,
+                    params=host_params,
+                    meta=meta,
+                )
+
+            # Every process keeps its own resume state (host-local
+            # disk) plus the run facts the next run's continuation
+            # semantics are decided from. The write overlaps the next
+            # epoch's compute (device->host snapshot is synchronous;
+            # the npz/rotation runs on a worker thread). On an early
+            # stop the run is marked COMPLETE at the stop point
+            # (target_epochs = epochs_completed) so a resumed run
+            # EXTENDS (continuous semantics) instead of "finishing"
+            # the abandoned target.
+            # Re-pin to the declared layout before snapshotting (a
+            # no-op for leaves already there; a collective reshard —
+            # every rank calls it — for any the step's output layout
+            # drifted, e.g. ZeRO-1 output params).
+            state_ckptr.save_async(
+                jax.device_put(sp.state, declared_shardings),
+                meta={
+                    "epochs_completed": e0 + k,
+                    "target_epochs": (
+                        e0 + k if stop_early else target_epochs
+                    ),
+                    # Exact resume refusal across optimizer configs
+                    # whose state trees are isomorphic (ADVICE r4).
+                    "optimizer": opt_identity,
+                },
+            )
+            # Both checkpoint tiers' synchronous cost (host gather,
+            # deploy-tier writes, the resume snapshot's device->host
+            # copy; the npz write itself overlaps on a worker thread).
+            ledger.add("checkpoint", ledger.clock() - _t_ckpt)
+            ckpt_span.end()
+            sp.epoch_span.end(val_loss=sub_epochs[-1][1])
+            consumed_through = e0 + k
+            return stop_early
+
+        def _consume_span(sp):
+            """Join span ``sp``'s device results and run all its host
+            bookkeeping. Serial mode calls it right after dispatch;
+            pipelined mode one span late, while the NEXT span computes
+            on device (so early-stop/health decisions trail the device
+            by at most one span — the documented trade). Returns
+            ``stop_early``."""
+            nonlocal global_step, dispatch_span, epoch_span
+            import numpy as _np
+
+            e0, k = sp.epoch0, sp.k
+            # Point the crash sweep at the span being joined: if the
+            # join or its bookkeeping dies, THESE are the spans still
+            # in flight (a pipelined successor's live in pending).
+            dispatch_span = sp.dispatch_span
+            epoch_span = sp.epoch_span
+            _t_join = ledger.clock()
+            # The device_get joins the span's program; the D2H copies
+            # were started right after its dispatch, so in steady
+            # pipelined state the bytes are already on the host.
+            if multi_fused is not None:
+                # [K, S] losses; val_sums is a 6-tuple of [K] arrays
+                # (dtype-preserving per leaf — see
+                # make_multi_epoch_train_eval_step). Stack host-side as
+                # float64 -> [K, 6]; the upcast only protects the
+                # stacking, precision is bounded by the on-device f32
+                # accumulation (exact for integral weights up to 2^24
+                # per epoch, steps.py).
+                losses_host = _np.asarray(jax.device_get(sp.losses))
+                gnorms_host = _np.asarray(jax.device_get(sp.gnorms))
+                val_host = _np.stack(
+                    [
+                        _np.asarray(v, dtype=_np.float64)
+                        for v in jax.device_get(sp.val_sums)
+                    ],
+                    axis=1,
+                )
+            else:  # [S] / 6-tuple — the k == 1 parity layout
+                losses_host = _np.asarray(
+                    jax.device_get(sp.losses)
+                )[None]
+                gnorms_host = _np.asarray(
+                    jax.device_get(sp.gnorms)
+                )[None]
+                val_host = _np.asarray(
+                    [float(v) for v in jax.device_get(sp.val_sums)]
+                )[None]
+            # Fused dispatch (train + eval in one program) bills to
+            # train_step; its first occurrence per program shape is the
+            # compile. Serial: one window, dispatch -> results joined
+            # (the historical accounting). Pipelined: the wall interval
+            # dispatch(e) -> consume(e) CONTAINS other billed windows
+            # (the previous span's checkpoint, the next span's
+            # data_wait), so billing it whole would double-count and
+            # push goodput_fraction past 1 — bill only the two
+            # main-thread-blocking windows instead: the dispatch call
+            # itself (trace + compile + enqueue, captured at dispatch)
+            # plus the join above. Device time overlapped by host
+            # bookkeeping is exactly the overlap the mode buys; it
+            # surfaces as the other categories' windows, never twice.
+            ledger.add_dispatch(
+                "train_step", f"scan_k{k}",
+                (sp.dispatch_elapsed + (ledger.clock() - _t_join))
+                if pipelined
+                else (ledger.clock() - sp.t_dispatch),
+            )
+            sp.dispatch_span.end()
+            # The fused program runs the validation pass(es) inside the
+            # timed window; credit them to MFU. Pipelined throughput
+            # windows chain consume-to-consume (they tile the loop's
+            # wall clock); serial keeps the historical start-to-join
+            # window.
+            epoch_stats = timer.stop(
+                e0, k * sp.n_steps * global_batch,
+                eval_samples=k * len(val_idx),
+            )
+            if pipelined:
+                timer.start()
+            flat = losses_host.reshape(-1)
+            # log_every_n_steps cadence without one Python iteration
+            # per step: visit only the multiples (identical records).
+            n_log = max(1, cfg.train.log_every_n_steps)
+            for i in range(
+                (-(global_step + 1)) % n_log, flat.size, n_log
+            ):
+                self.tracker.log_metrics(
+                    {"train_loss": float(flat[i])},
+                    step=global_step + i + 1,
+                )
+            global_step += flat.size
+            # Step-trigger faults on the scan path fire at the span
+            # boundary — steps inside a fused dispatch are not
+            # individually interruptible from the host.
+            if plan.enabled:
+                plan.maybe_fire(
+                    "step", step=global_step,
+                    pre_exit=state_ckptr.wait,
+                )
+            # Health pass over the span's per-step losses and grad
+            # norms BEFORE any epoch bookkeeping: under a halting
+            # policy the run stops here — no epoch_end, no checkpoint
+            # of the diverged state. (Pipelined: the successor span
+            # already in flight is abandoned by the raise — at most one
+            # extra span of device work, never an extra checkpoint.)
+            halt_finding = health.observe_span(
+                flat, gnorms_host.reshape(-1),
+                start_step=global_step - flat.size,
+                epoch=e0, steps_per_epoch=max(1, flat.size // k),
+            )
+            if halt_finding is not None:
+                # Close the epoch span BEFORE raising: the halted epoch
+                # is exactly the one the operator opens the trace to
+                # inspect.
+                sp.epoch_span.end(halted=halt_finding.kind)
+            HealthMonitor.raise_on(halt_finding)
+            # Reference parity: the logged train_loss is the
+            # EPOCH-AGGREGATED mean (Lightning epoch aggregation of
+            # jobs/train_lightning_ddp.py:70), not the last batch —
+            # one (train_loss, val_loss, val_acc, counts) entry per
+            # epoch in the span.
+            sub_epochs = []
+            for i in range(k):
+                ls, accs, c, tp, fp, fn = (
+                    float(v) for v in val_host[i]
+                )
+                sub_epochs.append((
+                    float(losses_host[i].mean())
+                    if losses_host[i].size else None,
+                    ls / c if c else float("nan"),
+                    accs / c if c else float("nan"),
+                    (tp, fp, fn),
+                ))
+            return _bookkeep_span(sp, sub_epochs, epoch_stats, flat.size)
+
         try:
             epoch = start_epoch
+            stop_early = False
             while epoch < target_epochs:
+                # Pipelined early-stop guard: if the un-bookkept span
+                # could trip the stop, consume it BEFORE dispatching
+                # more work (serial fallback for exactly this span, so
+                # the stop decision is never speculated past).
+                if (
+                    pending is not None
+                    and cfg.train.early_stop_patience > 0
+                    and es_stale + pending.k
+                    >= cfg.train.early_stop_patience
+                ):
+                    _sp, pending = pending, None
+                    stop_early = _consume_span(_sp)
+                    if guard.requested:
+                        self._preempt_exit(
+                            guard, events, state_ckptr,
+                            epochs_completed=consumed_through,
+                        )
+                    if stop_early:
+                        break
                 # Trainer fault hook at the epoch boundary (`crash` /
                 # `hang` / `slow_epoch` clauses). A crash first joins
                 # any in-flight resume-snapshot write so the death
                 # leaves a deterministic resume point — torn-write
                 # recovery has its own injector (`crash_save`).
+                # (Pipelining is auto-disabled while a plan is armed,
+                # so the hook always sees fully-bookkept prior epochs.)
                 if plan.enabled:
                     plan.maybe_fire(
                         "epoch", epoch=epoch, pre_exit=state_ckptr.wait
@@ -643,19 +1013,26 @@ class Trainer:
                 k = min(chunk, target_epochs - epoch) if use_scan else 1
                 profiler.maybe_start_span(epoch, k)
                 # One span per dispatch unit: the trace's "trainer
-                # epochs" row. Pushed so the phase spans (data_wait /
-                # dispatch / checkpoint) nest under it.
-                epoch_span = tracer.open(
+                # epochs" row. Parenting is EXPLICIT (not thread-stack):
+                # pipelined, span e is still open when span e+1 starts,
+                # so stack-implicit parenting would chain epochs under
+                # each other and leak the stack.
+                epoch_span = tracer.start(
                     "trainer.epoch", component="trainer",
-                    epoch=epoch, k=k,
+                    epoch=epoch, k=k, parent_id=fit_span.span_id,
                 )
-                timer.start()
+                # Pipelined throughput windows chain consume-to-consume
+                # (started once here, re-armed by each consume); serial
+                # keeps one window per span, started at the boundary.
+                if not (pipelined and timer_running):
+                    timer.start()
+                    timer_running = True
                 if use_scan:
                     # Goodput: joining the prefetch future (or assembling
                     # inline) is time the DEVICE spends waiting on data.
                     with ledger.span("data_wait"), tracer.span(
                         "trainer.data_wait", component="trainer",
-                        epoch=epoch,
+                        epoch=epoch, parent_id=epoch_span.span_id,
                     ):
                         if prefetched is not None:
                             n_steps, globs = prefetched.result()
@@ -683,6 +1060,7 @@ class Trainer:
                     dispatch_span = tracer.start(
                         "trainer.dispatch", component="trainer",
                         epoch=epoch, k=k, key=f"scan_k{k}",
+                        parent_id=epoch_span.span_id,
                     )
                     if multi_fused is not None:
                         state, losses, val_sums, gnorms = multi_fused(
@@ -692,123 +1070,66 @@ class Trainer:
                         state, losses, val_sums, gnorms = epoch_fused(
                             state, *globs, *val_global
                         )
+                    # Host-blocking cost of the dispatch call itself
+                    # (jit trace + XLA compile on the first span of a
+                    # program shape; ~enqueue after) — the pipelined
+                    # ledger bills this window separately from the
+                    # consume-time join so category windows stay
+                    # main-thread sequential (never double-counted).
+                    _dispatch_elapsed = ledger.clock() - _t_dispatch
+                    # Non-blocking bookkeeping: start the D2H copies of
+                    # everything consume will read NOW, so by the time
+                    # the span is bookkept the bytes are already on the
+                    # host and device_get just unblocks.
+                    for _buf in (losses, gnorms, *val_sums):
+                        try:
+                            _buf.copy_to_host_async()
+                        except (AttributeError, RuntimeError):
+                            break
                     # Prefetch the next span UNLESS early stopping is
-                    # armed and could trigger within this one: the next
-                    # span may never run, and a speculative multi-epoch
-                    # H2D would sit in HBM through checkpointing/upload
+                    # armed and could trigger within this span or the
+                    # still-unbookkept previous one: the next span may
+                    # never run, and a speculative multi-epoch H2D
+                    # would sit in HBM through checkpointing/upload
                     # for nothing.
                     speculative_ok = not (
                         cfg.train.early_stop_patience > 0
-                        and es_stale + k >= cfg.train.early_stop_patience
+                        and es_stale
+                        + (pending.k if pending is not None else 0)
+                        + k
+                        >= cfg.train.early_stop_patience
                     )
                     nxt = epoch + k
-                    if nxt < target_epochs and speculative_ok:
+                    if (
+                        prefetch_pool is not None
+                        and nxt < target_epochs
+                        and speculative_ok
+                    ):
                         prefetched = prefetch_pool.submit(
                             _assemble_span, nxt,
                             min(chunk, target_epochs - nxt),
                         )
                     else:
                         prefetched = None
-                    jax.block_until_ready(state.params)
-                    # Fused dispatch (train + eval in one program) bills
-                    # to train_step; its first occurrence per program
-                    # shape is the compile.
-                    ledger.add_dispatch(
-                        "train_step", f"scan_k{k}",
-                        ledger.clock() - _t_dispatch,
+                    cur = _SpanInFlight(
+                        epoch0=epoch, k=k, n_steps=n_steps, state=state,
+                        losses=losses, val_sums=val_sums, gnorms=gnorms,
+                        t_dispatch=_t_dispatch,
+                        dispatch_elapsed=_dispatch_elapsed,
+                        dispatch_span=dispatch_span,
+                        epoch_span=epoch_span,
                     )
-                    dispatch_span.end()
-                    # The fused program runs the validation pass(es)
-                    # inside the timed window; credit them to MFU.
-                    epoch_stats = timer.stop(
-                        epoch, k * n_steps * global_batch,
-                        eval_samples=k * len(val_idx),
-                    )
-                    import numpy as _np
-
-                    if multi_fused is not None:
-                        # [K, S] losses; val_sums is a 6-tuple of [K]
-                        # arrays (dtype-preserving per leaf — see
-                        # make_multi_epoch_train_eval_step). Stack
-                        # host-side as float64 -> [K, 6]; the upcast
-                        # only protects the stacking, precision is
-                        # bounded by the on-device f32 accumulation
-                        # (exact for integral weights up to 2^24 per
-                        # epoch, steps.py).
-                        losses_host = _np.asarray(jax.device_get(losses))
-                        gnorms_host = _np.asarray(jax.device_get(gnorms))
-                        val_host = _np.stack(
-                            [
-                                _np.asarray(v, dtype=_np.float64)
-                                for v in jax.device_get(val_sums)
-                            ],
-                            axis=1,
+                    if pipelined:
+                        # Swap FIRST: if consuming the previous span
+                        # raises (health halt), the finally sweep still
+                        # finds the in-flight successor via `pending`.
+                        _sp, pending = pending, cur
+                        stop_early = (
+                            _consume_span(_sp) if _sp is not None
+                            else False
                         )
-                    else:  # [S] / 6-tuple — the k == 1 parity layout
-                        losses_host = _np.asarray(
-                            jax.device_get(losses)
-                        )[None]
-                        gnorms_host = _np.asarray(
-                            jax.device_get(gnorms)
-                        )[None]
-                        val_host = _np.asarray(
-                            [float(v) for v in jax.device_get(val_sums)]
-                        )[None]
-                    flat = losses_host.reshape(-1)
-                    for i in range(flat.size):
-                        if (global_step + i + 1) % cfg.train.log_every_n_steps == 0:
-                            self.tracker.log_metrics(
-                                {"train_loss": float(flat[i])},
-                                step=global_step + i + 1,
-                            )
-                    global_step += flat.size
-                    # Step-trigger faults on the scan path fire at the
-                    # span boundary — steps inside a fused dispatch are
-                    # not individually interruptible from the host.
-                    if plan.enabled:
-                        plan.maybe_fire(
-                            "step", step=global_step,
-                            pre_exit=state_ckptr.wait,
-                        )
-                    # Health pass over the span's per-step losses and
-                    # grad norms BEFORE any epoch bookkeeping: under a
-                    # halting policy the run stops here — no epoch_end,
-                    # no checkpoint of the diverged state.
-                    gflat = gnorms_host.reshape(-1)
-                    per_epoch_upd = max(1, flat.size // k)
-                    halt_finding = None
-                    for i in range(flat.size):
-                        f = health.observe_step(
-                            float(flat[i]),
-                            grad_norm=float(gflat[i]),
-                            step=global_step - flat.size + i + 1,
-                            epoch=epoch + i // per_epoch_upd,
-                        )
-                        if halt_finding is None and f is not None and f.halt:
-                            halt_finding = f
-                    if halt_finding is not None:
-                        # Close the epoch span BEFORE raising: the
-                        # halted epoch is exactly the one the operator
-                        # opens the trace to inspect.
-                        epoch_span.end(halted=halt_finding.kind)
-                    HealthMonitor.raise_on(halt_finding)
-                    # Reference parity: the logged train_loss is the
-                    # EPOCH-AGGREGATED mean (Lightning epoch aggregation of
-                    # jobs/train_lightning_ddp.py:70), not the last batch —
-                    # one (train_loss, val_loss, val_acc, counts) entry per
-                    # epoch in the span.
-                    sub_epochs = []
-                    for i in range(k):
-                        ls, accs, c, tp, fp, fn = (
-                            float(v) for v in val_host[i]
-                        )
-                        sub_epochs.append((
-                            float(losses_host[i].mean())
-                            if losses_host[i].size else None,
-                            ls / c if c else float("nan"),
-                            accs / c if c else float("nan"),
-                            (tp, fp, fn),
-                        ))
+                    else:
+                        stop_early = _consume_span(cur)
                 else:
                     import numpy as _np
 
@@ -820,30 +1141,30 @@ class Trainer:
                     poison = plan.enabled and bool(
                         plan.check("data", epoch=epoch)
                     )
-                    pending: list = []
+                    group: list = []
                     for batch in train_loader.epoch(epoch):
-                        pending.append(batch)
-                        if len(pending) < accum:
+                        group.append(batch)
+                        if len(group) < accum:
                             continue
                         with annotate("host_batch_staging"), \
                                 ledger.span("data_wait"):
                             if accum > 1:
-                                bx = _np.concatenate([b.x for b in pending])
-                                by = _np.concatenate([b.y for b in pending])
+                                bx = _np.concatenate([b.x for b in group])
+                                by = _np.concatenate([b.y for b in group])
                                 bw = _np.concatenate(
-                                    [b.weight for b in pending]
+                                    [b.weight for b in group]
                                 )
                             else:
                                 bx, by, bw = (
-                                    pending[0].x, pending[0].y,
-                                    pending[0].weight,
+                                    group[0].x, group[0].y,
+                                    group[0].weight,
                                 )
                             if poison:
                                 poison = False
                                 bx = _np.array(bx, copy=True)
                                 bx[0, ...] = _np.nan
                             x, y, w = make_global_batch(self.mesh, bx, by, bw)
-                        pending = []
+                        group = []
                         # The device_get of the loss is the step's real
                         # sync point — include it in the dispatch window.
                         with ledger.dispatch("train_step", key="eager_step"):
@@ -909,174 +1230,53 @@ class Trainer:
                     epoch_stats = timer.stop(epoch, n_steps * global_batch)
                     epoch_loss = loss_sum / n_updates if n_updates else None
 
-                if not use_scan:
                     with ledger.dispatch("eval", key="eager_eval"), \
                             tracer.span(
                                 "trainer.eval", component="trainer",
                                 epoch=epoch,
+                                parent_id=epoch_span.span_id,
                             ):
                         val_loss, val_acc, (tp, fp, fn) = self._evaluate(
                             state, eval_step, val_loader
                         )
-                    sub_epochs = [
-                        (epoch_loss, val_loss, val_acc, (tp, fp, fn))
-                    ]
-                # Per-span goodput: category deltas since the previous
-                # report, logged to the tracker next to val_loss so a
-                # goodput regression is queryable like an accuracy one.
-                span_goodput = ledger.epoch_report()
-                if heartbeat is not None:
-                    heartbeat.beat(
-                        step=global_step, epoch=epoch + k - 1, phase="train"
-                    )
-                # Per-epoch bookkeeping for every epoch in the span; with
-                # k > 1 the chunk is the dispatch unit, so wall time is
-                # span-amortized and the metric step is reconstructed per
-                # epoch from the update count.
-                span_updates = flat.size if use_scan else 0
-                per_epoch_updates = span_updates // k if k else 0
-                last_rec = None
-                stop_early = False
-                for i, (epoch_loss, val_loss, val_acc, (tp, fp, fn)) in (
-                    enumerate(sub_epochs)
-                ):
-                    epoch_rec = {
-                        "epoch": epoch + i,
-                        "train_loss": epoch_loss if epoch_loss is not None else float("nan"),
-                        "val_loss": val_loss,
-                        "val_acc": val_acc,
-                    }
-                    epoch_metrics = {
-                        "train_loss_epoch": epoch_rec["train_loss"],
-                        "val_loss": val_loss,
-                        "val_acc": val_acc,
-                        "epoch_time": epoch_stats.seconds / k,
-                        "samples_per_sec": epoch_stats.samples_per_sec,
-                        "samples_per_sec_per_chip": epoch_stats.samples_per_sec_per_chip,
-                        # Span-level fraction (the span is the dispatch
-                        # unit; every epoch in it shares the value).
-                        "goodput_fraction": span_goodput["goodput_fraction"],
-                    }
-                    if cfg.model.num_classes == 2:
-                        # Positive class 1 = "rain" (the reference's label
-                        # encoding, jobs/preprocess.py:23-25). One-vs-rest
-                        # counts would mislead for num_classes > 2, so the
-                        # P/R/F1 surface is binary-only.
-                        val_precision, val_recall, val_f1 = precision_recall_f1(
-                            tp, fp, fn
-                        )
-                        epoch_rec["val_f1"] = val_f1
-                        epoch_metrics.update(
-                            val_precision=val_precision,
-                            val_recall=val_recall,
-                            val_f1=val_f1,
-                        )
-                    history.append(epoch_rec)
-                    if epoch_stats.mfu is not None:
-                        epoch_metrics["mfu"] = epoch_stats.mfu
-                    metric_step = (
-                        global_step - span_updates
-                        + (i + 1) * per_epoch_updates
-                        if use_scan else global_step
-                    )
-                    self.tracker.log_metrics(epoch_metrics, step=metric_step)
-                    events.emit(
-                        "trainer", "epoch_end",
-                        epoch=epoch + i,
-                        train_loss=epoch_rec["train_loss"],
-                        val_loss=val_loss, val_acc=val_acc,
-                        goodput_fraction=span_goodput["goodput_fraction"],
-                    )
-                    last_rec = epoch_rec
-                    # Early stopping (monitor val_loss, min mode — the
-                    # companion of the reference's ModelCheckpoint
-                    # policy). val_loss is a globally-reduced scalar, so
-                    # every SPMD rank takes the same branch; a nan never
-                    # counts as an improvement (including as the first
-                    # es_best). Inside a span the epochs already ran on
-                    # device; the stop takes effect at the span boundary,
-                    # and the es state freezes at the trigger point.
-                    if cfg.train.early_stop_patience > 0 and not stop_early:
-                        es_best, es_stale, stop_early = early_stop_update(
-                            val_loss, es_best, es_stale,
-                            patience=cfg.train.early_stop_patience,
-                            min_delta=cfg.train.early_stop_min_delta,
-                        )
-                _span_end_vl = sub_epochs[-1][1]
-                if not math.isnan(_span_end_vl):
-                    span_end_vl_min = min(span_end_vl_min, _span_end_vl)
-                profiler.maybe_stop_span(epoch, k)
-                # Host-gather BEFORE the coordinator gate: with TP/SP
-                # spanning processes this is a collective every rank must
-                # join; in the common fully-addressable case only the
-                # coordinator pays the device-to-host copy.
-                _t_ckpt = ledger.clock()
-                ckpt_span = tracer.open(
-                    "trainer.checkpoint", component="trainer",
-                    epoch=epoch + k - 1,
-                )
-                if params_cross_process or self.coordinator:
-                    host_params = to_host(state.params)
-                if self.coordinator:
-                    # Deploy-checkpoint policy at span granularity: only
-                    # the span-end params exist on device, so best/last
-                    # selection sees the span-end epoch's metrics (k == 1
-                    # reduces to the per-epoch policy exactly).
-                    _, last_vl, last_va, _ = sub_epochs[-1]
-                    ckpt_metrics = {"val_loss": last_vl, "val_acc": last_va}
-                    if "val_f1" in last_rec:
-                        ckpt_metrics["val_f1"] = last_rec["val_f1"]
-                    ckptr.update(
-                        epoch=epoch + k - 1,
-                        metrics=ckpt_metrics,
-                        params=host_params,
-                        meta=meta,
-                    )
-
-                # Every process keeps its own resume state (host-local
-                # disk) plus the run facts the next run's continuation
-                # semantics are decided from. The write overlaps the next
-                # epoch's compute (device->host snapshot is synchronous;
-                # the npz/rotation runs on a worker thread). On an early
-                # stop the run is marked COMPLETE at the stop point
-                # (target_epochs = epochs_completed) so a resumed run
-                # EXTENDS (continuous semantics) instead of "finishing"
-                # the abandoned target.
-                # Re-pin to the declared layout before snapshotting (a
-                # no-op for leaves already there; a collective reshard —
-                # every rank calls it — for any the step's output layout
-                # drifted, e.g. ZeRO-1 output params).
-                state_ckptr.save_async(
-                    jax.device_put(state, declared_shardings),
-                    meta={
-                        "epochs_completed": epoch + k,
-                        "target_epochs": (
-                            epoch + k if stop_early else target_epochs
+                    stop_early = _bookkeep_span(
+                        _SpanInFlight(
+                            epoch0=epoch, k=1, n_steps=n_steps,
+                            state=state, epoch_span=epoch_span,
                         ),
-                        # Exact resume refusal across optimizer configs
-                        # whose state trees are isomorphic (ADVICE r4).
-                        "optimizer": opt_identity,
-                    },
-                )
-                # Both checkpoint tiers' synchronous cost (host gather,
-                # deploy-tier writes, the resume snapshot's device->host
-                # copy; the npz write itself overlaps on a worker thread).
-                ledger.add("checkpoint", ledger.clock() - _t_ckpt)
-                ckpt_span.end()
-                epoch_span.end(val_loss=sub_epochs[-1][1])
+                        [(epoch_loss, val_loss, val_acc, (tp, fp, fn))],
+                        epoch_stats, 0,
+                    )
                 epoch += k
-                # Graceful preemption at the span boundary: the span's
-                # resume snapshot (epochs_completed = epoch) was just
-                # submitted — join it so the checkpoint is durable, then
+                # Graceful preemption at the span boundary: the last
+                # BOOKKEPT span's resume snapshot was just submitted —
+                # first drain any still-in-flight span so its progress
+                # is durable too (matching serial semantics: everything
+                # dispatched gets consumed), then join the write and
                 # exit PREEMPTED. With epoch_chunk=1 at most one epoch
                 # of progress is in flight when SIGTERM lands, so the
                 # resume loses at most that epoch.
                 if guard.requested:
+                    if pending is not None:
+                        _sp, pending = pending, None
+                        _consume_span(_sp)
                     self._preempt_exit(
-                        guard, events, state_ckptr, epochs_completed=epoch
+                        guard, events, state_ckptr,
+                        epochs_completed=consumed_through,
                     )
                 if stop_early:
                     break
+            # Pipelined tail: the loop exits on the epoch budget (or an
+            # early stop) with the last dispatched span's results still
+            # on device — bookkeep them now.
+            if pending is not None:
+                _sp, pending = pending, None
+                stop_early = _consume_span(_sp) or stop_early
+                if guard.requested:
+                    self._preempt_exit(
+                        guard, events, state_ckptr,
+                        epochs_completed=consumed_through,
+                    )
             completed = True
 
         except PreemptedError:
@@ -1136,9 +1336,15 @@ class Trainer:
                         if not completed:
                             # The crashing/preempted epoch is exactly
                             # the window the operator opens the trace to
-                            # inspect: record any span still in flight.
-                            for _sp in (dispatch_span, ckpt_span,
-                                        epoch_span):
+                            # inspect: record any span still in flight
+                            # (pipelined, the un-bookkept successor's
+                            # spans live in `pending`).
+                            in_flight = [dispatch_span, ckpt_span,
+                                         epoch_span]
+                            if pending is not None:
+                                in_flight += [pending.dispatch_span,
+                                              pending.epoch_span]
+                            for _sp in in_flight:
                                 if _sp is not None:
                                     _sp.end(error=not preempted)
                         # Fit span closes HERE, success or failure: a
@@ -1154,6 +1360,14 @@ class Trainer:
                                 if history else None
                             ),
                         )
+                        # Hot loop over (success, crash, or preempt):
+                        # drain buffered telemetry and drop both sinks
+                        # to write-through, so every record emitted so
+                        # far is durable and post-run emitters through
+                        # the installed process defaults get
+                        # read-after-emit visibility back.
+                        events.set_write_through()
+                        tracer.set_write_through()
 
         # Rank-0 post-train artifact upload, mirroring
         # jobs/train_lightning_ddp.py:146-164 (best, else last.ckpt fallback).
