@@ -1,0 +1,32 @@
+"""dct-lint: project-native static analysis for the platform's invariants.
+
+The platform's correctness rests on conventions nothing in a generic
+linter checks: rank-0-only artifact writes in SPMD code, tmp-then-
+``os.replace`` atomic publishes into checkpoint/package/registry
+directories, no blocking host sync inside the trainer's pipelined
+dispatch region, pure bodies under ``jax.jit``/``shard_map`` traces, a
+reconciled ``DCT_*`` env registry, and event names that match the
+documented observability schema. ``dct_tpu.analysis`` enforces them
+mechanically:
+
+- :mod:`core` — the framework: rule registry, findings, ``# dct:
+  noqa[rule-id]`` suppressions, the reviewed baseline file.
+- :mod:`rules` — the project-specific rules (one module per concern).
+- :mod:`lint` — the CLI: ``python -m dct_tpu.analysis.lint [paths]``
+  (text or ``--format json``, exit 0 clean / 1 findings / 2 error —
+  suitable for CI).
+
+The package is deliberately stdlib-only (``ast``/``re``/``json``): the
+CI job that runs it needs no jax, so a broken accelerator install can
+never mask a broken invariant. Rule catalog, suppression policy, and
+the how-to-extend guide live in ``docs/ANALYSIS.md``.
+"""
+
+from dct_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Project,
+    Rule,
+    all_rules,
+    analyze,
+    register,
+)

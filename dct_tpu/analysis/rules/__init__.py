@@ -1,0 +1,26 @@
+"""Built-in dct-lint rules. Importing this package registers them.
+
+One module per concern:
+
+- :mod:`io_rules` — ``rank0-io`` (coordinator-gated writes in SPMD
+  modules) and ``atomic-publish`` (tmp-then-``os.replace`` into
+  checkpoint/package/registry paths).
+- :mod:`purity_rules` — ``span-sync`` (no blocking host sync inside the
+  trainer's marked pipelined-dispatch region) and ``trace-purity`` (no
+  impure calls inside ``jit``/``shard_map``/``pallas_call`` bodies).
+- :mod:`registry_rules` — ``env-registry`` (``DCT_*`` declared in
+  ``config.py`` ⇄ documented in ``.env.example`` ⇄ actually read) and
+  ``event-names`` (``EventLog.emit`` sites vs the
+  ``docs/OBSERVABILITY.md`` event table).
+
+To add a rule: subclass :class:`dct_tpu.analysis.core.Rule`, decorate
+with :func:`dct_tpu.analysis.core.register`, import the module here,
+and pair it with good/bad fixtures in ``tests/test_analysis.py``
+(docs/ANALYSIS.md walks through it).
+"""
+
+from dct_tpu.analysis.rules import (  # noqa: F401 — imported to register
+    io_rules,
+    purity_rules,
+    registry_rules,
+)

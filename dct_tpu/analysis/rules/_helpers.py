@@ -1,0 +1,96 @@
+"""AST helpers shared by the built-in rules."""
+
+from __future__ import annotations
+
+import ast
+
+
+def func_repr(call: ast.Call) -> str:
+    """Source-ish spelling of a call's callee (``os.replace``,
+    ``shutil.copy2``, ``open`` ...); empty string when unrenderable."""
+    try:
+        return ast.unparse(call.func)
+    except Exception:  # pragma: no cover — unparse is total on 3.9+
+        return ""
+
+
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def iter_calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def open_mode(call: ast.Call) -> str | None:
+    """The literal mode of an ``open()``/``io.open()`` call; ``'r'`` when
+    defaulted; None when the callee is not open or the mode is dynamic."""
+    name = func_repr(call)
+    if name not in ("open", "io.open"):
+        return None
+    mode_node: ast.AST | None = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode_node = kw.value
+    if mode_node is None:
+        return "r"
+    if isinstance(mode_node, ast.Constant) and isinstance(mode_node.value, str):
+        return mode_node.value
+    return None  # dynamic mode: not statically checkable
+
+
+def open_target(call: ast.Call) -> ast.AST | None:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "file":
+            return kw.value
+    return None
+
+
+def string_candidates(node: ast.AST) -> list[str] | None:
+    """Statically-known string values of an expression: a constant, or
+    both arms of a constant conditional. None = dynamic (unknowable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        body = string_candidates(node.body)
+        orelse = string_candidates(node.orelse)
+        if body is not None and orelse is not None:
+            return body + orelse
+    return None
+
+
+def with_open_bindings(fn: ast.AST) -> dict[str, ast.AST]:
+    """``with open(X) as name`` bindings in a function body: name -> X.
+    Lets path-shape checks see through file handles (``np.savez(f)``)."""
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            call = item.context_expr
+            if (
+                isinstance(call, ast.Call)
+                and func_repr(call) in ("open", "io.open")
+                and isinstance(item.optional_vars, ast.Name)
+            ):
+                target = open_target(call)
+                if target is not None:
+                    out[item.optional_vars.id] = target
+    return out
+
+
+def enclosing_function(ctx, node: ast.AST) -> ast.AST | None:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None

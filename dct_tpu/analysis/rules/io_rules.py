@@ -1,0 +1,267 @@
+"""I/O discipline rules: rank-0-only writes and atomic publishes.
+
+``rank0-io`` — the platform's core SPMD contract (inherited from the
+reference's DDP design): in code that runs on every rank, shared
+filesystem/tracking artifacts are written by the coordinator only. N
+ranks racing one ``best.ckpt`` is a torn checkpoint at pod scale and a
+passing test at world_size=1, which is exactly why a machine checks it.
+
+``atomic-publish`` — anything published into a checkpoint / deploy
+package / tracking registry path must be written to a tmp-suffixed
+sibling and ``os.replace``d into place (the PR 3 crash-safety
+convention): a reader (or a preemption) must never observe a
+half-written file where a complete one is expected.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from dct_tpu.analysis.core import Finding, Project, Rule, register
+from dct_tpu.analysis.rules._helpers import (
+    enclosing_function,
+    func_repr,
+    iter_calls,
+    open_mode,
+    open_target,
+    unparse,
+    with_open_bindings,
+)
+
+#: A module participates in SPMD (every rank executes it) when it
+#: touches the process topology. Modules that never ask "which rank am
+#: I" are assumed single-process (orchestrator/DAG side).
+_MULTI_RANK_RE = re.compile(
+    r"jax\.process_index|jax\.process_count|multihost_utils|is_coordinator"
+)
+
+#: An ``if`` test that gates on the coordinator/rank-0 identity.
+_GUARD_RE = re.compile(
+    r"coordinator|process_index\(\)\s*==\s*0|process_id\s*==\s*0"
+    r"|rank\s*==\s*0"
+)
+
+#: The inverted spelling: ``if rank != 0: ... else: <write>``.
+_INV_GUARD_RE = re.compile(
+    r"process_index\(\)\s*!=\s*0|process_id\s*!=\s*0|rank\s*!=\s*0"
+)
+
+#: Callees that create/replace filesystem state.
+_WRITE_FUNCS = {
+    "os.replace",
+    "os.rename",
+    "shutil.copy",
+    "shutil.copy2",
+    "shutil.copyfile",
+    "shutil.copytree",
+    "shutil.move",
+    "np.savez",
+    "numpy.savez",
+    "np.save",
+    "numpy.save",
+}
+
+#: Project publish APIs whose *call* is the artifact write.
+_PUBLISH_CALLS = {"save_checkpoint", "write_train_metrics_prom"}
+_PUBLISH_ATTRS = {"log_artifact"}
+
+
+def _is_write_sink(call: ast.Call) -> str | None:
+    """A human-readable sink label, or None when the call writes nothing."""
+    mode = open_mode(call)
+    if mode is not None and any(c in mode for c in "wax+"):
+        return f"open(..., {mode!r})"
+    name = func_repr(call)
+    if name in _WRITE_FUNCS:
+        return name
+    tail = name.rsplit(".", 1)[-1]
+    if tail in _PUBLISH_CALLS or tail in _PUBLISH_ATTRS:
+        return name
+    return None
+
+
+@register
+class Rank0IoRule(Rule):
+    id = "rank0-io"
+    name = "rank-0-only artifact writes in multi-rank modules"
+    doc = (
+        "In modules that execute on every SPMD rank, filesystem and "
+        "tracking writes must sit under a coordinator gate "
+        "(`if self.coordinator:` / `is_coordinator()` / "
+        "`jax.process_index() == 0`). Per-process-by-design writers "
+        "(e.g. the resume checkpoint tier) mark the whole def/class "
+        "with `# dct: noqa[rank0-io] — <why>`."
+    )
+
+    def check(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in project.contexts:
+            if ctx.tree is None or not _MULTI_RANK_RE.search(ctx.source):
+                continue
+            for call in iter_calls(ctx.tree):
+                sink = _is_write_sink(call)
+                if sink is None:
+                    continue
+                if self._guarded(ctx, call):
+                    continue
+                out.append(
+                    ctx.finding(
+                        self.id,
+                        call,
+                        f"unguarded {sink} in a multi-rank module: every "
+                        "rank executes this — gate it on the coordinator "
+                        "(`if self.coordinator:` / `jax.process_index() "
+                        "== 0`), or mark the enclosing def/class "
+                        "`# dct: noqa[rank0-io] — <why per-process "
+                        "writes are safe here>`",
+                    )
+                )
+        return out
+
+    @classmethod
+    def _guarded(cls, ctx, call: ast.Call) -> bool:
+        """Branch-aware: the write must sit in the branch the guard
+        actually selects for the coordinator — a write in the `else` of
+        `if coordinator:`, or in the body of `if not coordinator:`, is
+        exactly the bug this rule exists to catch."""
+        parents = ctx.parents()
+        child: ast.AST = call
+        anc = parents.get(call)
+        while anc is not None:
+            if isinstance(anc, ast.If):
+                branch = cls._guard_branch(anc.test)
+                if branch == "body" and cls._in(child, anc.body):
+                    return True
+                if branch == "orelse" and cls._in(child, anc.orelse):
+                    return True
+            elif isinstance(anc, ast.IfExp):
+                branch = cls._guard_branch(anc.test)
+                if branch == "body" and child is anc.body:
+                    return True
+                if branch == "orelse" and child is anc.orelse:
+                    return True
+            child, anc = anc, parents.get(anc)
+        return False
+
+    @staticmethod
+    def _in(node: ast.AST, stmts: list) -> bool:
+        return any(node is s for s in stmts)
+
+    @classmethod
+    def _guard_branch(cls, test: ast.AST) -> str | None:
+        """Which branch of ``if test:`` is coordinator-only: 'body',
+        'orelse', or None. Negation flips the branch; a guard term
+        buried under a non-trivial `not` (`a and not coordinator`) is
+        conservatively no guard at all."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            inner = cls._guard_branch(test.operand)
+            if inner == "body":
+                return "orelse"
+            if inner == "orelse":
+                return "body"
+            return None
+        src = unparse(test)
+        if _INV_GUARD_RE.search(src):
+            return "orelse"
+        if _GUARD_RE.search(src) and "not " not in src:
+            return "body"
+        return None
+
+
+#: Layers whose files publish into shared checkpoint / deploy-package /
+#: tracking-registry paths; every file creation there must be atomic.
+_PUBLISH_LAYERS = (
+    "dct_tpu/checkpoint/",
+    "dct_tpu/deploy/",
+    "dct_tpu/serving/",
+    "dct_tpu/tracking/",
+    "dct_tpu/evaluation/",
+    "dct_tpu/observability/",
+)
+
+#: Destination-bearing copy/move callees: (callee -> dest arg index).
+_COPY_FUNCS = {
+    "shutil.copy": 1,
+    "shutil.copy2": 1,
+    "shutil.copyfile": 1,
+    "shutil.copytree": 1,
+    "shutil.move": 1,
+}
+_SAVE_FUNCS = {"np.savez": 0, "numpy.savez": 0, "np.save": 0, "numpy.save": 0}
+
+
+def _tmp_flavored(expr_src: str) -> bool:
+    low = expr_src.lower()
+    return "tmp" in low or "temp" in low
+
+
+@register
+class AtomicPublishRule(Rule):
+    id = "atomic-publish"
+    name = "tmp-then-os.replace publishes in the publish layers"
+    doc = (
+        "In the checkpoint/deploy/serving/tracking/evaluation/"
+        "observability layers, creating a file in place "
+        "(`open(final, 'w')`, `shutil.copy*(…, final)`, `np.savez(final)`)"
+        " can be torn by a crash mid-write; write a tmp-suffixed sibling "
+        "and `os.replace` it into place instead. Append-mode logs are "
+        "exempt (appends are incremental by contract)."
+    )
+
+    def check(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in project.contexts:
+            if ctx.tree is None or not ctx.relpath.startswith(_PUBLISH_LAYERS):
+                continue
+            for call in iter_calls(ctx.tree):
+                target, sink = self._non_tmp_target(ctx, call)
+                if target is None:
+                    continue
+                out.append(
+                    ctx.finding(
+                        self.id,
+                        call,
+                        f"non-atomic publish: {sink} creates "
+                        f"`{target}` in place — write `{target}.tmp.<pid>`"
+                        " and `os.replace` it into the final path so a "
+                        "crash mid-write can never publish a torn file",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _non_tmp_target(ctx, call: ast.Call) -> tuple[str | None, str]:
+        """(offending target source, sink label); (None, '') when fine."""
+        mode = open_mode(call)
+        if mode is not None:
+            # Pure append is incremental by contract; any 'w'/'x' create
+            # must go through a tmp sibling.
+            if not any(c in mode for c in "wx"):
+                return None, ""
+            node = open_target(call)
+            if node is None:
+                return None, ""
+            src = unparse(node)
+            return (None, "") if _tmp_flavored(src) else (src, f"open(..., {mode!r})")
+        name = func_repr(call)
+        if name in _COPY_FUNCS:
+            idx = _COPY_FUNCS[name]
+            if len(call.args) > idx:
+                src = unparse(call.args[idx])
+                return (None, "") if _tmp_flavored(src) else (src, name)
+            return None, ""
+        if name in _SAVE_FUNCS:
+            if not call.args:
+                return None, ""
+            node = call.args[0]
+            # See through a handle bound by `with open(tmp) as f`.
+            if isinstance(node, ast.Name):
+                fn = enclosing_function(ctx, call)
+                if fn is not None:
+                    bound = with_open_bindings(fn).get(node.id)
+                    if bound is not None:
+                        node = bound
+            src = unparse(node)
+            return (None, "") if _tmp_flavored(src) else (src, name)
+        return None, ""

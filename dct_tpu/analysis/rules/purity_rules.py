@@ -1,0 +1,237 @@
+"""Execution-semantics rules: pipelined-loop sync and trace purity.
+
+``span-sync`` — the PR 5 dispatch-gap work made ``Trainer.fit``'s scan
+path a one-span-in-flight pipeline: everything between dispatching span
+*e+1* and consuming span *e* must not join device results, or the
+overlap the mode buys silently collapses back to serial. The no-sync
+window is delimited in source with ``# dct: begin-no-host-sync`` /
+``# dct: end-no-host-sync`` markers; inside it the rule flags every
+construct that blocks on the device (``jax.device_get``,
+``.block_until_ready()``, ``float()``/``int()``/``.item()`` on arrays,
+``np.asarray``-style host materialization).
+
+``trace-purity`` — bodies traced by ``jax.jit`` / ``shard_map`` /
+``pallas_call`` execute once at trace time, then replay as compiled
+XLA: host side effects inside them (wall-clock reads, ``np.random``,
+``print``, env reads, file I/O) either bake a stale value into the
+program or silently vanish from steady-state steps. Tracedness is
+computed transitively over same-module calls (a helper called from a
+jitted function is traced too).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from dct_tpu.analysis.core import Finding, Project, Rule, register
+from dct_tpu.analysis.rules._helpers import func_repr, iter_calls, unparse
+
+_SYNC_FUNCS = {
+    "jax.device_get",
+    "jax.block_until_ready",
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+}
+_SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
+
+
+@register
+class SpanSyncRule(Rule):
+    id = "span-sync"
+    name = "no blocking host sync in the pipelined dispatch region"
+    doc = (
+        "Between `# dct: begin-no-host-sync` and `# dct: "
+        "end-no-host-sync` (the trainer's dispatch-to-swap window), "
+        "nothing may join device results: no `jax.device_get`, "
+        "`.block_until_ready()`, `.item()`, `float()`/`int()` on device "
+        "values, or `np.asarray`/`np.array` materialization. The join "
+        "belongs one span later, in `_consume_span`."
+    )
+
+    def check(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in project.contexts:
+            if ctx.tree is None:
+                continue
+            regions = ctx.regions()
+            if not regions:
+                continue
+
+            def in_region(lineno: int) -> bool:
+                return any(lo <= lineno <= hi for lo, hi in regions)
+
+            for call in iter_calls(ctx.tree):
+                if not in_region(call.lineno):
+                    continue
+                label = self._sync_label(call)
+                if label is None:
+                    continue
+                out.append(
+                    ctx.finding(
+                        self.id,
+                        call,
+                        f"blocking host sync `{label}` inside the "
+                        "no-host-sync region: this joins the in-flight "
+                        "span and serializes the pipelined loop — move "
+                        "it into the consume path (after the region), "
+                        "or use copy_to_host_async for a non-blocking "
+                        "D2H start",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _sync_label(call: ast.Call) -> str | None:
+        name = func_repr(call)
+        if name in _SYNC_FUNCS:
+            return name
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in _SYNC_ATTRS
+        ):
+            return f".{call.func.attr}()"
+        if name in ("float", "int") and call.args and not all(
+            isinstance(a, ast.Constant) for a in call.args
+        ):
+            return f"{name}(...)"
+        return None
+
+
+#: Decorators / higher-order callees whose function argument is traced.
+_TRACE_CALL_RE = re.compile(
+    r"(?:^|\.)(?:jit|pjit|shard_map|pallas_call|checkpoint|remat)$"
+)
+
+#: Impure callee prefixes (host state readers / side effects).
+_IMPURE_PREFIXES = (
+    "time.",
+    "np.random.",
+    "numpy.random.",
+    "random.",
+    "datetime.",
+    "uuid.",
+    "os.environ.",
+)
+_IMPURE_EXACT = {"os.getenv", "print", "open", "input"}
+
+
+@register
+class TracePurityRule(Rule):
+    id = "trace-purity"
+    name = "no impure calls inside jit/shard_map-traced bodies"
+    doc = (
+        "Functions traced by `jax.jit` / `shard_map` / `pallas_call` "
+        "(directly, via a factory's `return jax.jit(inner)`, or "
+        "transitively through same-module calls) must be pure: "
+        "`time.time`, `np.random`, `print`, env reads, `open` etc. "
+        "run once at trace time — the compiled program replays a stale "
+        "value (or nothing). Use `jax.random` for randomness and "
+        "`jax.debug.print`/`io_callback` for effects."
+    )
+
+    def check(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in project.contexts:
+            if ctx.tree is None:
+                continue
+            traced = self._traced_functions(ctx)
+            for fn in traced:
+                out.extend(self._scan_body(ctx, fn, traced))
+        return out
+
+    # -- tracedness ------------------------------------------------------
+    @staticmethod
+    def _traced_functions(ctx) -> list[ast.AST]:
+        defs_by_name: dict[str, list[ast.AST]] = {}
+        all_defs: list[ast.AST] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+                all_defs.append(node)
+
+        traced: set[ast.AST] = set()
+        # Seed 1: decorated defs.
+        for fn in all_defs:
+            for dec in fn.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _TRACE_CALL_RE.search(unparse(target)):
+                    traced.add(fn)
+        # Seed 2: functions passed (by name) to a tracing callee.
+        for call in iter_calls(ctx.tree):
+            if not _TRACE_CALL_RE.search(func_repr(call)):
+                continue
+            for arg in call.args[:1]:
+                if isinstance(arg, ast.Name):
+                    traced.update(defs_by_name.get(arg.id, ()))
+
+        # Closure: nested defs of traced functions, and same-module
+        # functions a traced body calls, are traced too.
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(traced):
+                for node in ast.walk(fn):
+                    if (
+                        isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and node is not fn
+                        and node not in traced
+                    ):
+                        traced.add(node)
+                        changed = True
+                    if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name
+                    ):
+                        for callee in defs_by_name.get(node.func.id, ()):
+                            if callee not in traced:
+                                traced.add(callee)
+                                changed = True
+        return sorted(traced, key=lambda f: f.lineno)
+
+    def _scan_body(self, ctx, fn, traced) -> list[Finding]:
+        out: list[Finding] = []
+        nested = {
+            n
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        }
+
+        def owned(node: ast.AST) -> bool:
+            # Attribute findings to the innermost traced def, so one
+            # violation reports once.
+            for anc in ctx.ancestors(node):
+                if anc is fn:
+                    return True
+                if anc in nested:
+                    return False
+            return False
+
+        for node in ast.walk(fn):
+            if not owned(node) and node is not fn:
+                continue
+            label = None
+            if isinstance(node, ast.Call):
+                name = func_repr(node)
+                if name in _IMPURE_EXACT or name.startswith(_IMPURE_PREFIXES):
+                    label = name
+            elif isinstance(node, ast.Subscript) and unparse(node.value) == (
+                "os.environ"
+            ):
+                label = "os.environ[...]"
+            if label is not None:
+                out.append(
+                    ctx.finding(
+                        self.id,
+                        node,
+                        f"impure call `{label}` inside traced function "
+                        f"`{fn.name}`: it executes at trace time and its "
+                        "value/effect is baked into (or dropped from) "
+                        "the compiled program — hoist it to the host "
+                        "loop, or use jax.random / jax.debug.print / "
+                        "io_callback",
+                    )
+                )
+        return out

@@ -667,3 +667,200 @@ class RunConfig:
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+
+# ======================================================================
+# The DCT_* environment registry — the contract of record.
+#
+# Every DCT_-prefixed environment variable any first-party code reads
+# (or exports into a child process) is declared here with a one-line
+# description, and mirrored in `.env.example`. The `env-registry`
+# dct-lint rule (docs/ANALYSIS.md) holds the three surfaces equal:
+# an undeclared read, an undocumented entry, and a dead entry are all
+# findings. Entries that are dataclass knobs above carry no extra
+# authority — the dict exists so the ~160-knob surface (bench,
+# campaign scripts, DAG plumbing, launcher-exported IDs included) has
+# ONE greppable index that cannot silently drift from the code.
+# ======================================================================
+
+ENV_REGISTRY: dict[str, str] = {
+    # --- data / filesystem contract --------------------------------
+    "DCT_PROCESSED_DIR": "Spark/pandas ETL output dir (parquet)",
+    "DCT_RAW_CSV": "raw weather CSV the ETL ingests",
+    "DCT_MODELS_DIR": "deploy-tier checkpoints + train_state root",
+    "DCT_VAL_FRACTION": "held-out validation fraction (reference 0.2)",
+    # --- model family ----------------------------------------------
+    "DCT_MODEL": "registry model name (weather_mlp | transformers | moe)",
+    "DCT_HIDDEN_DIM": "MLP hidden width (reference 64)",
+    "DCT_NUM_CLASSES": "classifier classes (reference 2: rain/no-rain)",
+    "DCT_DROPOUT": "dropout rate (reference 0.2)",
+    "DCT_SEQ_LEN": "sequence families: window length",
+    "DCT_D_MODEL": "transformer encoder width",
+    "DCT_N_HEADS": "attention heads",
+    "DCT_N_LAYERS": "encoder blocks",
+    "DCT_D_FF": "feed-forward width",
+    "DCT_N_EXPERTS": "MoE expert count",
+    "DCT_CAPACITY_FACTOR": "MoE switch-routing capacity factor",
+    "DCT_ROUTER_AUX_WEIGHT": "MoE load-balance aux-loss weight",
+    "DCT_MOE_DISPATCH": "MoE dispatch engine: einsum | sorted | auto",
+    "DCT_MOE_AUTO_THRESHOLD": "auto dispatch crossover (one-hot elements)",
+    "DCT_ROUTER_TOP_K": "MoE top-k routing (1 = switch)",
+    "DCT_N_STAGES": "pipeline-parallel stage count",
+    "DCT_N_MICROBATCHES": "GPipe microbatches (default = stages)",
+    "DCT_HORIZON": "causal family: forecast horizon H",
+    "DCT_REMAT": "activation rematerialization on/off",
+    "DCT_ATTN_WINDOW": "sliding-window local attention (0 = full causal)",
+    "DCT_N_KV_HEADS": "grouped-query attention KV heads (0 = MHA)",
+    "DCT_POS_EMBED": "position encoding: sincos | rope",
+    # --- optimization loop -----------------------------------------
+    "DCT_EPOCHS": "epoch budget per cycle (reference 10)",
+    "DCT_BATCH_SIZE": "per-device batch size (reference 4 per rank)",
+    "DCT_LR": "learning rate (reference 0.01)",
+    "DCT_OPTIMIZER": "adam | adamw | sgd | adafactor | lion",
+    "DCT_MOMENTUM": "sgd/adafactor momentum",
+    "DCT_LR_SCHEDULE": "constant | cosine",
+    "DCT_WARMUP_STEPS": "linear LR warmup steps",
+    "DCT_DECAY_STEPS": "cosine decay horizon (0 = auto full trajectory)",
+    "DCT_END_LR_FRACTION": "cosine floor as a fraction of peak LR",
+    "DCT_WEIGHT_DECAY": "decoupled weight decay (>0 makes Adam AdamW)",
+    "DCT_GRAD_CLIP_NORM": "global-norm gradient clipping (0 = off)",
+    "DCT_SEED": "data split + init RNG seed (reference 42)",
+    "DCT_LOG_EVERY_N_STEPS": "per-step train_loss logging cadence",
+    "DCT_RESUME": "1 = extend the optimizer trajectory from train_state",
+    "DCT_BF16_COMPUTE": "bfloat16 MXU compute (params stay f32)",
+    "DCT_USE_SCAN": "lax.scan the epoch into one dispatch",
+    "DCT_SHARD_OPT_STATE": "ZeRO-1 weight-update sharding over data axis",
+    "DCT_SHARD_PARAMS": "FSDP/ZeRO-3 param + moment sharding",
+    "DCT_GRAD_ACCUM_STEPS": "microbatches summed per optimizer update",
+    "DCT_EARLY_STOP_PATIENCE": "epochs without val_loss improvement (0 = off)",
+    "DCT_EARLY_STOP_MIN_DELTA": "improvement threshold for early stop",
+    "DCT_EPOCH_CHUNK": "epochs fused into one XLA dispatch",
+    "DCT_PREFETCH_SPANS": "1 = pipelined span consume; 0 = strict serial",
+    # --- mesh / distributed topology -------------------------------
+    "DCT_MESH_DATA": "mesh data axis size (-1 = remaining devices)",
+    "DCT_MESH_MODEL": "mesh tensor-parallel axis size",
+    "DCT_MESH_SEQ": "mesh sequence-parallel axis size",
+    "DCT_MESH_PIPE": "mesh pipeline axis size",
+    "DCT_NUM_PROCESSES": "jax.distributed world size (WORLD_SIZE compat)",
+    "DCT_PROCESS_ID": "jax.distributed process index (NODE_RANK compat)",
+    "DCT_COORDINATOR_ADDRESS": "host:port rendezvous (MASTER_ADDR compat)",
+    "DCT_WORLD_SIZE": "supervise CLI: ranks per supervised world",
+    "DCT_ICI_MESH": "ICI-aware torus device layout on real TPU meshes",
+    "DCT_SP_ENGINE": "sequence-parallel engine: ring | a2a (Ulysses)",
+    "DCT_RING_STRIPED": "zigzag layout for the causal ring: auto|on|off",
+    # --- attention kernels -----------------------------------------
+    "DCT_FLASH": "Pallas flash attention: auto | on | off | interpret",
+    "DCT_FLASH_BLOCK_Q": "flash kernel query-tile size",
+    "DCT_FLASH_BLOCK_K": "flash kernel key-tile size",
+    "DCT_FLASH_BWD": "flash backward: kernel | remat escape hatch",
+    # --- launcher / orchestration plumbing -------------------------
+    "DCT_TRAIN_HOSTS": "comma-separated trainer hosts the DAG launches onto",
+    "DCT_EXEC_TEMPLATE": "remote-exec template ({host}, {cmd})",
+    "DCT_TRAIN_COMMAND": "override the DAG's per-host training command",
+    "DCT_REPO_ROOT": "repo root for DAG task processes",
+    "DCT_DEPLOY_TARGET": "deploy DAGs: azure | local endpoint surface",
+    "DCT_KEEP_CHECKPOINTS": "pipeline DAG cleanup: newest ckpts to keep",
+    "DCT_ETL_ENGINE": "ETL engine: spark | pandas fallback",
+    "DCT_SPARK_MASTER_HOST": "Spark master hostname for the ETL DAG",
+    "DCT_SOAK_SECONDS": "auto-deploy DAG: canary soak dwell",
+    "DCT_ENDPOINT_NAME": "serve the named LOCAL rollout endpoint",
+    "DCT_LOCAL_ENDPOINT_STATE": "local endpoint traffic-state JSON path",
+    # --- observability ---------------------------------------------
+    "DCT_OBSERVABILITY": "master switch for the operator plane",
+    "DCT_EVENTS_DIR": "structured event log (+ spans, prom dump) dir",
+    "DCT_RUN_ID": "launcher-minted run-correlation ID (exported to ranks)",
+    "DCT_SPAN_ID": "parent span ID exported to child processes",
+    "DCT_HEARTBEAT_DIR": "per-rank heartbeat files",
+    "DCT_HEARTBEAT_INTERVAL": "same-phase heartbeat throttle (s)",
+    "DCT_HEARTBEAT_STALL_SECONDS": "heartbeat age that marks a rank stalled",
+    "DCT_METRICS_PROM": "end-of-run Prometheus textfile dump path",
+    "DCT_SPANS_DIR": "distributed-tracing span files dir",
+    "DCT_SERVE_TRACE": "opt-in per-request serving.score spans",
+    "DCT_SERVE_LOG": "per-request serving access log",
+    "DCT_HALT_ON_NAN": "halt training on non-finite loss",
+    "DCT_HALT_ON_SPIKE": "halt on loss/grad-norm z-score spike",
+    "DCT_SPIKE_ZSCORE": "spike detector z threshold",
+    "DCT_SPIKE_WINDOW": "spike detector rolling window",
+    "DCT_TELEMETRY_FLUSH_S": "event/span write-batch window (0 = through)",
+    "DCT_TELEMETRY_FLUSH_RECORDS": "record cap forcing an early flush",
+    "DCT_PROFILE": "jax.profiler one-epoch trace window",
+    "DCT_TRACE_DIR": "profiler trace output dir",
+    "DCT_PROFILE_EPOCH": "which epoch to trace (0-based)",
+    # --- resilience ------------------------------------------------
+    "DCT_MAX_RESTARTS": "supervised relaunch budget",
+    "DCT_RESTART_BACKOFF_S": "first relaunch backoff",
+    "DCT_RESTART_BACKOFF_FACTOR": "backoff growth per restart",
+    "DCT_RESTART_JITTER": "relative backoff jitter",
+    "DCT_PREEMPT_GRACE_S": "SIGTERM -> SIGKILL escalation window",
+    "DCT_GRACEFUL_PREEMPTION": "SIGTERM: finish step, save, exit 75",
+    "DCT_FAULT_SPEC": "deterministic chaos plan (faults.py grammar)",
+    "DCT_FAULT_SLEEP_S": "slow_save / slow_epoch fault duration",
+    "DCT_RETRY_MAX_ATTEMPTS": "tracking/deploy transient-network retries",
+    "DCT_RETRY_BACKOFF_S": "network retry backoff",
+    "DCT_STARTUP_RECOVERY_DEBT_S": "supervisor-set lost-wall-clock badput",
+    "DCT_LAUNCH_TIMEOUT_S": "supervise CLI: per-attempt launch timeout",
+    # --- evaluation / promotion gates / drift ----------------------
+    "DCT_GATE": "consult the promotion gate between rollout stages",
+    "DCT_GATE_MIN_IMPROVEMENT": "mean loss delta counted as improvement",
+    "DCT_GATE_MAX_REGRESSION": "mean regression tolerated before blocking",
+    "DCT_GATE_CONFIDENCE": "one-sided bootstrap confidence",
+    "DCT_GATE_BOOTSTRAP": "paired-bootstrap resamples",
+    "DCT_GATE_SEED": "bootstrap RNG seed (decisions deterministic)",
+    "DCT_GATE_MAX_SLICE_REGRESSION": "worst tolerated per-slice regression",
+    "DCT_GATE_REQUIRE_IMPROVEMENT": "strict mode: promote only on proof",
+    "DCT_GATE_EVAL_BATCH": "harness examples per forward pass",
+    "DCT_GATE_ENGINE": "eval engine: numpy serving twin | jax",
+    "DCT_GATE_FAIL_OPEN": "missing prerequisites promote (1) or hold (0)",
+    "DCT_GATE_LEDGER": "gate-decision ledger path for /metrics",
+    "DCT_DRIFT_PSI": "per-feature PSI threshold vs stamped snapshot",
+    "DCT_DRIFT_KS": "per-feature two-sample KS D threshold",
+    "DCT_DRIFT_BINS": "quantile bins in the stamped snapshot",
+    "DCT_DRIFT_MAX_DISAGREEMENT": "shadow prediction-disagreement hold rate",
+    "DCT_DRIFT_THRESHOLD": "ETL-side daily-stats drift gate (older knob)",
+    "DCT_MIRROR_CAPTURE": "mirrored shadow-response capture JSONL path",
+    # --- tracking --------------------------------------------------
+    "DCT_EXPERIMENT": "tracking experiment name",
+    "DCT_TRACKING_DIR": "LocalTracking file-store root",
+    # --- batch inference / serving ---------------------------------
+    "DCT_CKPT": "checkpoint to score (default: newest best)",
+    "DCT_PREDICTIONS": "batch-inference output parquet",
+    "DCT_PREDICT_CHUNK": "rows/windows scored per forward pass",
+    "DCT_PREDICT_ENGINE": "predict engine: numpy | jax",
+    "DCT_PREDICT_DTYPE": "jax predict compute dtype (e.g. bfloat16)",
+    "DCT_SERVE_HOST": "HTTP serving bind host",
+    "DCT_SERVE_PORT": "HTTP serving port",
+    # --- platform probing / caches / native ------------------------
+    "DCT_REQUIRE_TPU": "fail fast when no TPU backend is available",
+    "DCT_BACKEND_PROBE_TIMEOUT": "backend liveness probe timeout (s)",
+    "DCT_BACKEND_PROBE_RETRIES": "backend probe retry count",
+    "DCT_BACKEND_PROBE_BUDGET": "total probe wall-clock budget (s)",
+    "DCT_PEAK_TFLOPS": "per-chip peak TFLOPs override for MFU math",
+    "DCT_JAX_CACHE": "enable the persistent XLA compilation cache",
+    "DCT_JAX_CACHE_DIR": "compilation cache directory",
+    "DCT_NATIVE": "enable the native (C++) extension build",
+    "DCT_CXX": "C++ compiler for the native build",
+    # --- bench / campaign scripts ----------------------------------
+    "DCT_BENCH_ROWS": "bench dataset size (rows)",
+    "DCT_BENCH_EPOCHS": "bench trainer-loop epochs",
+    "DCT_BENCH_TORCH_EPOCHS": "bench torch-reference epochs",
+    "DCT_BENCH_FUSE": "bench fused-step legs on/off",
+    "DCT_BENCH_SCALED": "bench scaled-transformer leg on/off",
+    "DCT_BENCH_DEADLINE": "bench wall-clock deadline (s); legs self-gate",
+    "DCT_BENCH_PARTIAL": "path for the partial-results stash",
+    "DCT_VAL_PARITY_EPOCHS": "val-loss parity leg epoch budget",
+    "DCT_SCALED_DMODEL": "scaled bench leg: d_model",
+    "DCT_SCALED_LAYERS": "scaled bench leg: layers",
+    "DCT_SCALED_HEADS": "scaled bench leg: heads",
+    "DCT_SCALED_DFF": "scaled bench leg: d_ff",
+    "DCT_SCALED_SEQ": "scaled bench leg: sequence length",
+    "DCT_SCALED_BATCH": "scaled bench leg: per-device batch",
+    "DCT_SCALED_WINDOW": "scaled bench leg: attention window",
+    "DCT_SCALED_SCAN": "scaled bench leg: scan path on/off",
+    "DCT_ONCHIP_MOE": "on-chip campaign: include the MoE section",
+    "DCT_CAMPAIGN_SECTIONS": "campaign: comma-separated section filter",
+    "DCT_CAMPAIGN_OUT": "campaign: output JSON path",
+    "DCT_CAMPAIGN_MFU": "campaign: MFU gate threshold",
+    "DCT_CAMPAIGN_ALLOW_CPU": "campaign: permit CPU (evidence-only) runs",
+    "DCT_CAMPAIGN_INTERPRET": "campaign: Pallas interpret mode",
+    "DCT_CAMPAIGN_FLASH_SHAPES": "campaign: flash shape sweep spec",
+}

@@ -77,7 +77,10 @@ def prepare_package(
     if not ckpts:
         raise FileNotFoundError(f"No .ckpt in artifact dir {art_dir}")
     model_ckpt = os.path.join(deploy_dir, "model.ckpt")
-    shutil.copy2(ckpts[0], model_ckpt)
+    # The download already staged the bytes under _dl/ on the same
+    # filesystem: publish by atomic rename, so model.ckpt either holds
+    # the complete checkpoint or does not exist — never a torn copy.
+    os.replace(ckpts[0], model_ckpt)
     shutil.rmtree(os.path.join(deploy_dir, "_dl"))
 
     meta = generate_score_package(model_ckpt, deploy_dir)
@@ -91,7 +94,9 @@ def prepare_package(
     # training-data snapshot for the deploy-side drift detectors.
     import json
 
-    with open(os.path.join(deploy_dir, "run_info.json"), "w") as f:
+    info_path = os.path.join(deploy_dir, "run_info.json")
+    info_tmp = f"{info_path}.tmp.{os.getpid()}"
+    with open(info_tmp, "w") as f:
         json.dump(
             {
                 "tracking_run_id": best.run_id,
@@ -114,6 +119,10 @@ def prepare_package(
             f,
             indent=2,
         )
+    # The manifest gates every later stage's eval/drift decisions; a
+    # half-written one must be unobservable (the gate would fail open
+    # on a torn read as "pre-observability package").
+    os.replace(info_tmp, info_path)
     return {
         "run_id": best.run_id,
         "run_correlation_id": best.run_correlation_id,

@@ -80,13 +80,30 @@ def weights_from_checkpoint(ckpt_path: str) -> tuple[dict, dict]:
     return weights, meta
 
 
+def _publish_text(path: str, text: str) -> None:
+    """Atomic text-file publish: tmp sibling + ``os.replace`` (the
+    platform-wide torn-write convention — a serving worker or rollout
+    stage reading the package mid-regeneration must never see a
+    half-written file)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
 def export_npz_weights(ckpt_path: str, deploy_dir: str) -> dict:
     """model.ckpt -> model.npz + model_meta.json in ``deploy_dir``."""
     weights, meta = weights_from_checkpoint(ckpt_path)
     os.makedirs(deploy_dir, exist_ok=True)
-    np.savez(os.path.join(deploy_dir, "model.npz"), **weights)
-    with open(os.path.join(deploy_dir, "model_meta.json"), "w") as f:
-        json.dump(meta, f, indent=2)
+    npz_path = os.path.join(deploy_dir, "model.npz")
+    npz_tmp = f"{npz_path}.tmp.{os.getpid()}"
+    with open(npz_tmp, "wb") as f:
+        np.savez(f, **weights)
+    os.replace(npz_tmp, npz_path)
+    _publish_text(
+        os.path.join(deploy_dir, "model_meta.json"),
+        json.dumps(meta, indent=2),
+    )
     return meta
 
 
@@ -169,8 +186,6 @@ def generate_score_package(ckpt_path: str, deploy_dir: str) -> dict:
     # are untouched); only the template's own {{ }} literals are unescaped.
     score_py = _SCORE_TEMPLATE.format(runtime_source=runtime_source)
 
-    with open(os.path.join(deploy_dir, "score.py"), "w") as f:
-        f.write(score_py)
-    with open(os.path.join(deploy_dir, "conda.yaml"), "w") as f:
-        f.write(_CONDA_YAML)
+    _publish_text(os.path.join(deploy_dir, "score.py"), score_py)
+    _publish_text(os.path.join(deploy_dir, "conda.yaml"), _CONDA_YAML)
     return meta

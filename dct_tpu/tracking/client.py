@@ -56,6 +56,17 @@ class TrackingClient(Protocol):
     def download_artifacts(self, run_id: str, artifact_path: str, dst: str) -> str: ...
 
 
+def _publish_json(path: str, obj: dict) -> None:
+    """Atomic JSON publish (tmp + ``os.replace``): the deploy DAG's
+    ``search_best_run`` reads ``meta.json`` from a different process
+    while the trainer's ``end_run`` rewrites it — a torn read there
+    would silently drop the run from model selection."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2)
+    os.replace(tmp, path)
+
+
 class LocalTracking:
     """File-backed store: <root>/<experiment>/<run_id>/{meta.json,
     metrics.jsonl, artifacts/...}."""
@@ -92,8 +103,7 @@ class LocalTracking:
             "params": params or {},
             "status": "RUNNING",
         }
-        with open(os.path.join(d, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2)
+        _publish_json(os.path.join(d, "meta.json"), meta)
         self._active = True
         log.emit(
             "tracking", "run_start",
@@ -131,7 +141,12 @@ class LocalTracking:
             return
         d = os.path.join(self._run_dir(self._run_id), "artifacts", artifact_path)
         os.makedirs(d, exist_ok=True)
-        shutil.copy2(local_path, d)
+        # Atomic: the deploy DAG downloads from this dir; a checkpoint
+        # must appear complete or not at all.
+        dst = os.path.join(d, os.path.basename(local_path))
+        tmp = f"{dst}.tmp.{os.getpid()}"
+        shutil.copy2(local_path, tmp)
+        os.replace(tmp, dst)
 
     def end_run(self, status: str = "FINISHED") -> None:
         if not self._active:
@@ -142,8 +157,7 @@ class LocalTracking:
             meta = json.load(f)
         meta["status"] = status
         meta["end_time"] = time.time()
-        with open(os.path.join(d, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2)
+        _publish_json(os.path.join(d, "meta.json"), meta)
         self._active = False
         _events.get_default().emit(
             "tracking", "run_end",
@@ -202,7 +216,14 @@ class LocalTracking:
         os.makedirs(dst, exist_ok=True)
         if os.path.isdir(out):
             shutil.rmtree(out)
-        shutil.copytree(src, out)
+        # Stage the tree beside the destination, then rename: a crash
+        # mid-copy leaves only .tmp debris, never a partial artifact
+        # dir that a later prepare_package would mistake for complete.
+        tmp_out = f"{out}.tmp.{os.getpid()}"
+        if os.path.isdir(tmp_out):
+            shutil.rmtree(tmp_out)
+        shutil.copytree(src, tmp_out)
+        os.replace(tmp_out, out)
         return out
 
 

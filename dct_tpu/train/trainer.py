@@ -1056,6 +1056,13 @@ class Trainer:
                     # below; a span of k epochs and a ragged remainder
                     # span are DIFFERENT XLA programs, so the ledger's
                     # compile detection keys on k.
+                    # dct: begin-no-host-sync — the pipelined dispatch
+                    # region: from here until the consume swap, nothing
+                    # may join device results (device_get, float()/int()
+                    # on arrays, .block_until_ready()) or the one-span
+                    # overlap PR 5 bought collapses back to serial. The
+                    # join belongs in _consume_span, one span later.
+                    # Enforced by dct-lint rule `span-sync`.
                     _t_dispatch = ledger.clock()
                     dispatch_span = tracer.start(
                         "trainer.dispatch", component="trainer",
@@ -1119,6 +1126,9 @@ class Trainer:
                         dispatch_span=dispatch_span,
                         epoch_span=epoch_span,
                     )
+                    # dct: end-no-host-sync — the consume below is the
+                    # intended join point (serial mode joins its own
+                    # span; pipelined joins the PREVIOUS one).
                     if pipelined:
                         # Swap FIRST: if consuming the previous span
                         # raises (health halt), the finally sweep still
