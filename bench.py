@@ -594,6 +594,35 @@ def bench_scaled_transformer() -> dict:
     return out
 
 
+def _run_scaled_with_retries(record: dict) -> dict:
+    """ISSUE 7 satellite: the scaled section's compute rides the on-chip
+    relay; r05's leg died on a transient connection refusal and the
+    record silently shipped ``mfu: null``. Transient failures now retry
+    with backoff through the platform's ONE retry policy
+    (``resilience.retry``, DCT_RETRY_* envs), and a relay that stays
+    down stamps ``scaled_mfu_stale`` + the reason — prior rounds' MFU
+    numbers are the operative ones and the record SAYS so instead of
+    silently dropping the leg. Non-transient failures (a real XLA/
+    Mosaic error) degrade to the error marker immediately, unretried."""
+    from dct_tpu.resilience.retry import Retrier, is_transient
+
+    try:
+        return Retrier.from_env()(
+            bench_scaled_transformer, op="bench.scaled_transformer"
+        )
+    except Exception as e:  # noqa: BLE001 — same degrade-to-marker
+        # policy as _optional, plus the staleness attribution
+        msg = f"{type(e).__name__}: {e}"
+        print(
+            f"[bench] scaled_transformer FAILED ({msg})",
+            file=sys.stderr, flush=True,
+        )
+        if is_transient(e):
+            record["scaled_mfu_stale"] = True
+            record["scaled_mfu_stale_reason"] = msg[:160]
+        return {"error": msg[:200]}
+
+
 def bench_scaled_moe() -> dict:
     """Sorted/segment MoE dispatch vs the one-hot einsum engine at a size
     where the [N,E,C] dispatch tensors dominate the einsum path."""
@@ -769,6 +798,121 @@ def bench_serving(tmp: str) -> dict:
             "torch_p50_ms": round(times["torch"], 4),
             "speedup": round(times["torch"] / times["ours"], 2),
         }
+    return out
+
+
+def bench_serving_load(tmp: str) -> dict:
+    """The serving tier under traffic (ISSUE 7): a micro-batched HTTP
+    server over the bench checkpoint, closed-loop load generation at the
+    configured concurrency levels (>= 2), qps + p50/p99 per level, the
+    saturation knee, and two throughput ratios:
+
+    - ``batched_over_single`` — saturated endpoint qps over the
+      concurrency-1 qps, HTTP transport included. Bounded by this
+      host's cores (the loadgen client shares them with the server;
+      ``processes`` reports the SO_REUSEPORT pool size used).
+    - ``score_batched_over_single`` — rows/s of one merged micro-batch
+      flush vs the same requests dispatched one by one through the same
+      scorer: the compute-amortization factor batching buys, transport-
+      independent and host-portable.
+
+    ``parity`` asserts the tentpole's core invariant right in the
+    record: a batched HTTP response is bit-identical to the sequential
+    single-row reference."""
+    import numpy as np
+
+    from dct_tpu.config import ServingConfig
+    from dct_tpu.serving import loadgen
+    from dct_tpu.serving.batching import score_rows_invariant
+    from dct_tpu.serving.runtime import score_payload
+    from dct_tpu.serving.score_gen import weights_from_checkpoint
+    from dct_tpu.serving.server import ServerPool, make_server_from_weights
+
+    ckpts = [
+        f for f in os.listdir(os.path.join(tmp, "bench_models"))
+        if f.endswith(".ckpt")
+    ]
+    weights, meta = weights_from_checkpoint(
+        os.path.join(tmp, "bench_models", sorted(ckpts)[0])
+    )
+    cfg = ServingConfig.from_env()
+    rng = np.random.default_rng(0)
+    row = rng.standard_normal((1, int(meta["input_dim"]))).round(4)
+    body = json.dumps({"data": row.tolist()}).encode()
+
+    pool = ServerPool(
+        lambda h, p, reuse_port: make_server_from_weights(
+            weights, meta, host=h, port=p, serving=cfg,
+            reuse_port=reuse_port,
+        ),
+        processes=cfg.processes, host="127.0.0.1",
+    )
+    try:
+        levels = sorted(set(cfg.concurrency_levels()) | {1})
+        sweep = loadgen.sweep_closed_loop(
+            "127.0.0.1", pool.port, body, levels=levels,
+            requests_per_level=cfg.loadgen_requests, duration_s=30.0,
+        )
+        base = next(
+            r for r in sweep["levels"] if r["concurrency"] == 1
+        )
+        out = {"processes": cfg.processes, **sweep}
+        out["baseline_qps"] = base["qps"]
+        out["batched_over_single"] = (
+            round(sweep["saturated_qps"] / base["qps"], 2)
+            if base["qps"] else None
+        )
+        _leg("serving_load_qps", out["saturated_qps"])
+        if cfg.loadgen_qps > 0:
+            out["open_loop"] = loadgen.run_open_loop(
+                "127.0.0.1", pool.port, body, qps=cfg.loadgen_qps,
+                duration_s=cfg.loadgen_duration_s,
+            )
+
+        # Parity, proven against the LIVE server: the batched response's
+        # bits equal the sequential single-row reference while the sweep
+        # traffic above has exercised real merging.
+        client = loadgen._Client("127.0.0.1", pool.port)
+        try:
+            status, resp = client.post(body)
+        finally:
+            client.close()
+        served = np.asarray(
+            json.loads(resp)["probabilities"], np.float32
+        )
+        reference = np.asarray(
+            score_payload(weights, meta, row.tolist())["probabilities"],
+            np.float32,
+        )
+        out["parity"] = bool(
+            status == 200
+            and served.shape == reference.shape
+            and (served == reference).all()
+        )
+    finally:
+        pool.close()
+
+    # Transport-free amortization: one merged flush of 64 single-row
+    # requests vs the same 64 dispatched sequentially.
+    arrays = [
+        rng.standard_normal((1, int(meta["input_dim"])))
+        .astype(np.float32)
+        for _ in range(64)
+    ]
+
+    def _timeit(fn, n=50):
+        fn()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n
+
+    t_batched = _timeit(lambda: score_rows_invariant(weights, meta, arrays))
+    t_single = _timeit(
+        lambda: [score_rows_invariant(weights, meta, [a]) for a in arrays],
+        n=10,
+    )
+    out["score_batched_over_single"] = round(t_single / t_batched, 2)
     return out
 
 
@@ -1040,6 +1184,46 @@ def _stdout_record(record: dict) -> dict:
         vp = dict(vp)
         vp["protocol"] = "BASELINE.md row 1"
         out["val_parity"] = vp
+    tg = out.get("trainer_gap")
+    if isinstance(tg, dict):
+        # fused/fit duplicate the top-level value / trainer_loop keys
+        # byte for byte; stdout keeps the ratio + the mode knob only.
+        out["trainer_gap"] = {
+            k: tg.get(k) for k in ("fused_over_fit", "prefetch_spans")
+        }
+    sl = out.get("serving_load")
+    if isinstance(sl, dict) and isinstance(sl.get("levels"), list):
+        # Columnar digest of the sweep: every measured number still on
+        # stdout at ~half the bytes of the per-level dict list (which
+        # stays verbatim in the partial). Derivables (knee qps = qps at
+        # the knee level, saturated concurrency, a processes=1 default,
+        # all-zero error columns) stay on disk only.
+        sl = dict(sl)
+        lv = [r for r in sl["levels"] if isinstance(r, dict)]
+        sl["levels"] = {
+            "concurrency": [r.get("concurrency") for r in lv],
+            "qps": [r.get("qps") for r in lv],
+            "p50_ms": [r.get("p50_ms") for r in lv],
+            "p99_ms": [r.get("p99_ms") for r in lv],
+        }
+        if any(r.get("errors") for r in lv):  # all-zero = noise
+            sl["levels"]["errors"] = [r.get("errors") for r in lv]
+        sl.pop("knee_qps", None)
+        sl.pop("saturated_concurrency", None)
+        if sl.get("processes") == 1:
+            sl.pop("processes")
+        out["serving_load"] = sl
+    legs = out.get("scaled_legs")
+    if isinstance(legs, dict):
+        # The streamed crash hedges survive when their section FAILED —
+        # exactly the r05 shape (the scaled death left scaled_legs in
+        # the record). The val_parity hedge carries the ~140 B protocol
+        # prose; same pointer treatment as the section stanza.
+        legs = dict(legs)
+        for k in ("val_parity", "val_parity_torch"):
+            if isinstance(legs.get(k), dict) and "protocol" in legs[k]:
+                legs[k] = dict(legs[k], protocol="BASELINE.md row 1")
+        out["scaled_legs"] = legs
 
     def _cfg_digest(cfg: dict) -> str:
         """One short provenance string for a size config dict (the full
@@ -1097,16 +1281,26 @@ def _shrink_to_budget(out: dict) -> dict:
         if isinstance(sec, dict):
             kept = {k: sec[k] for k in fields if k in sec}
             if len(kept) < len(sec):
-                kept["more"] = "BENCH_PARTIAL.json"
+                # ONE top-level pointer for every collapsed stanza: a
+                # per-stanza "more" marker cost 28 B per fired rung —
+                # at the bottom of the ladder that waste alone was
+                # collapsing the next stanza in line.
+                out["more"] = "BENCH_PARTIAL.json"
             out[key] = kept
 
-    # Least headline first; each rung re-checks the budget.
+    # Least headline first; each rung re-checks the budget. Every
+    # top-level stanza the bench can emit has a rung here (the r05
+    # lesson: a stanza the ladder cannot reach — scaled_legs back then —
+    # is a stanza that can push the line past the driver tail).
     ladder = (
         ("host_dataplane", ("rows_speedup", "windows_speedup")),
         ("serving", ()),
         ("probe", ("platform", "attempts", "fallback_reason")),
         ("val_parity", ("protocol", "torch_val_loss", "jax_val_loss",
                         "abs_diff")),
+        ("scaled_legs", ("attn_blockwise_ms", "attn_flash_ms",
+                         "moe_sorted_ms", "moe_einsum_ms",
+                         "serving_load_qps")),
         ("moe", ("config", "sorted_ms", "einsum_ms", "sorted_speedup",
                  "deadline_skipped")),
         ("scaled", ("config", "step_time_ms", "step_time_dispatch_ms",
@@ -1115,6 +1309,19 @@ def _shrink_to_budget(out: dict) -> dict:
                     "deadline_skipped")),
         ("prior_onchip", ("source", "captured_utc", "platform", "value",
                           "vs_baseline", "mfu")),
+        # Late probe squeeze: the fallback-reason prose yields before
+        # the serving levels do (the partial keeps the full reason; a
+        # cpu `platform` on the record already says a fallback
+        # happened).
+        ("probe", ("platform", "attempts")),
+        # The serving tier's headline stanza goes LAST in tier 1: its
+        # per-level qps/p50/p99 columns outlive every other stanza's
+        # detail (the acceptance contract wants >= 2 levels on the
+        # driver record), collapsing to the ratios only when even the
+        # scaled/carry-forward digests were not enough.
+        ("serving_load", ("processes", "baseline_qps", "saturated_qps",
+                          "knee_concurrency", "batched_over_single",
+                          "score_batched_over_single", "parity")),
     )
     for key, fields in ladder:
         if key == "serving":
@@ -1130,14 +1337,44 @@ def _shrink_to_budget(out: dict) -> dict:
         if fits():
             return out
 
+    # Tier 2: a maximally-populated record (every stanza AND the
+    # carry-forward AND failure leftovers at once) can exceed the budget
+    # even with every tier-1 rung fired — r05's lesson generalized. Each
+    # stanza collapses to its headline number(s); the partial keeps all.
+    for key, fields in (
+        ("host_dataplane", ("rows_speedup",)),
+        ("serving", ()),
+        ("scaled_legs", ("attn_blockwise_ms", "attn_flash_ms")),
+        ("serving_load", ("saturated_qps", "batched_over_single",
+                          "score_batched_over_single", "parity")),
+        ("probe", ("platform",)),
+        ("val_parity", ("abs_diff",)),
+        ("moe", ("sorted_speedup",)),
+        ("trainer_gap", ("fused_over_fit", "prefetch_spans")),
+        ("scaled", ("step_time_ms", "attn_blockwise_ms",
+                    "attn_flash_ms", "mfu")),
+        ("prior_onchip", ("source", "captured_utc", "value", "mfu")),
+    ):
+        if key == "serving":
+            if isinstance(out.get("serving"), dict):
+                out["serving"] = {"more": "BENCH_PARTIAL.json"}
+        else:
+            _keep(key, fields)
+        if fits():
+            return out
+
     # Last rung: no stanza may carry a multi-KB string — error text from
     # XLA/Mosaic (attn_*_error, a section-level {"error": ...}) can run
     # to kilobytes and none of the field-keep rungs above touch string
     # values. Progressively harder truncation until the line fits;
-    # stderr and the partial keep the full text.
+    # stderr and the partial keep the full text. Recurses LISTS too —
+    # the r05-class shapes carry dict lists (probe attempts, loadgen
+    # levels, deadline_skipped) a dict-only walk would sail past.
     def _truncate(obj, limit):
         if isinstance(obj, dict):
             return {k: _truncate(v, limit) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [_truncate(v, limit) for v in obj]
         if isinstance(obj, str) and len(obj) > limit:
             return obj[:limit]
         return obj
@@ -1520,8 +1757,8 @@ def main():
             _flush_partial(record)
 
         if not (skip_scaled or _gate("scaled_transformer")):
-            scaled = _optional(
-                "scaled_transformer", bench_scaled_transformer
+            scaled = _section(
+                "scaled_transformer", _run_scaled_with_retries, record
             )
             record["scaled"] = scaled
             if isinstance(scaled, dict) and "error" not in scaled:
@@ -1568,6 +1805,15 @@ def main():
             record["serving"] = _optional("serving", bench_serving, tmp)
             _flush_partial(record)
 
+        # The serving tier under traffic: qps/p50/p99 at >= 2
+        # concurrency levels + the saturation knee (ISSUE 7). Runs on
+        # the host CPU regardless of relay state, like `serving`.
+        if not _gate("serving_load"):
+            record["serving_load"] = _optional(
+                "serving_load", bench_serving_load, tmp
+            )
+            _flush_partial(record)
+
         if not _gate("host_dataplane"):
             dataplane = _optional(
                 "host_dataplane", bench_host_dataplane
@@ -1586,7 +1832,8 @@ def main():
     # "skipped this run" (deadline or DCT_BENCH_SCALED=0), never "not part
     # of this bench" — and the partial file must match the printed record.
     for skippable in (
-        "scaled", "moe", "val_parity", "serving", "host_dataplane"
+        "scaled", "moe", "val_parity", "serving", "serving_load",
+        "host_dataplane",
     ):
         record.setdefault(skippable, None)
     _flush_partial(record)

@@ -636,6 +636,88 @@ class EvaluationConfig:
 
 
 @dataclass
+class ServingConfig:
+    """High-throughput serving tier knobs (dct_tpu.serving;
+    docs/SERVING.md): the dynamic micro-batcher behind both HTTP server
+    modes, the scoring worker pool, and the load-generation bench.
+
+    The batcher merges compatible in-flight requests into one stacked
+    forward — up to ``max_batch`` rows, waiting at most
+    ``batch_window_ms`` past the oldest queued request for co-arrivals.
+    ``batch_window_ms=0`` (default) is purely opportunistic: whatever
+    is queued when a worker frees up merges, and an idle server adds
+    zero latency; raise it to trade p50 for bigger batches under
+    open-loop trickle traffic. Batched scoring is bit-identical to
+    per-request scoring (serving/batching.py module docstring).
+    """
+
+    # Flush cap in ROWS (a request always flushes whole).
+    max_batch: int = 64
+    # Co-arrival deadline window in milliseconds (0 = opportunistic).
+    batch_window_ms: float = 0.0
+    # Scoring worker threads draining the batch queue (numpy releases
+    # the GIL inside stacked GEMMs; 0 = score inline on the handler
+    # thread through the same code path).
+    workers: int = 2
+    # Serving PROCESSES sharing one port via SO_REUSEPORT (ServerPool):
+    # one Python process tops out at its GIL, N processes multiply the
+    # ceiling. 1 = no fork (the safe default inside threaded hosts);
+    # raise it on dedicated serving entry points (jobs/serve.py).
+    processes: int = 1
+    # 'numpy' (default; bit-identity guarantee) | 'jax' (jitted registry
+    # model — the throughput choice for transformer/MoE on accelerator
+    # rigs; matches numpy to ~2e-6, the harness's engine-parity band).
+    engine: str = "numpy"
+    # Zero-copy payload parsing: ndarray straight from the raw JSON
+    # envelope bytes, no intermediate Python lists (runtime.
+    # parse_envelope_array); non-rectangular payloads fall back to
+    # json.loads transparently. Off = always json.loads.
+    fast_parse: bool = True
+    # Load-generation bench (serving/loadgen.py + bench.py serving_load
+    # stanza): open-loop target qps (0 = closed loop), per-level wall
+    # budget, requests per concurrency level, and the sweep's levels.
+    loadgen_qps: float = 0.0
+    loadgen_duration_s: float = 2.0
+    loadgen_requests: int = 300
+    loadgen_concurrency: str = "1,4,16"
+
+    @classmethod
+    def from_env(cls) -> "ServingConfig":
+        c = cls()
+        c.max_batch = _env("DCT_SERVE_MAX_BATCH", c.max_batch, int)
+        c.batch_window_ms = _env(
+            "DCT_SERVE_BATCH_WINDOW_MS", c.batch_window_ms, float
+        )
+        c.workers = _env("DCT_SERVE_WORKERS", c.workers, int)
+        c.processes = _env("DCT_SERVE_PROCS", c.processes, int)
+        c.engine = _env("DCT_SERVE_ENGINE", c.engine, str).strip().lower()
+        c.fast_parse = _env("DCT_SERVE_FAST_PARSE", c.fast_parse, bool)
+        c.loadgen_qps = _env(
+            "DCT_SERVE_LOADGEN_QPS", c.loadgen_qps, float
+        )
+        c.loadgen_duration_s = _env(
+            "DCT_SERVE_LOADGEN_DURATION_S", c.loadgen_duration_s, float
+        )
+        c.loadgen_requests = _env(
+            "DCT_SERVE_LOADGEN_REQUESTS", c.loadgen_requests, int
+        )
+        c.loadgen_concurrency = _env(
+            "DCT_SERVE_LOADGEN_CONCURRENCY", c.loadgen_concurrency, str
+        )
+        return c
+
+    def concurrency_levels(self) -> list[int]:
+        """The loadgen sweep's concurrency levels, parsed and sanitized
+        (bad tokens dropped; at least level 1 always present)."""
+        levels = []
+        for tok in str(self.loadgen_concurrency).split(","):
+            tok = tok.strip()
+            if tok.isdigit() and int(tok) > 0:
+                levels.append(int(tok))
+        return sorted(set(levels)) or [1]
+
+
+@dataclass
 class RunConfig:
     """Top-level bundle passed to the Trainer."""
 
@@ -649,6 +731,7 @@ class RunConfig:
     obs: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     evaluation: EvaluationConfig = field(default_factory=EvaluationConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
 
     @classmethod
     def from_env(cls) -> "RunConfig":
@@ -663,6 +746,7 @@ class RunConfig:
             obs=ObservabilityConfig.from_env(),
             resilience=ResilienceConfig.from_env(),
             evaluation=EvaluationConfig.from_env(),
+            serving=ServingConfig.from_env(),
         )
 
     def to_dict(self) -> dict:
@@ -829,6 +913,16 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_PREDICT_DTYPE": "jax predict compute dtype (e.g. bfloat16)",
     "DCT_SERVE_HOST": "HTTP serving bind host",
     "DCT_SERVE_PORT": "HTTP serving port",
+    "DCT_SERVE_MAX_BATCH": "micro-batcher flush cap in rows",
+    "DCT_SERVE_BATCH_WINDOW_MS": "co-arrival deadline window (0 = opportunistic)",
+    "DCT_SERVE_WORKERS": "scoring worker threads (0 = inline)",
+    "DCT_SERVE_PROCS": "SO_REUSEPORT serving processes (1 = no fork)",
+    "DCT_SERVE_ENGINE": "batched scorer: numpy (bit-identical) | jax (jitted)",
+    "DCT_SERVE_FAST_PARSE": "zero-copy JSON envelope parsing on/off",
+    "DCT_SERVE_LOADGEN_QPS": "loadgen open-loop target qps (0 = closed loop)",
+    "DCT_SERVE_LOADGEN_DURATION_S": "loadgen per-level wall budget (s)",
+    "DCT_SERVE_LOADGEN_REQUESTS": "loadgen requests per concurrency level",
+    "DCT_SERVE_LOADGEN_CONCURRENCY": "loadgen sweep levels (comma-separated)",
     # --- platform probing / caches / native ------------------------
     "DCT_REQUIRE_TPU": "fail fast when no TPU backend is available",
     "DCT_BACKEND_PROBE_TIMEOUT": "backend liveness probe timeout (s)",

@@ -1,0 +1,440 @@
+"""Dynamic micro-batching: decouple request arrival from compute dispatch.
+
+The serving tier's throughput story (ROADMAP item 1): the endpoint's
+HTTP front end handles each connection on its own thread, but scoring
+one request at a time pays the full Python/numpy per-call overhead —
+~a hundred small ops per transformer forward — once PER REQUEST. This
+module batches instead: handler threads validate and enqueue; a small
+pool of scoring workers drains the queue, merging compatible in-flight
+requests (same weights object, same family, same row shape) into ONE
+stacked forward of up to ``DCT_SERVE_MAX_BATCH`` rows, waiting up to
+``DCT_SERVE_BATCH_WINDOW_MS`` from the oldest queued request for
+co-arrivals before flushing. That is the Podracer decoupling applied to
+the scorer: arrival concurrency fills batches, batches amortize
+dispatch, and the compute path stays saturated instead of thrashing
+per-request.
+
+**Bit-identity contract** (the property tests/test_serving_batching.py
+pins): a request's probabilities NEVER depend on what other traffic it
+was batched with. Two mechanisms:
+
+- Row/window-independent families (MLP, GRU, the transformer variants)
+  are scored through :func:`dct_tpu.serving.runtime.forward_numpy` with
+  the ``rows_mm`` matmul hook — every 2D GEMM runs each row as its own
+  ``[1, K]`` product, so row ``i`` of a merged batch is bit-identical
+  to scoring that row alone (plain GEMMs pick different BLAS kernels at
+  different batch sizes; see ``rows_mm``'s docstring).
+- The MoE family's routing capacity is a function of the TOTAL token
+  count, so cross-request merging would change which tokens get
+  dropped. MoE requests are therefore scored as per-request segments
+  inside the flush (bit-identical to the request scored alone); the
+  batch still amortizes queueing and dispatch overhead.
+
+An optional jitted scorer (``DCT_SERVE_ENGINE=jax``) replaces the numpy
+flush with a registry-model ``jax.jit`` forward — the throughput choice
+for the transformer/MoE families on accelerator rigs. It matches the
+numpy twin to ~2e-6 (the evaluation harness's proven engine-parity
+band) but trades the bitwise guarantee; the default engine keeps it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from dct_tpu.serving.runtime import forward_numpy, rows_mm, softmax_numpy
+
+
+class ScoringError(RuntimeError):
+    """A server-fault scoring failure (maps to HTTP 500 — the request
+    already passed validation, so whatever broke is ours)."""
+
+
+def score_rows_invariant(weights: dict, meta: dict,
+                         arrays: list) -> list:
+    """Score several validated requests as one flush; returns one
+    ``[N_i, ...]`` probability array per request, bit-identical to each
+    request scored alone (module docstring). ``arrays`` must share one
+    trailing shape (the batch key guarantees it)."""
+    family = meta.get("model", "weather_mlp")
+    if family == "weather_moe":
+        # Token-count-dependent routing capacity: merging requests would
+        # change drop semantics. Segment per request — same bits as the
+        # request scored alone through score_payload.
+        return [
+            softmax_numpy(forward_numpy(weights, meta, a)) for a in arrays
+        ]
+    stacked = (
+        np.concatenate(arrays, axis=0) if len(arrays) > 1 else arrays[0]
+    )
+    probs = softmax_numpy(forward_numpy(weights, meta, stacked, mm=rows_mm))
+    out = []
+    start = 0
+    for a in arrays:
+        out.append(probs[start:start + len(a)])
+        start += len(a)
+    return out
+
+
+def _build_jax_scorer(weights: dict, meta: dict):
+    """Jitted batched scorer: registry model rebuilt from the package's
+    self-describing meta (the evaluation harness's jax-engine idiom),
+    returning the SERVING contract's probability shape (multi-horizon
+    causal heads keep ``[N, H, C]``). Batches are padded to the next
+    power of two so jit recompiles O(log max_batch) times, not per
+    distinct arrival pattern."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from dct_tpu.config import ModelConfig
+    from dct_tpu.evaluation.harness import _unflatten_weights
+    from dct_tpu.models.registry import get_model, is_causal_model
+
+    family = meta.get("model", "weather_mlp")
+    fields = {f.name for f in dataclasses.fields(ModelConfig)}
+    cfg = ModelConfig(name=family, **{
+        k: v for k, v in meta.items() if k in fields and k != "name"
+    })
+    model = get_model(
+        cfg, input_dim=int(meta["input_dim"]), compute_dtype=jnp.float32
+    )
+    params = _unflatten_weights(weights, family)
+    causal = is_causal_model(family)
+    horizon = int(meta.get("horizon", 1))
+    moe = family == "weather_moe"
+
+    @jax.jit
+    def forward(xb):
+        logits = model.apply({"params": params}, xb, train=False)
+        if causal:
+            # Per-position head: [B, S, C] (horizon 1) or [B, S, H, C];
+            # serving answers for the window's LAST position, keeping
+            # the multi-horizon axis ([B, H, C]) like the numpy twin.
+            logits = logits[:, -1]
+            if horizon > 1 and logits.ndim == 2:
+                logits = logits.reshape(logits.shape[0], horizon, -1)
+        return jax.nn.softmax(logits, axis=-1)
+
+    def score(x: np.ndarray) -> np.ndarray:
+        if moe:
+            # MoE capacity is a function of the TOTAL token count:
+            # padding rows would change which tokens get dropped, so the
+            # request is scored at its true shape (jit recompiles per
+            # distinct request size — the opt-in engine's cost here).
+            return np.asarray(jax.device_get(forward(x)))
+        n = len(x)
+        padded = 1
+        while padded < n:
+            padded *= 2
+        if padded != n:
+            x = np.concatenate([x, np.repeat(x[-1:], padded - n, axis=0)])
+        return np.asarray(jax.device_get(forward(x)))[:n]
+
+    return score
+
+
+class _Request:
+    """One logical request in flight through the batcher."""
+
+    __slots__ = ("x", "slot", "t", "done", "probs", "error")
+
+    def __init__(self, x: np.ndarray, slot: str):
+        self.x = x
+        self.slot = slot
+        self.t = time.monotonic()
+        self.done = threading.Event()
+        self.probs: np.ndarray | None = None
+        self.error: str | None = None
+
+
+class _Group:
+    """Pending requests sharing one batch key (weights/meta/row shape)."""
+
+    __slots__ = ("weights", "meta", "items", "rows")
+
+    def __init__(self, weights: dict, meta: dict):
+        self.weights = weights
+        self.meta = meta
+        self.items: list[_Request] = []
+        self.rows = 0
+
+    @property
+    def t_oldest(self) -> float:
+        return self.items[0].t if self.items else float("inf")
+
+
+class MicroBatcher:
+    """The dynamic micro-batcher behind both HTTP server modes.
+
+    - ``max_batch`` caps a flush in ROWS (a multi-row request always
+      flushes whole; a single request larger than the cap flushes
+      alone).
+    - ``window_ms`` is the co-arrival deadline: a flush waits at most
+      this long past the OLDEST queued request before dispatching. 0
+      (the default) is purely opportunistic — whatever is queued when a
+      worker frees up merges, and an idle server adds zero latency.
+    - ``workers`` scoring threads drain the queue (numpy releases the
+      GIL inside the stacked GEMMs, so workers overlap on real cores).
+      ``workers=0`` scores inline on the caller's thread through the
+      same code path — the hermetic mode tests and the loadgen
+      selftest use.
+
+    Thread-safe; shared by every handler thread of a server. Slot flips
+    stay atomic under concurrency because the batch key includes the
+    identity of the weights dict the package cache resolved — a request
+    routed to the new package can never merge into a flush of the old
+    one.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_batch: int = 64,
+        window_ms: float = 0.0,
+        workers: int = 2,
+        engine: str = "numpy",
+        metrics=None,
+        emit_events: bool | None = None,
+    ):
+        self.max_batch = max(1, int(max_batch))
+        self.window_s = max(0.0, float(window_ms)) / 1e3
+        self.engine = str(engine or "numpy").strip().lower()
+        self.metrics = metrics
+        if emit_events is None:
+            from dct_tpu.config import _env
+
+            # Same opt-in as serving spans: per-flush disk appends have
+            # no place on an un-traced heavy-traffic hot path.
+            emit_events = _env("DCT_SERVE_TRACE", False, bool)
+        self.emit_events = bool(emit_events)
+        self._cond = threading.Condition()
+        self._groups: dict = {}
+        self._order: deque = deque()
+        self._closed = False
+        self._jax_scorers: dict = {}
+        self.flushes = 0  # lifetime flush count (tests/diagnostics)
+        self._threads = [
+            threading.Thread(
+                target=self._worker, name=f"dct-serve-worker-{i}",
+                daemon=True,
+            )
+            for i in range(max(0, int(workers)))
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- request side ---------------------------------------------------
+
+    def score(
+        self, weights: dict, meta: dict, x: np.ndarray,
+        *, slot: str = "default", timeout: float = 30.0,
+    ) -> np.ndarray:
+        """Blocking scoring of one validated request; returns this
+        request's probability array. Raises :class:`ScoringError` for
+        any server-fault (broken weights, non-finite output, timeout)."""
+        if not self._threads:
+            return self._score_one(weights, meta, x)
+        req = _Request(np.ascontiguousarray(x, np.float32), slot)
+        key = (id(weights), meta.get("model", "weather_mlp"), x.shape[1:])
+        with self._cond:
+            if self._closed:
+                raise ScoringError("micro-batcher is closed")
+            g = self._groups.get(key)
+            if g is None:
+                g = self._groups[key] = _Group(weights, meta)
+                self._order.append(key)
+            g.items.append(req)
+            g.rows += len(req.x)
+            self._cond.notify()
+        if not req.done.wait(timeout):
+            raise ScoringError(f"scoring timed out after {timeout:.0f}s")
+        if req.error is not None:
+            raise ScoringError(req.error)
+        return req.probs
+
+    def _score_one(self, weights: dict, meta: dict,
+                   x: np.ndarray) -> np.ndarray:
+        probs = self._dispatch(weights, meta, [x])[0]
+        if not np.isfinite(probs).all():
+            raise ScoringError("non-finite probabilities")
+        return probs
+
+    # -- worker side ----------------------------------------------------
+
+    #: Jitted scorers kept per batcher, at most this many: each entry
+    #: pins device-resident params, so the cache must not accumulate one
+    #: per package ever served (the same reason _PackageCache evicts).
+    _JAX_SCORER_CAP = 8
+
+    def _jax_scorer_for(self, weights: dict, meta: dict):
+        """Scorer cache entries hold a STRONG reference to the weights
+        dict next to the compiled fn: the key is ``id(weights)``, and an
+        id is only unique while the object lives — without the ref, a
+        retired package's freed dict could hand its id to a new
+        package's weights and silently serve the old model. Oldest
+        entries evict past the cap."""
+        key = id(weights)
+        entry = self._jax_scorers.get(key)
+        if entry is None or entry[0] is not weights:
+            entry = (weights, _build_jax_scorer(weights, meta))
+            self._jax_scorers.pop(key, None)
+            self._jax_scorers[key] = entry
+            while len(self._jax_scorers) > self._JAX_SCORER_CAP:
+                self._jax_scorers.pop(next(iter(self._jax_scorers)))
+        return entry[1]
+
+    def _dispatch(self, weights: dict, meta: dict, arrays: list) -> list:
+        if self.engine == "jax":
+            fn = self._jax_scorer_for(weights, meta)
+            if meta.get("model", "weather_mlp") == "weather_moe":
+                # Same segmentation as the numpy path: MoE routing
+                # capacity depends on the total token count, so merging
+                # (or padding) would make a request's drops depend on
+                # co-batched traffic.
+                return [fn(a) for a in arrays]
+            stacked = (
+                np.concatenate(arrays, axis=0)
+                if len(arrays) > 1 else arrays[0]
+            )
+            probs = fn(stacked)
+            out, start = [], 0
+            for a in arrays:
+                out.append(probs[start:start + len(a)])
+                start += len(a)
+            return out
+        return score_rows_invariant(weights, meta, arrays)
+
+    def _claim(self, key, g: _Group) -> tuple:
+        """Pop up to ``max_batch`` rows of ``g`` (≥ 1 request always);
+        caller holds the lock."""
+        take: list[_Request] = []
+        rows = 0
+        while g.items and (
+            not take or rows + len(g.items[0].x) <= self.max_batch
+        ):
+            req = g.items.pop(0)
+            take.append(req)
+            rows += len(req.x)
+        if not g.items:
+            self._groups.pop(key, None)
+            try:
+                self._order.remove(key)
+            except ValueError:
+                pass
+        return g.weights, g.meta, take
+
+    def _worker(self) -> None:
+        while True:
+            batch = None
+            with self._cond:
+                while batch is None:
+                    if self._closed and not self._groups:
+                        return
+                    now = time.monotonic()
+                    next_deadline = None
+                    for key in list(self._order):
+                        g = self._groups.get(key)
+                        if g is None or not g.items:
+                            self._groups.pop(key, None)
+                            try:
+                                self._order.remove(key)
+                            except ValueError:
+                                pass
+                            continue
+                        deadline = g.t_oldest + self.window_s
+                        if (
+                            self._closed
+                            or g.rows >= self.max_batch
+                            or now >= deadline
+                        ):
+                            batch = self._claim(key, g)
+                            break
+                        if next_deadline is None or deadline < next_deadline:
+                            next_deadline = deadline
+                    if batch is None:
+                        if self._closed and not self._groups:
+                            return
+                        if next_deadline is None:
+                            self._cond.wait()
+                        else:
+                            self._cond.wait(max(0.0, next_deadline - now))
+                queue_depth = sum(
+                    grp.rows for grp in self._groups.values()
+                )
+                self.flushes += 1
+            self._flush(batch, queue_depth)
+
+    def _flush(self, batch: tuple, queue_depth: int) -> None:
+        weights, meta, items = batch
+        rows = sum(len(req.x) for req in items)
+        waited_ms = round(
+            (time.monotonic() - min(req.t for req in items)) * 1e3, 3
+        )
+        try:
+            results = self._dispatch(weights, meta, [r.x for r in items])
+            for req, probs in zip(items, results):
+                if np.isfinite(probs).all():
+                    req.probs = probs
+                else:
+                    # A finite validated input producing NaN is a broken
+                    # checkpoint — attributed per request so the 500
+                    # lands on exactly the requests it poisoned.
+                    req.error = "non-finite probabilities"
+        except Exception as e:  # noqa: BLE001 — anything past validation
+            # is a server fault; every co-batched request shares it.
+            msg = f"{type(e).__name__}: {e}"
+            for req in items:
+                req.error = msg
+            if self.emit_events:
+                from dct_tpu.observability import events as _events
+
+                _events.get_default().emit(
+                    "serve", "serve.batch_error",
+                    rows=rows, requests=len(items), error=msg[:300],
+                )
+        finally:
+            for req in items:
+                req.done.set()
+        if self.metrics is not None:
+            try:
+                self.metrics.observe_batch(rows, len(items), queue_depth)
+            except Exception:  # noqa: BLE001 — telemetry never fails a flush
+                pass
+        if self.emit_events:
+            from dct_tpu.observability import events as _events
+
+            _events.get_default().emit(
+                "serve", "serve.batch_flush",
+                rows=rows, requests=len(items), queue_depth=queue_depth,
+                waited_ms=waited_ms,
+            )
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop accepting requests, drain pending flushes, join workers."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout)
+
+
+def batcher_from_env(metrics=None) -> MicroBatcher:
+    """A :class:`MicroBatcher` configured from the ``DCT_SERVE_*`` knobs
+    (``ServingConfig.from_env`` is the registry of record)."""
+    from dct_tpu.config import ServingConfig
+
+    cfg = ServingConfig.from_env()
+    return MicroBatcher(
+        max_batch=cfg.max_batch,
+        window_ms=cfg.batch_window_ms,
+        workers=cfg.workers,
+        engine=cfg.engine,
+        metrics=metrics,
+    )

@@ -32,6 +32,23 @@ def softmax_numpy(logits: np.ndarray) -> np.ndarray:
     return e / e.sum(axis=-1, keepdims=True)
 
 
+def rows_mm(x: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """Row-invariant 2D matmul: ``x[i] @ w`` computed as an independent
+    ``[1, K] @ [K, M]`` product per row.
+
+    A plain ``[N, K] @ [K, M]`` GEMM picks different BLAS kernels (and
+    different FMA groupings) at different ``N``, so row ``i``'s bits can
+    depend on how many OTHER rows share the call — which would make a
+    micro-batched score depend on co-batched traffic. Batched matmul
+    over a size-1 middle axis runs each row as its own ``[1, K]`` GEMM,
+    bit-identical to scoring that row alone, at any stacking. The
+    micro-batcher (serving/batching.py) threads this in via the ``mm``
+    hooks below; the direct :func:`score_payload` path keeps the plain
+    GEMM (``mm=np.matmul`` defaults — bits unchanged for existing
+    consumers)."""
+    return (x[:, None, :] @ w)[:, 0, :]
+
+
 def _sigmoid(x: np.ndarray) -> np.ndarray:
     return 1.0 / (1.0 + np.exp(-x))
 
@@ -61,25 +78,31 @@ def _sincos_positions(seq_len: int, d_model: int) -> np.ndarray:
     return out
 
 
-def mlp_forward_numpy(weights: dict, x: np.ndarray) -> np.ndarray:
+def mlp_forward_numpy(weights: dict, x: np.ndarray,
+                      mm=np.matmul) -> np.ndarray:
     """Forward pass of a sequential dense stack (dropout is inference-off).
 
     weights keys: w0/b0 .. wN/bN, exported from the flax checkpoint by the
-    packager; ReLU between layers, raw logits at the last.
+    packager; ReLU between layers, raw logits at the last. ``mm`` is the
+    2D-matmul hook (:func:`rows_mm` makes the pass row-invariant for the
+    micro-batcher; the default keeps the plain GEMM).
     """
     n_layers = sum(1 for k in weights if k.startswith("w"))
     h = x
     for i in range(n_layers):
-        h = h @ weights[f"w{i}"] + weights[f"b{i}"]
+        h = mm(h, weights[f"w{i}"]) + weights[f"b{i}"]
         if i < n_layers - 1:
             h = np.maximum(h, 0.0)
     return h
 
 
-def gru_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
+def gru_forward_numpy(weights: dict, meta: dict, x: np.ndarray,
+                      mm=np.matmul) -> np.ndarray:
     """Stacked GRU inference; weights carry flax paths
     (``gru_<i>/x_gates/kernel`` etc., gate order r,z,n — torch semantics:
-    reset gate applied to the full hidden pre-activation)."""
+    reset gate applied to the full hidden pre-activation). ``mm`` hooks
+    the 2D matmuls (recurrence + head) — the x-gate product is a 3D
+    stacked matmul and is per-window-invariant already."""
     n_layers = int(meta["n_layers"])
     h_seq = x
     h = None
@@ -95,7 +118,7 @@ def gru_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
         keep_seq = i < n_layers - 1
         outs = []
         for t in range(xg.shape[1]):
-            hg = h @ wh + bh
+            hg = mm(h, wh) + bh
             xr, xz, xn = np.split(xg[:, t], 3, axis=-1)
             hr, hz, hn = np.split(hg, 3, axis=-1)
             r = _sigmoid(xr + hr)
@@ -106,7 +129,7 @@ def gru_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
                 outs.append(h)
         if keep_seq:
             h_seq = np.stack(outs, axis=1)
-    return h @ weights["head/kernel"] + weights["head/bias"]
+    return mm(h, weights["head/kernel"]) + weights["head/bias"]
 
 
 @functools.lru_cache(maxsize=8)
@@ -197,10 +220,11 @@ def _pre_ln_block(w: dict, pre: str, h: np.ndarray, n_heads: int, ffn,
 
 
 def _head_numpy(weights: dict, h: np.ndarray,
-                per_position: bool, horizon: int = 1) -> np.ndarray:
+                per_position: bool, horizon: int = 1,
+                mm=np.matmul) -> np.ndarray:
     h = _layernorm(h, weights["ln_out/scale"], weights["ln_out/bias"])
     pooled = h[:, -1, :] if per_position else h.mean(axis=1)
-    out = pooled @ weights["head/kernel"] + weights["head/bias"]
+    out = mm(pooled, weights["head/kernel"]) + weights["head/bias"]
     if per_position and horizon > 1:
         # Multi-horizon causal head: [B, H*C] -> [B, H, C] — forecasts
         # for steps t+1..t+H from the window's last position.
@@ -210,7 +234,8 @@ def _head_numpy(weights: dict, h: np.ndarray,
 
 def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn, *,
                    causal: bool = False,
-                   per_position: bool = False) -> np.ndarray:
+                   per_position: bool = False,
+                   mm=np.matmul) -> np.ndarray:
     """Shared pre-LN encoder skeleton (in_proj + positions, per-block
     attention and FFN residuals, final LN + mean-pool + head). ``ffn`` is
     ``(weights, block_prefix, h) -> h_ffn`` — the only point where the
@@ -239,25 +264,29 @@ def _encoder_numpy(weights: dict, meta: dict, x: np.ndarray, ffn, *,
             rope,
         )
     return _head_numpy(
-        weights, h, per_position, horizon=int(meta.get("horizon", 1))
+        weights, h, per_position, horizon=int(meta.get("horizon", 1)),
+        mm=mm,
     )
 
 
 def transformer_forward_numpy(
-    weights: dict, meta: dict, x: np.ndarray, *, causal: bool = False
+    weights: dict, meta: dict, x: np.ndarray, *, causal: bool = False,
+    mm=np.matmul,
 ) -> np.ndarray:
     """Pre-LN encoder inference; weights carry flax paths
     (``block_<i>/attn/qkv_proj/kernel`` etc.). ``causal`` serves the
-    decoder-style causal family (per-position head, last position out)."""
+    decoder-style causal family (per-position head, last position out).
+    Every block matmul is a 3D/4D stacked product (per-window-invariant
+    by construction); ``mm`` hooks the one 2D site, the pooled head."""
 
     return _encoder_numpy(
         weights, meta, x, _dense_ffn_numpy, causal=causal,
-        per_position=causal,
+        per_position=causal, mm=mm,
     )
 
 
 def transformer_pp_forward_numpy(
-    weights: dict, meta: dict, x: np.ndarray
+    weights: dict, meta: dict, x: np.ndarray, mm=np.matmul
 ) -> np.ndarray:
     """Pipeline-parallel transformer inference: the ``pp_stages`` param is
     a stacked tree (leading dim = stage,
@@ -289,7 +318,7 @@ def transformer_pp_forward_numpy(
                 w, f"block_{i}", h, n_heads, _dense_ffn_numpy,
                 n_kv_heads=n_kv, rope=rope,
             )
-    return _head_numpy(weights, h, per_position=False)
+    return _head_numpy(weights, h, per_position=False, mm=mm)
 
 
 def _moe_ffn_numpy(weights: dict, prefix: str, h: np.ndarray,
@@ -345,20 +374,27 @@ def moe_forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
     return _encoder_numpy(weights, meta, x, moe_ffn)
 
 
-def forward_numpy(weights: dict, meta: dict, x: np.ndarray) -> np.ndarray:
-    """Dispatch inference on the checkpoint's model family."""
+def forward_numpy(weights: dict, meta: dict, x: np.ndarray,
+                  mm=np.matmul) -> np.ndarray:
+    """Dispatch inference on the checkpoint's model family.
+
+    ``mm`` is the 2D-matmul hook (:func:`rows_mm` = row-invariant bits
+    for the micro-batcher). The MoE family ignores it: its routing
+    capacity depends on the total token count, so batch-invariance there
+    is the batcher's job (it scores MoE requests as separate segments,
+    serving/batching.py)."""
     family = meta.get("model", "weather_mlp")
     if family == "weather_gru":
-        return gru_forward_numpy(weights, meta, x)
+        return gru_forward_numpy(weights, meta, x, mm=mm)
     if family == "weather_transformer":
-        return transformer_forward_numpy(weights, meta, x)
+        return transformer_forward_numpy(weights, meta, x, mm=mm)
     if family == "weather_transformer_causal":
-        return transformer_forward_numpy(weights, meta, x, causal=True)
+        return transformer_forward_numpy(weights, meta, x, causal=True, mm=mm)
     if family == "weather_transformer_pp":
-        return transformer_pp_forward_numpy(weights, meta, x)
+        return transformer_pp_forward_numpy(weights, meta, x, mm=mm)
     if family == "weather_moe":
         return moe_forward_numpy(weights, meta, x)
-    return mlp_forward_numpy(weights, x)
+    return mlp_forward_numpy(weights, x, mm=mm)
 
 
 _SEQUENCE_FAMILIES = (
@@ -407,6 +443,119 @@ def validate_payload(meta: dict, data) -> np.ndarray:
             "features must be finite after float32 conversion"
         )
     return x
+
+
+#: Exact JSON number grammar (one token, then comma-separated): the
+#: fast path accepts PRECISELY what json.loads would, so it can never
+#: answer 200 to a payload the contract path would 400 (no leading
+#: zeros/plus signs, no bare trailing dots, no NaN/Infinity literals).
+_JSON_NUM = rb"-?(?:0|[1-9][0-9]*)(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?"
+_NUM_LIST_RE = None  # compiled lazily (module import stays regex-free)
+
+#: Whitespace BETWEEN two number-grammar bytes means the global strip
+#: below would splice tokens together ("[1 2]" -> "12" — invalid JSON
+#: scored as the wrong number). Whitespace next to punctuation
+#: (pretty-printed arrays) never matches.
+_WS_SPLICE_RE = None
+
+
+def parse_envelope_array(body: bytes) -> np.ndarray | None:
+    """Zero-copy(-ish) fast path for the ``{"data": [...]}`` envelope:
+    raw request bytes -> float32 ndarray without materializing the
+    nested Python lists (and millions of boxed floats) ``json.loads``
+    would build. The numeric text is parsed C-side in one pass
+    (``np.fromstring`` text mode) after the bracket structure is
+    verified rectangular and every token is matched against the exact
+    JSON number grammar (one C-side regex pass — a malformed token like
+    ``4.5.6`` must fall back, not half-parse).
+
+    Returns ``None`` for anything that is not a strictly rectangular
+    JSON-numeric envelope (ragged rows, strings, objects, nesting
+    deeper than 3, extra top-level keys, non-JSON numerics) — the
+    caller then falls back to the ``json.loads`` path, whose error
+    reporting stays the contract. Overflow to ``inf`` is still rejected
+    downstream by :func:`validate_payload`."""
+    import re
+
+    global _NUM_LIST_RE, _WS_SPLICE_RE
+    if _NUM_LIST_RE is None:
+        _NUM_LIST_RE = re.compile(
+            _JSON_NUM + rb"(?:," + _JSON_NUM + rb")*"
+        )
+        _WS_SPLICE_RE = re.compile(rb"[0-9.eE+-][ \t\r\n]+[0-9.eE+-]")
+    if _WS_SPLICE_RE.search(body):
+        return None
+    s = body.translate(None, b" \t\r\n")
+    if not (s.startswith(b'{"data":[') and s.endswith(b']}')):
+        return None
+    arr = s[8:-1]
+    depth = 0
+    for c in arr:
+        if c != 0x5B:  # ord('[')
+            break
+        depth += 1
+    if not 1 <= depth <= 3 or arr.count(b"[") != arr.count(b"]"):
+        return None
+    flat_txt = arr.translate(None, b"[]")
+    # Every token must be an exact JSON number (comma-separated): this
+    # one pass rejects strings/objects/true/null AND malformed numerics
+    # np.fromstring would silently half-parse ("4.5.6" -> 4.5).
+    if not flat_txt or _NUM_LIST_RE.fullmatch(flat_txt) is None:
+        return None
+
+    # Rectangularity: every row at every level must agree in length —
+    # the flat parse below cannot see brackets, so shape is proven here
+    # (splitting on the row separators costs O(rows) small bytes
+    # objects, never a Python float).
+    if not (arr.startswith(b"[" * depth) and arr.endswith(b"]" * depth)):
+        return None
+    if depth == 1:
+        if arr.count(b"[") != 1:  # e.g. [3,[1,2]] — not a flat vector
+            return None
+        shape: tuple = (flat_txt.count(b",") + 1,)
+    elif depth == 2:
+        rows = arr[2:-2].split(b"],[")
+        width = rows[0].count(b",") + 1
+        if any(
+            b"[" in r or b"]" in r or not r or r.count(b",") + 1 != width
+            for r in rows
+        ):
+            return None
+        shape = (len(rows), width)
+    else:
+        outer = arr[3:-3].split(b"]],[[")
+        seq = feat = None
+        for win in outer:
+            rows = win.split(b"],[")
+            if seq is None:
+                seq = len(rows)
+                feat = rows[0].count(b",") + 1
+            if len(rows) != seq or any(
+                b"[" in r or b"]" in r or not r
+                or r.count(b",") + 1 != feat
+                for r in rows
+            ):
+                return None
+        shape = (len(outer), seq, feat)
+
+    expected = 1
+    for d in shape:
+        expected *= d
+    parser = getattr(np, "fromstring", None)
+    if parser is None:  # a future numpy without text-mode fromstring:
+        return None  # the json.loads path is always correct, just slower
+    try:
+        with np.errstate(over="ignore", invalid="ignore"):
+            flat = parser(
+                flat_txt.decode("ascii"), dtype=np.float32, sep=","
+            )
+    except (ValueError, DeprecationWarning, UnicodeDecodeError):
+        return None
+    if flat.size != expected:
+        # A token fromstring could not parse truncates the output — the
+        # count check catches it and the json path reports it properly.
+        return None
+    return flat.reshape(shape)
 
 
 def score_payload(weights: dict, meta: dict, data) -> dict:

@@ -8,6 +8,13 @@ Env contract (matching the other job CLIs):
   DCT_SERVE_HOST  — bind host (default 0.0.0.0)
   DCT_SERVE_PORT  — bind port (default 8901)
 
+Throughput knobs (docs/SERVING.md; ServingConfig in dct_tpu/config.py):
+  DCT_SERVE_PROCS           — SO_REUSEPORT serving processes (>1 forks
+                              a ServerPool; this CLI forks EARLY, before
+                              any threads, so it is the safe place)
+  DCT_SERVE_WORKERS / DCT_SERVE_MAX_BATCH / DCT_SERVE_BATCH_WINDOW_MS
+                            — per-process micro-batcher shape
+
 Endpoint mode — serve the LOCAL rollout endpoint instead of a raw
 checkpoint (traffic-weighted blue/green routing + mirror shadowing over
 the deploy DAG's persisted state):
@@ -28,15 +35,62 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 
+def _serve_pool(build_server, what: str, serving, host: str,
+                port: int) -> int:
+    """Run a multi-process ServerPool until SIGTERM/SIGINT (clean exit
+    0) or until a child dies on its own (exit 1 — a pool whose workers
+    are gone must not sit behind a healthy-looking banner)."""
+    import signal
+
+    from dct_tpu.serving.server import ServerPool
+
+    pool = ServerPool(
+        build_server, processes=serving.processes, host=host, port=port
+    )
+
+    def _term(signum, frame):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+    print(
+        f"serving {what} with {serving.processes} processes on "
+        f"http://{host}:{pool.port} (POST /score, GET /healthz)",
+        flush=True,
+    )
+    try:
+        rc = pool.wait()
+        if rc:
+            print(
+                "serving pool: a worker process died — shutting down",
+                file=sys.stderr, flush=True,
+            )
+        return rc
+    finally:
+        pool.close()
+
+
 def main() -> int:
+    from dct_tpu.config import ServingConfig
+
     host = os.environ.get("DCT_SERVE_HOST", "0.0.0.0")
     port = int(os.environ.get("DCT_SERVE_PORT", "8901"))
+    serving = ServingConfig.from_env()
 
     endpoint = os.environ.get("DCT_ENDPOINT_NAME")
     if endpoint:
         from dct_tpu.serving.server import make_endpoint_server
 
-        server = make_endpoint_server(endpoint, host=host, port=port)
+        if serving.processes > 1:
+            return _serve_pool(
+                lambda h, p, reuse_port: make_endpoint_server(
+                    endpoint, host=h, port=p, serving=serving,
+                    reuse_port=reuse_port,
+                ),
+                f"rollout endpoint {endpoint!r}", serving, host, port,
+            )
+        server = make_endpoint_server(
+            endpoint, host=host, port=port, serving=serving
+        )
         print(
             f"serving rollout endpoint {endpoint!r} (state: "
             f"{server.state_path}) on http://{host}:{port} "
@@ -51,6 +105,16 @@ def main() -> int:
 
     models_dir = os.environ.get("DCT_MODELS_DIR", "data/models")
     ckpt = _find_checkpoint(models_dir)
+    if serving.processes > 1:
+        from dct_tpu.serving.server import make_server
+
+        return _serve_pool(
+            lambda h, p, reuse_port: make_server(
+                ckpt, host=h, port=p, serving=serving,
+                reuse_port=reuse_port,
+            ),
+            ckpt, serving, host, port,
+        )
     serve_forever(ckpt, host=host, port=port)
     return 0
 

@@ -56,12 +56,26 @@ def test_bench_main_cpu_record_carries_everything(
     assert record["value"] > 0
     assert record["probe"]["platform"] == "cpu"
     assert "generated_utc" in record
-    # Dispatch-gap tracker: fused vs fit ratio rides every record.
+    # Dispatch-gap tracker: the ratio rides every record. fused/fit
+    # duplicate the top-level value / trainer_loop keys byte for byte,
+    # so stdout carries the ratio + mode knob only (the partial keeps
+    # the full stanza — asserted below).
     gap = record["trainer_gap"]
-    assert gap["fused"] == record["value"]
-    assert gap["fit"] > 0
     assert gap["fused_over_fit"] > 0
     assert gap["prefetch_spans"] == 1
+    assert "fused" not in gap
+    # Serving under traffic (ISSUE 7): qps + tails at >= 2 concurrency
+    # levels as the columnar stdout digest, knee + both throughput
+    # ratios, and the live bit-identity parity check.
+    sl = record["serving_load"]
+    assert len(sl["levels"]["concurrency"]) >= 2
+    assert all(q > 0 for q in sl["levels"]["qps"])
+    assert all(p > 0 for p in sl["levels"]["p99_ms"])
+    assert sl["knee_concurrency"] in sl["levels"]["concurrency"]
+    assert sl["saturated_qps"] > 0 and sl["baseline_qps"] > 0
+    assert sl["batched_over_single"] > 0
+    assert sl["score_batched_over_single"] > 1
+    assert sl["parity"] is True
     # Carry-forward ON STDOUT is a compact digest (headline numbers +
     # provenance); the verbatim record lives in the partial on disk.
     po = record["prior_onchip"]
@@ -81,6 +95,9 @@ def test_bench_main_cpu_record_carries_everything(
     # carry-forward's full provenance), matching stdout's digest.
     with open(tmp_path / "BENCH_PARTIAL.json") as f:
         partial = json.load(f)
+    assert partial["trainer_gap"]["fused"] == partial["value"]
+    assert partial["trainer_gap"]["fit"] > 0
+    assert isinstance(partial["serving_load"]["levels"], list)
     assert partial["prior_onchip"]["record"] == onchip
     assert partial["prior_onchip"]["campaign"]["tpu_item_count"] == 1
     assert "train_lightning_ddp" in partial["val_parity"]["protocol"]

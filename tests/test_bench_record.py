@@ -200,6 +200,50 @@ def _worst_case_record() -> dict:
             "batch64": {"numpy_p50_ms": 0.0671, "torch_p50_ms": 0.1388,
                         "speedup": 2.07},
         },
+        "serving_load": {
+            "processes": 1,
+            "levels": [
+                {"mode": "closed", "concurrency": c, "requests": 300,
+                 "errors": 0, "duration_s": 0.4, "qps": q,
+                 "p50_ms": p50, "p99_ms": p99}
+                for c, q, p50, p99 in (
+                    (1, 2186.7, 0.3982, 0.9883),
+                    (4, 2493.1, 1.4849, 3.7727),
+                    (16, 1477.6, 4.5024, 11.4212),
+                )
+            ],
+            "knee_concurrency": 4, "knee_qps": 2493.1,
+            "saturated_qps": 2493.1, "saturated_concurrency": 4,
+            "baseline_qps": 2186.7, "batched_over_single": 1.14,
+            "parity": True, "score_batched_over_single": 15.96,
+        },
+        # The streamed crash hedges a failed section leaves behind (the
+        # r05 shape: the scaled death kept scaled_legs in the record),
+        # val_parity hedge with its full protocol prose included.
+        "scaled_legs": {
+            "attn_blockwise_ms": 16.76, "attn_flash_ms": 15.31,
+            "attn_causal_flash_ms": 9.97,
+            "attn_gqa": {"kv_heads": 2, "mha_ms": 4.021, "gqa_ms": 3.312,
+                         "speedup": 1.21},
+            "moe_sorted_ms": 21.4, "moe_einsum_ms": 44.1,
+            "val_parity_torch": {"torch_val_loss": 0.30294,
+                                 "torch_val_acc": 0.86643},
+            "val_parity": {
+                "protocol": (
+                    "10 epochs, batch 4, Adam lr 0.01, seeded 80/20 "
+                    "split, seed 42 "
+                    "(train_lightning_ddp.py:14,88,117,122,132)"
+                ),
+                "torch_val_loss": 0.30294, "jax_val_loss": 0.31351,
+                "abs_diff": 0.01057,
+            },
+        },
+        "scaled_mfu_stale": True,
+        "scaled_mfu_stale_reason": (
+            "JaxRuntimeError: UNAVAILABLE: http://127.0.0.1:8103/"
+            "remote_compile: transport: Connection Failed: Connect "
+            "error: Connection refused (os error 111)"
+        ),
         "host_dataplane": {
             "rows_native_ms": 0.23, "rows_numpy_ms": 0.51,
             "rows_speedup": 2.18, "windows_native_ms": 1.43,
@@ -242,16 +286,23 @@ def test_stdout_record_worst_case_fits_driver_tail(bench_mod):
 
 
 def test_stdout_record_typical_round_is_not_collapsed(bench_mod):
-    """A realistic single-platform record (r05 shape, no variant-leg
-    pileup) must fit WITHOUT the shrink ladder firing: the full scaled
-    section and serving p50s ride stdout untouched."""
+    """A realistic single-platform record (no carry-forward pileup, no
+    failure leftovers) must keep every HEADLINE stanza un-collapsed:
+    the full scaled section, moe timings, val_parity, and the
+    serving_load columnar digest all ride stdout. Only the two
+    least-headline rungs (host_dataplane detail, serving p50 detail)
+    may yield — their speedup headlines survive."""
     record = _worst_case_record()
     # A normal round (r05 shape): no carry-forward pileup, no chunked
-    # leg, and the scaled section without the full variant-leg sweep.
+    # leg, no failed-section leftovers, and the scaled section without
+    # the full variant-leg sweep.
     del record["prior_onchip"]
     del record["trainer_loop_chunked_note"]
     del record["trainer_loop_chunked_samples_per_sec_per_chip"]
     del record["deadline_skipped"]
+    del record["scaled_legs"]
+    del record["scaled_mfu_stale"]
+    del record["scaled_mfu_stale_reason"]
     for leg in ("attn_causal_flash_ms", "attn_causal_blockwise_ms",
                 "attn_window_flash_ms", "attn_window_blockwise_ms",
                 "attn_gqa", "attn_window", "deadline_skipped"):
@@ -259,9 +310,22 @@ def test_stdout_record_typical_round_is_not_collapsed(bench_mod):
     out = bench_mod._stdout_record(record)
     line = json.dumps(out, default=bench_mod._json_default)
     assert len(line.encode()) <= bench_mod._STDOUT_BUDGET
-    assert out["serving"] == record["serving"]  # ladder did not fire
+    # Headline stanzas un-collapsed...
     assert out["scaled"]["step_time_dispatch_ms"] == 45.98
     assert out["moe"]["einsum_ms"] == 44.1
+    assert out["val_parity"]["jax_val_acc"] == 0.86292
+    # ...serving keeps (at least) its speedup headlines...
+    assert out["serving"]["single_row"] in (
+        1.97, record["serving"]["single_row"]
+    )
+    # ...and serving_load rides stdout as the columnar digest with
+    # every level's numbers intact (the per-level dict list stays in
+    # the partial).
+    sl = out["serving_load"]
+    assert sl["levels"]["qps"] == [2186.7, 2493.1, 1477.6]
+    assert sl["levels"]["p99_ms"] == [0.9883, 3.7727, 11.4212]
+    assert sl["batched_over_single"] == 1.14
+    assert sl["score_batched_over_single"] == 15.96
 
 
 def test_stdout_record_bounds_error_strings(bench_mod):
@@ -290,6 +354,221 @@ def test_stdout_record_passthrough_without_carry_forward(bench_mod):
     """A record with no prior_onchip/val_parity must print unchanged."""
     rec = {"metric": "m", "value": 1.0, "scaled": None}
     assert bench_mod._stdout_record(rec) == rec
+
+
+def _r05_record() -> dict:
+    """The ACTUAL record shape that shipped 2,578 B and ``parsed: null``
+    in round 5 (BENCH_r05.json): a CPU driver run whose prior_onchip
+    stanza embedded the full verbatim TPU record — including the
+    multi-hundred-byte connection-refused scaled error — next to every
+    CPU section. Reconstructed field-for-field from the captured tail."""
+    xla_err = (
+        "JaxRuntimeError: UNAVAILABLE: http://127.0.0.1:8103/"
+        "remote_compile: transport: http://127.0.0.1:8103/"
+        "remote_compile: Connection Failed: Connect error: "
+        "Connection refused (os error 111)"
+    )
+    inner_tpu = {
+        "metric": "weather_parity_train_samples_per_sec_per_chip",
+        "unit": "samples/sec/chip", "mfu": None,
+        "probe": {"requested": "axon", "platform": "tpu", "attempts": 1,
+                  "elapsed_s": 2.6, "budget_s": 750.0,
+                  "fallback_reason": None},
+        "baseline_torch_cpu_samples_per_sec": 5278.9,
+        "value": 8342288.3, "vs_baseline": 1580.31,
+        "final_train_loss": 0.0037, "platform": "tpu",
+        "trainer_loop_samples_per_sec_per_chip": 198817.8,
+        "trainer_loop_vs_baseline": 37.66,
+        "scaled": {"error": xla_err},
+        "moe": None, "serving": None, "host_dataplane": None,
+    }
+    return {
+        "metric": "weather_parity_train_samples_per_sec_per_chip",
+        "unit": "samples/sec/chip", "mfu": None,
+        "generated_utc": "2026-08-01T09:00:00Z",
+        "probe": {"requested": "axon", "platform": "cpu", "attempts": 5,
+                  "elapsed_s": 750.0, "budget_s": 750.0,
+                  "fallback_reason": (
+                      "backend 'axon' failed to initialize: 5 probe "
+                      "attempt(s) over 750s (budget 750s, per-attempt "
+                      "cap 150s)"
+                  )},
+        "prior_onchip": {
+            "source": "BENCH_PARTIAL.json (pre-run stash)",
+            "captured_utc": "2026-07-31T04:47:00Z",
+            "record": inner_tpu,
+        },
+        "baseline_torch_cpu_samples_per_sec": 5609.3,
+        "value": 239743.4, "vs_baseline": 42.74,
+        "final_train_loss": 0.0023, "platform": "cpu",
+        "trainer_loop_samples_per_sec_per_chip": 211724.6,
+        "trainer_loop_vs_baseline": 37.75,
+        "scaled": {
+            "config": {"d_model": 128, "n_heads": 8, "n_layers": 2,
+                       "d_ff": 256, "seq_len": 256, "batch": 4,
+                       "dtype": "bfloat16", "scan_len": 2,
+                       "remat": False},
+            "step_time_ms": 162.76, "step_time_dispatch_ms": 194.98,
+            "flops_per_step": 2421424128.0, "tflops_per_sec": 0.01,
+            "attn_blockwise_ms": 162.76, "attn_flash_ms": None,
+            "samples_per_sec_per_chip": 24.6,
+        },
+        "moe": {"config": {"d_model": 64, "n_heads": 4, "n_layers": 1,
+                           "d_ff": 128, "seq_len": 64, "n_experts": 4,
+                           "batch": 4, "dtype": "bfloat16"},
+                "sorted_ms": 5.47, "einsum_ms": 5.88,
+                "sorted_speedup": 1.07},
+        "val_parity": {
+            "protocol": (
+                "10 epochs, batch 4, Adam lr 0.01, seeded 80/20 split, "
+                "seed 42 (train_lightning_ddp.py:14,88,117,122,132)"
+            ),
+            "torch_val_loss": 0.30294, "torch_val_acc": 0.85675,
+            "jax_val_loss": 0.31351, "jax_val_acc": 0.85425,
+            "abs_diff": 0.01057,
+        },
+        "serving": {
+            "single_row": {"numpy_p50_ms": 0.0161, "torch_p50_ms": 0.0297,
+                           "speedup": 1.85},
+            "batch64": {"numpy_p50_ms": 0.0469, "torch_p50_ms": 0.0652,
+                        "speedup": 1.39},
+        },
+        "host_dataplane": {
+            "rows_native_ms": 0.458, "rows_numpy_ms": 0.999,
+            "rows_speedup": 2.18, "windows_native_ms": 1.148,
+            "windows_numpy_ms": 8.848, "windows_speedup": 7.71,
+        },
+    }
+
+
+def test_stdout_record_r05_regression(bench_mod):
+    """ISSUE 7 satellite: the round-5 record that actually shipped
+    2,578 B and landed ``parsed: null`` must print inside the cap —
+    the shrink ladder enforced on the REAL record shape, not just the
+    synthetic fixture."""
+    record = _r05_record()
+    raw = len(json.dumps(record, default=bench_mod._json_default).encode())
+    assert raw > 2000, raw  # the shape genuinely overflows un-shrunk
+    line = json.dumps(
+        bench_mod._stdout_record(record), default=bench_mod._json_default
+    )
+    assert len(line.encode()) <= 1800, len(line.encode())
+    out = json.loads(line)
+    # The carried TPU evidence survives as the digest...
+    assert out["prior_onchip"]["value"] == 8342288.3
+    assert out["prior_onchip"]["platform"] == "tpu"
+    # ...and the verbatim inner record (with its XLA error) does not.
+    assert "record" not in out["prior_onchip"]
+    # This run's own headline numbers are intact.
+    assert out["value"] == 239743.4
+    assert out["trainer_loop_samples_per_sec_per_chip"] == 211724.6
+    assert out["val_parity"]["abs_diff"] == 0.01057
+
+
+def test_stdout_record_failed_scaled_leaves_bounded_legs(bench_mod):
+    """When the scaled section dies, its streamed scaled_legs hedge
+    stays in the record (the r05 on-chip shape) — the ladder must now
+    reach it, and the staleness flag + reason must survive every
+    rung."""
+    record = _worst_case_record()
+    record["scaled"] = {"error": "JaxRuntimeError: UNAVAILABLE: " + "x" * 400}
+    record["mfu"] = None
+    line = json.dumps(
+        bench_mod._stdout_record(record), default=bench_mod._json_default
+    )
+    assert len(line.encode()) <= 1800, len(line.encode())
+    out = json.loads(line)
+    assert out["scaled_mfu_stale"] is True
+    assert "Connection refused" in out["scaled_mfu_stale_reason"]
+    # The legs hedge survives in digest form (headline kernels only).
+    assert out["scaled_legs"]["attn_blockwise_ms"] == 16.76
+
+
+def test_truncate_recurses_into_lists(bench_mod):
+    """Probe attempts / loadgen levels are LISTS of dicts; a huge
+    string inside one must still be bounded by the last rung."""
+    record = _worst_case_record()
+    record["probe"] = {
+        "platform": "cpu",
+        "attempts": [
+            {"n": i, "error": "Connection refused " + "y" * 3000}
+            for i in range(4)
+        ],
+        "fallback_reason": "z" * 3000,
+    }
+    line = json.dumps(
+        bench_mod._stdout_record(record), default=bench_mod._json_default
+    )
+    assert len(line.encode()) <= 1800, len(line.encode())
+
+
+def test_scaled_retry_satellite_transient_retries(bench_mod, monkeypatch):
+    """A transient (relay-class) failure retries through the platform
+    retry policy and succeeds without staleness flags."""
+    monkeypatch.setenv("DCT_RETRY_MAX_ATTEMPTS", "3")
+    monkeypatch.setenv("DCT_RETRY_BACKOFF_S", "0")
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionRefusedError("Connection refused (os error 111)")
+        return {"mfu": 0.21, "step_time_ms": 15.3}
+
+    monkeypatch.setattr(bench_mod, "bench_scaled_transformer", flaky)
+    record = {}
+    out = bench_mod._run_scaled_with_retries(record)
+    assert out == {"mfu": 0.21, "step_time_ms": 15.3}
+    assert len(calls) == 3
+    assert "scaled_mfu_stale" not in record
+
+
+def test_scaled_retry_satellite_relay_down_stamps_stale(
+    bench_mod, monkeypatch
+):
+    """Retries exhausted on a dead relay: the record carries
+    scaled_mfu_stale + the failure reason instead of a silent null
+    (r05's scaled leg shape)."""
+    monkeypatch.setenv("DCT_RETRY_MAX_ATTEMPTS", "2")
+    monkeypatch.setenv("DCT_RETRY_BACKOFF_S", "0")
+    calls = []
+
+    def dead_relay():
+        calls.append(1)
+        raise RuntimeError(
+            "UNAVAILABLE: http://127.0.0.1:8103/remote_compile: "
+            "Connection refused (os error 111)"
+        )
+
+    monkeypatch.setattr(bench_mod, "bench_scaled_transformer", dead_relay)
+    record = {}
+    out = bench_mod._run_scaled_with_retries(record)
+    assert len(calls) == 2  # retried once, then exhausted
+    assert "error" in out and "UNAVAILABLE" in out["error"]
+    assert record["scaled_mfu_stale"] is True
+    assert "Connection refused" in record["scaled_mfu_stale_reason"]
+
+
+def test_scaled_retry_satellite_fatal_does_not_retry(
+    bench_mod, monkeypatch
+):
+    """A real compile error is not transient: no retry, no staleness
+    claim — the number is absent because the code is broken, not
+    because the relay ate it."""
+    monkeypatch.setenv("DCT_RETRY_MAX_ATTEMPTS", "3")
+    monkeypatch.setenv("DCT_RETRY_BACKOFF_S", "0")
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("Mosaic lowering failed: bad block shape")
+
+    monkeypatch.setattr(bench_mod, "bench_scaled_transformer", broken)
+    record = {}
+    out = bench_mod._run_scaled_with_retries(record)
+    assert len(calls) == 1
+    assert "error" in out
+    assert "scaled_mfu_stale" not in record
 
 
 def test_deadline_gate_subtracts_probe_elapsed(bench_mod, monkeypatch):

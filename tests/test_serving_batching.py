@@ -1,0 +1,579 @@
+"""The serving tier's micro-batching subsystem (ISSUE 7 tentpole):
+batched scoring bit-identical to sequential single-row scoring across
+every model family, deadline-window flush under trickle load, the
+max-batch cap, zero-copy payload parsing, the batch/queue histograms on
+/metrics, per-request mirror capture under batching, and the
+SO_REUSEPORT server pool."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dct_tpu.config import ModelConfig, ServingConfig
+from dct_tpu.models.registry import get_model
+from dct_tpu.serving.batching import (
+    MicroBatcher,
+    ScoringError,
+    score_rows_invariant,
+)
+from dct_tpu.serving.runtime import (
+    parse_envelope_array,
+    score_payload,
+    softmax_numpy,
+)
+from dct_tpu.serving.score_gen import _flatten_params
+
+
+def _family_fixture(name, seq_len=8, input_dim=5):
+    """(weights, meta) for any registry family, straight from a flax
+    init — the same export path score_gen uses, no disk."""
+    if name == "weather_mlp":
+        model = get_model(ModelConfig(), input_dim=input_dim)
+        params = model.init(
+            jax.random.PRNGKey(3), jnp.zeros((1, input_dim))
+        )["params"]
+        layers = sorted(params)
+        weights = {}
+        for i, layer in enumerate(layers):
+            weights[f"w{i}"] = np.asarray(
+                params[layer]["kernel"], np.float32
+            )
+            weights[f"b{i}"] = np.asarray(params[layer]["bias"], np.float32)
+        meta = {"model": name, "input_dim": input_dim, "hidden_dim": 64,
+                "num_classes": 2}
+        return weights, meta
+    cfg = ModelConfig(
+        name=name, seq_len=seq_len, d_model=16, n_heads=2, n_layers=2,
+        d_ff=32, horizon=3 if name == "weather_transformer_causal" else 1,
+    )
+    model = get_model(cfg, input_dim=input_dim)
+    variables = model.init(
+        jax.random.PRNGKey(5), jnp.zeros((1, seq_len, input_dim))
+    )
+    weights = _flatten_params(variables["params"])
+    meta = {
+        "model": name, "input_dim": input_dim, "seq_len": seq_len,
+        "d_model": 16, "n_heads": 2, "n_layers": 2, "d_ff": 32,
+        "n_experts": 4, "capacity_factor": 1.25, "n_stages": 2,
+        "num_classes": 2,
+        "horizon": 3 if name == "weather_transformer_causal" else 1,
+    }
+    return weights, meta
+
+
+_FAMILIES = (
+    "weather_mlp", "weather_gru", "weather_transformer",
+    "weather_transformer_causal", "weather_transformer_pp", "weather_moe",
+)
+
+
+@pytest.mark.parametrize("name", _FAMILIES)
+def test_batched_bit_identical_to_single_row(name, rng):
+    """THE tentpole invariant: a merged flush's per-request results are
+    bitwise equal to each request scored alone via score_payload — for
+    every family, at mixed request sizes (MoE via per-request
+    segmentation; everyone else via the row-invariant stacked
+    forward)."""
+    weights, meta = _family_fixture(name)
+    shape = (
+        (meta["seq_len"], meta["input_dim"])
+        if name != "weather_mlp" else (meta["input_dim"],)
+    )
+    # Single-row requests plus one multi-row request in the same flush.
+    sizes = [1, 1, 3, 1, 2]
+    arrays = [
+        rng.standard_normal((n, *shape)).astype(np.float32)
+        for n in sizes
+    ]
+    merged = score_rows_invariant(weights, meta, arrays)
+    for a, got in zip(arrays, merged):
+        alone = np.asarray(
+            score_payload(weights, meta, a.tolist())["probabilities"],
+            np.float32,
+        )
+        if name == "weather_moe":
+            # MoE segments per REQUEST (capacity is token-count
+            # dependent): exact equality against the request scored
+            # alone is the guarantee.
+            assert got.shape == alone.shape and (
+                got.astype(np.float32) == alone
+            ).all()
+        else:
+            # Row families: every row equals the SINGLE-ROW reference
+            # bitwise, regardless of which request carried it.
+            for i in range(len(a)):
+                ref = np.asarray(
+                    score_payload(weights, meta, a[i:i + 1].tolist())
+                    ["probabilities"],
+                    np.float32,
+                )
+                assert (got[i:i + 1].astype(np.float32) == ref).all(), (
+                    name, i
+                )
+
+
+def test_batched_result_independent_of_cobatched_traffic(rng):
+    """The same request must produce the same bits whether it flushes
+    alone or merged with arbitrary other traffic."""
+    weights, meta = _family_fixture("weather_transformer")
+    x = rng.standard_normal((2, 8, 5)).astype(np.float32)
+    alone = score_rows_invariant(weights, meta, [x])[0]
+    for n_other in (1, 5, 17):
+        others = [
+            rng.standard_normal((1, 8, 5)).astype(np.float32)
+            for _ in range(n_other)
+        ]
+        merged = score_rows_invariant(weights, meta, [x, *others])[0]
+        assert (merged == alone).all(), n_other
+
+
+def test_microbatcher_merges_concurrent_requests(rng):
+    """Concurrent submissions inside one window land in one flush, and
+    each caller gets exactly its own rows back."""
+    weights, meta = _family_fixture("weather_mlp")
+    b = MicroBatcher(max_batch=64, window_ms=150.0, workers=1)
+    try:
+        rows = rng.standard_normal((8, 5)).astype(np.float32)
+        out: list = [None] * 8
+
+        def one(i):
+            out[i] = b.score(weights, meta, rows[i:i + 1])
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert b.flushes == 1, b.flushes
+        for i in range(8):
+            assert out[i].shape == (1, 2)
+            expected = np.asarray(
+                score_payload(weights, meta, rows[i:i + 1].tolist())
+                ["probabilities"],
+                np.float32,
+            )
+            assert (out[i].astype(np.float32) == expected).all()
+    finally:
+        b.close()
+
+
+def test_deadline_window_flush_under_trickle(rng):
+    """A lone request (trickle load) must not wait past the window: the
+    flush fires at the deadline with a batch of one."""
+    weights, meta = _family_fixture("weather_mlp")
+    b = MicroBatcher(max_batch=64, window_ms=50.0, workers=1)
+    try:
+        t0 = time.perf_counter()
+        probs = b.score(
+            weights, meta, rng.standard_normal((1, 5)).astype(np.float32)
+        )
+        dt = time.perf_counter() - t0
+        assert probs.shape == (1, 2)
+        assert 0.04 <= dt < 5.0, dt  # waited the window, not forever
+        assert b.flushes == 1
+    finally:
+        b.close()
+
+
+def test_max_batch_caps_flush_rows():
+    """No flush may exceed max_batch rows: submit far more than the cap
+    concurrently and read the batch-rows histogram — every observation
+    must sit in a bucket <= the cap."""
+    from dct_tpu.serving.server import _SlotMetrics
+
+    weights, meta = _family_fixture("weather_mlp")
+    metrics = _SlotMetrics()
+    b = MicroBatcher(
+        max_batch=4, window_ms=100.0, workers=2, metrics=metrics
+    )
+    try:
+        rng = np.random.default_rng(0)
+        rows = rng.standard_normal((24, 5)).astype(np.float32)
+        threads = [
+            threading.Thread(
+                target=b.score, args=(weights, meta, rows[i:i + 1])
+            )
+            for i in range(24)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        hist = metrics._batch_rows
+        assert hist.count >= 6  # 24 rows / cap 4
+        # Cumulative buckets: everything must already be counted at le=4.
+        le4 = hist.counts[hist.buckets.index(4.0)]
+        assert le4 == hist.count, (le4, hist.count)
+    finally:
+        b.close()
+
+
+def test_batcher_propagates_server_faults_per_request():
+    """Broken weights inside a flush surface as ScoringError to every
+    affected caller (the HTTP layer's 500), and the batcher survives."""
+    weights, meta = _family_fixture("weather_mlp")
+    broken = {k: v for k, v in weights.items() if k != "w0"}
+    b = MicroBatcher(max_batch=8, window_ms=0.0, workers=1)
+    try:
+        with pytest.raises(ScoringError):
+            b.score(broken, meta, np.zeros((1, 5), np.float32))
+        # A later good request still works.
+        out = b.score(weights, meta, np.zeros((1, 5), np.float32))
+        assert out.shape == (1, 2)
+    finally:
+        b.close()
+
+
+def test_non_finite_probs_attributed_as_fault():
+    weights, meta = _family_fixture("weather_mlp")
+    poisoned = dict(weights, w0=np.full_like(weights["w0"], np.nan))
+    b = MicroBatcher(workers=0)  # inline path, same code
+    with pytest.raises(ScoringError, match="non-finite"):
+        b.score(poisoned, meta, np.zeros((1, 5), np.float32))
+
+
+def test_jax_engine_matches_numpy_twin(rng):
+    """DCT_SERVE_ENGINE=jax: the jitted batched scorer agrees with the
+    numpy twin inside the harness's proven engine-parity band."""
+    weights, meta = _family_fixture("weather_transformer")
+    x = rng.standard_normal((3, 8, 5)).astype(np.float32)
+    b_np = MicroBatcher(workers=0, engine="numpy")
+    b_jax = MicroBatcher(workers=0, engine="jax")
+    got_np = b_np.score(weights, meta, x)
+    got_jax = b_jax.score(weights, meta, x)
+    assert got_np.shape == got_jax.shape
+    np.testing.assert_allclose(got_np, got_jax, atol=2e-5)
+
+
+def test_jax_engine_moe_segments_per_request(rng):
+    """The jax engine must give the MoE family the SAME co-traffic
+    independence as the numpy path: capacity depends on total token
+    count, so requests are scored segmented and unpadded — a request's
+    probabilities are identical whether it flushes alone or merged."""
+    weights, meta = _family_fixture("weather_moe")
+    b = MicroBatcher(workers=0, engine="jax")
+    x = rng.standard_normal((3, 8, 5)).astype(np.float32)
+    alone = b._dispatch(weights, meta, [x])[0]
+    others = [
+        rng.standard_normal((1, 8, 5)).astype(np.float32)
+        for _ in range(4)
+    ]
+    merged = b._dispatch(weights, meta, [x, *others])[0]
+    assert merged.shape == alone.shape and (merged == alone).all()
+
+
+def test_jax_scorer_cache_bounded_and_pins_weights(rng):
+    """The jitted-scorer cache is keyed by id(weights): entries must
+    hold the weights dict alive (a freed dict's id can be reused by a
+    NEW package -> stale model served) and the cache must not grow one
+    device-resident entry per package ever served."""
+    b = MicroBatcher(workers=0, engine="jax")
+    x = np.zeros((1, 5), np.float32)
+    for seed in range(b._JAX_SCORER_CAP + 4):
+        weights, meta = _family_fixture("weather_mlp")
+        for k in weights:
+            weights[k] = weights[k] + seed * 1e-3
+        b.score(weights, meta, x)
+    assert len(b._jax_scorers) <= b._JAX_SCORER_CAP
+    for key, (w, _fn) in b._jax_scorers.items():
+        assert key == id(w)  # the entry pins exactly its key's object
+
+
+def test_jax_engine_multi_horizon_contract(rng):
+    """The jax engine must keep the causal family's [N, H, C] serving
+    shape (the harness collapses to next-step; serving must not)."""
+    weights, meta = _family_fixture("weather_transformer_causal")
+    x = rng.standard_normal((2, 8, 5)).astype(np.float32)
+    b_jax = MicroBatcher(workers=0, engine="jax")
+    got = b_jax.score(weights, meta, x)
+    assert got.shape == (2, 3, 2)
+    np.testing.assert_allclose(got.sum(axis=-1), 1.0, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# Zero-copy envelope parsing.
+
+def test_fast_parse_matches_json_path(rng):
+    for shape in ((4,), (3, 5), (2, 4, 3)):
+        data = rng.standard_normal(shape).round(6).tolist()
+        body = json.dumps({"data": data}).encode()
+        arr = parse_envelope_array(body)
+        assert arr is not None, shape
+        ref = np.asarray(data, np.float32)
+        assert arr.shape == ref.shape and (arr == ref).all()
+
+
+def test_fast_parse_rejects_irregular_envelopes():
+    cases = [
+        {"data": [[1, 2], [3]]},          # ragged
+        {"data": [1, [2, 3]]},            # mixed depth
+        {"data": [[1, "x"]]},             # string
+        {"data": [[1, None]]},            # null
+        {"data": [[True, False]]},        # booleans
+        {"data": {"a": 1}},               # object
+        {"data": [[1]], "slot": "blue"},  # extra key
+        {"nope": [[1]]},                  # wrong key
+        {"data": []},                     # empty
+        {"data": [[[[1]]]]},              # depth 4
+    ]
+    for payload in cases:
+        assert parse_envelope_array(
+            json.dumps(payload).encode()
+        ) is None, payload
+
+
+def test_fast_parse_rejects_malformed_numerics_exact_json_grammar():
+    """np.fromstring half-parses tokens ("4.5.6" -> 4.5, stop) and the
+    global whitespace strip would splice "1 2" into 12 — both must fall
+    back to the json path (which 400s), never score a number the client
+    did not send. The fast path accepts EXACTLY the JSON number
+    grammar."""
+    bad_bodies = [
+        b'{"data": [[1,2],[3,4.5.6]]}',   # fromstring stops mid-token
+        b'{"data": [[1 2]]}',             # whitespace splice -> 12
+        b'{"data": [[+5, 1]]}',           # leading plus (not JSON)
+        b'{"data": [[1., 2]]}',           # bare trailing dot
+        b'{"data": [[.5, 2]]}',           # bare leading dot
+        b'{"data": [[01, 2]]}',           # leading zero
+        b'{"data": [[1e, 2]]}',           # dangling exponent
+        b'{"data": [[- 5, 2]]}',          # split sign
+        b'{"data": [[NaN, 1]]}',          # non-JSON literal
+    ]
+    for body in bad_bodies:
+        assert parse_envelope_array(body) is None, body
+    # ...while every JSON-legal spelling still takes the fast path.
+    good = b'{"data": [[-1.5, 0, 2e3, 6.25e-2, 1E+2]]}'
+    arr = parse_envelope_array(good)
+    ref = np.asarray(json.loads(good)["data"], np.float32)
+    assert arr is not None and (arr == ref).all()
+
+
+def test_fast_parse_overflow_still_400s_via_validate():
+    from dct_tpu.serving.runtime import validate_payload
+
+    arr = parse_envelope_array(b'{"data": [[1e39, 0, 0, 0, 0]]}')
+    assert arr is not None and np.isinf(arr).any()
+    with pytest.raises(ValueError, match="finite"):
+        validate_payload({"input_dim": 5}, arr)
+
+
+# ----------------------------------------------------------------------
+# HTTP integration: batched server end-to-end.
+
+def _start(server):
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url + "/score", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_batched_server_responses_match_reference(rng):
+    from dct_tpu.serving.server import make_server_from_weights
+
+    weights, meta = _family_fixture("weather_mlp")
+    server = make_server_from_weights(
+        weights, meta,
+        serving=ServingConfig(max_batch=16, batch_window_ms=5.0, workers=2),
+    )
+    url = _start(server)
+    try:
+        rows = rng.standard_normal((12, 5)).astype(np.float32)
+        got: list = [None] * len(rows)
+
+        def one(i):
+            got[i] = _post(url, {"data": [rows[i].tolist()]})
+
+        threads = [
+            threading.Thread(target=one, args=(i,))
+            for i in range(len(rows))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        for i in range(len(rows)):
+            expected = score_payload(
+                weights, meta, [rows[i].tolist()]
+            )["probabilities"]
+            assert got[i]["probabilities"] == expected, i
+
+        # The batch histograms surface on /metrics.
+        with urllib.request.urlopen(url + "/metrics") as r:
+            text = r.read().decode()
+        assert "dct_serve_batch_rows_count" in text
+        assert "dct_serve_queue_depth_bucket" in text
+        assert "dct_serve_batch_requests_count" in text
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_server_pool_reuseport_serves(rng):
+    """ServerPool (processes<=1 path: in-process, no fork) binds an
+    ephemeral port via the reservation socket and serves."""
+    from dct_tpu.serving.server import ServerPool, make_server_from_weights
+
+    weights, meta = _family_fixture("weather_mlp")
+    with ServerPool(
+        lambda h, p, reuse_port: make_server_from_weights(
+            weights, meta, host=h, port=p,
+            serving=ServingConfig(workers=1), reuse_port=reuse_port,
+        ),
+        processes=1,
+    ) as pool:
+        url = f"http://127.0.0.1:{pool.port}"
+        out = _post(url, {"data": [[0.0] * 5]})
+        assert np.asarray(out["probabilities"]).shape == (1, 2)
+
+
+@pytest.mark.slow
+def test_server_pool_dead_children_surface_nonzero(rng):
+    """Children that fail to build their server must exit nonzero and
+    wait() must return 1 — a pool of dead workers may not hide behind a
+    healthy-looking parent banner (jobs/serve.py exits with it)."""
+    from dct_tpu.serving.server import ServerPool
+
+    def broken_build(h, p, reuse_port):
+        raise RuntimeError("corrupt checkpoint")
+
+    pool = ServerPool(broken_build, processes=2)
+    try:
+        assert pool.wait() == 1
+    finally:
+        pool.close()
+
+
+@pytest.mark.slow
+def test_server_pool_forked_processes(rng):
+    """processes=2: forked SO_REUSEPORT children both serve one port.
+    Slow-marked (forks from a jax-loaded test process)."""
+    from dct_tpu.serving.server import ServerPool, make_server_from_weights
+
+    weights, meta = _family_fixture("weather_mlp")
+    with ServerPool(
+        lambda h, p, reuse_port: make_server_from_weights(
+            weights, meta, host=h, port=p,
+            serving=ServingConfig(workers=1), reuse_port=reuse_port,
+        ),
+        processes=2,
+    ) as pool:
+        assert len(pool.pids) == 2
+        url = f"http://127.0.0.1:{pool.port}"
+        deadline = time.time() + 10
+        last = None
+        while time.time() < deadline:
+            try:
+                out = _post(url, {"data": [[0.0] * 5]})
+                break
+            except Exception as e:  # noqa: BLE001 — children still binding
+                last = e
+                time.sleep(0.2)
+        else:
+            raise AssertionError(f"pool never came up: {last}")
+        assert np.asarray(out["probabilities"]).shape == (1, 2)
+
+
+def test_mirror_capture_stays_per_request_under_batching(
+    processed_dir, tmp_path, monkeypatch
+):
+    """PR 4's shadow mirror evidence under the batched endpoint:
+    concurrent logical requests with a 100% mirror must produce exactly
+    ONE paired record per live request, each carrying that request's own
+    probability rows."""
+    from dct_tpu.config import DataConfig, RunConfig, TrainConfig
+    from dct_tpu.deploy.local import LocalEndpointClient
+    from dct_tpu.serving.score_gen import generate_score_package
+    from dct_tpu.serving.server import make_endpoint_server
+    from dct_tpu.tracking.client import LocalTracking
+    from dct_tpu.train.trainer import Trainer
+
+    monkeypatch.delenv("DCT_MIRROR_CAPTURE", raising=False)
+    cfg = RunConfig(
+        data=DataConfig(
+            processed_dir=processed_dir, models_dir=str(tmp_path / "m")
+        ),
+        train=TrainConfig(epochs=1, batch_size=8, bf16_compute=False),
+    )
+    res = Trainer(cfg, tracker=LocalTracking(root=str(tmp_path / "r"))).fit()
+    pkg_live = str(tmp_path / "pkg_live")
+    pkg_shadow = str(tmp_path / "pkg_shadow")
+    generate_score_package(res.best_model_path, pkg_live)
+    generate_score_package(res.best_model_path, pkg_shadow)
+
+    state = str(tmp_path / "state.json")
+    c = LocalEndpointClient(state_path=state)
+    c.create_endpoint("ep")
+    c.deploy("ep", "blue", pkg_live)
+    c.deploy("ep", "green", pkg_shadow)
+    c.set_traffic("ep", {"blue": 100})
+    c.set_mirror_traffic("ep", {"green": 100})
+
+    server = make_endpoint_server(
+        "ep", state_path=state,
+        serving=ServingConfig(max_batch=32, batch_window_ms=5.0, workers=2),
+    )
+    url = _start(server)
+    try:
+        rng = np.random.default_rng(0)
+        n_requests = 10
+        sizes = [1 if i % 2 else 2 for i in range(n_requests)]
+        results: list = [None] * n_requests
+
+        def one(i):
+            results[i] = _post(
+                url,
+                {"data": rng.standard_normal((sizes[i], 5)).tolist()},
+            )
+
+        threads = [
+            threading.Thread(target=one, args=(i,))
+            for i in range(n_requests)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        assert all(r is not None for r in results)
+
+        capture = c.mirror_capture_path
+        deadline = time.time() + 10
+        records = []
+        while time.time() < deadline:
+            try:
+                with open(capture) as f:
+                    records = [json.loads(l) for l in f if l.strip()]
+            except FileNotFoundError:
+                records = []
+            if len(records) >= n_requests:
+                break
+            time.sleep(0.1)  # mirror writes happen after the live reply
+        assert len(records) == n_requests, len(records)
+        # Every record pairs ONE logical request's own rows.
+        live_probs = sorted(
+            json.dumps(r["probabilities"]) for r in results
+        )
+        rec_probs = sorted(
+            json.dumps(r["live_probs"]) for r in records
+        )
+        assert live_probs == rec_probs
+        for rec in records:
+            assert len(rec["shadow_probs"]) == len(rec["live_probs"])
+    finally:
+        server.shutdown()
+        server.server_close()

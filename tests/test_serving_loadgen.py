@@ -1,0 +1,134 @@
+"""The serving load generator (ISSUE 7): closed/open loop correctness,
+knee analysis, the CI selftest, and the bench's serving_load stanza
+schema."""
+
+import json
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from dct_tpu.config import ServingConfig
+from dct_tpu.serving import loadgen
+from dct_tpu.serving.server import make_server_from_weights
+
+
+@pytest.fixture()
+def live_server():
+    weights, meta = loadgen.synthetic_mlp()
+    server = make_server_from_weights(
+        weights, meta,
+        serving=ServingConfig(max_batch=32, batch_window_ms=1.0, workers=2),
+    )
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    host, port = server.server_address[:2]
+    yield host, port, weights, meta, server
+    server.shutdown()
+    server.server_close()
+
+
+def _body(rows=1):
+    rng = np.random.default_rng(3)
+    return json.dumps(
+        {"data": rng.standard_normal((rows, 5)).round(4).tolist()}
+    ).encode()
+
+
+def test_closed_loop_measures_qps_and_tails(live_server):
+    host, port, *_ = live_server
+    out = loadgen.run_closed_loop(
+        host, port, _body(), concurrency=4, total_requests=120,
+        duration_s=30.0,
+    )
+    assert out["mode"] == "closed" and out["concurrency"] == 4
+    assert out["requests"] == 120 and out["errors"] == 0
+    assert out["qps"] > 0
+    assert out["p50_ms"] > 0 and out["p99_ms"] >= out["p50_ms"]
+
+
+def test_closed_loop_counts_non_200_as_errors(live_server):
+    host, port, *_ = live_server
+    bad = json.dumps({"data": [[1.0, 2.0]]}).encode()  # wrong width: 400
+    out = loadgen.run_closed_loop(
+        host, port, bad, concurrency=2, total_requests=10,
+        duration_s=30.0,
+    )
+    assert out["errors"] == 10 and out["requests"] == 0
+
+
+def test_open_loop_paces_arrivals(live_server):
+    host, port, *_ = live_server
+    out = loadgen.run_open_loop(
+        host, port, _body(), qps=100.0, duration_s=1.0
+    )
+    assert out["mode"] == "open" and out["target_qps"] == 100.0
+    # 100 scheduled arrivals; all should land on this tiny model.
+    assert out["requests"] + out["errors"] + out["dropped"] == 100
+    assert out["requests"] > 50
+    assert out["p50_ms"] > 0
+
+
+def test_saturation_knee_rules():
+    mk = lambda c, qps: {"concurrency": c, "qps": qps}
+    # Monotone growth past the gain bar: knee = last level.
+    out = loadgen.saturation_knee([mk(1, 100), mk(4, 300), mk(16, 900)])
+    assert out["knee_concurrency"] == 16
+    assert out["saturated_qps"] == 900
+    # Growth stalls after 4: the knee is 4 even though 16 is max level.
+    out = loadgen.saturation_knee([mk(1, 100), mk(4, 300), mk(16, 320)])
+    assert out["knee_concurrency"] == 4
+    assert out["saturated_qps"] == 320
+    # Throughput COLLAPSE past the knee: saturated tracks the peak.
+    out = loadgen.saturation_knee([mk(1, 100), mk(4, 300), mk(16, 150)])
+    assert out["knee_concurrency"] == 4
+    assert out["saturated_qps"] == 300 and out["saturated_concurrency"] == 4
+
+
+def test_sweep_schema(live_server):
+    host, port, *_ = live_server
+    out = loadgen.sweep_closed_loop(
+        host, port, _body(), levels=[1, 2], requests_per_level=40,
+        duration_s=30.0,
+    )
+    assert [r["concurrency"] for r in out["levels"]] == [1, 2]
+    assert all(r["qps"] > 0 for r in out["levels"])
+    assert out["knee_concurrency"] in (1, 2)
+    assert out["saturated_qps"] >= max(
+        r["qps"] for r in out["levels"]
+    ) - 1e-9
+
+
+def test_selftest_runs_hermetically():
+    """The CI smoke in-process: parity + qps assertions over a synthetic
+    model, no checkpoint, no jax."""
+    out = loadgen._selftest(requests_per_level=60, levels=(2, 4))
+    assert out["ok"] is True
+    assert out["parity"] is True
+    assert all(r["errors"] == 0 for r in out["levels"])
+
+
+@pytest.mark.slow
+def test_selftest_cli_subprocess():
+    """`python -m dct_tpu.serving.loadgen --selftest` — exactly the CI
+    job's invocation — exits 0 and prints one JSON line."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dct_tpu.serving.loadgen", "--selftest"],
+        capture_output=True, text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "."},
+        cwd=".",
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] and out["parity"]
+
+
+def test_concurrency_levels_parse():
+    cfg = ServingConfig(loadgen_concurrency="1, 8,4,bogus,8,-2")
+    assert cfg.concurrency_levels() == [1, 4, 8]
+    assert ServingConfig(
+        loadgen_concurrency=""
+    ).concurrency_levels() == [1]
