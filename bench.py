@@ -913,6 +913,47 @@ def bench_serving_load(tmp: str) -> dict:
         n=10,
     )
     out["score_batched_over_single"] = round(t_single / t_batched, 2)
+
+    # Metrics-plane cost bound (ISSUE 8 acceptance): the hot-path price
+    # of snapshot publishing, measured — same in-process server, same
+    # closed-loop traffic, with the plane off vs armed at the DEFAULT
+    # publish throttle (the shipped config: one clock read per request
+    # inside the window, a snapshot write per DCT_METRICS_PUBLISH_S).
+    def _p50_with_env(metrics_dir: str | None) -> float:
+        saved = {"DCT_METRICS_DIR": os.environ.get("DCT_METRICS_DIR")}
+        try:
+            if metrics_dir is None:
+                os.environ["DCT_METRICS_DIR"] = ""
+            else:
+                os.environ["DCT_METRICS_DIR"] = metrics_dir
+            with ServerPool(
+                lambda h, p, reuse_port: make_server_from_weights(
+                    weights, meta, host=h, port=p, serving=cfg,
+                    reuse_port=reuse_port,
+                ),
+                processes=1, host="127.0.0.1",
+            ) as p1:
+                return loadgen.run_closed_loop(
+                    "127.0.0.1", p1.port, body, concurrency=1,
+                    total_requests=200, duration_s=10.0,
+                )["p50_ms"]
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    plain_p50 = _p50_with_env(None)
+    publish_p50 = _p50_with_env(os.path.join(tmp, "bench_metrics"))
+    out["snapshot_publish"] = {
+        "plain_p50_ms": plain_p50,
+        "publish_p50_ms": publish_p50,
+    }
+    # Flat copy for the stdout digest: the shrink ladder's serving_load
+    # rungs keep scalars by name, and the overhead bound must survive
+    # to the driver tail.
+    out["publish_overhead_ms"] = round(publish_p50 - plain_p50, 4)
     return out
 
 
@@ -1210,6 +1251,9 @@ def _stdout_record(record: dict) -> dict:
             sl["levels"]["errors"] = [r.get("errors") for r in lv]
         sl.pop("knee_qps", None)
         sl.pop("saturated_concurrency", None)
+        # The per-variant p50 pair stays in the partial; stdout carries
+        # the flat publish_overhead_ms bound only.
+        sl.pop("snapshot_publish", None)
         if sl.get("processes") == 1:
             sl.pop("processes")
         out["serving_load"] = sl
@@ -1321,7 +1365,8 @@ def _shrink_to_budget(out: dict) -> dict:
         # scaled/carry-forward digests were not enough.
         ("serving_load", ("processes", "baseline_qps", "saturated_qps",
                           "knee_concurrency", "batched_over_single",
-                          "score_batched_over_single", "parity")),
+                          "score_batched_over_single", "parity",
+                          "publish_overhead_ms")),
     )
     for key, fields in ladder:
         if key == "serving":
@@ -1346,7 +1391,8 @@ def _shrink_to_budget(out: dict) -> dict:
         ("serving", ()),
         ("scaled_legs", ("attn_blockwise_ms", "attn_flash_ms")),
         ("serving_load", ("saturated_qps", "batched_over_single",
-                          "score_batched_over_single", "parity")),
+                          "score_batched_over_single", "parity",
+                          "publish_overhead_ms")),
         ("probe", ("platform",)),
         ("val_parity", ("abs_diff",)),
         ("moe", ("sorted_speedup",)),

@@ -444,6 +444,29 @@ class ObservabilityConfig:
     # one — they are throttled by heartbeat_interval instead.
     telemetry_flush_s: float = 0.25
     telemetry_flush_records: int = 128
+    # Cross-process metrics plane (observability/{metrics,aggregate}.py;
+    # docs/OBSERVABILITY.md "Metrics plane"): processes publish atomic
+    # registry snapshots under this dir and any /metrics scrape merges
+    # the live siblings into fleet totals. "" = plane off (the serving
+    # CLI jobs/serve.py arms logs/metrics by default; library-built
+    # servers stay local-only unless the env opts in).
+    metrics_dir: str = ""
+    # Min seconds between snapshot publishes per process (the hot-path
+    # throttle; an idle-process timer republishes on the same cadence).
+    metrics_publish_s: float = 2.0
+    # A LIVE process's snapshot older than this stops counting (dead
+    # pids drop immediately; `final` batch snapshots never age out).
+    metrics_stale_s: float = 30.0
+    # SLO monitoring over the aggregated series (observability/slo.py
+    # grammar): e.g. "availability:0.999;latency:0.25@0.95;goodput:0.5;
+    # freshness:3600". Evaluated at scrape time by whichever process
+    # answers /metrics; alerts emit slo.alert events + dct_slo_* gauges.
+    slo_spec: str = "availability:0.999;latency:0.5@0.95"
+    # Multi-window burn-rate rule: alert only when BOTH windows burn
+    # error budget above the threshold (1.0 = exactly budget rate).
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    slo_burn_threshold: float = 1.0
 
     @classmethod
     def from_env(cls) -> "ObservabilityConfig":
@@ -469,6 +492,23 @@ class ObservabilityConfig:
         )
         c.telemetry_flush_records = _env(
             "DCT_TELEMETRY_FLUSH_RECORDS", c.telemetry_flush_records, int
+        )
+        c.metrics_dir = _env("DCT_METRICS_DIR", c.metrics_dir, str)
+        c.metrics_publish_s = _env(
+            "DCT_METRICS_PUBLISH_S", c.metrics_publish_s, float
+        )
+        c.metrics_stale_s = _env(
+            "DCT_METRICS_STALE_S", c.metrics_stale_s, float
+        )
+        c.slo_spec = _env("DCT_SLO_SPEC", c.slo_spec, str)
+        c.slo_fast_window_s = _env(
+            "DCT_SLO_FAST_WINDOW_S", c.slo_fast_window_s, float
+        )
+        c.slo_slow_window_s = _env(
+            "DCT_SLO_SLOW_WINDOW_S", c.slo_slow_window_s, float
+        )
+        c.slo_burn_threshold = _env(
+            "DCT_SLO_BURN_THRESHOLD", c.slo_burn_threshold, float
         )
         return c
 
@@ -867,6 +907,13 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_SPIKE_WINDOW": "spike detector rolling window",
     "DCT_TELEMETRY_FLUSH_S": "event/span write-batch window (0 = through)",
     "DCT_TELEMETRY_FLUSH_RECORDS": "record cap forcing an early flush",
+    "DCT_METRICS_DIR": "metrics-plane snapshot dir ('' = plane off)",
+    "DCT_METRICS_PUBLISH_S": "min seconds between snapshot publishes",
+    "DCT_METRICS_STALE_S": "live snapshot age that stops counting (s)",
+    "DCT_SLO_SPEC": "SLO specs over the aggregated series (slo.py grammar)",
+    "DCT_SLO_FAST_WINDOW_S": "burn-rate fast window (s)",
+    "DCT_SLO_SLOW_WINDOW_S": "burn-rate slow window (s)",
+    "DCT_SLO_BURN_THRESHOLD": "alert when BOTH windows burn above this",
     "DCT_PROFILE": "jax.profiler one-epoch trace window",
     "DCT_TRACE_DIR": "profiler trace output dir",
     "DCT_PROFILE_EPOCH": "which epoch to trace (0-based)",

@@ -68,6 +68,27 @@ def _launcher_event_log(env: dict) -> EventLog:
     )
 
 
+def _launcher_metrics_publisher(env: dict, proc: str):
+    """Metrics-plane publisher for the orchestrator side (None when the
+    plane is unarmed): the launcher/supervisor contributes per-rank
+    progress-age gauges and restart counters to the same snapshot dir
+    the serving pool and trainer publish into, so one ``/metrics``
+    scrape sees them all (docs/OBSERVABILITY.md "Metrics plane")."""
+    metrics_dir = env.get("DCT_METRICS_DIR") or ""
+    if not metrics_dir or not observability_enabled(env):
+        return None
+    from dct_tpu.observability.aggregate import SnapshotPublisher
+    from dct_tpu.observability.metrics import MetricsRegistry
+
+    try:
+        interval = float(env.get("DCT_METRICS_PUBLISH_S") or 2.0)
+    except ValueError:
+        interval = 2.0
+    return SnapshotPublisher(
+        MetricsRegistry(), metrics_dir, proc=proc, interval_s=interval
+    )
+
+
 def _launcher_span_recorder(env: dict) -> SpanRecorder:
     """Orchestrator-side span recorder over the same env the ranks
     inherit: the launch span and every rank's trainer spans share one
@@ -467,6 +488,25 @@ class LocalProcessLauncher:
             if hb_dir and observability_enabled(base_env)
             else None
         )
+        # Metrics plane: per-rank PROGRESS age (seconds since step/epoch
+        # last advanced — write age alone cannot tell a beating-but-
+        # wedged rank from a healthy one) published as a gauge next to
+        # the serving pool's snapshots.
+        metrics_pub = (
+            _launcher_metrics_publisher(
+                base_env, f"launcher-{os.getpid()}"
+            )
+            if monitor is not None else None
+        )
+        progress_gauge = (
+            metrics_pub.registry.gauge(
+                "dct_rank_progress_age_seconds",
+                "Seconds since each rank's heartbeat (step, epoch) last "
+                "advanced (progress age, not write age).",
+                agg="max",
+            )
+            if metrics_pub is not None else None
+        )
         flagged: set[tuple[int, str]] = set()
         last_scan = 0.0
         try:
@@ -544,7 +584,9 @@ class LocalProcessLauncher:
                 ):
                     last_scan = time.monotonic()
                     wedged = self._flag_heartbeats(
-                        monitor, codes, flagged, events
+                        monitor, codes, flagged, events,
+                        progress_gauge=progress_gauge,
+                        metrics_pub=metrics_pub,
                     )
                     if wedged and self.stall_kill and not killed:
                         # Supervision mode: a stalled rank blocks every
@@ -597,6 +639,10 @@ class LocalProcessLauncher:
                 for r in range(world_size)
             ]
         finally:
+            if metrics_pub is not None:
+                # Progress age is a LIVE signal: retire the snapshot so
+                # a post-run scrape never reads a frozen age as current.
+                metrics_pub.close()
             live = [p for p in procs if p.poll() is None]
             if live:
                 # Exception-path teardown (supervisor terminated, monitor
@@ -635,6 +681,8 @@ class LocalProcessLauncher:
         codes: dict[int, int],
         flagged: set,
         events: EventLog,
+        progress_gauge=None,
+        metrics_pub=None,
     ) -> list[int]:
         """One monitor pass: warn (stderr + event) once per (rank, state)
         for stalled/missing ranks that have not exited, and once per new
@@ -643,6 +691,23 @@ class LocalProcessLauncher:
         stall-kill supervisor can act on them."""
         wedged: list[int] = []
         statuses = monitor.scan()
+        if progress_gauge is not None:
+            for s in statuses:
+                # "done" ranks and reaped ranks stop advancing by
+                # design — publishing their ever-growing age would page
+                # on a healthy completion (report() excludes them from
+                # max_progress_age_seconds for the same reason).
+                if (
+                    s.progress_age_seconds is not None
+                    and s.state != "done"
+                    and s.rank not in codes
+                ):
+                    progress_gauge.set(
+                        round(s.progress_age_seconds, 3),
+                        {"rank": s.rank},
+                    )
+            if metrics_pub is not None:
+                metrics_pub.maybe_publish()
         for s in statuses:
             if s.rank in codes or s.state not in ("stalled", "missing"):
                 continue
@@ -742,6 +807,26 @@ class LocalProcessLauncher:
             world_size=world_size, max_restarts=max_restarts,
             argv=list(argv),
         )
+        # Restart accounting on the metrics plane: relaunch counts by
+        # classification + the cumulative lost wall clock, published as
+        # a FINAL snapshot when supervision ends (the restart history
+        # outlives the supervisor — ROADMAP item 5's restart-debt
+        # numbers next to the trainer's compile series).
+        metrics_pub = _launcher_metrics_publisher(
+            merged, f"supervisor-{os.getpid()}"
+        )
+        restarts_ctr = lost_gauge = None
+        if metrics_pub is not None:
+            restarts_ctr = metrics_pub.registry.counter(
+                "dct_restarts_total",
+                "Supervised world relaunches, by failure classification.",
+            )
+            lost_gauge = metrics_pub.registry.gauge(
+                "dct_restart_lost_wall_seconds",
+                "Wall seconds lost to failed attempts and backoff "
+                "(handed to the relaunched trainer as startup_recovery "
+                "badput).", agg="sum",
+            )
         attempts: list[AttemptRecord] = []
         restarts = 0
         debt = 0.0
@@ -805,6 +890,10 @@ class LocalProcessLauncher:
                     merged, run_id, cls, t0_wall, wall
                 ) + delay
                 self._clear_heartbeats(merged, world_size)
+                if restarts_ctr is not None:
+                    restarts_ctr.inc(1, {"classification": cls})
+                    lost_gauge.set(round(debt, 3))
+                    metrics_pub.publish()
                 events.emit(
                     "launcher", "restart.relaunch",
                     attempt=len(attempts) + 1, classification=cls,
@@ -839,6 +928,8 @@ class LocalProcessLauncher:
                 classification="preempted",
             )
         finally:
+            if metrics_pub is not None:
+                metrics_pub.close(final=True)
             for sig, prev in prev_handlers.items():
                 try:
                     signal.signal(sig, prev)

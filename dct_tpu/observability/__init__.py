@@ -27,6 +27,14 @@ plane built on four pillars:
 - :mod:`inspect` — the run-inspector CLI
   (``python -m dct_tpu.observability.inspect <run_dir>``) joining
   events + spans + goodput + heartbeats into a cycle report.
+- :mod:`metrics` / :mod:`aggregate` / :mod:`slo` — the metrics plane
+  (ISSUE 8): a general registry (counter/gauge/histogram with merge
+  semantics) every process publishes as atomic snapshot files, scrape-
+  time aggregation into fleet totals + per-``proc`` series, and SLO
+  burn-rate monitoring (``slo.alert`` events, ``dct_slo_*`` gauges)
+  over the aggregated view.
+- :mod:`report` — the bench-trajectory regression sentinel
+  (``python -m dct_tpu.observability.report BENCH_r0*.json``).
 
 Everything here is dependency-free, failure-isolated (a full disk or an
 unwritable dir degrades telemetry to a no-op, never fails training), and

@@ -7,6 +7,15 @@ final metrics as an exposition-format file and any file-shipping agent
 turns them into series. Same metric names every run, labelled by the
 run-correlation ID, so goodput is chartable across continuous-training
 cycles.
+
+Since ISSUE 8 the dump is built on the same
+:class:`~dct_tpu.observability.metrics.MetricsRegistry` the serving
+tier uses (identical exposition bytes, one metric model), and — when
+the metrics plane is armed (``DCT_METRICS_DIR``) — the run ALSO leaves
+a **final snapshot** behind: a terminal registry snapshot the
+aggregation layer keeps after the trainer pid dies, so a ``/metrics``
+scrape of the serving pool reports the training fleet's goodput,
+health, and compile debt next to the request series.
 """
 
 from __future__ import annotations
@@ -14,7 +23,110 @@ from __future__ import annotations
 import math
 import os
 
-from dct_tpu.observability.prometheus import MetricFamily, render
+from dct_tpu.observability.metrics import MetricsRegistry
+
+
+def build_train_registry(
+    goodput_summary: dict,
+    *,
+    run_id: str,
+    samples_per_sec: float = 0.0,
+    val_loss: float | None = None,
+    health: dict | None = None,
+    resilience: dict | None = None,
+    compile_windows: list | None = None,
+) -> MetricsRegistry:
+    """The run's final metrics as a registry (shared by the textfile
+    dump and the metrics-plane snapshot — one source, two sinks)."""
+    labels = {"run_id": run_id}
+    reg = MetricsRegistry()
+    cat = reg.gauge(
+        "dct_train_goodput_seconds",
+        "Run wall seconds by goodput/badput category.", agg="sum",
+    )
+    for c, sec in goodput_summary.get("categories", {}).items():
+        cat.set(sec, {**labels, "category": c})
+    cat.set(
+        goodput_summary.get("unattributed_seconds", 0.0),
+        {**labels, "category": "unattributed"},
+    )
+    reg.gauge(
+        "dct_train_goodput_fraction",
+        "Productive (train_step + eval) seconds over wall seconds.",
+        agg="last",
+    ).set(goodput_summary.get("goodput_fraction", 0.0), labels)
+    reg.gauge(
+        "dct_train_wall_seconds",
+        "Total run wall seconds (Trainer.fit entry to summary).",
+        agg="sum",
+    ).set(goodput_summary.get("wall_seconds", 0.0), labels)
+    reg.gauge(
+        "dct_train_samples_per_sec",
+        "Mean training throughput over the run.", agg="last",
+    ).set(samples_per_sec, labels)
+    reg.counter(
+        "dct_train_epochs_total", "Epochs completed by this run.",
+    ).inc(goodput_summary.get("epochs", 0), labels)
+    if val_loss is not None and math.isfinite(val_loss):
+        reg.gauge(
+            "dct_train_val_loss", "Final validation loss of the run.",
+            agg="last",
+        ).set(val_loss, labels)
+    if health is not None:
+        # Training-health surface (observability.health.HealthMonitor
+        # summary): incident counts by kind + the last grad global norm.
+        incidents = reg.counter(
+            "dct_train_health_events_total",
+            "Training-health incidents (nan_loss / loss_spike / "
+            "grad_norm_spike) observed by this run.",
+        )
+        for kind, n in sorted((health.get("events") or {}).items()):
+            incidents.inc(n, {**labels, "kind": kind})
+        gn = health.get("last_grad_norm")
+        if gn is not None and math.isfinite(gn):
+            reg.gauge(
+                "dct_train_grad_norm",
+                "Last observed gradient global norm.", agg="last",
+            ).set(gn, labels)
+    if resilience is not None:
+        # Resilience surface (dct_tpu.resilience): injected-fault count
+        # and the supervised-relaunch debt this run was handed
+        # (restart.* counters live with the supervisor's events; the
+        # debt itself is also inside the startup_recovery category).
+        reg.counter(
+            "dct_train_faults_injected_total",
+            "Faults the DCT_FAULT_SPEC plan fired in this run.",
+        ).inc(resilience.get("faults_injected", 0), labels)
+        reg.gauge(
+            "dct_train_startup_recovery_debt_seconds",
+            "Wall seconds lost to failed attempts before this run "
+            "(booked as startup_recovery badput).", agg="sum",
+        ).set(resilience.get("startup_debt_s", 0.0), labels)
+    if compile_windows:
+        # Compile accounting (observability.goodput.compile_report):
+        # count + duration per program, keyed by the (family,
+        # config-hash, mesh) identity an AOT compilation cache would
+        # use — the restart/spin-up debt ROADMAP item 5 attacks.
+        n_fam = reg.counter(
+            "dct_compile_windows_total",
+            "XLA compile windows (first dispatch of a distinct "
+            "program) paid by this run.",
+        )
+        s_fam = reg.counter(
+            "dct_compile_seconds_total",
+            "Wall seconds inside compile windows, by program identity.",
+        )
+        for w in compile_windows:
+            wl = {
+                **labels,
+                "program": w.get("program", "?"),
+                "family": w.get("family", ""),
+                "config_hash": w.get("config_hash", ""),
+                "mesh": w.get("mesh", ""),
+            }
+            n_fam.inc(w.get("count", 0), wl)
+            s_fam.inc(w.get("seconds", 0.0), wl)
+    return reg
 
 
 def write_train_metrics_prom(
@@ -26,82 +138,30 @@ def write_train_metrics_prom(
     val_loss: float | None = None,
     health: dict | None = None,
     resilience: dict | None = None,
+    compile_windows: list | None = None,
+    metrics_dir: str | None = None,
+    proc: str | None = None,
 ) -> str | None:
     """Write the run's final metrics at ``path`` (tmp+rename so a
-    shipping agent never reads a torn file). Returns the path, or None
-    when the write failed (telemetry never fails the run)."""
-    labels = {"run_id": run_id}
-    fams = [
-        MetricFamily(
-            "dct_train_goodput_seconds", "gauge",
-            "Run wall seconds by goodput/badput category.",
-        ),
-        MetricFamily(
-            "dct_train_goodput_fraction", "gauge",
-            "Productive (train_step + eval) seconds over wall seconds.",
-        ).add(goodput_summary.get("goodput_fraction", 0.0), labels),
-        MetricFamily(
-            "dct_train_wall_seconds", "gauge",
-            "Total run wall seconds (Trainer.fit entry to summary).",
-        ).add(goodput_summary.get("wall_seconds", 0.0), labels),
-        MetricFamily(
-            "dct_train_samples_per_sec", "gauge",
-            "Mean training throughput over the run.",
-        ).add(samples_per_sec, labels),
-        MetricFamily(
-            "dct_train_epochs_total", "counter",
-            "Epochs completed by this run.",
-        ).add(goodput_summary.get("epochs", 0), labels),
-    ]
-    for cat, sec in goodput_summary.get("categories", {}).items():
-        fams[0].add(sec, {**labels, "category": cat})
-    fams[0].add(
-        goodput_summary.get("unattributed_seconds", 0.0),
-        {**labels, "category": "unattributed"},
+    shipping agent never reads a torn file); when ``metrics_dir`` is
+    set, also publish the registry as a FINAL metrics-plane snapshot
+    under ``proc``. Returns the path, or None when the write failed
+    (telemetry never fails the run)."""
+    reg = build_train_registry(
+        goodput_summary,
+        run_id=run_id,
+        samples_per_sec=samples_per_sec,
+        val_loss=val_loss,
+        health=health,
+        resilience=resilience,
+        compile_windows=compile_windows,
     )
-    if val_loss is not None and math.isfinite(val_loss):
-        fams.append(
-            MetricFamily(
-                "dct_train_val_loss", "gauge",
-                "Final validation loss of the run.",
-            ).add(val_loss, labels)
-        )
-    if health is not None:
-        # Training-health surface (observability.health.HealthMonitor
-        # summary): incident counts by kind + the last grad global norm.
-        incidents = MetricFamily(
-            "dct_train_health_events_total", "counter",
-            "Training-health incidents (nan_loss / loss_spike / "
-            "grad_norm_spike) observed by this run.",
-        )
-        for kind, n in sorted((health.get("events") or {}).items()):
-            incidents.add(n, {**labels, "kind": kind})
-        fams.append(incidents)
-        gn = health.get("last_grad_norm")
-        if gn is not None and math.isfinite(gn):
-            fams.append(
-                MetricFamily(
-                    "dct_train_grad_norm", "gauge",
-                    "Last observed gradient global norm.",
-                ).add(gn, labels)
-            )
-    if resilience is not None:
-        # Resilience surface (dct_tpu.resilience): injected-fault count
-        # and the supervised-relaunch debt this run was handed
-        # (restart.* counters live with the supervisor's events; the
-        # debt itself is also inside the startup_recovery category).
-        fams.append(
-            MetricFamily(
-                "dct_train_faults_injected_total", "counter",
-                "Faults the DCT_FAULT_SPEC plan fired in this run.",
-            ).add(resilience.get("faults_injected", 0), labels)
-        )
-        fams.append(
-            MetricFamily(
-                "dct_train_startup_recovery_debt_seconds", "gauge",
-                "Wall seconds lost to failed attempts before this run "
-                "(booked as startup_recovery badput).",
-            ).add(resilience.get("startup_debt_s", 0.0), labels)
+    if metrics_dir:
+        from dct_tpu.observability.aggregate import write_snapshot
+
+        write_snapshot(
+            reg.snapshot(proc=proc or f"train-{run_id}", final=True),
+            metrics_dir,
         )
     tmp = path + ".tmp"
     try:
@@ -109,7 +169,7 @@ def write_train_metrics_prom(
         if parent:
             os.makedirs(parent, exist_ok=True)
         with open(tmp, "w") as f:
-            f.write(render(fams))
+            f.write(reg.render())
         os.replace(tmp, path)
     except OSError:
         return None

@@ -64,6 +64,11 @@ class GoodputLedger:
         self._seen_dispatch_keys: set = set()
         self._epoch_walls: list[tuple[int, float]] = []
         self._last_report: tuple[float, dict] | None = None
+        # Every window billed to `compile`, as (program key, seconds):
+        # the raw material of the compile/restart accounting layer
+        # (compile.* events + dct_compile_* series — ROADMAP item 5's
+        # baseline numbers live here).
+        self.compile_windows: list[tuple[str, float]] = []
 
     # -- clock surface (for callers that bracket non-contiguous code) --
     def clock(self) -> float:
@@ -100,14 +105,25 @@ class GoodputLedger:
 
     @contextmanager
     def dispatch(self, category: str, *, key: str | None = None):
-        with self.span(self.dispatch_category(category, key or category)):
+        key = key or category
+        cat = self.dispatch_category(category, key)
+        t0 = self._clock()
+        try:
             yield
+        finally:
+            sec = self._clock() - t0
+            if cat == "compile":
+                self.compile_windows.append((key, sec))
+            self.add(cat, sec)
 
     def add_dispatch(self, category: str, key: str, seconds: float) -> None:
         """Non-contextmanager form for dispatches whose timing window is
         interleaved with other code (the trainer's prefetch submit sits
         between the fused call and its block_until_ready)."""
-        self.add(self.dispatch_category(category, key), seconds)
+        cat = self.dispatch_category(category, key)
+        if cat == "compile":
+            self.compile_windows.append((key, float(seconds)))
+        self.add(cat, seconds)
 
     # -- epoch feed (EpochTimer calls this) ----------------------------
     def note_epoch(self, epoch: int, seconds: float) -> None:
@@ -171,3 +187,69 @@ class GoodputLedger:
             out[f"{prefix}_{cat}_seconds"] = sec
         out[f"badput_{UNATTRIBUTED}_seconds"] = s[f"{UNATTRIBUTED}_seconds"]
         return out
+
+
+# ----------------------------------------------------------------------
+# Compile/restart accounting (ROADMAP item 5's baseline numbers): the
+# ledger's compile windows, grouped per program and stamped with the
+# (family, config-hash, mesh) identity a future AOT compilation cache
+# would key on — if the SAME identity keeps re-compiling across
+# restarts/workers, that is exactly the debt a persistent cache erases.
+
+
+def config_hash(cfg_dict: dict) -> str:
+    """Stable 8-hex digest of a config mapping (sorted-key JSON, so
+    field order never changes the identity)."""
+    import hashlib
+    import json
+
+    blob = json.dumps(cfg_dict, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:8]
+
+
+def mesh_descriptor(mesh) -> str:
+    """The mesh axis sizes as one label value (``data2_model1_seq1_
+    pipe1``) — compile identity includes layout: the same model on a
+    different mesh is a different XLA program. Accepts a live
+    ``jax.sharding.Mesh`` (RESOLVED sizes — a config's ``data=-1``
+    placeholder is not an identity) or a :class:`MeshConfig`."""
+    shape = getattr(mesh, "shape", None)
+    if shape:
+        return "_".join(f"{k}{v}" for k, v in dict(shape).items())
+    return (
+        f"data{getattr(mesh, 'data', -1)}"
+        f"_model{getattr(mesh, 'model', 1)}"
+        f"_seq{getattr(mesh, 'seq', 1)}"
+        f"_pipe{getattr(mesh, 'pipe', 1)}"
+    )
+
+
+def compile_report(
+    windows: list[tuple[str, float]],
+    *,
+    family: str = "",
+    config_hash: str = "",
+    mesh: str = "",
+) -> list[dict]:
+    """Group raw ``(program, seconds)`` compile windows into one record
+    per program, carrying the cache-key labels — the shape both the
+    ``compile.window`` events and the ``dct_compile_*`` series use."""
+    grouped: dict[str, dict] = {}
+    for program, sec in windows:
+        g = grouped.setdefault(
+            program,
+            {
+                "program": program,
+                "family": family,
+                "config_hash": config_hash,
+                "mesh": mesh,
+                "count": 0,
+                "seconds": 0.0,
+            },
+        )
+        g["count"] += 1
+        g["seconds"] += float(sec)
+    out = list(grouped.values())
+    for g in out:
+        g["seconds"] = round(g["seconds"], 6)
+    return out

@@ -60,6 +60,13 @@ class HeartbeatWriter:
         self._last_write: float | None = None
         self._last_phase: str | None = None
         self._dead = False
+        # Progress tracking: the last (step, epoch) that CHANGED and
+        # when. Write age says "the process is alive"; progress age says
+        # "the process is getting somewhere" — a rank beating every 5 s
+        # while wedged at the same step looks healthy to the first and
+        # stalled to the second.
+        self._last_progress: tuple | None = None
+        self._progress_time: float | None = None
 
     @property
     def path(self) -> str:
@@ -87,6 +94,14 @@ class HeartbeatWriter:
             and now - self._last_write < self.min_interval
         ):
             return False
+        if self._progress_time is None or (
+            (step, epoch) != self._last_progress
+            and (step is not None or epoch is not None)
+        ):
+            # First beat counts as progress (startup IS forward motion);
+            # after that only a step/epoch advance refreshes the clock.
+            self._progress_time = now
+            self._last_progress = (step, epoch)
         rec = {
             "rank": self.rank,
             "run_id": self.run_id,
@@ -95,6 +110,7 @@ class HeartbeatWriter:
             "step": step,
             "epoch": epoch,
             "phase": phase,
+            "progress_time": round(self._progress_time, 3),
         }
         tmp = self.path + f".tmp.{os.getpid()}"
         try:
@@ -130,6 +146,10 @@ class RankStatus:
     step: int | None = None
     epoch: int | None = None
     phase: str | None = None
+    # Seconds since the rank's (step, epoch) last ADVANCED — the
+    # progress age a supervisor reports as dct_rank_progress_age_seconds
+    # (write age only proves liveness; this proves forward motion).
+    progress_age_seconds: float | None = None
 
 
 class HeartbeatMonitor:
@@ -173,6 +193,13 @@ class HeartbeatMonitor:
                 state = "stalled"
             else:
                 state = "ok"
+            # Progress age: older records (pre-ISSUE 8) lack the field —
+            # fall back to write age, which can only UNDER-state it.
+            ptime = rec.get("progress_time")
+            progress_age = (
+                max(0.0, now - float(ptime))
+                if isinstance(ptime, (int, float)) else age
+            )
             out.append(
                 RankStatus(
                     rank,
@@ -181,6 +208,7 @@ class HeartbeatMonitor:
                     step=rec.get("step"),
                     epoch=rec.get("epoch"),
                     phase=phase,
+                    progress_age_seconds=progress_age,
                 )
             )
         return out
@@ -199,9 +227,16 @@ class HeartbeatMonitor:
 
     def report(self) -> dict:
         statuses = self.scan()
+        progress = [
+            s.progress_age_seconds for s in statuses
+            if s.progress_age_seconds is not None and s.state != "done"
+        ]
         return {
             "ranks": {s.rank: s.state for s in statuses},
             "stalled": [s.rank for s in statuses if s.state == "stalled"],
             "missing": [s.rank for s in statuses if s.state == "missing"],
+            "max_progress_age_seconds": (
+                round(max(progress), 3) if progress else None
+            ),
             **self.skew(statuses),
         }

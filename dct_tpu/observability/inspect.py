@@ -99,12 +99,69 @@ def _fmt_num(v) -> str:
     return str(v)
 
 
+def load_bench_record(run_dir: str) -> tuple[str, dict] | None:
+    """Newest ``BENCH*.json`` under ``run_dir`` (rounds sort by name),
+    or None. The cycle report surfaces its MFU — including the
+    ``scaled_mfu_stale_reason`` a dead relay stamps — instead of
+    silently omitting the number an operator will otherwise chase."""
+    paths = _find_files(
+        run_dir,
+        lambda fn, d: fn.startswith("BENCH") and fn.endswith(".json"),
+    )
+    if not paths:
+        return None
+    path = sorted(paths, key=lambda p: os.path.basename(p))[-1]
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return os.path.basename(path), {}
+    return os.path.basename(path), rec if isinstance(rec, dict) else {}
+
+
+def _bench_mfu_lines(bench: tuple[str, dict] | None) -> list[str]:
+    lines = ["", "Bench MFU:"]
+    if bench is None:
+        lines.append("  (no BENCH*.json record in the run dir)")
+        return lines
+    name, rec = bench
+    parsed = rec.get("parsed")
+    if not isinstance(parsed, dict):
+        lines.append(
+            f"  {name}: record present but unparsable (stdout "
+            "overflowed the driver tail?) — no MFU to report"
+        )
+        return lines
+    mfu = parsed.get("mfu")
+    stale = parsed.get("scaled_mfu_stale")
+    reason = parsed.get("scaled_mfu_stale_reason")
+    if mfu is not None:
+        line = f"  {name}: mfu={_fmt_num(mfu)}"
+        if stale:
+            line += f" STALE ({reason or 'reason unrecorded'})"
+        lines.append(line)
+    elif stale or reason:
+        why = reason or "no reason recorded"
+        lines.append(
+            f"  {name}: scaled MFU stale — {why} "
+            "(prior rounds' numbers do not transfer)"
+        )
+    else:
+        lines.append(
+            f"  {name}: no MFU in the record "
+            f"(platform={parsed.get('platform')}: CPU rounds carry "
+            "no on-chip MFU)"
+        )
+    return lines
+
+
 def build_report(
     events: list[dict],
     heartbeats: list[dict],
     spans: list[dict],
     run_id: str | None,
     trace_path: str | None,
+    bench: tuple[str, dict] | None = None,
 ) -> str:
     """The cycle report as one printable string (pure function of the
     artifacts — unit-testable without capturing stdout)."""
@@ -134,10 +191,19 @@ def build_report(
         "deploy_new_slot", "shadow", "canary", "full_rollout",
         "rank_exit", "rank_stalled", "rank_missing",
     }
+    # The cycle is no longer trainer-centric: serving, gating, SLO and
+    # compile-accounting events belong on the same timeline (serve.*
+    # stays OFF it — per-flush events would drown the launch story; the
+    # Serving section below summarizes them instead).
+    interesting_prefixes = (
+        "health.", "deploy.", "slo.", "compile.", "restart.",
+    )
     shown = 0
     for r in ev:
         name = r.get("event", "?")
-        if name not in interesting and not name.startswith("health."):
+        if name not in interesting and not name.startswith(
+            interesting_prefixes
+        ):
             continue
         who = (
             f"rank {r['rank']}" if r.get("rank") is not None else "host"
@@ -149,6 +215,21 @@ def build_report(
             extra = (
                 f" value={r.get('value')} step={r.get('step')}"
                 f" halt={r.get('halt')}"
+            )
+        if name == "deploy.gate":
+            extra = (
+                f" stage={r.get('stage')} decision={r.get('decision')}"
+                f" reason={r.get('reason')}"
+            )
+        if name.startswith("slo."):
+            extra = (
+                f" slo={r.get('slo')} burn_fast={r.get('burn_fast')}"
+                f" burn_slow={r.get('burn_slow')}"
+            )
+        if name == "compile.window":
+            extra = (
+                f" program={r.get('program')} "
+                f"seconds={_fmt_num(r.get('seconds'))}"
             )
         lines.append(
             f"  {_fmt_ts(r.get('ts'), t0)}  "
@@ -229,6 +310,68 @@ def build_report(
     else:
         lines.append("  (no health events — clean run)")
 
+    # -- serving (micro-batcher + request-path events) ----------------
+    lines.append("")
+    lines.append("Serving:")
+    flushes = [r for r in ev if r.get("event") == "serve.batch_flush"]
+    berrors = [r for r in ev if r.get("event") == "serve.batch_error"]
+    if flushes or berrors:
+        rows = sum(int(r.get("rows") or 0) for r in flushes)
+        reqs = sum(int(r.get("requests") or 0) for r in flushes)
+        lines.append(
+            f"  batch flushes: {len(flushes)} "
+            f"({reqs} requests merged into {rows} rows"
+            + (
+                f", {reqs / len(flushes):.1f} req/flush"
+                if flushes else ""
+            )
+            + f"); flush errors: {len(berrors)}"
+        )
+    else:
+        lines.append(
+            "  (no serve.* events — traffic untraced or none served; "
+            "serving telemetry is opt-in via DCT_SERVE_TRACE)"
+        )
+
+    # -- deploy gates / SLO -------------------------------------------
+    lines.append("")
+    lines.append("Gates & SLO:")
+    gates = [r for r in ev if r.get("event") == "deploy.gate"]
+    slo_ev = [
+        r for r in ev
+        if str(r.get("event", "")).startswith("slo.")
+    ]
+    for r in gates:
+        lines.append(
+            f"  gate {r.get('stage')}: {r.get('decision')} "
+            f"({r.get('reason')})"
+        )
+    for r in slo_ev:
+        lines.append(
+            f"  {r['event']}: {r.get('slo')} "
+            f"burn fast={_fmt_num(r.get('burn_fast'))} "
+            f"slow={_fmt_num(r.get('burn_slow'))}"
+        )
+    if not gates and not slo_ev:
+        lines.append("  (no deploy.gate or slo.* events)")
+
+    # -- compile accounting -------------------------------------------
+    lines.append("")
+    lines.append("Compile windows (family/config-hash/mesh):")
+    compiles = [r for r in ev if r.get("event") == "compile.window"]
+    if compiles:
+        for r in compiles:
+            lines.append(
+                f"  {r.get('program')}: {_fmt_num(r.get('seconds'))}s "
+                f"x{r.get('count')} "
+                f"[{r.get('family')}/{r.get('config_hash')}/"
+                f"{r.get('mesh')}]"
+            )
+        total = sum(float(r.get("seconds") or 0.0) for r in compiles)
+        lines.append(f"  total compile: {total:.4f}s")
+    else:
+        lines.append("  (no compile.window events)")
+
     # -- spans / trace -------------------------------------------------
     lines.append("")
     lines.append("Spans by component:")
@@ -242,6 +385,7 @@ def build_report(
             lines.append(f"  {comp:12s} {by_comp[comp]}")
     else:
         lines.append("  (none found)")
+    lines.extend(_bench_mfu_lines(bench))
     if trace_path:
         lines.append("")
         lines.append(f"Perfetto trace written: {trace_path}")
@@ -299,7 +443,10 @@ def main(argv: list[str] | None = None) -> int:
         trace_path, spans = export_run(
             args.run_dir, out_path=args.out, trace_id=run_id
         )
-    print(build_report(events, heartbeats, spans, run_id, trace_path))
+    print(build_report(
+        events, heartbeats, spans, run_id, trace_path,
+        bench=load_bench_record(args.run_dir),
+    ))
     return 0
 
 

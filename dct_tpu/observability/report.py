@@ -1,0 +1,229 @@
+"""Bench-trajectory regression sentinel.
+
+The repo checks in one ``BENCH_r0N.json`` per growth round — a
+trajectory nobody was watching: r05's scaled MFU went stale on a dead
+relay and the stdout record overflowed to ``parsed: null`` without any
+tooling noticing. This CLI reads the trajectory and FLAGS it::
+
+    python -m dct_tpu.observability.report BENCH_r0*.json
+    python -m dct_tpu.observability.report            # globs ./BENCH_r*.json
+
+Per round it extracts the comparable series (headline samples/s/chip,
+trainer-loop throughput, serving single-row p50, serving-load saturated
+qps), then compares CONSECUTIVE comparable rounds:
+
+- a throughput metric dropping more than ``--threshold`` (default 10%)
+  is a REGRESSION finding;
+- a latency metric rising more than ``--latency-threshold`` (default
+  25%) likewise;
+- a round whose record is unparsable (``parsed: null`` — the stdout
+  tail overflowed) or whose headline metric NAME changed is reported
+  and excluded from deltas (comparing different metrics is noise, not
+  signal).
+
+Exit code 0 by default (the sentinel reports; CI decides) — ``--strict``
+exits 1 when any regression is flagged. Read-only over the records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+#: (label, path into parsed record, direction) — direction "up" means
+#: bigger is better (drops regress), "down" means smaller is better
+#: (rises regress).
+SERIES = (
+    ("headline", ("value",), "up"),
+    ("trainer_loop", ("trainer_loop_samples_per_sec_per_chip",), "up"),
+    ("serving_p50_ms", ("serving", "single_row", "numpy_p50_ms"), "down"),
+    ("serving_load_qps", ("serving_load", "saturated_qps"), "up"),
+)
+
+
+def _dig(rec: dict, path: tuple):
+    cur = rec
+    for k in path:
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(k)
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def load_round(path: str) -> dict:
+    """One record -> {name, parsable, metric, series: {label: value}}."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"name": name, "parsable": False, "error": str(e)}
+    parsed = rec.get("parsed") if isinstance(rec, dict) else None
+    if not isinstance(parsed, dict):
+        return {
+            "name": name, "parsable": False,
+            "error": "parsed: null (stdout record overflowed the "
+                     "driver tail)",
+        }
+    out = {
+        "name": name,
+        "parsable": True,
+        "metric": parsed.get("metric"),
+        "series": {},
+    }
+    for label, path_keys, _direction in SERIES:
+        v = _dig(parsed, path_keys)
+        if v is not None:
+            out["series"][label] = float(v)
+    if parsed.get("scaled_mfu_stale"):
+        out["mfu_stale_reason"] = parsed.get("scaled_mfu_stale_reason")
+    return out
+
+
+def compare_rounds(
+    rounds: list[dict],
+    *,
+    threshold: float = 0.10,
+    latency_threshold: float = 0.25,
+) -> list[dict]:
+    """Consecutive-round deltas -> regression findings."""
+    findings: list[dict] = []
+    prev = None
+    for rnd in rounds:
+        if not rnd.get("parsable"):
+            findings.append({
+                "kind": "unparsable", "round": rnd["name"],
+                "detail": rnd.get("error", ""),
+            })
+            continue
+        if prev is not None:
+            for label, _path, direction in SERIES:
+                a = prev["series"].get(label)
+                b = rnd["series"].get(label)
+                if a is None or b is None or a <= 0:
+                    continue
+                if label == "headline" and (
+                    prev.get("metric") != rnd.get("metric")
+                ):
+                    # The headline metric was redefined between rounds:
+                    # the numbers are not comparable.
+                    continue
+                if direction == "up":
+                    drop = (a - b) / a
+                    if drop > threshold:
+                        findings.append({
+                            "kind": "regression", "round": rnd["name"],
+                            "series": label, "prev": a, "cur": b,
+                            "delta_pct": round(-100.0 * drop, 1),
+                            "vs": prev["name"],
+                        })
+                else:
+                    rise = (b - a) / a
+                    if rise > latency_threshold:
+                        findings.append({
+                            "kind": "regression", "round": rnd["name"],
+                            "series": label, "prev": a, "cur": b,
+                            "delta_pct": round(100.0 * rise, 1),
+                            "vs": prev["name"],
+                        })
+        if "mfu_stale_reason" in rnd:
+            findings.append({
+                "kind": "mfu_stale", "round": rnd["name"],
+                "detail": rnd.get("mfu_stale_reason") or "",
+            })
+        prev = rnd
+    return findings
+
+
+def render_report(rounds: list[dict], findings: list[dict]) -> str:
+    lines = ["=" * 72, "dct_tpu bench trajectory", "=" * 72]
+    labels = [label for label, _p, _d in SERIES]
+    header = f"{'round':18s}" + "".join(f"{h:>18s}" for h in labels)
+    lines.append(header)
+    for rnd in rounds:
+        if not rnd.get("parsable"):
+            lines.append(f"{rnd['name']:18s}{'(unparsable)':>18s}")
+            continue
+        row = f"{rnd['name']:18s}"
+        for label in labels:
+            v = rnd["series"].get(label)
+            row += f"{v:>18.4g}" if v is not None else f"{'-':>18s}"
+        lines.append(row)
+    lines.append("")
+    if findings:
+        lines.append(f"Findings ({len(findings)}):")
+        for f in findings:
+            if f["kind"] == "regression":
+                lines.append(
+                    f"  REGRESSION {f['round']} {f['series']}: "
+                    f"{f['prev']:.4g} -> {f['cur']:.4g} "
+                    f"({f['delta_pct']:+.1f}% vs {f['vs']})"
+                )
+            elif f["kind"] == "unparsable":
+                lines.append(
+                    f"  UNPARSABLE {f['round']}: {f['detail']}"
+                )
+            else:
+                lines.append(
+                    f"  MFU-STALE  {f['round']}: {f['detail']}"
+                )
+    else:
+        lines.append("Findings: none — trajectory holds.")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dct_tpu.observability.report",
+        description=(
+            "Regression sentinel over the checked-in BENCH_r*.json "
+            "trajectory: flags throughput drops, latency rises, "
+            "unparsable records and stale MFU between rounds."
+        ),
+    )
+    parser.add_argument(
+        "records", nargs="*",
+        help="bench record paths (default: ./BENCH_r*.json, sorted)",
+    )
+    parser.add_argument(
+        "--threshold", type=float, default=0.10,
+        help="throughput drop fraction that flags (default 0.10)",
+    )
+    parser.add_argument(
+        "--latency-threshold", type=float, default=0.25,
+        help="latency rise fraction that flags (default 0.25)",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="exit 1 when any regression is flagged (CI gate mode)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable output",
+    )
+    args = parser.parse_args(argv)
+    paths = args.records or sorted(glob.glob("BENCH_r*.json"))
+    if not paths:
+        print("error: no bench records found", file=sys.stderr)
+        return 2
+    rounds = [load_round(p) for p in sorted(paths)]
+    findings = compare_rounds(
+        rounds,
+        threshold=args.threshold,
+        latency_threshold=args.latency_threshold,
+    )
+    if args.as_json:
+        print(json.dumps(
+            {"rounds": rounds, "findings": findings}, indent=2
+        ))
+    else:
+        print(render_report(rounds, findings))
+    regressions = [f for f in findings if f["kind"] == "regression"]
+    return 1 if (args.strict and regressions) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,351 @@
+"""SLO monitoring over the aggregated metrics plane: declarative
+objectives, multi-window burn rates, ``slo.alert`` events and
+``dct_slo_*`` gauges.
+
+A raw error counter tells an operator something broke; an SLO burn rate
+tells them how fast the error budget is being spent and whether to act
+now. The monitor evaluates declarative specs against the FLEET view
+(:class:`~dct_tpu.observability.aggregate.MergedMetrics` — one process
+alone would alert on 1/N of the truth) at every scrape:
+
+Spec grammar (``DCT_SLO_SPEC``; semicolon-separated clauses, each
+optionally prefixed ``name=``):
+
+- ``availability:<objective>`` — server-fault error ratio over
+  ``dct_request_errors_total / dct_requests_total``; objective is the
+  success target (``0.999`` tolerates a 0.1% error budget).
+- ``latency:<seconds>@<objective>`` — the fraction of requests slower
+  than ``<seconds>`` (from the ``dct_request_latency_seconds`` bucket
+  deltas) must stay under ``1 - objective``.
+- ``goodput:<min_fraction>`` — the training fleet's worst
+  ``dct_train_goodput_fraction`` gauge must stay at or above the floor.
+- ``freshness:<max_age_s>`` — seconds since the cycle's last successful
+  deploy (``full_rollout`` / ``deploy_new_slot`` on the event log) must
+  stay under the budget: the continuous-training promise, measured.
+
+Burn rate = (observed bad fraction) / (budgeted bad fraction); 1.0
+means spending the budget exactly at the rate that exhausts it at the
+objective horizon. Counter-backed specs evaluate over TWO windows
+(``fast``/``slow``, the Google SRE multi-window pattern): the fast
+window catches a cliff quickly, the slow window keeps one burst from
+paging, and an alert fires only when BOTH burn above the threshold.
+Gauge-backed specs (goodput, freshness) are instantaneous — their two
+windows report the same value.
+
+Alerts are edge-triggered: one ``slo.alert`` event on the transition
+into burning (and one ``slo.resolved`` on the way out), while the
+``dct_slo_alert_active`` gauge stays level-triggered for scrapers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from dct_tpu.observability.prometheus import MetricFamily
+
+#: Events on the run log that mark a successful deploy (freshness spec).
+DEPLOY_EVENTS = ("full_rollout", "deploy_new_slot")
+
+KINDS = ("availability", "latency", "goodput", "freshness")
+
+DEFAULT_SPEC = "availability:0.999;latency:0.5@0.95"
+
+
+class SLOSpecError(ValueError):
+    """A malformed ``DCT_SLO_SPEC`` clause (mis-speced monitoring is
+    worse than none: it must fail loudly at parse time, not quietly
+    at alert time)."""
+
+
+@dataclass
+class SLOSpec:
+    name: str
+    kind: str  # availability | latency | goodput | freshness
+    objective: float  # success target (availability/latency), floor
+    #                   (goodput); unused for freshness
+    threshold: float = 0.0  # latency seconds | freshness max-age seconds
+
+    @property
+    def budget(self) -> float:
+        """The tolerated bad fraction."""
+        return max(1e-9, 1.0 - self.objective)
+
+
+def parse_slo_spec(spec: str) -> list[SLOSpec]:
+    """``DCT_SLO_SPEC`` grammar -> specs (module docstring)."""
+    out: list[SLOSpec] = []
+    for clause in (spec or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        name = None
+        if "=" in clause.split(":", 1)[0]:
+            name, clause = clause.split("=", 1)
+            name = name.strip()
+        if ":" not in clause:
+            raise SLOSpecError(
+                f"SLO clause {clause!r} must be kind:params"
+            )
+        kind, params = (p.strip() for p in clause.split(":", 1))
+        if kind not in KINDS:
+            raise SLOSpecError(
+                f"unknown SLO kind {kind!r}; known: {KINDS}"
+            )
+        try:
+            if kind == "availability":
+                sp = SLOSpec(name or kind, kind, float(params))
+            elif kind == "latency":
+                if "@" not in params:
+                    raise ValueError("latency needs <seconds>@<objective>")
+                secs, obj = params.split("@", 1)
+                sp = SLOSpec(name or kind, kind, float(obj),
+                             threshold=float(secs))
+            elif kind == "goodput":
+                sp = SLOSpec(name or kind, kind, float(params))
+            else:  # freshness
+                sp = SLOSpec(name or kind, kind, 0.0,
+                             threshold=float(params))
+        except ValueError as e:
+            raise SLOSpecError(
+                f"SLO clause {clause!r}: {e}"
+            ) from e
+        if kind != "freshness" and not 0.0 < sp.objective < 1.0:
+            raise SLOSpecError(
+                f"SLO clause {clause!r}: objective must be in (0, 1)"
+            )
+        if kind in ("latency", "freshness") and sp.threshold <= 0:
+            raise SLOSpecError(
+                f"SLO clause {clause!r}: threshold must be positive"
+            )
+        out.append(sp)
+    return out
+
+
+# ----------------------------------------------------------------------
+# freshness source: the run's event log
+
+
+_deploy_ts_cache: dict[str, tuple[tuple, float | None]] = {}
+
+
+def last_deploy_ts(events_path: str | None) -> float | None:
+    """Newest successful-deploy timestamp on the event log (cached by
+    file identity — scrapes must not re-read a long log every time)."""
+    if not events_path:
+        return None
+    try:
+        st = os.stat(events_path)
+    except OSError:
+        return None
+    key = (st.st_mtime_ns, st.st_size)
+    cached = _deploy_ts_cache.get(events_path)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    latest: float | None = None
+    try:
+        with open(events_path) as f:
+            for line in f:
+                # Cheap pre-filter before the JSON parse.
+                if not any(e in line for e in DEPLOY_EVENTS):
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") in DEPLOY_EVENTS:
+                    ts = rec.get("ts")
+                    if isinstance(ts, (int, float)):
+                        latest = max(latest or ts, ts)
+    except OSError:
+        return None
+    _deploy_ts_cache[events_path] = (key, latest)
+    return latest
+
+
+# ----------------------------------------------------------------------
+# monitor
+
+
+def _latency_over_threshold(hist: dict, threshold: float) -> tuple:
+    """(total_count, over_threshold_count) from a cumulative-bucket
+    histogram dict. Only requests PROVABLY within the threshold count
+    as under: the largest bucket boundary <= threshold stands in
+    (conservative — a threshold between boundaries over-reports
+    violations, never under-reports them; picking the boundary ABOVE
+    would count a 0.4 s request as meeting a 0.3 s SLO)."""
+    buckets = hist.get("buckets") or []
+    counts = hist.get("counts") or []
+    total = int(hist.get("count", 0))
+    under = 0  # threshold below every boundary: nothing provably under
+    for le, c in zip(buckets, counts):
+        if le > threshold:
+            break
+        under = int(c)
+    return total, max(0, total - under)
+
+
+@dataclass
+class _SpecState:
+    history: deque = field(default_factory=lambda: deque(maxlen=4096))
+    alerting: bool = False
+
+
+class SLOMonitor:
+    """Evaluates specs against each scrape's merged view; holds the
+    windowed history per spec. One instance per serving process —
+    state is process-local but the INPUT is the fleet view, so every
+    process converges on the same verdict within a scrape interval."""
+
+    def __init__(
+        self,
+        specs: list[SLOSpec],
+        *,
+        fast_window_s: float = 300.0,
+        slow_window_s: float = 3600.0,
+        burn_threshold: float = 1.0,
+        clock=time.time,
+        emit=None,
+        events_path: str | None = None,
+    ):
+        self.specs = list(specs)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_threshold = float(burn_threshold)
+        self._clock = clock
+        self._emit = emit
+        self.events_path = events_path
+        self._state = {sp.name: _SpecState() for sp in self.specs}
+
+    # -- per-kind observation -----------------------------------------
+    def _observe_point(self, sp: SLOSpec, merged, now: float):
+        """-> (cumulative-or-instant observation, is_cumulative)."""
+        if sp.kind == "availability":
+            total = merged.total("dct_requests_total")
+            errors = merged.total("dct_request_errors_total") or 0.0
+            if total is None:
+                return None, True
+            return (now, float(total), float(errors)), True
+        if sp.kind == "latency":
+            hist = merged.histogram_total("dct_request_latency_seconds")
+            if hist is None:
+                return None, True
+            total, over = _latency_over_threshold(hist, sp.threshold)
+            return (now, float(total), float(over)), True
+        if sp.kind == "goodput":
+            m = merged.metrics.get("dct_train_goodput_fraction")
+            if not m or not m["totals"]:
+                return None, False
+            worst = min(float(v) for v in m["totals"].values())
+            burn = (1.0 - worst) / sp.budget
+            return (now, worst, burn), False
+        # freshness
+        ts = last_deploy_ts(self.events_path)
+        if ts is None:
+            return None, False
+        age = max(0.0, now - ts)
+        return (now, age, age / sp.threshold), False
+
+    @staticmethod
+    def _window_burn(history, now: float, window_s: float,
+                     budget: float) -> float:
+        """Burn over the trailing window from cumulative observations:
+        (bad delta / total delta) / budget. With only one observation
+        the window is empty — burn 0 (no evidence is not an alert)."""
+        if len(history) < 2:
+            return 0.0
+        cur = history[-1]
+        oldest = None
+        for obs in history:
+            if obs[0] >= now - window_s:
+                oldest = obs
+                break
+        if oldest is None or oldest is cur:
+            oldest = history[-2]
+        d_total = cur[1] - oldest[1]
+        d_bad = cur[2] - oldest[2]
+        if d_total <= 0:
+            return 0.0
+        return max(0.0, d_bad / d_total) / budget
+
+    # -- the scrape-time entry point -----------------------------------
+    def evaluate(self, merged, *, now: float | None = None) -> list[dict]:
+        """One evaluation pass: update histories, compute burn rates,
+        emit edge-triggered ``slo.alert`` / ``slo.resolved`` events.
+        Returns one state dict per spec."""
+        now = self._clock() if now is None else now
+        out = []
+        for sp in self.specs:
+            st = self._state[sp.name]
+            point, cumulative = self._observe_point(sp, merged, now)
+            if point is not None:
+                st.history.append(point)
+            if not st.history:
+                out.append({
+                    "slo": sp.name, "kind": sp.kind, "data": False,
+                    "burn_fast": 0.0, "burn_slow": 0.0, "alerting": False,
+                })
+                continue
+            if cumulative:
+                burn_fast = self._window_burn(
+                    st.history, now, self.fast_window_s, sp.budget
+                )
+                burn_slow = self._window_burn(
+                    st.history, now, self.slow_window_s, sp.budget
+                )
+            else:
+                burn_fast = burn_slow = float(st.history[-1][2])
+            alerting = (
+                burn_fast >= self.burn_threshold
+                and burn_slow >= self.burn_threshold
+            )
+            rec = {
+                "slo": sp.name, "kind": sp.kind, "data": True,
+                "burn_fast": round(burn_fast, 6),
+                "burn_slow": round(burn_slow, 6),
+                "alerting": alerting,
+            }
+            if alerting and not st.alerting and self._emit is not None:
+                self._emit(
+                    "slo", "slo.alert",
+                    slo=sp.name, kind=sp.kind,
+                    burn_fast=rec["burn_fast"], burn_slow=rec["burn_slow"],
+                    objective=sp.objective, threshold=sp.threshold,
+                    burn_threshold=self.burn_threshold,
+                    fast_window_s=self.fast_window_s,
+                    slow_window_s=self.slow_window_s,
+                )
+            elif st.alerting and not alerting and self._emit is not None:
+                self._emit(
+                    "slo", "slo.resolved",
+                    slo=sp.name, kind=sp.kind,
+                    burn_fast=rec["burn_fast"], burn_slow=rec["burn_slow"],
+                )
+            st.alerting = alerting
+            out.append(rec)
+        return out
+
+    def families(self, states: list[dict]) -> list[MetricFamily]:
+        """The ``dct_slo_*`` gauges for the scrape body."""
+        burn = MetricFamily(
+            "dct_slo_burn_rate", "gauge",
+            "Error-budget burn rate per SLO and window "
+            "(1.0 = spending the budget exactly at objective rate).",
+        )
+        active = MetricFamily(
+            "dct_slo_alert_active", "gauge",
+            "1 while the SLO burns above threshold on both windows.",
+        )
+        for rec in states:
+            burn.add(rec["burn_fast"], {"slo": rec["slo"], "window": "fast"})
+            burn.add(rec["burn_slow"], {"slo": rec["slo"], "window": "slow"})
+            active.add(1 if rec["alerting"] else 0, {"slo": rec["slo"]})
+        return [burn, active]
+
+    def render(self, merged, *, now: float | None = None) -> str:
+        """Evaluate + render in one call (the scrape handler's path)."""
+        states = self.evaluate(merged, now=now)
+        return "\n".join(f.render() for f in self.families(states)) + "\n"

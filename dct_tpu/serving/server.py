@@ -129,14 +129,34 @@ class _JsonHandler(BaseHTTPRequestHandler):
         plus the promotion-gate counters (``dct_deploy_gate_decisions_
         total`` / ``dct_drift_psi``) when a gate ledger exists — the
         gate runs in DAG task processes, so the long-lived serving
-        process is the natural scrape surface for its decisions."""
+        process is the natural scrape surface for its decisions.
+
+        With the metrics plane armed (``DCT_METRICS_DIR``), the scrape
+        is FLEET-WIDE: this process publishes its own snapshot, merges
+        every live sibling snapshot (pool workers, trainer coordinator,
+        supervisor — docs/OBSERVABILITY.md "Metrics plane"), renders
+        totals plus per-process ``proc``-labelled series, and runs the
+        SLO monitor over the aggregated view (``dct_slo_*`` gauges;
+        burn-rate transitions emit ``slo.alert`` events)."""
         from dct_tpu.evaluation.gates import render_gate_metrics
         from dct_tpu.observability.prometheus import CONTENT_TYPE
 
-        body = (
-            self.server.slot_metrics.prometheus_text()
-            + render_gate_metrics()
-        ).encode()
+        publisher = getattr(self.server, "metrics_publisher", None)
+        if publisher is None:
+            text = self.server.slot_metrics.prometheus_text()
+        else:
+            from dct_tpu.observability import aggregate
+
+            publisher.publish()
+            text, merged = aggregate.aggregate_text(
+                publisher.directory,
+                stale_s=getattr(self.server, "metrics_stale_s",
+                                aggregate.DEFAULT_STALE_S),
+            )
+            monitor = getattr(self.server, "slo_monitor", None)
+            if monitor is not None:
+                text += monitor.render(merged)
+        body = (text + render_gate_metrics()).encode()
         self.send_response(200)
         self.send_header("Content-Type", CONTENT_TYPE)
         self.send_header("Content-Length", str(len(body)))
@@ -288,6 +308,12 @@ class _BatchedHTTPServer(ThreadingHTTPServer):
         batcher = getattr(self, "batcher", None)
         if batcher is not None:
             batcher.close()
+        publisher = getattr(self, "metrics_publisher", None)
+        if publisher is not None:
+            # A cleanly-closed server leaves the fleet: retire its
+            # snapshot so the still-alive pid does not keep yesterday's
+            # counts in every later scrape of the same metrics dir.
+            publisher.close()
 
 
 class _ReusePortHTTPServer(_BatchedHTTPServer):
@@ -424,12 +450,72 @@ class ServerPool:
         self.close()
 
 
+def _emit_default(component: str, event: str, **fields) -> None:
+    """Late-bound emit through the process-default event log (the SLO
+    monitor's alert sink; resolved per call so env-built sinks and
+    monkeypatched tests both see their own log)."""
+    from dct_tpu.observability import events as _events
+
+    _events.get_default().emit(component, event, **fields)
+
+
+def _arm_metrics_plane(server) -> None:
+    """Attach the cross-process metrics plane to a freshly-built server
+    when ``DCT_METRICS_DIR`` is configured: a snapshot publisher over
+    the slot metrics' registry (throttled on the request path, timer-
+    refreshed when idle) and the SLO monitor evaluated at scrape time.
+    A malformed ``DCT_SLO_SPEC`` disables SLO monitoring loudly
+    (stderr) instead of killing the serving process."""
+    from dct_tpu.config import ObservabilityConfig
+
+    obs = ObservabilityConfig.from_env()
+    if not obs.metrics_dir:
+        return
+    from dct_tpu.observability.aggregate import SnapshotPublisher
+    from dct_tpu.observability.slo import (
+        SLOMonitor,
+        SLOSpecError,
+        parse_slo_spec,
+    )
+
+    server.metrics_publisher = SnapshotPublisher(
+        server.slot_metrics.registry,
+        obs.metrics_dir,
+        proc=f"serve-{os.getpid()}",
+        interval_s=obs.metrics_publish_s,
+    )
+    server.slot_metrics.publisher = server.metrics_publisher
+    server.metrics_stale_s = obs.metrics_stale_s
+    try:
+        specs = parse_slo_spec(obs.slo_spec)
+    except SLOSpecError as e:
+        import sys as _sys
+
+        print(f"[serving] DCT_SLO_SPEC disabled: {e}",
+              file=_sys.stderr, flush=True)
+        return
+    if specs:
+        server.slo_monitor = SLOMonitor(
+            specs,
+            fast_window_s=obs.slo_fast_window_s,
+            slow_window_s=obs.slo_slow_window_s,
+            burn_threshold=obs.slo_burn_threshold,
+            emit=_emit_default,
+            events_path=(
+                os.path.join(obs.events_dir, "events.jsonl")
+                if obs.enabled and obs.events_dir else None
+            ),
+        )
+
+
 def _new_score_server(handler_cls, host: str, port: int, serving=None,
                       reuse_port: bool = False):
     """Shared construction for both server modes: metrics, the
-    micro-batcher (wired to the metrics' batch/queue histograms), and
-    the fast-parse flag, all from :class:`ServingConfig` (env-driven
-    unless an explicit config is passed)."""
+    micro-batcher (wired to the metrics' batch/queue histograms), the
+    fast-parse flag, and the metrics plane (snapshot publisher + SLO
+    monitor) when ``DCT_METRICS_DIR`` arms it — all from
+    :class:`ServingConfig` / :class:`ObservabilityConfig` (env-driven
+    unless an explicit serving config is passed)."""
     if serving is None:
         from dct_tpu.config import ServingConfig
 
@@ -445,6 +531,7 @@ def _new_score_server(handler_cls, host: str, port: int, serving=None,
         metrics=server.slot_metrics,
     )
     server.fast_parse = serving.fast_parse
+    _arm_metrics_plane(server)
     return server
 
 
@@ -536,28 +623,74 @@ _SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
 class _SlotMetrics:
     """Thread-safe per-slot request metrics: what an operator watches
     during a canary (the Azure endpoint surfaces the same per-deployment
-    request/latency series). Bounded memory: a sliding window of the
-    last 1024 latencies per slot — p50/p99 reflect recent traffic, not
-    all-time history — plus an all-time cumulative latency histogram in
-    Prometheus bucket layout for ``GET /metrics`` (fixed size: bucket
-    counters only, no samples retained).
+    request/latency series).
+
+    Since ISSUE 8 the state lives in a
+    :class:`dct_tpu.observability.metrics.MetricsRegistry` — the common
+    shape the cross-process metrics plane publishes and merges — instead
+    of an ad-hoc dict-of-dicts; the surface (``record`` /
+    ``observe_batch`` / ``snapshot`` / ``prometheus_text``) and the
+    metric names are unchanged. A sliding window of the last 1024
+    latencies per slot rides alongside for the ``/healthz`` p50/p99
+    snapshot (recent traffic, not all-time history); the cumulative
+    registry histogram feeds ``GET /metrics``.
 
     The micro-batcher feeds three server-wide histograms through
     :meth:`observe_batch` — flushed batch rows, requests merged per
     flush, and the queue depth left behind — the saturation evidence an
     operator reads off ``/metrics`` (batch size hugging 1 = idle; rows
-    pinned at the cap with queue depth climbing = past the knee)."""
+    pinned at the cap with queue depth climbing = past the knee).
+
+    When a :class:`~dct_tpu.observability.aggregate.SnapshotPublisher`
+    is attached, every ``record`` offers it a throttled publish (one
+    clock read inside the throttle window — hot-path safe)."""
 
     def __init__(self):
         import threading
 
-        from dct_tpu.observability.prometheus import HistogramAccumulator
+        from dct_tpu.observability.metrics import MetricsRegistry
 
         self._lock = threading.Lock()
         self._by_slot: dict = {}
-        self._batch_rows = HistogramAccumulator(_SIZE_BUCKETS)
-        self._batch_requests = HistogramAccumulator(_SIZE_BUCKETS)
-        self._queue_depth = HistogramAccumulator(_SIZE_BUCKETS)
+        self.publisher = None
+        self.registry = MetricsRegistry()
+        self._req = self.registry.counter(
+            "dct_requests_total",
+            "Scoring requests served, by deployment slot.",
+        )
+        self._err = self.registry.counter(
+            "dct_request_errors_total",
+            "Server-fault scoring errors, by deployment slot "
+            "(client 4xx never counts against a slot).",
+        )
+        self._lat = self.registry.histogram(
+            "dct_request_latency_seconds",
+            "End-to-end scoring latency, by deployment slot.",
+        )
+        hist = self.registry.histogram
+        self._batch_rows_h = hist(
+            "dct_serve_batch_rows",
+            "Rows scored per micro-batch flush (server-wide).",
+            buckets=_SIZE_BUCKETS,
+        )
+        self._batch_requests_h = hist(
+            "dct_serve_batch_requests",
+            "Logical requests merged per micro-batch flush.",
+            buckets=_SIZE_BUCKETS,
+        )
+        self._queue_depth_h = hist(
+            "dct_serve_queue_depth",
+            "Rows still queued behind each flush (saturation signal).",
+            buckets=_SIZE_BUCKETS,
+        )
+        # READ handles (tests/diagnostics); all mutation goes through
+        # the Histogram objects above so it serializes under the
+        # registry lock with snapshot()/render() — an accumulator
+        # mutated under a different lock could be snapshotted torn
+        # (non-monotone cumulative counts mid-increment).
+        self._batch_rows = self._batch_rows_h.accumulator()
+        self._batch_requests = self._batch_requests_h.accumulator()
+        self._queue_depth = self._queue_depth_h.accumulator()
 
     def observe_batch(
         self, rows: int, requests: int, queue_depth: int
@@ -565,32 +698,41 @@ class _SlotMetrics:
         """One micro-batch flush: ``rows`` scored as one dispatch for
         ``requests`` logical requests, ``queue_depth`` rows still
         queued behind it."""
-        with self._lock:
-            self._batch_rows.observe(rows)
-            self._batch_requests.observe(requests)
-            self._queue_depth.observe(queue_depth)
+        self._batch_rows_h.observe(rows)
+        self._batch_requests_h.observe(requests)
+        self._queue_depth_h.observe(queue_depth)
+        if self.publisher is not None:
+            # Flushes mutate plane-visible histograms too — offer the
+            # throttled publish here as well, so a batcher-heavy but
+            # record-light window (mirror traffic) still stays fresh.
+            try:
+                self.publisher.maybe_publish()
+            except Exception:  # noqa: BLE001 — telemetry never fails a flush
+                pass
 
     def record(self, slot: str, seconds: float, ok: bool) -> None:
-        from dct_tpu.observability.prometheus import HistogramAccumulator
-
+        labels = {"slot": slot}
         with self._lock:
             m = self._by_slot.setdefault(
-                slot,
-                {
-                    "requests": 0,
-                    "errors": 0,
-                    "lat": [],
-                    "hist": HistogramAccumulator(),
-                },
+                slot, {"requests": 0, "errors": 0, "lat": []}
             )
             m["requests"] += 1
             if not ok:
                 m["errors"] += 1
-            m["hist"].observe(seconds)
             lat = m["lat"]
             lat.append(seconds)
             if len(lat) > 1024:
                 del lat[: len(lat) - 1024]
+        self._req.inc(1.0, labels)
+        # inc(0) materializes the slot's error series at 0, so a clean
+        # slot still renders an explicit zero (rate() needs the sample).
+        self._err.inc(0.0 if ok else 1.0, labels)
+        self._lat.observe(seconds, labels)
+        if self.publisher is not None:
+            try:
+                self.publisher.maybe_publish()
+            except Exception:  # noqa: BLE001 — telemetry never fails serving
+                pass
 
     def snapshot(self) -> dict:
         import statistics
@@ -612,59 +754,10 @@ class _SlotMetrics:
             return out
 
     def prometheus_text(self) -> str:
-        """Text exposition (0.0.4) of every slot's series. Histogram
-        state is deep-copied under the lock; rendering happens outside
-        it so a slow scrape never blocks request recording."""
-        import copy
-
-        from dct_tpu.observability.prometheus import MetricFamily, render
-
-        with self._lock:
-            slots = {
-                slot: {
-                    "requests": m["requests"],
-                    "errors": m["errors"],
-                    "hist": copy.deepcopy(m["hist"]),
-                }
-                for slot, m in self._by_slot.items()
-            }
-            batch_hists = (
-                copy.deepcopy(self._batch_rows),
-                copy.deepcopy(self._batch_requests),
-                copy.deepcopy(self._queue_depth),
-            )
-        req = MetricFamily(
-            "dct_requests_total", "counter",
-            "Scoring requests served, by deployment slot.",
-        )
-        err = MetricFamily(
-            "dct_request_errors_total", "counter",
-            "Server-fault scoring errors, by deployment slot "
-            "(client 4xx never counts against a slot).",
-        )
-        lat = MetricFamily(
-            "dct_request_latency_seconds", "histogram",
-            "End-to-end scoring latency, by deployment slot.",
-        )
-        for slot in sorted(slots):
-            m = slots[slot]
-            req.add(m["requests"], {"slot": slot})
-            err.add(m["errors"], {"slot": slot})
-            m["hist"].samples_into(lat, {"slot": slot})
-        families = [req, err, lat]
-        batch_meta = (
-            ("dct_serve_batch_rows",
-             "Rows scored per micro-batch flush (server-wide)."),
-            ("dct_serve_batch_requests",
-             "Logical requests merged per micro-batch flush."),
-            ("dct_serve_queue_depth",
-             "Rows still queued behind each flush (saturation signal)."),
-        )
-        for hist, (name, help_text) in zip(batch_hists, batch_meta):
-            fam = MetricFamily(name, "histogram", help_text)
-            hist.samples_into(fam, None)
-            families.append(fam)
-        return render(families)
+        """Text exposition (0.0.4) of this process's series (the
+        metrics plane's aggregated body is built in ``_reply_metrics``
+        from the published snapshots instead)."""
+        return self.registry.render()
 
 
 class EndpointScoreHandler(_JsonHandler):

@@ -1422,6 +1422,29 @@ class Trainer:
         goodput_summary = ledger.summary()
         self.tracker.log_metrics(ledger.tracker_metrics(), step=global_step)
         events.emit("trainer", "goodput_summary", **goodput_summary)
+        # Compile/restart accounting (ROADMAP item 5's baseline): the
+        # ledger's compile windows become compile.window events keyed by
+        # the (family, config-hash, mesh) identity an AOT compilation
+        # cache would use, and dct_compile_* series in the prom dump —
+        # re-compiles of the SAME identity across restarts/workers are
+        # the debt a persistent cache would erase.
+        import dataclasses as _dataclasses
+
+        from dct_tpu.observability.goodput import (
+            compile_report,
+            config_hash,
+            mesh_descriptor,
+        )
+
+        compile_windows = compile_report(
+            ledger.compile_windows,
+            family=cfg.model.name,
+            config_hash=config_hash(_dataclasses.asdict(cfg.model)),
+            mesh=mesh_descriptor(self.mesh),
+        )
+        if self.coordinator:
+            for w in compile_windows:
+                events.emit("compile", "compile.window", **w)
         # An explicit DCT_METRICS_PROM must work even with the event log
         # disabled (textfile-collector-only rigs clear DCT_EVENTS_DIR).
         if self.coordinator and cfg.obs.enabled and (
@@ -1444,6 +1467,12 @@ class Trainer:
                     "faults_injected": plan.fired_count,
                     "startup_debt_s": cfg.resilience.startup_debt_s,
                 },
+                compile_windows=compile_windows,
+                # Metrics plane: leave a final snapshot so a /metrics
+                # scrape of the serving pool reports this run's goodput
+                # and compile debt next to the request series.
+                metrics_dir=cfg.obs.metrics_dir,
+                proc=f"train-rank{jax.process_index()}",
             )
         self.tracker.end_run()
 

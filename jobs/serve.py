@@ -14,6 +14,10 @@ Throughput knobs (docs/SERVING.md; ServingConfig in dct_tpu/config.py):
                               any threads, so it is the safe place)
   DCT_SERVE_WORKERS / DCT_SERVE_MAX_BATCH / DCT_SERVE_BATCH_WINDOW_MS
                             — per-process micro-batcher shape
+  DCT_METRICS_DIR           — metrics-plane snapshot dir (this CLI arms
+                              logs/metrics by default so a /metrics
+                              scrape of any pool process reports fleet
+                              totals; set empty to disable)
 
 Endpoint mode — serve the LOCAL rollout endpoint instead of a raw
 checkpoint (traffic-weighted blue/green routing + mirror shadowing over
@@ -74,6 +78,13 @@ def main() -> int:
 
     host = os.environ.get("DCT_SERVE_HOST", "0.0.0.0")
     port = int(os.environ.get("DCT_SERVE_PORT", "8901"))
+    # The dedicated serving entry point ARMS the metrics plane by
+    # default (docs/OBSERVABILITY.md "Metrics plane"): every process of
+    # a DCT_SERVE_PROCS pool publishes snapshots under this dir, so one
+    # /metrics scrape of ANY process reports fleet totals. Library-built
+    # servers stay local-only unless DCT_METRICS_DIR opts in; "" (set
+    # but empty) disables explicitly.
+    os.environ.setdefault("DCT_METRICS_DIR", "logs/metrics")
     serving = ServingConfig.from_env()
 
     endpoint = os.environ.get("DCT_ENDPOINT_NAME")

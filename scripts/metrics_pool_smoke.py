@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Metrics-plane pool smoke (ISSUE 8 acceptance, CI edition).
+
+Launches a REAL forked ``DCT_SERVE_PROCS=2`` SO_REUSEPORT ServerPool
+over a synthetic MLP (numpy + stdlib only — same hermetic footing as
+the loadgen selftest), drives traffic across both worker processes on
+fresh connections, scrapes ``/metrics`` ONCE, and asserts:
+
+1. the fleet-total ``dct_requests_total`` equals the traffic sent —
+   one scrape of one process reports ALL processes' counts;
+2. the per-process ``proc``-labelled series sum to the same total
+   (the merge is an identity, not an estimate);
+3. the ``dct_slo_burn_rate`` gauges are present (the SLO monitor ran
+   over the aggregated view).
+
+Exit 0 on success, 1 with a diagnostic on any mismatch.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import tempfile
+import urllib.request
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+TRAFFIC = 40
+
+
+def main() -> int:
+    metrics_dir = tempfile.mkdtemp(prefix="dct-metrics-smoke-")
+    # Env BEFORE the pool forks: children inherit it when they build
+    # their servers. Publish-per-request so the scrape never races a
+    # sibling's throttle window.
+    os.environ["DCT_METRICS_DIR"] = metrics_dir
+    os.environ["DCT_METRICS_PUBLISH_S"] = "0"
+    os.environ.setdefault("DCT_SERVE_PROCS", "2")
+
+    import json
+
+    from dct_tpu.serving.loadgen import synthetic_mlp
+    from dct_tpu.serving.server import ServerPool, make_server_from_weights
+
+    weights, meta = synthetic_mlp()
+    body = json.dumps(
+        {"data": [[0.1, -0.2, 0.3, 0.0, 1.0]]}
+    ).encode()
+    procs = int(os.environ["DCT_SERVE_PROCS"])
+
+    with ServerPool(
+        lambda h, p, reuse_port: make_server_from_weights(
+            weights, meta, host=h, port=p, reuse_port=reuse_port
+        ),
+        processes=procs, host="127.0.0.1",
+    ) as pool:
+        url = f"http://127.0.0.1:{pool.port}"
+        # Readiness: the reserve socket parks the port unlistened, so
+        # connections race the children's bind — poll until one serves.
+        import time
+        import urllib.error
+
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                with urllib.request.urlopen(url + "/healthz", timeout=5):
+                    break
+            except (urllib.error.URLError, OSError):
+                if time.monotonic() >= deadline:
+                    print("FAIL: pool never became ready")
+                    return 1
+                time.sleep(0.1)
+        for i in range(TRAFFIC):
+            # A fresh connection per request: the kernel's SO_REUSEPORT
+            # hash spreads distinct source ports across the children.
+            req = urllib.request.Request(
+                url + "/score", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200, r.status
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+
+    total = None
+    per_proc: dict[str, float] = {}
+    for line in text.splitlines():
+        m = re.match(r'^dct_requests_total\{([^}]*)\} ([\d.e+-]+)$', line)
+        if not m:
+            continue
+        labels, value = m.group(1), float(m.group(2))
+        pm = re.search(r'proc="([^"]+)"', labels)
+        if pm:
+            per_proc[pm.group(1)] = per_proc.get(pm.group(1), 0.0) + value
+        else:
+            total = (total or 0.0) + value
+
+    print(f"scraped total={total} per_proc={per_proc}")
+    ok = True
+    if total != float(TRAFFIC):
+        print(f"FAIL: fleet total {total} != traffic sent {TRAFFIC}")
+        ok = False
+    if sum(per_proc.values()) != (total or 0.0):
+        print(
+            f"FAIL: per-proc sum {sum(per_proc.values())} != total {total}"
+        )
+        ok = False
+    if procs > 1 and len(per_proc) < 2:
+        # Overwhelmingly unlikely with 40 distinct source ports; if it
+        # triggers, the kernel pinned every connection to one child.
+        print(
+            f"WARN: only {len(per_proc)} proc series saw traffic "
+            "(kernel hashed every connection to one child?)"
+        )
+    if "dct_slo_burn_rate" not in text:
+        print("FAIL: dct_slo_burn_rate gauges missing from the scrape")
+        ok = False
+    print("metrics-plane pool smoke:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,11 @@ def test_bench_main_cpu_record_carries_everything(
     assert sl["batched_over_single"] > 0
     assert sl["score_batched_over_single"] > 1
     assert sl["parity"] is True
+    # Metrics-plane cost bound (ISSUE 8): the snapshot-publish p50
+    # overhead is measured every round; the flat scalar rides stdout,
+    # the per-variant p50 pair stays in the partial.
+    assert isinstance(sl["publish_overhead_ms"], float)
+    assert "snapshot_publish" not in sl
     # Carry-forward ON STDOUT is a compact digest (headline numbers +
     # provenance); the verbatim record lives in the partial on disk.
     po = record["prior_onchip"]
@@ -98,6 +103,8 @@ def test_bench_main_cpu_record_carries_everything(
     assert partial["trainer_gap"]["fused"] == partial["value"]
     assert partial["trainer_gap"]["fit"] > 0
     assert isinstance(partial["serving_load"]["levels"], list)
+    assert partial["serving_load"]["snapshot_publish"]["plain_p50_ms"] > 0
+    assert partial["serving_load"]["snapshot_publish"]["publish_p50_ms"] > 0
     assert partial["prior_onchip"]["record"] == onchip
     assert partial["prior_onchip"]["campaign"]["tpu_item_count"] == 1
     assert "train_lightning_ddp" in partial["val_parity"]["protocol"]

@@ -330,8 +330,10 @@ def test_train_metrics_prom_includes_health():
             },
         )
         samples = _parse_exposition(open(path).read())
+    # Labels render in canonical sorted order since the ISSUE 8 registry
+    # rebuild (a merge identity must not depend on insertion order).
     assert samples[
-        'dct_train_health_events_total{run_id="dct-h",kind="nan_loss"}'
+        'dct_train_health_events_total{kind="nan_loss",run_id="dct-h"}'
     ] == 2
     assert samples['dct_train_grad_norm{run_id="dct-h"}'] == 3.5
 
