@@ -1,0 +1,142 @@
+"""Persistent-XLA-cache wiring and the ``DCT_COMPILE_CACHE_*`` contract.
+
+Mode resolution (``DCT_COMPILE_CACHE``):
+
+- ``off`` (and the usual falsy spellings) — everything disabled;
+- ``auto`` (default) — enabled **iff** ``DCT_COMPILE_CACHE_DIR`` names a
+  directory: the operator arming a cache dir is the opt-in;
+- ``on`` / ``force`` — enabled; the cache dir defaults to
+  :data:`DEFAULT_CACHE_DIR` when unset.
+
+The persistent XLA cache must be configured **before this process's
+first compile**: JAX memoizes whether the cache is in use at the first
+compilation, so a late ``enable_from_env`` silently does nothing for
+the rest of the process (the AOT store in :mod:`.aot` has no such
+constraint — it is pure file I/O around ``lower().compile()``). Every
+long-running entry point (trainer fit, the serving CLI) therefore
+calls this before touching jax-compiled code.
+
+Relationship to the older ``DCT_JAX_CACHE`` knob
+(:func:`dct_tpu.utils.platform.enable_compilation_cache`): that one is
+the bench/campaign measurement hedge, TPU-gated by default. This module
+is the platform-wide relaunch/spin-up contract; when both run, the last
+``jax.config.update`` wins (they can share a directory safely — entries
+are content-keyed).
+
+Cache directories are **per-machine**: XLA:CPU executables are pinned
+to the host's CPU features, so a dir shared over NFS across
+heterogeneous hosts can produce entries another host cannot run. The
+AOT artifact header fingerprints backend/device/arch and degrades to a
+loud miss; the XLA cache keys include the compile options but not the
+micro-architecture — keep the dir host-local.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from collections.abc import Mapping
+
+#: Default persistent-cache dir for mode ``on`` (under the gitignored
+#: ``logs/`` convention, shared by every relaunch attempt in a cwd).
+DEFAULT_CACHE_DIR = "logs/compile_cache"
+
+_FALSY = ("0", "false", "no", "off", "disable", "none")
+
+
+def cache_mode(env: Mapping | None = None) -> str:
+    """``off`` | ``auto`` | ``on`` (normalized)."""
+    env = os.environ if env is None else env
+    raw = str(env.get("DCT_COMPILE_CACHE", "auto")).strip().lower()
+    if raw in _FALSY:
+        return "off"
+    if raw in ("on", "force", "1", "true", "yes"):
+        return "on"
+    return "auto"
+
+
+def resolve_cache_dir(env: Mapping | None = None) -> str | None:
+    """The persistent-XLA-cache dir the env selects (None = cache off)."""
+    env = os.environ if env is None else env
+    mode = cache_mode(env)
+    if mode == "off":
+        return None
+    explicit = env.get("DCT_COMPILE_CACHE_DIR")
+    if explicit:
+        return str(explicit)
+    return DEFAULT_CACHE_DIR if mode == "on" else None
+
+
+def enabled(env: Mapping | None = None) -> bool:
+    """True when the compile cache (XLA dir + AOT store) is armed."""
+    return resolve_cache_dir(env) is not None
+
+
+def aot_enabled(env: Mapping | None = None) -> bool:
+    """AOT executable serialization on top of the enabled cache
+    (``DCT_COMPILE_CACHE_AOT``, default on)."""
+    env = os.environ if env is None else env
+    if not enabled(env):
+        return False
+    raw = str(env.get("DCT_COMPILE_CACHE_AOT", "1")).strip().lower()
+    return raw not in _FALSY
+
+
+def warm_sizes(env: Mapping | None = None) -> list[int]:
+    """Packaging-time scorer pre-compile batch sizes
+    (``DCT_COMPILE_CACHE_WARM_SIZES``, comma-separated; empty = skip)."""
+    env = os.environ if env is None else env
+    raw = str(env.get("DCT_COMPILE_CACHE_WARM_SIZES", ""))
+    sizes = []
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if tok.isdigit() and int(tok) > 0:
+            sizes.append(int(tok))
+    return sorted(set(sizes))
+
+
+def enable_from_env(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at the configured dir.
+
+    Returns the dir in use, or None when disabled/unavailable. Never
+    raises — the cache is an optimization, not a reason to fail a run.
+    ``DCT_COMPILE_CACHE_MIN_COMPILE_S`` (default 0: cache everything)
+    maps to ``jax_persistent_cache_min_compile_time_secs``.
+    """
+    path = cache_dir or resolve_cache_dir()
+    if path is None:
+        return None
+    try:
+        import jax
+
+        min_s = float(
+            os.environ.get("DCT_COMPILE_CACHE_MIN_COMPILE_S", "0") or 0.0
+        )
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_s
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # noqa: BLE001 — never fail the run for a cache
+        sys.stderr.write(
+            f"[dct_tpu] persistent compile cache unavailable: {e}\n"
+        )
+        return None
+    return path
+
+
+def export_env(child_env: dict, current_env: Mapping | None = None) -> None:
+    """Pin the resolved cache dir into a child environment (the
+    supervised relauncher calls this): every relaunch attempt must
+    agree on ONE directory, or attempt 2 cannot hit what attempt 1
+    compiled. No-op when the cache is off. ``current_env`` is the
+    merged view the children will actually see (defaults to this
+    process's environ overlaid with ``child_env``)."""
+    merged = dict(os.environ if current_env is None else current_env)
+    merged.update({k: v for k, v in child_env.items() if v is not None})
+    path = resolve_cache_dir(merged)
+    if path is not None:
+        child_env.setdefault(
+            "DCT_COMPILE_CACHE_DIR", os.path.abspath(path)
+        )

@@ -978,6 +978,14 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_PEAK_TFLOPS": "per-chip peak TFLOPs override for MFU math",
     "DCT_JAX_CACHE": "enable the persistent XLA compilation cache",
     "DCT_JAX_CACHE_DIR": "compilation cache directory",
+    # Compile cache + AOT executables (dct_tpu.compilecache;
+    # docs/OBSERVABILITY.md §compile): sub-second relaunch/spin-up.
+    "DCT_COMPILE_CACHE": "compile cache mode: off | auto (dir arms) | on",
+    "DCT_COMPILE_CACHE_DIR": "persistent XLA compile-cache dir (per-machine)",
+    "DCT_COMPILE_CACHE_AOT": "AOT executable store on/off (default on)",
+    "DCT_COMPILE_CACHE_AOT_DIR": "AOT store root override (default <models>/aot)",
+    "DCT_COMPILE_CACHE_MIN_COMPILE_S": "min compile seconds worth caching (0 = all)",
+    "DCT_COMPILE_CACHE_WARM_SIZES": "packaging scorer pre-compile batch sizes",
     "DCT_NATIVE": "enable the native (C++) extension build",
     "DCT_CXX": "C++ compiler for the native build",
     # --- bench / campaign scripts ----------------------------------
@@ -986,6 +994,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_BENCH_TORCH_EPOCHS": "bench torch-reference epochs",
     "DCT_BENCH_FUSE": "bench fused-step legs on/off",
     "DCT_BENCH_SCALED": "bench scaled-transformer leg on/off",
+    "DCT_BENCH_SPINUP": "bench restart_spinup (cold/warm relaunch) leg on/off",
     "DCT_BENCH_DEADLINE": "bench wall-clock deadline (s); legs self-gate",
     "DCT_BENCH_PARTIAL": "path for the partial-results stash",
     "DCT_VAL_PARITY_EPOCHS": "val-loss parity leg epoch budget",

@@ -35,6 +35,10 @@ class _Deployment:
             self._weights = {k: npz[k] for k in npz.files}
             with open(os.path.join(self.package_dir, "model_meta.json")) as f:
                 self._meta = json.load(f)
+            # In-memory only (never persisted back): where this
+            # package's pre-compiled scorer executables live, for the
+            # jax serving engine's AOT store (serving/batching.py).
+            self._meta["_aot_dir"] = os.path.join(self.package_dir, "aot")
         return self._weights, self._meta
 
 

@@ -797,6 +797,14 @@ class LocalProcessLauncher:
         # the story of this cycle, and one grep must reconstruct it.
         run_id = merged.get("DCT_RUN_ID") or mint_run_id()
         base_env["DCT_RUN_ID"] = merged["DCT_RUN_ID"] = run_id
+        # Compile-cache continuity across attempts: pin ONE resolved
+        # cache dir into every rank env, so a relaunch disk-hits the
+        # programs its dead predecessor compiled (the relaunch IS the
+        # steady-state cache consumer — ROADMAP item 5). No-op unless
+        # DCT_COMPILE_CACHE arms the cache.
+        from dct_tpu import compilecache as _compilecache
+
+        _compilecache.export_env(base_env, merged)
         events = _launcher_event_log(merged)
         policy = RestartPolicy(
             max_restarts=max_restarts, backoff_s=backoff_s,

@@ -123,6 +123,11 @@ def build_train_registry(
                 "family": w.get("family", ""),
                 "config_hash": w.get("config_hash", ""),
                 "mesh": w.get("mesh", ""),
+                # AOT-store resolution: "hit" windows are deserialized
+                # executables (disk read, ~ms), "miss"/"disabled" are
+                # real XLA compiles — the label that proves a warm
+                # relaunch paid zero fresh compiles.
+                "cache": w.get("cache", "disabled"),
             }
             n_fam.inc(w.get("count", 0), wl)
             s_fam.inc(w.get("seconds", 0.0), wl)

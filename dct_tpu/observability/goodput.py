@@ -230,10 +230,17 @@ def compile_report(
     family: str = "",
     config_hash: str = "",
     mesh: str = "",
+    cache_states: dict | None = None,
 ) -> list[dict]:
     """Group raw ``(program, seconds)`` compile windows into one record
     per program, carrying the cache-key labels — the shape both the
-    ``compile.window`` events and the ``dct_compile_*`` series use."""
+    ``compile.window`` events and the ``dct_compile_*`` series use.
+
+    ``cache_states`` maps program key -> ``hit``/``miss``/``disabled``
+    (the AOT store's per-program resolution,
+    :class:`dct_tpu.compilecache.ExecutableStore`); a program the store
+    never fronted reports ``disabled`` — its window was a real XLA
+    compile with no cache in the loop."""
     grouped: dict[str, dict] = {}
     for program, sec in windows:
         g = grouped.setdefault(
@@ -243,6 +250,7 @@ def compile_report(
                 "family": family,
                 "config_hash": config_hash,
                 "mesh": mesh,
+                "cache": (cache_states or {}).get(program, "disabled"),
                 "count": 0,
                 "seconds": 0.0,
             },

@@ -365,10 +365,20 @@ def build_report(
                 f"  {r.get('program')}: {_fmt_num(r.get('seconds'))}s "
                 f"x{r.get('count')} "
                 f"[{r.get('family')}/{r.get('config_hash')}/"
-                f"{r.get('mesh')}]"
+                f"{r.get('mesh')}] "
+                f"cache={r.get('cache', 'disabled')}"
             )
         total = sum(float(r.get("seconds") or 0.0) for r in compiles)
-        lines.append(f"  total compile: {total:.4f}s")
+        by_cache: dict[str, int] = {}
+        for r in compiles:
+            c = str(r.get("cache", "disabled"))
+            by_cache[c] = by_cache.get(c, 0) + int(r.get("count") or 1)
+        cache_line = " / ".join(
+            f"{k} {by_cache[k]}" for k in sorted(by_cache)
+        )
+        lines.append(
+            f"  total compile: {total:.4f}s  (cache: {cache_line})"
+        )
     else:
         lines.append("  (no compile.window events)")
 

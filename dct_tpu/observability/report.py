@@ -41,6 +41,12 @@ SERIES = (
     ("trainer_loop", ("trainer_loop_samples_per_sec_per_chip",), "up"),
     ("serving_p50_ms", ("serving", "single_row", "numpy_p50_ms"), "down"),
     ("serving_load_qps", ("serving_load", "saturated_qps"), "up"),
+    # Restart/spin-up debt (the restart_spinup bench leg): warm
+    # time-from-SIGKILL-to-first-step and warm endpoint
+    # time-to-first-score — cold-start latencies gated at the same
+    # >25% rise threshold as the serving latency series.
+    ("warm_step_s", ("restart_spinup", "warm_step_s"), "down"),
+    ("warm_score_s", ("restart_spinup", "warm_score_s"), "down"),
 )
 
 

@@ -79,13 +79,22 @@ def score_rows_invariant(weights: dict, meta: dict,
     return out
 
 
-def _build_jax_scorer(weights: dict, meta: dict):
+def _build_jax_scorer(weights: dict, meta: dict, force_store: bool = False):
     """Jitted batched scorer: registry model rebuilt from the package's
     self-describing meta (the evaluation harness's jax-engine idiom),
     returning the SERVING contract's probability shape (multi-horizon
     causal heads keep ``[N, H, C]``). Batches are padded to the next
     power of two so jit recompiles O(log max_batch) times, not per
-    distinct arrival pattern."""
+    distinct arrival pattern.
+
+    When the package loader stamped an ``_aot_dir`` into ``meta`` and
+    the compile cache is armed (``DCT_COMPILE_CACHE``; or
+    ``force_store=True`` — the packaging-time warm-up), the forward
+    fronts an AOT executable store over ``<package>/aot/``: a deployed
+    package carries its pre-compiled scorer, so a fresh endpoint
+    worker's first score deserializes instead of compiling. Identity =
+    (family, hash of the package meta, local layout); a skewed artifact
+    is a loud miss back onto the jit path."""
     import dataclasses
 
     import jax
@@ -120,20 +129,54 @@ def _build_jax_scorer(weights: dict, meta: dict):
                 logits = logits.reshape(logits.shape[0], horizon, -1)
         return jax.nn.softmax(logits, axis=-1)
 
+    from dct_tpu import compilecache as _cc
+    from dct_tpu.compilecache.aot import weights_digest as _weights_digest
+    from dct_tpu.observability.goodput import config_hash as _config_hash
+
+    def _emit_compile_event(component, event, **fields):
+        # Late-bound process-default sink (the same idiom as the
+        # batcher's serve.* events): a skewed/corrupt package artifact
+        # must be a LOUD miss on the event log, not a silent recompile.
+        from dct_tpu.observability import events as _events
+
+        _events.get_default().emit(component, event, **fields)
+
+    aot_root = meta.get("_aot_dir")
+    armed = bool(aot_root) and (_cc.aot_enabled() or force_store)
+    store = _cc.store_from_env(
+        aot_root,
+        family=family,
+        config_hash=_config_hash(
+            {k: v for k, v in meta.items() if not k.startswith("_")}
+        ),
+        mesh="serve_local",
+        # The scorer closes over the weights — they are constants baked
+        # into the executable, so they MUST be part of the artifact
+        # identity (a meta-identical package with different weights
+        # would otherwise load a stale model's executable). Hashed only
+        # when the store can actually engage (one build-time pass).
+        extra={"weights": _weights_digest(weights)} if armed else None,
+        emit=_emit_compile_event,
+    )
+    if force_store and aot_root:
+        store.enabled = True
+    forward_prog = store.wrap(forward, program="serve_scorer")
+
     def score(x: np.ndarray) -> np.ndarray:
         if moe:
             # MoE capacity is a function of the TOTAL token count:
             # padding rows would change which tokens get dropped, so the
             # request is scored at its true shape (jit recompiles per
-            # distinct request size — the opt-in engine's cost here).
-            return np.asarray(jax.device_get(forward(x)))
+            # distinct request size — the opt-in engine's cost here;
+            # the AOT store still serves repeat sizes across restarts).
+            return np.asarray(jax.device_get(forward_prog(x)))
         n = len(x)
         padded = 1
         while padded < n:
             padded *= 2
         if padded != n:
             x = np.concatenate([x, np.repeat(x[-1:], padded - n, axis=0)])
-        return np.asarray(jax.device_get(forward(x)))[:n]
+        return np.asarray(jax.device_get(forward_prog(x)))[:n]
 
     return score
 

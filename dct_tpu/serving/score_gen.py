@@ -188,4 +188,16 @@ def generate_score_package(ckpt_path: str, deploy_dir: str) -> dict:
 
     _publish_text(os.path.join(deploy_dir, "score.py"), score_py)
     _publish_text(os.path.join(deploy_dir, "conda.yaml"), _CONDA_YAML)
+
+    # Packaging-time scorer warm-up (compilecache): with the compile
+    # cache armed AND DCT_COMPILE_CACHE_WARM_SIZES set, pre-compile the
+    # jitted batched scorer at those (power-of-two-padded) batch sizes
+    # into <deploy_dir>/aot/ — the deployed package then carries its
+    # executables and an endpoint worker's first score deserializes
+    # instead of compiling. Best-effort: a rig without a working jax
+    # backend still produces a valid (un-warmed) package.
+    from dct_tpu import compilecache as _compilecache
+
+    if _compilecache.enabled() and _compilecache.warm_sizes():
+        _compilecache.warm_package_scorer(deploy_dir)
     return meta

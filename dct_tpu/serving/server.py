@@ -556,6 +556,13 @@ def make_server(ckpt_path: str, *, host: str = "127.0.0.1", port: int = 0,
     ``port=0`` binds an ephemeral port (``server.server_address[1]``
     after construction)."""
     weights, meta = weights_from_checkpoint(ckpt_path)
+    # Checkpoint-mode AOT root: the sibling aot/ dir of the models dir
+    # the checkpoint lives in — shared with the trainer's store, so a
+    # serving worker over a raw checkpoint still spins up pre-compiled
+    # when the compile cache is armed (serving/batching.py).
+    meta["_aot_dir"] = os.path.join(
+        os.path.dirname(os.path.abspath(ckpt_path)), "aot"
+    )
     return make_server_from_weights(
         weights, meta, host=host, port=port, serving=serving,
         reuse_port=reuse_port,

@@ -205,6 +205,14 @@ class Trainer:
     # ------------------------------------------------------------------
     def fit(self, data: WeatherArrays | None = None) -> TrainResult:
         cfg = self.cfg
+        # Persistent compile cache (ROADMAP item 5): point jax at the
+        # DCT_COMPILE_CACHE_DIR before this process's FIRST compile
+        # (model init below is one) — a supervised relaunch then disk-
+        # hits every program its dead predecessor already compiled.
+        # No-op unless the env arms it (compilecache.cache docstring).
+        from dct_tpu import compilecache as _compilecache
+
+        _compilecache.enable_from_env()
         # Observability plane: structured events (installed as the
         # process default so the checkpoint/tracking layers stamp the
         # same run-correlation ID), the goodput ledger, and this rank's
@@ -353,6 +361,11 @@ class Trainer:
             )
 
         lr_schedule = None
+        # The decay horizon actually baked into the schedule (auto mode
+        # resolves it from the restored trajectory): part of the AOT
+        # store's program identity — the schedule's constants live
+        # inside the compiled executable.
+        resolved_decay = cfg.train.decay_steps
         if cfg.train.lr_schedule != "constant" or cfg.train.warmup_steps > 0:
             from dct_tpu.train.state import make_lr_schedule
 
@@ -379,6 +392,7 @@ class Trainer:
                 decay_steps=decay,
                 end_lr_fraction=cfg.train.end_lr_fraction,
             )
+            resolved_decay = decay
         state = create_train_state(
             model, input_dim=data.input_dim, lr=cfg.train.lr,
             seed=cfg.train.seed, example_shape=example_shape,
@@ -498,6 +512,50 @@ class Trainer:
             and cfg.train.prefetch_spans >= 1
             and not plan.enabled
         )
+        # AOT executable store (compilecache): the fused epoch programs
+        # load-or-miss against <models_dir>/aot (override:
+        # DCT_COMPILE_CACHE_AOT_DIR) — a resume snapshot's layout
+        # carries its pre-compiled steps. The identity is the compile-
+        # accounting key (family, model-config hash, resolved mesh)
+        # PLUS the train knobs whose constants are baked into the
+        # executable (optimizer chain, lr/schedule with its RESOLVED
+        # decay horizon, precision, sharding, accumulation) and the
+        # resolved donation mode — serial mode donates the input state,
+        # and a donating executable loaded into the pipelined loop
+        # would free a buffer the checkpoint tier still reads. Loop-
+        # control knobs (epochs, resume, early-stop, logging cadence)
+        # are deliberately OUT: a relaunch flips resume=1 and must
+        # still hit. Disabled = a transparent pass-through.
+        import dataclasses as _dc
+
+        from dct_tpu.observability.goodput import (
+            config_hash as _config_hash,
+            mesh_descriptor as _mesh_descriptor,
+        )
+
+        _train_identity = {
+            k: v
+            for k, v in _dc.asdict(cfg.train).items()
+            if k not in (
+                "resume", "epochs", "log_every_n_steps",
+                "early_stop_patience", "early_stop_min_delta",
+                "prefetch_spans",
+            )
+        }
+        _train_identity["decay_resolved"] = int(resolved_decay)
+        aot_store = _compilecache.store_from_env(
+            os.environ.get("DCT_COMPILE_CACHE_AOT_DIR")
+            or os.path.join(cfg.data.models_dir, "aot"),
+            family=cfg.model.name,
+            config_hash=_config_hash(_dc.asdict(cfg.model)),
+            mesh=_mesh_descriptor(self.mesh),
+            extra={
+                **_train_identity,
+                "donate": not pipelined,
+                "input_dim": data.input_dim,
+            },
+            emit=events.emit,
+        )
         if use_scan:
             # Built only for the per-epoch path: with epoch_chunk > 1
             # every span (including k == 1 remainders) dispatches the
@@ -510,11 +568,11 @@ class Trainer:
             # buffer must survive the dispatch — one extra resident
             # state copy is the documented price of the overlap.
             if max(1, cfg.train.epoch_chunk) == 1:
-                epoch_fused = make_epoch_train_eval_step(
+                epoch_fused = aot_store.wrap(make_epoch_train_eval_step(
                     donate=not pipelined,
                     accum_steps=accum, donate_stacks=True,
                     with_grad_norms=True,
-                )
+                ))
         else:
             train_step = make_train_step(
                 accum_steps=accum, with_grad_norm=True
@@ -603,11 +661,11 @@ class Trainer:
         if chunk > 1:
             from dct_tpu.train.steps import make_multi_epoch_train_eval_step
 
-            multi_fused = make_multi_epoch_train_eval_step(
+            multi_fused = aot_store.wrap(make_multi_epoch_train_eval_step(
                 donate=not pipelined,
                 accum_steps=accum, donate_stacks=True,
                 with_grad_norms=True,
-            )
+            ))
 
         # Epoch-ahead input pipeline (scan path): the next span's host
         # batch assembly + H2D staging runs on a worker thread WHILE the
@@ -1069,13 +1127,16 @@ class Trainer:
                         epoch=epoch, k=k, key=f"scan_k{k}",
                         parent_id=epoch_span.span_id,
                     )
+                    # `key=` threads the goodput dispatch key into the
+                    # AOT store so cache hit/miss states line up 1:1
+                    # with the compile.window accounting below.
                     if multi_fused is not None:
                         state, losses, val_sums, gnorms = multi_fused(
-                            state, *globs, *val_global
+                            state, *globs, *val_global, key=f"scan_k{k}"
                         )
                     else:
                         state, losses, val_sums, gnorms = epoch_fused(
-                            state, *globs, *val_global
+                            state, *globs, *val_global, key=f"scan_k{k}"
                         )
                     # Host-blocking cost of the dispatch call itself
                     # (jit trace + XLA compile on the first span of a
@@ -1441,6 +1502,9 @@ class Trainer:
             family=cfg.model.name,
             config_hash=config_hash(_dataclasses.asdict(cfg.model)),
             mesh=mesh_descriptor(self.mesh),
+            # cache="hit" windows were deserialized executables, not XLA
+            # compiles — the label a warm-relaunch e2e asserts on.
+            cache_states=aot_store.states,
         )
         if self.coordinator:
             for w in compile_windows:

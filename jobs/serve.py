@@ -76,6 +76,15 @@ def _serve_pool(build_server, what: str, serving, host: str,
 def main() -> int:
     from dct_tpu.config import ServingConfig
 
+    # Persistent compile cache for the jax serving engine: configured
+    # BEFORE any compile (the scorer compiles lazily on the first jax
+    # flush), so endpoint spin-up disk-hits programs an earlier worker
+    # — or the packaging-time warm-up — already compiled. No-op unless
+    # DCT_COMPILE_CACHE arms it.
+    from dct_tpu import compilecache
+
+    compilecache.enable_from_env()
+
     host = os.environ.get("DCT_SERVE_HOST", "0.0.0.0")
     port = int(os.environ.get("DCT_SERVE_PORT", "8901"))
     # The dedicated serving entry point ARMS the metrics plane by

@@ -21,6 +21,11 @@ def test_bench_main_cpu_record_carries_everything(
     monkeypatch.setenv("DCT_BENCH_TORCH_EPOCHS", "1")
     monkeypatch.setenv("DCT_VAL_PARITY_EPOCHS", "1")
     monkeypatch.setenv("DCT_BENCH_SCALED", "0")
+    # The restart_spinup leg spawns two supervised subprocess worlds
+    # (~a minute); the smoke gates the WIRING, and the null marker
+    # below proves skipped-not-absent. scripts/compile_cache_smoke.py
+    # (the compile-cache CI job) runs the leg's machinery for real.
+    monkeypatch.setenv("DCT_BENCH_SPINUP", "0")
     monkeypatch.setenv(
         "DCT_BENCH_PARTIAL", str(tmp_path / "BENCH_PARTIAL.json")
     )
@@ -98,6 +103,9 @@ def test_bench_main_cpu_record_carries_everything(
     assert vp["protocol"] == "BASELINE.md row 1"
     # The partial on disk is the VERBATIM record (crash hedge + the
     # carry-forward's full provenance), matching stdout's digest.
+    # Skipped-not-absent: the gated restart_spinup leg leaves its null
+    # marker (DCT_BENCH_SPINUP=0 above), like every skippable section.
+    assert record["restart_spinup"] is None
     with open(tmp_path / "BENCH_PARTIAL.json") as f:
         partial = json.load(f)
     assert partial["trainer_gap"]["fused"] == partial["value"]

@@ -244,6 +244,13 @@ def _worst_case_record() -> dict:
             "remote_compile: transport: Connection Failed: Connect "
             "error: Connection refused (os error 111)"
         ),
+        "restart_spinup": {
+            "cold_step_s": 15.828, "warm_step_s": 4.866,
+            "cold_compile_s": 10.242, "warm_compile_s": 2.68,
+            "warm_cache": ["hit"], "step_speedup": 3.25,
+            "cold_score_s": 2.0097, "warm_score_s": 0.8364,
+            "score_speedup": 2.4,
+        },
         "host_dataplane": {
             "rows_native_ms": 0.23, "rows_numpy_ms": 0.51,
             "rows_speedup": 2.18, "windows_native_ms": 1.43,
@@ -314,6 +321,13 @@ def test_stdout_record_typical_round_is_not_collapsed(bench_mod):
     assert out["scaled"]["step_time_dispatch_ms"] == 45.98
     assert out["moe"]["einsum_ms"] == 44.1
     assert out["val_parity"]["jax_val_acc"] == 0.86292
+    # ...the restart_spinup digest rides stdout with the sentinel's
+    # warm series + both ratios (cold controls derivable, detail in
+    # the partial)...
+    assert out["restart_spinup"] == {
+        "warm_step_s": 4.866, "step_speedup": 3.25,
+        "warm_score_s": 0.8364, "score_speedup": 2.4,
+    }
     # ...serving keeps (at least) its speedup headlines...
     assert out["serving"]["single_row"] in (
         1.97, record["serving"]["single_row"]
@@ -463,6 +477,34 @@ def test_stdout_record_r05_regression(bench_mod):
     assert out["value"] == 239743.4
     assert out["trainer_loop_samples_per_sec_per_chip"] == 211724.6
     assert out["val_parity"]["abs_diff"] == 0.01057
+
+
+def test_stdout_record_r05_shape_with_restart_spinup_pinned(bench_mod):
+    """ISSUE 9 satellite: the restart_spinup stanza riding the exact
+    r05 overflow shape must stay inside the driver tail, with the
+    sentinel's warm series surviving the ladder (regressing the
+    parsed:null overflow via the new stanza is the failure mode this
+    test exists to block)."""
+    record = _r05_record()
+    record["restart_spinup"] = {
+        "cold_step_s": 15.828, "warm_step_s": 4.866,
+        "cold_compile_s": 10.242, "warm_compile_s": 2.68,
+        "warm_cache": ["hit"], "step_speedup": 3.25,
+        "cold_score_s": 2.0097, "warm_score_s": 0.8364,
+        "score_speedup": 2.4,
+    }
+    line = json.dumps(
+        bench_mod._stdout_record(record), default=bench_mod._json_default
+    )
+    assert len(line.encode()) <= 1800, len(line.encode())
+    out = json.loads(line)
+    rs = out["restart_spinup"]
+    # The warm series (what observability/report.py tracks) survives.
+    assert rs["warm_step_s"] == 4.866
+    assert rs["warm_score_s"] == 0.8364
+    assert rs["step_speedup"] == 3.25 and rs["score_speedup"] == 2.4
+    # The cold controls + cache detail live in the partial, not stdout.
+    assert "cold_step_s" not in rs and "warm_cache" not in rs
 
 
 def test_stdout_record_failed_scaled_leaves_bounded_legs(bench_mod):

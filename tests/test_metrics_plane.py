@@ -539,7 +539,8 @@ def test_trainer_dump_body_roundtrips(tmp_path):
     samples = parse_exposition_strict(open(path).read())
     assert samples['dct_train_samples_per_sec{run_id="dct-t"}'] == 42.0
     key = (
-        'dct_compile_seconds_total{config_hash="abcd1234",'
+        'dct_compile_seconds_total{cache="disabled",'
+        'config_hash="abcd1234",'
         'family="weather_mlp",mesh="data8_model1_seq1_pipe1",'
         'program="scan_k1",run_id="dct-t"}'
     )
