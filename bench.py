@@ -1060,6 +1060,268 @@ def bench_restart_spinup(tmp: str) -> dict:
     return out
 
 
+#: cycle_freshness leg shape: two SCORED generations arriving while the
+#: system is busy, after a bootstrap generation that pays XLA compile
+#: and the first deploy for BOTH runners. The serial side's train
+#: quantum is the episodic cycle's epoch budget; the loop's is its
+#: round — equal per-step semantics (same trainer), different
+#: architecture. Soak dwell is identical on both sides (the rollout's
+#: shadow/canary windows are inherent promotion latency either way).
+_FRESHNESS_GENS = 2
+_FRESHNESS_ROWS = 1200
+_FRESHNESS_APPEND_ROWS = 300
+#: The episodic cycle's per-trigger train budget. Sized so the train
+#: stage DOMINATES the serial cycle (roughly 3:1 over the gate+deploy
+#: tail on the CPU rig) — the regime the episodic architecture
+#: actually lives in (a daily DAG trains the day's budget per cycle,
+#: hours of training against minutes of deploy); a toy budget would
+#: measure two promotion paths, not two architectures. The loop trains
+#: the IDENTICAL per-step program continuously in
+#: _FRESHNESS_LOOP_ROUND_EPOCHS-sized rounds — small enough that fresh
+#: data waits under a round for its first gradient, large enough to
+#: amortize the per-fit fixed costs.
+_FRESHNESS_EPOCHS_PER_GEN = 200
+_FRESHNESS_LOOP_ROUND_EPOCHS = 8
+_FRESHNESS_SOAK_S = 0.35
+_FRESHNESS_MAX_CYCLES_PER_GEN = 4
+_FRESHNESS_LOOP_WALL_CAP_S = 150.0
+
+
+def _freshness_append(raw_csv: str, seed: int) -> float:
+    """Append one generation of rows and return the arrival timestamp
+    (the file's mtime — what the ETL stamps)."""
+    from dct_tpu.data.synthetic import append_weather_rows
+
+    append_weather_rows(raw_csv, rows=_FRESHNESS_APPEND_ROWS, seed=seed)
+    return os.path.getmtime(raw_csv)
+
+
+def _freshness_cfg(work: str, side: str, epochs_per_round: int):
+    from dct_tpu.config import (
+        DataConfig, LoopConfig, ObservabilityConfig, RunConfig,
+    )
+
+    base = os.path.join(work, side)
+    return RunConfig(
+        data=DataConfig(
+            processed_dir=os.path.join(base, "processed"),
+            raw_csv=os.path.join(base, "raw", "weather.csv"),
+            models_dir=os.path.join(base, "models"),
+        ),
+        obs=ObservabilityConfig(
+            events_dir=os.path.join(base, "events"),
+            heartbeat_dir=os.path.join(base, "hb"),
+        ),
+        loop=LoopConfig(
+            poll_s=0.1, eval_poll_s=0.1,
+            epochs_per_round=epochs_per_round,
+            train_mode="inline", soak_s=_FRESHNESS_SOAK_S,
+            packages_dir=os.path.join(base, "packages"),
+            max_wall_s=_FRESHNESS_LOOP_WALL_CAP_S,
+        ),
+    )
+
+
+def _freshness_serial(work: str) -> dict:
+    """The episodic baseline: back-to-back serial cycles (ETL -> train
+    -> gate -> deploy) with each scored generation arriving MID-cycle —
+    the steady state of a schedule-triggered DAG."""
+    import threading
+
+    from dct_tpu.continuous import PromotionEvaluator, run_episodic_cycle
+    from dct_tpu.data.synthetic import generate_weather_csv
+    from dct_tpu.deploy.local import LocalEndpointClient
+
+    cfg = _freshness_cfg(work, "serial", _FRESHNESS_EPOCHS_PER_GEN)
+    generate_weather_csv(cfg.data.raw_csv, rows=_FRESHNESS_ROWS, seed=11)
+    client = LocalEndpointClient()
+    ev = PromotionEvaluator(
+        cfg.data.models_dir, cfg.loop.packages_dir,
+        client=client, endpoint="bench-fresh",
+        processed_dir=cfg.data.processed_dir,
+        soak_s=_FRESHNESS_SOAK_S, poll_s=0.0,
+    )
+    t0 = time.perf_counter()
+    boot = run_episodic_cycle(cfg, client=client, evaluator=ev)
+    cycle_s = boot["cycle_s"]
+    fresh: list[float] = []
+    cycles: list[dict] = []
+    for g in range(_FRESHNESS_GENS):
+        target_gen = g + 2  # bootstrap published generation 1
+        arrival_box: dict = {}
+        timer = threading.Timer(
+            max(0.05, 0.4 * cycle_s),
+            lambda: arrival_box.setdefault(
+                "ts", _freshness_append(cfg.data.raw_csv, seed=100 + g)
+            ),
+        )
+        timer.start()
+        # The cycle the arrival lands inside (the episodic trigger was
+        # already committed to the OLD data), then cycles until a
+        # promoted model has trained on the new generation — a gate
+        # hold honestly delays freshness by another full cycle.
+        for _ in range(1 + _FRESHNESS_MAX_CYCLES_PER_GEN):
+            rec = run_episodic_cycle(cfg, client=client, evaluator=ev)
+            cycles.append(rec)
+            promoted_gen = (
+                ev.promotions[-1].get("generation") or 0
+            ) if ev.promotions else 0
+            if "ts" in arrival_box and promoted_gen >= target_gen:
+                fresh.append(
+                    ev.promotions[-1]["ts"] - arrival_box["ts"]
+                )
+                break
+        timer.cancel()
+    wall = time.perf_counter() - t0
+    train_step = sum(c["train_step_wall_s"] for c in cycles) + boot[
+        "train_step_wall_s"
+    ]
+    sps = [
+        c["train_samples_per_sec_per_chip"]
+        for c in cycles + [boot]
+        if c["train_samples_per_sec_per_chip"]
+    ]
+    return {
+        "freshness_s": [round(f, 3) for f in fresh],
+        "mean_freshness_s": (
+            round(sum(fresh) / len(fresh), 3) if fresh else None
+        ),
+        "cycle_s": round(
+            sum(c["cycle_s"] for c in cycles) / len(cycles), 3
+        ) if cycles else None,
+        "cycles": len(cycles) + 1,
+        "promotions": len(ev.promotions),
+        "held": len(ev.held),
+        "goodput": round(train_step / wall, 4) if wall > 0 else None,
+        "train_samples_per_sec_per_chip": (
+            round(sum(sps) / len(sps), 1) if sps else None
+        ),
+        "wall_s": round(wall, 3),
+    }
+
+
+def _freshness_loop(work: str) -> dict:
+    """The overlapped loop on the SAME workload: short rounds, ingest
+    and promotion concurrent, arrivals landing mid-round."""
+    import threading
+
+    from dct_tpu.continuous import AlwaysOnLoop
+    from dct_tpu.data.synthetic import generate_weather_csv
+
+    cfg = _freshness_cfg(work, "loop", _FRESHNESS_LOOP_ROUND_EPOCHS)
+    generate_weather_csv(cfg.data.raw_csv, rows=_FRESHNESS_ROWS, seed=11)
+    arrivals: dict[int, float] = {}
+    fresh: dict[int, float] = {}
+    state = {"next": 2, "loop": None}
+    lock = threading.Lock()
+
+    def _arrive_later(gen: int, delay: float) -> None:
+        def _go():
+            arrivals[gen] = _freshness_append(
+                cfg.data.raw_csv, seed=100 + (gen - 2)
+            )
+        threading.Timer(delay, _go).start()
+
+    def on_promotion(rec: dict) -> None:
+        gen = rec.get("generation") or 0
+        with lock:
+            for g, ats in list(arrivals.items()):
+                if ats is not None and g not in fresh and gen >= g:
+                    fresh[g] = rec["ts"] - ats
+            if gen >= 1 and state["next"] == 2 and 2 not in arrivals:
+                # Bootstrap deployed: first scored generation arrives
+                # mid-round, like the serial side's mid-cycle arrival.
+                arrivals[2] = None  # reserve
+                _arrive_later(2, 0.2)
+                state["next"] = 3
+            elif (
+                state["next"] <= _FRESHNESS_GENS + 1
+                and (state["next"] - 1) in fresh
+            ):
+                g = state["next"]
+                arrivals[g] = None
+                _arrive_later(g, 0.2)
+                state["next"] = g + 1
+            if len(fresh) >= _FRESHNESS_GENS and state["loop"] is not None:
+                state["loop"].request_stop("freshness_measured")
+
+    loop = AlwaysOnLoop(cfg, on_promotion=on_promotion)
+    state["loop"] = loop
+    summary = loop.run()
+    scored = [v for v in fresh.values() if v is not None]
+    return {
+        "freshness_s": [round(f, 3) for f in sorted(scored)],
+        "mean_freshness_s": (
+            round(sum(scored) / len(scored), 3) if scored else None
+        ),
+        "rounds": summary["rounds"],
+        "promotions": summary["promotions"],
+        "held": summary["held"],
+        "goodput": summary["goodput"],
+        "train_samples_per_sec_per_chip":
+            summary["train_samples_per_sec_per_chip"],
+        "wall_s": summary["wall_s"],
+        "stop_reason": summary["reason"],
+    }
+
+
+def bench_cycle_freshness(tmp: str) -> dict:
+    """Data-arrival -> deployed-model latency, serial episodic cycle vs
+    the always-on overlapped loop (ISSUE 10 / ROADMAP item 3), same
+    workload and same promotion machinery on both sides. The headline
+    is ``freshness_speedup`` (serial mean / loop mean; the acceptance
+    bar is >= 2x at equal per-step training semantics) plus platform
+    goodput (train-step wall / runner wall) for both architectures."""
+    work = os.path.join(tmp, "cycle_freshness")
+    saved = {
+        k: os.environ.get(k)
+        for k in ("DCT_TRACKING_DIR", "DCT_COMPILE_CACHE")
+    }
+    try:
+        # Tracker files under the leg's own tree; AOT executable store
+        # armed so rounds/cycles past the bootstrap load their fused
+        # programs instead of recompiling (both sides benefit equally —
+        # the steady-state configuration the loop lives in, PR 9).
+        os.environ["DCT_TRACKING_DIR"] = os.path.join(work, "mlruns")
+        os.environ["DCT_COMPILE_CACHE"] = "on"
+        serial = _section("cycle_freshness.serial", _freshness_serial, work)
+        loop = _section("cycle_freshness.loop", _freshness_loop, work)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    out: dict = {
+        "generations": _FRESHNESS_GENS,
+        "epochs_per_gen_serial": _FRESHNESS_EPOCHS_PER_GEN,
+        "loop_round_epochs": _FRESHNESS_LOOP_ROUND_EPOCHS,
+        "soak_s": _FRESHNESS_SOAK_S,
+        "serial": serial,
+        "loop": loop,
+        # Flat copies: the stdout digest + the report.py sentinel series
+        # dig these without descending into the side stanzas.
+        "serial_mean_freshness_s": serial["mean_freshness_s"],
+        "loop_mean_freshness_s": loop["mean_freshness_s"],
+        "goodput_serial": serial["goodput"],
+        "goodput_loop": loop["goodput"],
+    }
+    if serial["mean_freshness_s"] and loop["mean_freshness_s"]:
+        out["freshness_speedup"] = round(
+            serial["mean_freshness_s"] / loop["mean_freshness_s"], 2
+        )
+        _leg("cycle_freshness_speedup", out["freshness_speedup"])
+    if (
+        serial["train_samples_per_sec_per_chip"]
+        and loop["train_samples_per_sec_per_chip"]
+    ):
+        out["train_throughput_ratio"] = round(
+            loop["train_samples_per_sec_per_chip"]
+            / serial["train_samples_per_sec_per_chip"], 2,
+        )
+    return out
+
+
 def _torch_reference_setup(data):
     """The reference's exact seed/data/model/optimizer
     (jobs/train_lightning_ddp.py:14,45-46,57-61,88): seed 42, float
@@ -1354,6 +1616,21 @@ def _stdout_record(record: dict) -> dict:
         }
         if digest:
             out["restart_spinup"] = digest
+    cf = out.get("cycle_freshness")
+    if isinstance(cf, dict) and "error" not in cf:
+        # Stdout carries the architecture comparison (speedup, both
+        # means, both goodputs, throughput parity, loop outcome
+        # counts); the per-side stanzas with freshness series, cycle
+        # walls and stop reasons stay in the partial.
+        out["cycle_freshness"] = {
+            k: cf[k]
+            for k in (
+                "freshness_speedup", "serial_mean_freshness_s",
+                "loop_mean_freshness_s", "goodput_serial",
+                "goodput_loop", "train_throughput_ratio", "generations",
+            )
+            if k in cf
+        }
     sl = out.get("serving_load")
     if isinstance(sl, dict) and isinstance(sl.get("levels"), list):
         # Columnar digest of the sweep: every measured number still on
@@ -1481,6 +1758,12 @@ def _shrink_to_budget(out: dict) -> dict:
         # partial.
         ("restart_spinup", ("warm_step_s", "step_speedup",
                             "warm_score_s", "score_speedup")),
+        # Same guard for the freshness digest: the speedup + both means
+        # + both goodputs survive every tier-1 squeeze.
+        ("cycle_freshness", ("freshness_speedup",
+                             "serial_mean_freshness_s",
+                             "loop_mean_freshness_s",
+                             "goodput_serial", "goodput_loop")),
         # Late probe squeeze: the fallback-reason prose yields before
         # the serving levels do (the partial keeps the full reason; a
         # cpu `platform` on the record already says a fallback
@@ -1524,6 +1807,7 @@ def _shrink_to_budget(out: dict) -> dict:
         ("probe", ("platform",)),
         ("val_parity", ("abs_diff",)),
         ("restart_spinup", ("step_speedup", "score_speedup")),
+        ("cycle_freshness", ("freshness_speedup", "loop_mean_freshness_s")),
         ("moe", ("sorted_speedup",)),
         ("trainer_gap", ("fused_over_fit", "prefetch_spans")),
         ("scaled", ("step_time_ms", "attn_blockwise_ms",
@@ -2005,6 +2289,21 @@ def main():
             )
             _flush_partial(record)
 
+        # Always-on freshness (ISSUE 10): serial episodic cycle vs the
+        # overlapped loop on one workload — data-arrival -> deployed
+        # latency + platform goodput, recorded every round. Host-CPU
+        # leg like serving/spinup; DCT_BENCH_FRESHNESS=0 skips (the
+        # in-process smoke's knob), frac carve-out keeps the two
+        # runners from starving the dataplane tail.
+        skip_fresh = os.environ.get(
+            "DCT_BENCH_FRESHNESS", "1"
+        ).strip().lower() in ("0", "false", "no")
+        if not (skip_fresh or _gate("cycle_freshness", frac=0.95)):
+            record["cycle_freshness"] = _optional(
+                "cycle_freshness", bench_cycle_freshness, tmp
+            )
+            _flush_partial(record)
+
         if not _gate("host_dataplane"):
             dataplane = _optional(
                 "host_dataplane", bench_host_dataplane
@@ -2024,7 +2323,7 @@ def main():
     # of this bench" — and the partial file must match the printed record.
     for skippable in (
         "scaled", "moe", "val_parity", "serving", "serving_load",
-        "restart_spinup", "host_dataplane",
+        "restart_spinup", "cycle_freshness", "host_dataplane",
     ):
         record.setdefault(skippable, None)
     _flush_partial(record)

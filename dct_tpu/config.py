@@ -758,6 +758,67 @@ class ServingConfig:
 
 
 @dataclass
+class LoopConfig:
+    """Always-on overlapped cycles (dct_tpu.continuous;
+    docs/CONTINUOUS.md): ingest watcher, continuous training rounds,
+    and the concurrent evaluator that promotes mid-run.
+
+    The loop replaces the episodic DAG clock (ROADMAP item 3): ETL,
+    training, gating and deploy overlap instead of serializing, so
+    data-arrival -> deployed-model freshness is bounded by stage
+    latency, not cycle latency. Budgets (``max_*``) exist for smokes
+    and benches; production leaves them 0 (run until SIGTERM).
+    """
+
+    # Ingest watcher poll cadence over the raw staging CSV (stat-based
+    # pre-check; content digest decides no-op vs delta vs rebuild).
+    poll_s: float = 2.0
+    # Evaluator poll cadence over the deploy-tier best checkpoint.
+    eval_poll_s: float = 2.0
+    # Epochs per training round — the loop's train quantum. Small keeps
+    # fresh data's wait-for-round short; each round EXTENDS the same
+    # optimizer trajectory (DCT_RESUME semantics).
+    epochs_per_round: int = 2
+    # 'supervised' = each round runs under the PR 3 supervisor
+    # (crash/hang/preemption healing, compile-cache continuity across
+    # relaunches); 'inline' = Trainer.fit in-process (benches/tests).
+    train_mode: str = "supervised"
+    # Rollout soak per stage (shadow/canary dwell) for mid-run
+    # promotions — the loop's evaluator overlaps these with training.
+    soak_s: float = 5.0
+    # Local endpoint name the loop promotes into.
+    endpoint: str = "weather-loop"
+    # Challenger package root (one package dir per promotion attempt;
+    # slot-referenced packages are retained, stale ones pruned).
+    packages_dir: str = "data/loop_packages"
+    # Stop budgets: 0 = unbounded (production always-on).
+    max_rounds: int = 0
+    max_wall_s: float = 0.0
+    max_promotions: int = 0
+
+    @classmethod
+    def from_env(cls) -> "LoopConfig":
+        c = cls()
+        c.poll_s = _env("DCT_LOOP_POLL_S", c.poll_s, float)
+        c.eval_poll_s = _env("DCT_LOOP_EVAL_POLL_S", c.eval_poll_s, float)
+        c.epochs_per_round = _env(
+            "DCT_LOOP_EPOCHS_PER_ROUND", c.epochs_per_round, int
+        )
+        c.train_mode = _env(
+            "DCT_LOOP_TRAIN_MODE", c.train_mode, str
+        ).strip().lower()
+        c.soak_s = _env("DCT_LOOP_SOAK_S", c.soak_s, float)
+        c.endpoint = _env("DCT_LOOP_ENDPOINT", c.endpoint, str)
+        c.packages_dir = _env("DCT_LOOP_PACKAGES_DIR", c.packages_dir, str)
+        c.max_rounds = _env("DCT_LOOP_MAX_ROUNDS", c.max_rounds, int)
+        c.max_wall_s = _env("DCT_LOOP_MAX_WALL_S", c.max_wall_s, float)
+        c.max_promotions = _env(
+            "DCT_LOOP_MAX_PROMOTIONS", c.max_promotions, int
+        )
+        return c
+
+
+@dataclass
 class RunConfig:
     """Top-level bundle passed to the Trainer."""
 
@@ -772,6 +833,7 @@ class RunConfig:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     evaluation: EvaluationConfig = field(default_factory=EvaluationConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    loop: LoopConfig = field(default_factory=LoopConfig)
 
     @classmethod
     def from_env(cls) -> "RunConfig":
@@ -787,6 +849,7 @@ class RunConfig:
             resilience=ResilienceConfig.from_env(),
             evaluation=EvaluationConfig.from_env(),
             serving=ServingConfig.from_env(),
+            loop=LoopConfig.from_env(),
         )
 
     def to_dict(self) -> dict:
@@ -885,6 +948,21 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_DEPLOY_TARGET": "deploy DAGs: azure | local endpoint surface",
     "DCT_KEEP_CHECKPOINTS": "pipeline DAG cleanup: newest ckpts to keep",
     "DCT_ETL_ENGINE": "ETL engine: spark | pandas fallback",
+    "DCT_ETL_INCREMENTAL": "digest no-op + append-only delta ETL (default on)",
+    "DCT_ETL_REBUILD_TOL": "basis-stats shift forcing a full ETL rebuild",
+    # --- always-on loop (dct_tpu.continuous; docs/CONTINUOUS.md) ----
+    "DCT_LOOP_POLL_S": "ingest watcher poll cadence over the raw CSV (s)",
+    "DCT_LOOP_EVAL_POLL_S": "evaluator poll cadence over the best ckpt (s)",
+    "DCT_LOOP_EPOCHS_PER_ROUND": "epochs per continuous training round",
+    "DCT_LOOP_TRAIN_MODE": "round runner: supervised (PR 3) | inline",
+    "DCT_LOOP_SOAK_S": "mid-run rollout soak per stage (s)",
+    "DCT_LOOP_ENDPOINT": "local endpoint the loop promotes into",
+    "DCT_LOOP_PACKAGES_DIR": "challenger package root for mid-run promotions",
+    "DCT_LOOP_MAX_ROUNDS": "loop stop budget: training rounds (0 = unbounded)",
+    "DCT_LOOP_MAX_WALL_S": "loop stop budget: wall seconds (0 = unbounded)",
+    "DCT_LOOP_MAX_PROMOTIONS": "loop stop budget: promotions (0 = unbounded)",
+    "DCT_LOOP_DAG_HOURS": "always-on DAG: one task occupancy before re-trigger",
+    "DCT_LOOP_SMOKE_WAIT_S": "continuous-loop CI smoke: wall budget (s)",
     "DCT_SPARK_MASTER_HOST": "Spark master hostname for the ETL DAG",
     "DCT_SOAK_SECONDS": "auto-deploy DAG: canary soak dwell",
     "DCT_ENDPOINT_NAME": "serve the named LOCAL rollout endpoint",
@@ -995,6 +1073,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_BENCH_FUSE": "bench fused-step legs on/off",
     "DCT_BENCH_SCALED": "bench scaled-transformer leg on/off",
     "DCT_BENCH_SPINUP": "bench restart_spinup (cold/warm relaunch) leg on/off",
+    "DCT_BENCH_FRESHNESS": "bench cycle_freshness (serial vs loop) leg on/off",
     "DCT_BENCH_DEADLINE": "bench wall-clock deadline (s); legs self-gate",
     "DCT_BENCH_PARTIAL": "path for the partial-results stash",
     "DCT_VAL_PARITY_EPOCHS": "val-loss parity leg epoch budget",

@@ -1,0 +1,118 @@
+"""Ingest watcher: content-digest polling of the raw staging path.
+
+The episodic DAG runs ETL once per trigger whether or not the data
+changed; the watcher inverts that — it polls the staging CSV on a
+cadence (cheap ``stat`` pre-check, so an idle loop costs two syscalls
+per poll) and hands any change to the incremental ETL
+(:func:`dct_tpu.etl.preprocess.preprocess_csv_to_parquet`), which
+digests the content and decides no-op / append-only delta / full
+rebuild. ETL therefore runs CONCURRENTLY with training: by the time the
+trainer's next round starts, the fresh generation is already published.
+
+Events (``ingest`` component, documented in docs/OBSERVABILITY.md):
+``ingest.detected`` when the stat pre-check sees a change,
+``ingest.processed`` when a generation was actually published (mode,
+rows, etl seconds), ``ingest.error`` when the ETL raised.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class IngestWatcher:
+    """Polls ``raw_csv`` and feeds the incremental ETL.
+
+    Single-consumer by design: one watcher owns the processed dir's
+    etl_state. ``check_once`` is the unit (poll loops and tests share
+    it); :meth:`run` is the thread body.
+    """
+
+    def __init__(
+        self,
+        raw_csv: str,
+        processed_dir: str,
+        *,
+        poll_s: float = 2.0,
+        emit=None,
+        clock=time.time,
+    ):
+        self.raw_csv = raw_csv
+        self.processed_dir = processed_dir
+        self.poll_s = float(poll_s)
+        self._emit = emit or (lambda *a, **k: None)
+        self._clock = clock
+        self._last_stat: tuple | None = None
+        self._retries = 0
+        self.processed = 0
+        self.errors = 0
+
+    def _stat(self) -> tuple | None:
+        try:
+            st = os.stat(self.raw_csv)
+        except OSError:
+            return None
+        return (st.st_size, st.st_mtime_ns)
+
+    def check_once(self) -> dict | None:
+        """One poll: stat pre-check, then the incremental ETL on any
+        change. Returns the published etl_state when a generation was
+        processed, None otherwise (no data / unchanged / ETL no-op)."""
+        cur = self._stat()
+        if cur is None or cur == self._last_stat:
+            return None
+        self._emit(
+            "ingest", "ingest.detected",
+            path=self.raw_csv, size=cur[0],
+        )
+        from dct_tpu.etl.preprocess import (
+            preprocess_csv_to_parquet, read_etl_state,
+        )
+
+        before = read_etl_state(self.processed_dir).get("generation", 0)
+        t0 = self._clock()
+        try:
+            preprocess_csv_to_parquet(
+                self.raw_csv, self.processed_dir, incremental=True
+            )
+        except Exception as e:  # noqa: BLE001 — the loop must outlive one bad poll
+            self.errors += 1
+            # Transient failures (disk pressure mid-publish, a reader
+            # race) retry on the next polls; only a persistent failure
+            # parks this content's stat — a permanently-broken file
+            # must not re-parse every poll, while any FIX changes the
+            # stat (mtime_ns at minimum) and is picked up.
+            self._retries += 1
+            parked = self._retries >= 3
+            if parked:
+                self._last_stat = cur
+                self._retries = 0
+            self._emit(
+                "ingest", "ingest.error",
+                parked=parked,
+                error=f"{type(e).__name__}: {e}"[:300],
+            )
+            return None
+        self._last_stat = cur
+        self._retries = 0
+        state = read_etl_state(self.processed_dir)
+        if state.get("generation", 0) == before:
+            return None  # content digest said no-op (mtime-only touch)
+        self.processed += 1
+        self._emit(
+            "ingest", "ingest.processed",
+            generation=state.get("generation"),
+            mode=state.get("mode"),
+            rows=state.get("rows"),
+            rows_delta=state.get("rows_delta"),
+            etl_s=round(self._clock() - t0, 4),
+            arrival_ts=state.get("arrival_ts"),
+        )
+        return state
+
+    def run(self, stop_event) -> None:
+        """Thread body: poll until ``stop_event`` is set."""
+        while not stop_event.is_set():
+            self.check_once()
+            stop_event.wait(self.poll_s)

@@ -1,0 +1,392 @@
+"""The always-on loop: overlapped ETL / train / gate / deploy.
+
+``AlwaysOnLoop.run()`` drives three concurrent actors over shared,
+atomically-published artifacts:
+
+- the TRAIN PUMP (this thread): back-to-back rounds of
+  ``epochs_per_round`` epochs, each EXTENDING one optimizer trajectory
+  (``resume`` semantics — exactly the serial trainer's continuation
+  path, so per-step semantics are bit-identical by construction). In
+  ``supervised`` mode every round runs under the PR 3 supervisor
+  (crash/hang healing, compile-cache continuity); ``inline`` runs
+  Trainer.fit in-process (benches/tests).
+- the INGEST WATCHER (daemon thread): digest-polls the raw staging CSV
+  and feeds the incremental ETL, so a fresh generation is published
+  while training computes — the next round picks it up with zero serial
+  ETL wait.
+- the PROMOTION EVALUATOR (daemon thread): watches the deploy-tier best
+  checkpoint and walks each new one through gate + rollout against the
+  live champion — promotion happens MID-RUN, overlapped with training.
+
+Freshness: data-arrival -> deployed-model latency is bounded by stage
+latencies (round + gate + rollout), not by the episodic cycle sum. The
+``cycle_freshness`` bench leg measures both against
+:func:`run_episodic_cycle`, the serial comparator built from the SAME
+primitives run strictly in sequence.
+
+Shutdown: ``request_stop()`` (or SIGTERM via ``jobs/loop.py``) finishes
+the round in flight — mid-fit, the trainer's own PreemptionGuard turns
+the signal into a durable resume snapshot — then drains both threads,
+runs one final evaluator sweep over whatever the last round published,
+and emits ``loop.stop``. A relaunch resumes the trajectory and the
+deployed champion unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import threading
+import time
+
+from dct_tpu.config import RunConfig
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+def _loop_event_log(cfg: RunConfig, run_id: str):
+    from dct_tpu.observability.events import EventLog
+
+    path = (
+        os.path.join(cfg.obs.events_dir, "events.jsonl")
+        if cfg.obs.enabled and cfg.obs.events_dir
+        else None
+    )
+    return EventLog(path, run_id=run_id)
+
+
+def _round_config(cfg: RunConfig, epochs: int) -> RunConfig:
+    """One training round's config: the loop's epoch quantum with
+    resume ALWAYS on (every round extends the same trajectory)."""
+    return dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(cfg.train, epochs=epochs, resume=True),
+    )
+
+
+class AlwaysOnLoop:
+    """The loop runtime. Construct with a full :class:`RunConfig`
+    (``cfg.loop`` carries the loop knobs); ``client`` defaults to a
+    :class:`~dct_tpu.deploy.local.LocalEndpointClient` persisted beside
+    the packages dir so a relaunched loop sees its deployed champion."""
+
+    def __init__(
+        self,
+        cfg: RunConfig,
+        *,
+        client=None,
+        clock=time.time,
+        sleep_fn=time.sleep,
+        on_promotion=None,
+        on_round=None,
+    ):
+        from dct_tpu.observability.events import current_run_id
+
+        self.cfg = cfg
+        self.loop_cfg = cfg.loop
+        self._clock = clock
+        self._sleep = sleep_fn
+        self._on_round = on_round
+        self.run_id = cfg.obs.run_id or current_run_id()
+        # Every inline fit (and the checkpoint/tracking layers under it)
+        # stamps the SAME run-correlation ID: one grep spans the whole
+        # always-on session.
+        cfg.obs.run_id = self.run_id
+        self.events = _loop_event_log(cfg, self.run_id)
+        if client is None:
+            from dct_tpu.deploy.local import LocalEndpointClient
+
+            os.makedirs(self.loop_cfg.packages_dir, exist_ok=True)
+            client = LocalEndpointClient(
+                state_path=os.path.join(
+                    self.loop_cfg.packages_dir, "endpoint_state.json"
+                )
+            )
+        self.client = client
+        from dct_tpu.continuous.evaluator import PromotionEvaluator
+        from dct_tpu.continuous.ingest import IngestWatcher
+
+        self.ingest = IngestWatcher(
+            cfg.data.raw_csv, cfg.data.processed_dir,
+            poll_s=self.loop_cfg.poll_s,
+            emit=self.events.emit, clock=clock,
+        )
+        self.evaluator = PromotionEvaluator(
+            cfg.data.models_dir, self.loop_cfg.packages_dir,
+            client=self.client, endpoint=self.loop_cfg.endpoint,
+            processed_dir=cfg.data.processed_dir,
+            soak_s=self.loop_cfg.soak_s, poll_s=self.loop_cfg.eval_poll_s,
+            run_id=self.run_id, emit=self.events.emit,
+            clock=clock, sleep_fn=sleep_fn,
+            on_promotion=on_promotion,
+        )
+        self._stop = threading.Event()
+        self.stop_reason: str | None = None
+        self.rounds = 0
+        self.round_results: list[dict] = []
+        self.train_step_wall_s = 0.0
+        self.train_samples_per_sec_per_chip: list[float] = []
+
+    # -- control --------------------------------------------------------
+    def request_stop(self, reason: str = "requested") -> None:
+        if self.stop_reason is None:
+            self.stop_reason = reason
+        self._stop.set()
+
+    @property
+    def stopping(self) -> bool:
+        return self._stop.is_set()
+
+    # -- training rounds ------------------------------------------------
+    def _run_round_inline(self) -> dict:
+        from dct_tpu.train.trainer import Trainer
+
+        cfg = _round_config(self.cfg, self.loop_cfg.epochs_per_round)
+        try:
+            result = Trainer(cfg).fit()
+        except FileNotFoundError:
+            # The ingest thread's full-rebuild swap has a two-rename
+            # window with no parquet dir; a round starting inside it
+            # must retry, not kill the always-on session (supervised
+            # mode heals the same race via the PR 3 relauncher).
+            self._sleep(0.2)
+            result = Trainer(cfg).fit()
+        cats = (result.goodput or {}).get("categories") or {}
+        self.train_step_wall_s += float(cats.get("train_step", 0.0))
+        if result.steady_samples_per_sec_per_chip:
+            self.train_samples_per_sec_per_chip.append(
+                result.steady_samples_per_sec_per_chip
+            )
+        return {
+            "mode": "inline",
+            "epochs": self.loop_cfg.epochs_per_round,
+            "val_loss": result.val_loss,
+            "val_acc": result.val_acc,
+        }
+
+    def _run_round_supervised(self) -> dict:
+        from dct_tpu.launch.launcher import LocalProcessLauncher
+
+        world_size = int(os.environ.get("DCT_WORLD_SIZE", "1") or 1)
+        # The child ranks rebuild RunConfig.from_env(): every path THIS
+        # loop was constructed with must travel, or a programmatic
+        # RunConfig would train into env-default dirs while the
+        # watcher/evaluator look at the configured ones.
+        env = {
+            "DCT_EPOCHS": str(self.loop_cfg.epochs_per_round),
+            "DCT_RESUME": "1",
+            "DCT_RUN_ID": self.run_id,
+            "DCT_PROCESSED_DIR": self.cfg.data.processed_dir,
+            "DCT_RAW_CSV": self.cfg.data.raw_csv,
+            "DCT_MODELS_DIR": self.cfg.data.models_dir,
+            "DCT_EVENTS_DIR": self.cfg.obs.events_dir,
+            "DCT_HEARTBEAT_DIR": self.cfg.obs.heartbeat_dir,
+        }
+        launcher = LocalProcessLauncher()
+        res = launcher.supervise(
+            [sys.executable, os.path.join(_REPO_ROOT, "jobs", "train_tpu.py")],
+            world_size=world_size,
+            env=env,
+            max_restarts=self.cfg.resilience.max_restarts,
+            backoff_s=self.cfg.resilience.restart_backoff_s,
+            backoff_factor=self.cfg.resilience.restart_backoff_factor,
+            jitter=self.cfg.resilience.restart_jitter,
+        )
+        if res.classification == "preempted" and not res.success:
+            # The supervisor itself caught SIGTERM (it forwards our
+            # process signals while a round is in flight): the world
+            # saved its resume snapshot — drain.
+            self.request_stop("preempted")
+        elif not res.success:
+            self.request_stop(f"train_{res.classification}")
+            raise RuntimeError(
+                f"supervised round gave up: {res.classification} "
+                f"(restarts={res.restarts})"
+            )
+        return {
+            "mode": "supervised",
+            "epochs": self.loop_cfg.epochs_per_round,
+            "restarts": res.restarts,
+            "classification": res.classification,
+        }
+
+    def _budget_exhausted(self, t0: float) -> str | None:
+        lc = self.loop_cfg
+        if lc.max_rounds and self.rounds >= lc.max_rounds:
+            return "max_rounds"
+        if lc.max_wall_s and self._clock() - t0 >= lc.max_wall_s:
+            return "max_wall_s"
+        if lc.max_promotions and len(
+            self.evaluator.promotions
+        ) >= lc.max_promotions:
+            return "max_promotions"
+        return None
+
+    # -- the loop --------------------------------------------------------
+    def run(self) -> dict:
+        """Run until a stop budget, :meth:`request_stop`, or SIGTERM;
+        returns the session summary (also emitted as ``loop.stop``)."""
+        from dct_tpu.resilience.preempt import PreemptedError
+
+        lc = self.loop_cfg
+        t0 = self._clock()
+        self.events.emit(
+            "loop", "loop.start",
+            train_mode=lc.train_mode,
+            epochs_per_round=lc.epochs_per_round,
+            endpoint=lc.endpoint,
+            poll_s=lc.poll_s, eval_poll_s=lc.eval_poll_s,
+            max_rounds=lc.max_rounds, max_wall_s=lc.max_wall_s,
+            max_promotions=lc.max_promotions,
+        )
+        threads = []
+        if self.cfg.data.raw_csv and lc.poll_s > 0:
+            # Prime the snapshot BEFORE round 1: a cold start must not
+            # race the first fit against an absent parquet.
+            self.ingest.check_once()
+            t = threading.Thread(
+                target=self.ingest.run, args=(self._stop,),
+                name="loop-ingest", daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        if lc.eval_poll_s > 0:
+            t = threading.Thread(
+                target=self.evaluator.run, args=(self._stop,),
+                name="loop-evaluator", daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        error: str | None = None
+        try:
+            while not self._stop.is_set():
+                reason = self._budget_exhausted(t0)
+                if reason is not None:
+                    self.request_stop(reason)
+                    break
+                try:
+                    if lc.train_mode == "inline":
+                        rec = self._run_round_inline()
+                    else:
+                        rec = self._run_round_supervised()
+                except PreemptedError:
+                    # Inline round honored SIGTERM: resume snapshot is
+                    # durable; drain and exit clean.
+                    self.request_stop("preempted")
+                    break
+                except Exception as e:  # noqa: BLE001 — name it, then stop cleanly
+                    error = f"{type(e).__name__}: {e}"[:300]
+                    self.events.emit(
+                        "loop", "loop.error", where="train", error=error
+                    )
+                    self.request_stop("train_error")
+                    break
+                self.rounds += 1
+                rec["round"] = self.rounds
+                self.round_results.append(rec)
+                self.events.emit("loop", "loop.round", **rec)
+        finally:
+            self.request_stop("completed")
+            for t in threads:
+                t.join(timeout=max(60.0, 4 * lc.soak_s + 30.0))
+            if error is None and not any(t.is_alive() for t in threads):
+                # Drain semantics: whatever the final round published
+                # still gets one evaluator pass (bounded: one gate +
+                # rollout) — a SIGTERM between checkpoint and promotion
+                # must not strand a better model undeployed. Skipped if
+                # a join timed out: the evaluator thread may still be
+                # mid-pass, and a concurrent second rollout against the
+                # same endpoint is worse than a missed final sweep.
+                self.evaluator.check_once()
+            summary = self.summary(wall_s=self._clock() - t0, error=error)
+            self.events.emit("loop", "loop.stop", **summary)
+            self.events.close()
+        return summary
+
+    def summary(self, *, wall_s: float, error: str | None = None) -> dict:
+        promos = self.evaluator.promotions
+        fresh = [
+            p["freshness_s"] for p in promos
+            if p.get("freshness_s") is not None
+        ]
+        sps = self.train_samples_per_sec_per_chip
+        return {
+            "reason": self.stop_reason,
+            "error": error,
+            "rounds": self.rounds,
+            "wall_s": round(wall_s, 3),
+            "ingested_generations": self.ingest.processed,
+            "promotions": len(promos),
+            "held": len(self.evaluator.held),
+            "evaluator_errors": self.evaluator.errors,
+            "ingest_errors": self.ingest.errors,
+            "freshness_s": [round(f, 3) for f in fresh],
+            "mean_freshness_s": (
+                round(sum(fresh) / len(fresh), 3) if fresh else None
+            ),
+            # Platform goodput: train-step wall as a fraction of loop
+            # wall (inline rounds; supervised rounds account in their
+            # own rank events).
+            "train_step_wall_s": round(self.train_step_wall_s, 3),
+            "goodput": (
+                round(self.train_step_wall_s / wall_s, 4)
+                if wall_s > 0 else None
+            ),
+            "train_samples_per_sec_per_chip": (
+                round(sum(sps) / len(sps), 1) if sps else None
+            ),
+        }
+
+
+# ----------------------------------------------------------------------
+# The episodic comparator: the SAME primitives, strictly serial.
+
+
+def run_episodic_cycle(
+    cfg: RunConfig,
+    *,
+    client,
+    evaluator,
+    clock=time.time,
+) -> dict:
+    """One serial ETL -> train -> gate -> deploy cycle — the reference's
+    episodic DAG semantics built from the loop's own primitives, so the
+    ``cycle_freshness`` bench compares architectures, not
+    implementations. ``evaluator`` is a
+    :class:`~dct_tpu.continuous.evaluator.PromotionEvaluator` reused
+    across cycles (its seen-checkpoint state and package counter
+    persist, exactly like the loop's)."""
+    from dct_tpu.etl.preprocess import preprocess_csv_to_parquet, read_etl_state
+    from dct_tpu.train.trainer import Trainer
+
+    t0 = clock()
+    preprocess_csv_to_parquet(
+        cfg.data.raw_csv, cfg.data.processed_dir, incremental=True
+    )
+    t_etl = clock()
+    result = Trainer(_round_config(cfg, cfg.loop.epochs_per_round)).fit()
+    t_train = clock()
+    promo = evaluator.check_once()
+    t_done = clock()
+    state = read_etl_state(cfg.data.processed_dir)
+    arrival = state.get("arrival_ts")
+    cats = (result.goodput or {}).get("categories") or {}
+    return {
+        "cycle_s": round(t_done - t0, 4),
+        "etl_s": round(t_etl - t0, 4),
+        "train_s": round(t_train - t_etl, 4),
+        "deploy_s": round(t_done - t_train, 4),
+        "train_step_wall_s": float(cats.get("train_step", 0.0)),
+        "train_samples_per_sec_per_chip":
+            result.steady_samples_per_sec_per_chip,
+        "promoted": promo is not None,
+        "generation": state.get("generation"),
+        "freshness_s": (
+            round(t_done - arrival, 4)
+            if promo is not None and arrival else None
+        ),
+        "val_loss": result.val_loss,
+    }

@@ -94,3 +94,70 @@ def load_processed_dataset(
     ).astype(np.float32)
     labels = table.column(label_column).to_numpy(zero_copy_only=False).astype(np.int32)
     return WeatherArrays(features=feats, labels=labels, feature_names=feature_cols)
+
+
+# ----------------------------------------------------------------------
+# Snapshot-keyed load cache: the always-on loop's evaluator re-reads the
+# SAME processed snapshot on every champion/challenger pass (one pass
+# per new best checkpoint, several per ETL generation) — the parquet IO
+# dominates those evals at dataset scale. Keyed by the part files'
+# (name, mtime_ns, size) set, so an incremental-ETL delta part (or a
+# full-rebuild swap) invalidates on the next call.
+
+_LOAD_CACHE: dict[tuple, tuple[tuple, WeatherArrays]] = {}
+_LOAD_CACHE_SLOTS = 4
+
+
+def _snapshot_key(parquet_path: str) -> tuple | None:
+    """Stat-derived identity of a parquet file or directory snapshot;
+    None when it cannot be stat'd (callers fall through to the loud
+    loader)."""
+    try:
+        if os.path.isdir(parquet_path):
+            entries = []
+            for name in sorted(os.listdir(parquet_path)):
+                if not name.endswith(".parquet"):
+                    continue
+                st = os.stat(os.path.join(parquet_path, name))
+                entries.append((name, st.st_mtime_ns, st.st_size))
+            return tuple(entries) or None
+        st = os.stat(parquet_path)
+        return ((os.path.basename(parquet_path), st.st_mtime_ns, st.st_size),)
+    except OSError:
+        return None
+
+
+def load_processed_dataset_cached(
+    processed_dir: str,
+    *,
+    feature_suffix: str = "_norm",
+    label_column: str = "label_encoded",
+    parquet_name: str = "data.parquet",
+) -> WeatherArrays:
+    """:func:`load_processed_dataset` behind a snapshot-keyed cache.
+
+    Returns the SAME :class:`WeatherArrays` object for an unchanged
+    snapshot — callers must treat it as immutable. Bounded to
+    ``_LOAD_CACHE_SLOTS`` snapshots (oldest-inserted evicted), so a
+    loop cycling processed dirs cannot grow host RAM unboundedly.
+    """
+    cache_id = (
+        os.path.abspath(processed_dir), feature_suffix, label_column,
+        parquet_name,
+    )
+    key = _snapshot_key(os.path.join(processed_dir, parquet_name))
+    if key is not None:
+        hit = _LOAD_CACHE.get(cache_id)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+    data = load_processed_dataset(
+        processed_dir,
+        feature_suffix=feature_suffix,
+        label_column=label_column,
+        parquet_name=parquet_name,
+    )
+    if key is not None:
+        _LOAD_CACHE[cache_id] = (key, data)
+        while len(_LOAD_CACHE) > _LOAD_CACHE_SLOTS:
+            _LOAD_CACHE.pop(next(iter(_LOAD_CACHE)))
+    return data

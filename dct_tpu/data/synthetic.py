@@ -58,3 +58,23 @@ def generate_weather_csv(path: str, *, rows: int = 2500, seed: int = 0) -> str:
             vals = ",".join(f"{c[i]:.4f}" for c in cols)
             f.write(f"{vals},{rain[i]}\n")
     return path
+
+
+def append_weather_rows(path: str, *, rows: int, seed: int) -> str:
+    """Append freshly-generated rows (same schema/distribution) to an
+    existing weather CSV — the always-on loop's staging-path growth
+    pattern (docs/CONTINUOUS.md). The payload is complete lines written
+    in ONE ``write`` call and every generated file ends in a newline,
+    so the incremental ETL's append-only digest check holds and a
+    concurrent poll can at worst observe a clean prefix. Returns the
+    path."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        extra = os.path.join(td, "extra.csv")
+        generate_weather_csv(extra, rows=rows, seed=seed)
+        with open(extra) as f:
+            payload = "".join(f.readlines()[1:])  # drop the header
+    with open(path, "a") as f:
+        f.write(payload)
+    return path

@@ -163,10 +163,13 @@ def _training_data_snapshot(data_dir: str | None) -> dict | None:
 
     data_dir = data_dir or os.environ.get("DCT_PROCESSED_DIR", "data/processed")
     try:
-        from dct_tpu.data.dataset import load_processed_dataset
+        # Cached by snapshot identity: the always-on loop packages a
+        # challenger per promotion against the same processed snapshot —
+        # the quantile stamp must not re-pay the parquet IO each time.
+        from dct_tpu.data.dataset import load_processed_dataset_cached
         from dct_tpu.evaluation.drift import snapshot_features
 
-        data = load_processed_dataset(data_dir)
+        data = load_processed_dataset_cached(data_dir)
         return snapshot_features(
             data.features, data.feature_names,
             bins=EvaluationConfig.from_env().drift_bins,

@@ -269,11 +269,15 @@ class PromotionGate:
     def _load_data(self):
         """One parquet load per evaluation, shared by the harness split
         and the drift report (dataset-scale splits must not pay the IO
-        twice). None when unavailable — callers degrade."""
-        from dct_tpu.data.dataset import load_processed_dataset
+        twice) — and cached across CONSECUTIVE evaluations by snapshot
+        identity (dataset._snapshot_key: part-file name/mtime/size), so
+        the always-on loop's repeated evals against one processed
+        snapshot pay the parquet IO once. None when unavailable —
+        callers degrade."""
+        from dct_tpu.data.dataset import load_processed_dataset_cached
 
         try:
-            return load_processed_dataset(self.processed_dir)
+            return load_processed_dataset_cached(self.processed_dir)
         except Exception:  # noqa: BLE001 — harness raises its own
             return None  # typed EvalError; drift just has no evidence
 

@@ -197,6 +197,9 @@ def build_report(
     # Serving section below summarizes them instead).
     interesting_prefixes = (
         "health.", "deploy.", "slo.", "compile.", "restart.",
+        # Always-on loop actors (docs/CONTINUOUS.md): rounds, ingested
+        # generations and mid-run promotions are cycle landmarks.
+        "loop.", "ingest.",
     )
     shown = 0
     for r in ev:
@@ -230,6 +233,16 @@ def build_report(
             extra = (
                 f" program={r.get('program')} "
                 f"seconds={_fmt_num(r.get('seconds'))}"
+            )
+        if name == "loop.promoted":
+            extra = (
+                f" generation={r.get('generation')}"
+                f" freshness_s={_fmt_num(r.get('freshness_s'))}"
+            )
+        if name == "ingest.processed":
+            extra = (
+                f" generation={r.get('generation')} mode={r.get('mode')}"
+                f" rows={r.get('rows')}"
             )
         lines.append(
             f"  {_fmt_ts(r.get('ts'), t0)}  "
@@ -332,6 +345,45 @@ def build_report(
             "  (no serve.* events — traffic untraced or none served; "
             "serving telemetry is opt-in via DCT_SERVE_TRACE)"
         )
+
+    # -- always-on loop -----------------------------------------------
+    loop_ev = [
+        r for r in ev
+        if str(r.get("event", "")).startswith(("loop.", "ingest."))
+    ]
+    if loop_ev:
+        lines.append("")
+        lines.append("Continuous loop:")
+        rounds = [r for r in loop_ev if r.get("event") == "loop.round"]
+        ingests = [
+            r for r in loop_ev if r.get("event") == "ingest.processed"
+        ]
+        promos = [r for r in loop_ev if r.get("event") == "loop.promoted"]
+        held = [
+            r for r in loop_ev if r.get("event") == "loop.promotion_held"
+        ]
+        lines.append(
+            f"  rounds: {len(rounds)}; generations ingested: "
+            f"{len(ingests)}; promotions: {len(promos)}; held: {len(held)}"
+        )
+        fresh = [
+            r.get("freshness_s") for r in promos
+            if isinstance(r.get("freshness_s"), (int, float))
+        ]
+        if fresh:
+            lines.append(
+                f"  freshness_s: last={_fmt_num(fresh[-1])} "
+                f"mean={_fmt_num(sum(fresh) / len(fresh))} "
+                f"worst={_fmt_num(max(fresh))}"
+            )
+        stops = [r for r in loop_ev if r.get("event") == "loop.stop"]
+        if stops:
+            s = stops[-1]
+            lines.append(
+                f"  stopped: reason={s.get('reason')} "
+                f"goodput={_fmt_num(s.get('goodput'))} "
+                f"wall={_fmt_num(s.get('wall_s'))}s"
+            )
 
     # -- deploy gates / SLO -------------------------------------------
     lines.append("")

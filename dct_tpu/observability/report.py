@@ -47,6 +47,13 @@ SERIES = (
     # >25% rise threshold as the serving latency series.
     ("warm_step_s", ("restart_spinup", "warm_step_s"), "down"),
     ("warm_score_s", ("restart_spinup", "warm_score_s"), "down"),
+    # Always-on loop (the cycle_freshness bench leg): data-arrival ->
+    # deployed-model latency through the overlapped loop, and its
+    # advantage over the serial episodic cycle. The latency gates at
+    # the >25% rise threshold; the speedup at the >10% drop threshold.
+    ("loop_freshness_s", ("cycle_freshness", "loop_mean_freshness_s"),
+     "down"),
+    ("freshness_speedup", ("cycle_freshness", "freshness_speedup"), "up"),
 )
 
 

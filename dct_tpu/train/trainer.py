@@ -271,6 +271,25 @@ class Trainer:
         )
         _t_startup = ledger.clock()
         startup_span = tracer.start("trainer.startup", component="trainer")
+        # Data-generation provenance for the always-on loop's freshness
+        # accounting (dct_tpu.continuous): the incremental ETL stamps a
+        # generation + arrival_ts into etl_state.json, read here BEFORE
+        # the parquet load — so a checkpoint's stamped generation never
+        # claims rows a concurrent ETL published after our snapshot.
+        # Only when this fit loads the data itself: a caller-provided
+        # array set has no provable tie to the processed dir.
+        _data_provenance: dict = {}
+        if data is None:
+            from dct_tpu.etl.preprocess import read_etl_state
+
+            _etl_state = read_etl_state(cfg.data.processed_dir)
+            if _etl_state.get("generation"):
+                _data_provenance = {
+                    "data_generation": int(_etl_state["generation"]),
+                    "data_arrival_ts": float(
+                        _etl_state.get("arrival_ts") or 0.0
+                    ),
+                }
         if data is None:
             data = load_processed_dataset(
                 cfg.data.processed_dir,
@@ -589,6 +608,10 @@ class Trainer:
             "model": cfg.model.name,
             "input_dim": data.input_dim,
             "feature_names": list(data.feature_names),
+            # Which ETL generation this trajectory extension trained on
+            # (empty pre-incremental-ETL): the loop's evaluator reads it
+            # off the packaged meta to attribute promotion freshness.
+            **_data_provenance,
         }
         meta.pop("name", None)
         run_id = self.tracker.start_run(params={**meta, "lr": cfg.train.lr,

@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Always-on loop entry point: ``python3 jobs/loop.py``.
+
+Runs :class:`dct_tpu.continuous.AlwaysOnLoop` from the ``DCT_*`` env
+contract (``DCT_LOOP_*`` knobs; docs/CONTINUOUS.md) until SIGTERM/
+SIGINT or a stop budget (``DCT_LOOP_MAX_ROUNDS`` / ``_MAX_WALL_S`` /
+``_MAX_PROMOTIONS`` — smokes and benches; production leaves them 0).
+
+SIGTERM drains cleanly: the round in flight finishes (mid-fit, the
+trainer's PreemptionGuard saves a durable resume snapshot; in
+supervised mode the PR 3 supervisor forwards the signal to the world),
+the ingest/evaluator threads join, one final evaluator sweep covers the
+last published checkpoint, and the process exits 0 with ``loop.stop``
+on the event log. A relaunch resumes the trajectory and the deployed
+champion unchanged — the loop is restart-transparent by construction.
+
+Exit code: 0 on a clean drain (including SIGTERM and stop budgets),
+1 when the loop stopped on an error (supervisor gave up, ETL wedged).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main() -> int:
+    from dct_tpu.config import RunConfig
+    from dct_tpu.continuous import AlwaysOnLoop
+    from dct_tpu.utils.logging import get_logger
+
+    log = get_logger("loop")
+    cfg = RunConfig.from_env()
+    loop = AlwaysOnLoop(cfg)
+    log.info(
+        "always-on loop starting: run_id=%s mode=%s endpoint=%s "
+        "epochs/round=%d",
+        loop.run_id, cfg.loop.train_mode, cfg.loop.endpoint,
+        cfg.loop.epochs_per_round,
+    )
+
+    def _drain(signum, frame):
+        # Idempotent: the first signal requests the drain; the trainer's
+        # own PreemptionGuard (inline) or the supervisor (supervised)
+        # owns the handler while a round is in flight and restores this
+        # one after.
+        log.info("signal %d: draining the loop", signum)
+        loop.request_stop(f"signal_{signum}")
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _drain)
+
+    summary = loop.run()
+    log.info(
+        "loop stopped: reason=%s rounds=%d promotions=%d held=%d "
+        "mean_freshness_s=%s",
+        summary["reason"], summary["rounds"], summary["promotions"],
+        summary["held"], summary["mean_freshness_s"],
+    )
+    return 1 if summary.get("error") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

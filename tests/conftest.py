@@ -83,6 +83,7 @@ def cpu_smoke_env(**overrides) -> dict:
         DCT_BENCH_TORCH_EPOCHS="1",
         DCT_VAL_PARITY_EPOCHS="1",
         DCT_BENCH_SCALED="0",
+        DCT_BENCH_FRESHNESS="0",
     )
     env.update({k: str(v) for k, v in overrides.items()})
     return env

@@ -26,6 +26,11 @@ def test_bench_main_cpu_record_carries_everything(
     # below proves skipped-not-absent. scripts/compile_cache_smoke.py
     # (the compile-cache CI job) runs the leg's machinery for real.
     monkeypatch.setenv("DCT_BENCH_SPINUP", "0")
+    # Likewise cycle_freshness: the serial-vs-loop comparison runs two
+    # full continuous-training rigs (~40 s); tests/test_continuous.py
+    # exercises the loop machinery for real, the smoke pins the null
+    # marker wiring.
+    monkeypatch.setenv("DCT_BENCH_FRESHNESS", "0")
     monkeypatch.setenv(
         "DCT_BENCH_PARTIAL", str(tmp_path / "BENCH_PARTIAL.json")
     )
@@ -103,9 +108,11 @@ def test_bench_main_cpu_record_carries_everything(
     assert vp["protocol"] == "BASELINE.md row 1"
     # The partial on disk is the VERBATIM record (crash hedge + the
     # carry-forward's full provenance), matching stdout's digest.
-    # Skipped-not-absent: the gated restart_spinup leg leaves its null
-    # marker (DCT_BENCH_SPINUP=0 above), like every skippable section.
+    # Skipped-not-absent: the gated restart_spinup / cycle_freshness
+    # legs leave their null markers (DCT_BENCH_SPINUP=0 /
+    # DCT_BENCH_FRESHNESS=0 above), like every skippable section.
     assert record["restart_spinup"] is None
+    assert record["cycle_freshness"] is None
     with open(tmp_path / "BENCH_PARTIAL.json") as f:
         partial = json.load(f)
     assert partial["trainer_gap"]["fused"] == partial["value"]

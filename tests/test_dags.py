@@ -23,6 +23,7 @@ DAG_MODULES = [
     "pipeline_dag",
     "azure_manual_deploy_dag",
     "azure_auto_deploy_dag",
+    "continuous_loop_dag",
 ]
 
 
@@ -82,6 +83,19 @@ def test_all_five_reference_dag_ids_exist(dags):
         "azure_manual_deploy",
         "azure_automated_rollout",
     }
+
+
+def test_always_on_loop_dag(dags):
+    """The always-on entrypoint (docs/CONTINUOUS.md): unscheduled (the
+    loop retires the DAG clock — it is started deliberately), one task
+    running jobs/loop.py under an execution timeout whose SIGTERM is
+    the loop's clean drain signal."""
+    dag = dags["continuous_always_on_loop"]
+    assert dag.kwargs.get("schedule") is None
+    assert list(dag.tasks) == ["run_always_on_loop"]
+    task = dag.tasks["run_always_on_loop"]
+    assert "jobs/loop.py" in task.bash_command
+    assert "DCT_RUN_ID" in task.bash_command  # run-correlation contract
 
 
 def test_trigger_targets_exist(dags):

@@ -49,11 +49,14 @@ def test_label_encoding(processed_dir, weather_csv):
 
 
 def test_overwrite_mode(weather_csv, tmp_path):
+    # incremental=False pins the historical full-transform semantics:
+    # every call rebuilds the snapshot (the incremental path's no-op
+    # short-circuit has its own tests below).
     out = str(tmp_path / "proc")
-    preprocess_csv_to_parquet(weather_csv, out)
+    preprocess_csv_to_parquet(weather_csv, out, incremental=False)
     marker = os.path.join(out, "data.parquet", "stale_file")
     open(marker, "w").close()
-    preprocess_csv_to_parquet(weather_csv, out)
+    preprocess_csv_to_parquet(weather_csv, out, incremental=False)
     assert not os.path.exists(marker), "overwrite mode must wipe previous output"
 
 
@@ -79,8 +82,9 @@ def test_drift_report_between_runs(tmp_path):
     assert (tmp_path / "proc" / "stats.json").exists()
     assert not (tmp_path / "proc" / "drift_report.json").exists()
 
-    # Identical data -> no drift.
-    preprocess_csv_to_parquet(csv1, out)
+    # Identical data (forced full re-run) -> no drift. (The incremental
+    # default would short-circuit an unchanged CSV to a no-op instead.)
+    preprocess_csv_to_parquet(csv1, out, incremental=False)
     rep = json.load(open(tmp_path / "proc" / "drift_report.json"))
     assert not rep["any_drift"], rep
 
@@ -174,3 +178,163 @@ def test_drift_edge_cases(tmp_path):
         threshold=10.0,
     )
     assert rep["label_drifted"]
+
+
+# ----------------------------------------------------------------------
+# Incremental mode (ISSUE 10 satellite): digest no-op + append-only delta.
+
+
+def _append_rows(csv_path: str, rows: int, seed: int) -> None:
+    """The shared staging-path growth helper (one definition so every
+    rig appends exactly how the incremental ETL expects)."""
+    from dct_tpu.data.synthetic import append_weather_rows
+
+    append_weather_rows(csv_path, rows=rows, seed=seed)
+
+
+def test_incremental_unchanged_csv_is_noop(tmp_path):
+    from dct_tpu.data.synthetic import generate_weather_csv
+    from dct_tpu.etl.preprocess import read_etl_state
+
+    csv = str(tmp_path / "raw.csv")
+    generate_weather_csv(csv, rows=400, seed=3)
+    out = str(tmp_path / "proc")
+    preprocess_csv_to_parquet(csv, out, incremental=True)
+    state1 = read_etl_state(out)
+    assert state1["generation"] == 1 and state1["mode"] == "full"
+    pdir = os.path.join(out, "data.parquet")
+    mtimes = {f: os.path.getmtime(os.path.join(pdir, f)) for f in os.listdir(pdir)}
+
+    # mtime-touch without content change: still a no-op (content digest,
+    # not stat, is the authority).
+    os.utime(csv)
+    preprocess_csv_to_parquet(csv, out, incremental=True)
+    state2 = read_etl_state(out)
+    assert state2["generation"] == 1, "no-op must not mint a generation"
+    assert {
+        f: os.path.getmtime(os.path.join(pdir, f)) for f in os.listdir(pdir)
+    } == mtimes, "no-op must not rewrite any part file"
+    assert not os.path.exists(tmp_path / "proc" / "drift_report.json")
+
+
+def test_incremental_append_processes_only_delta(tmp_path):
+    import json
+
+    from dct_tpu.data.dataset import load_processed_dataset
+    from dct_tpu.data.synthetic import generate_weather_csv
+    from dct_tpu.etl.preprocess import read_etl_state
+
+    csv = str(tmp_path / "raw.csv")
+    generate_weather_csv(csv, rows=500, seed=4)
+    out = str(tmp_path / "proc")
+    preprocess_csv_to_parquet(csv, out, incremental=True)
+    pdir = os.path.join(out, "data.parquet")
+    part0 = os.path.join(pdir, "part-00000.parquet")
+    part0_bytes = open(part0, "rb").read()
+    basis = read_etl_state(out)["norm_basis"]
+
+    _append_rows(csv, 200, seed=5)
+    preprocess_csv_to_parquet(csv, out, incremental=True)
+    state = read_etl_state(out)
+    assert state["mode"] == "delta" and state["generation"] == 2
+    assert state["rows"] == 700 and state["rows_delta"] == 200
+    # Delta mode appends a new part; the existing part is untouched bytes.
+    assert os.path.exists(os.path.join(pdir, "part-00001.parquet"))
+    assert open(part0, "rb").read() == part0_bytes
+
+    # Every part shares ONE normalization basis: the loaded dataset is
+    # exactly "full transform under the basis stats".
+    import pyarrow.csv as pacsv
+
+    data = load_processed_dataset(out)
+    assert len(data) == 700
+    raw = pacsv.read_csv(csv)
+    for i, name in enumerate(DEFAULT_FEATURES):
+        col = raw.column(name).to_numpy(zero_copy_only=False).astype(np.float64)
+        b = basis[name]
+        expected = (col - b["mean"]) / (b["std"] if b["std"] else 1.0)
+        np.testing.assert_allclose(
+            np.sort(data.features[:, i].astype(np.float64)),
+            np.sort(expected),
+            rtol=1e-5,  # float32 storage
+        )
+
+    # stats.json sees the FULL distribution: merged moments match a
+    # from-scratch recompute over all 700 rows.
+    stats = json.load(open(tmp_path / "proc" / "stats.json"))
+    assert stats["rows"] == 700
+    for name in DEFAULT_FEATURES:
+        col = raw.column(name).to_numpy(zero_copy_only=False).astype(np.float64)
+        assert stats["features"][name]["mean"] == pytest.approx(col.mean(), rel=1e-9)
+        assert stats["features"][name]["std"] == pytest.approx(
+            col.std(ddof=1), rel=1e-9
+        )
+    # Drift check ran against the previous full stats.
+    rep = json.load(open(tmp_path / "proc" / "drift_report.json"))
+    assert not rep["any_drift"], rep
+
+
+def test_incremental_rewrite_triggers_full_rebuild(tmp_path):
+    """A non-append change (row edit) must fall back to the full
+    transform — and a shifted append past DCT_ETL_REBUILD_TOL must too,
+    so the frozen normalization basis can never misrepresent the data."""
+    import pandas as pd
+
+    from dct_tpu.data.synthetic import generate_weather_csv
+    from dct_tpu.etl.preprocess import read_etl_state
+
+    csv = str(tmp_path / "raw.csv")
+    generate_weather_csv(csv, rows=300, seed=6)
+    out = str(tmp_path / "proc")
+    preprocess_csv_to_parquet(csv, out, incremental=True)
+
+    # In-place rewrite (not append-only): full rebuild, single part.
+    df = pd.read_csv(csv)
+    df["Temperature"] = df["Temperature"] + 1.0
+    df.to_csv(csv, index=False)
+    preprocess_csv_to_parquet(csv, out, incremental=True)
+    state = read_etl_state(out)
+    assert state["mode"] == "full" and state["generation"] == 2
+    pdir = os.path.join(out, "data.parquet")
+    parts = [f for f in os.listdir(pdir) if f.endswith(".parquet")]
+    assert parts == ["part-00000.parquet"]
+
+    # Appended rows shifted by many sigma: append-only in bytes, but the
+    # merged stats leave the basis tolerance -> full rebuild again.
+    sigma = float(df["Temperature"].std())
+    shifted = df.copy()
+    shifted["Temperature"] += 25 * sigma
+    with open(csv, "a") as f:
+        shifted.to_csv(f, index=False, header=False)
+    preprocess_csv_to_parquet(csv, out, incremental=True)
+    state = read_etl_state(out)
+    assert state["mode"] == "full" and state["generation"] == 3
+    parts = [f for f in os.listdir(pdir) if f.endswith(".parquet")]
+    assert parts == ["part-00000.parquet"], "stale basis must not accrete parts"
+
+
+def test_forced_full_run_invalidates_incremental_state(tmp_path):
+    """A non-incremental rebuild rewrites the snapshot under a NEW
+    normalization basis; leaving the old etl_state behind would let a
+    later incremental call append already-transformed rows as a delta
+    (duplicated rows under a mixed basis). The full run must invalidate
+    the state."""
+    from dct_tpu.data.dataset import load_processed_dataset
+    from dct_tpu.data.synthetic import generate_weather_csv
+    from dct_tpu.etl.preprocess import read_etl_state
+
+    csv = str(tmp_path / "raw.csv")
+    generate_weather_csv(csv, rows=300, seed=9)
+    out = str(tmp_path / "proc")
+    preprocess_csv_to_parquet(csv, out, incremental=True)
+    _append_rows(csv, 100, seed=10)
+
+    # Operator forces a full (non-incremental) rebuild over the grown CSV.
+    preprocess_csv_to_parquet(csv, out, incremental=False)
+    assert read_etl_state(out) == {}, "stale incremental state must die"
+
+    # Back on the incremental path: a further append must NOT replay
+    # rows the rebuild already transformed.
+    _append_rows(csv, 50, seed=11)
+    preprocess_csv_to_parquet(csv, out, incremental=True)
+    assert len(load_processed_dataset(out)) == 450
