@@ -1060,6 +1060,158 @@ def bench_restart_spinup(tmp: str) -> dict:
     return out
 
 
+#: model_sharded leg shape: the SAME small transformer config measured
+#: twice on a 4-virtual-CPU-device mesh in ISOLATED subprocesses (each
+#: variant's peak host RSS is per-process, and XLA_FLAGS must be set
+#: before the child's first jax import): pure DP (data=4, everything
+#: replicated per device) vs partition-rule sharded (data=2/model=2 TP
+#: + ZeRO-1 optimizer sharding). On the CPU rig "device" memory IS host
+#: memory, so the replicated run materializes one state copy per
+#: device while the sharded run holds one copy split across them — the
+#: peak-RSS delta is the memory story, the samples/sec ratio the
+#: throughput story (sharded_sps_ratio, tracked by report.py).
+_SHARDED_DEVICES = 4
+_SHARDED_CFG = dict(seq_len=16, d_model=64, n_heads=2, n_layers=2, d_ff=128)
+_SHARDED_BATCH = 32
+_SHARDED_SCAN = 8
+
+
+def _model_sharded_child():
+    """Subprocess body (``python -c "import bench; bench._model_sharded_
+    child()" '<spec json>'``): build the mesh/layout the spec asks for,
+    time the fused scanned step, report throughput + peak host RSS as
+    one JSON line on stdout."""
+    import resource
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    spec = json.loads(sys.argv[-1])
+
+    from dct_tpu.config import MeshConfig, ModelConfig
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.ops.attention import make_attention_fn
+    from dct_tpu.parallel.mesh import make_global_epoch, make_mesh
+    from dct_tpu.parallel.sharding_rules import shard_state_with_rules
+    from dct_tpu.train.state import create_train_state
+    from dct_tpu.train.steps import make_epoch_train_step
+
+    mesh = make_mesh(MeshConfig(**spec["mesh"]))
+    cfg = ModelConfig(name="weather_transformer", **_SHARDED_CFG)
+    input_dim = 5
+    model = get_model(
+        cfg, input_dim=input_dim, compute_dtype=jnp.float32,
+        attn_fn=make_attention_fn(mesh), mesh=mesh,
+    )
+    state = create_train_state(
+        model, input_dim=input_dim, lr=1e-3, seed=0,
+        example_shape=(1, cfg.seq_len, input_dim),
+    )
+    state = shard_state_with_rules(
+        state, mesh,
+        shard_opt=spec["shard_opt"], shard_params=spec["shard_params"],
+        family="weather_transformer",
+    )
+    rng = np.random.default_rng(0)
+    scan_len, batch = _SHARDED_SCAN, _SHARDED_BATCH
+    xs = rng.standard_normal(
+        (scan_len, batch, cfg.seq_len, input_dim)
+    ).astype(np.float32)
+    ys = rng.integers(0, 2, (scan_len, batch)).astype(np.int32)
+    ws = np.ones((scan_len, batch), np.float32)
+    stacks = make_global_epoch(mesh, xs, ys, ws)
+    epoch_step = make_epoch_train_step(donate=False)
+    t_step = _time_scanned_step(
+        epoch_step, state, stacks, scan_len=scan_len
+    )
+    # One fresh trajectory for the parity sanity number (the timed
+    # states above advanced through warmup reps).
+    import jax as _jax
+
+    _st, losses = epoch_step(state, *stacks)
+    _jax.block_until_ready(_st.params)
+    peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    print(json.dumps({
+        "samples_per_sec": round(batch / t_step, 1),
+        "step_ms": round(t_step * 1e3, 3),
+        "peak_host_rss_mb": round(peak_mb, 1),
+        "first_epoch_loss": float(np.asarray(losses).mean()),
+    }))
+
+
+def bench_model_sharded() -> dict:
+    """Partition-rule sharded vs pure-DP continuous training at matched
+    config on the CPU mesh (ISSUE 11): throughput ratio + peak host
+    memory per variant, each measured in an isolated subprocess world
+    so RSS and device layout cannot bleed between them. The loss of the
+    first fused epoch rides along as a cross-variant sanity pin (layout
+    is not math: the two must agree to float tolerance)."""
+    import subprocess
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=f"--xla_force_host_platform_device_count={_SHARDED_DEVICES}",
+    )
+    # The A/B must compare THIS tree's layouts, not an operator's
+    # override experiment.
+    env.pop("DCT_SHARD_RULES", None)
+
+    def run(tag: str, mesh: dict, *, shard_opt: bool, shard_params: bool):
+        spec = {
+            "mesh": mesh, "shard_opt": shard_opt,
+            "shard_params": shard_params,
+        }
+        out = subprocess.run(
+            [
+                sys.executable, "-c",
+                "import bench; bench._model_sharded_child()",
+                json.dumps(spec),
+            ],
+            env=env, cwd=_REPO_ROOT, capture_output=True, text=True,
+            timeout=600,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"model_sharded {tag} child failed: {out.stderr[-400:]}"
+            )
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    dp = run(
+        "dp", {"data": _SHARDED_DEVICES, "model": 1},
+        shard_opt=False, shard_params=False,
+    )
+    sh = run(
+        "sharded", {"data": _SHARDED_DEVICES // 2, "model": 2},
+        shard_opt=True, shard_params=False,
+    )
+    out = {
+        "devices": _SHARDED_DEVICES,
+        "config": dict(_SHARDED_CFG, batch=_SHARDED_BATCH,
+                       scan_len=_SHARDED_SCAN),
+        "dp_sps": dp["samples_per_sec"],
+        "sharded_sps": sh["samples_per_sec"],
+        "dp_peak_rss_mb": dp["peak_host_rss_mb"],
+        "sharded_peak_rss_mb": sh["peak_host_rss_mb"],
+        # Layout is not math: the two first-epoch losses must agree to
+        # float tolerance (different meshes reduce in different orders,
+        # so bitwise is not promised HERE; the trainer-level pins live
+        # in tests/test_sharded_loop.py).
+        "loss_delta": round(
+            abs(dp["first_epoch_loss"] - sh["first_epoch_loss"]), 8
+        ),
+    }
+    if dp["samples_per_sec"]:
+        out["sharded_sps_ratio"] = round(
+            sh["samples_per_sec"] / dp["samples_per_sec"], 3
+        )
+    if sh["peak_host_rss_mb"]:
+        out["peak_rss_ratio"] = round(
+            dp["peak_host_rss_mb"] / sh["peak_host_rss_mb"], 3
+        )
+    return out
+
+
 #: cycle_freshness leg shape: two SCORED generations arriving while the
 #: system is busy, after a bootstrap generation that pays XLA compile
 #: and the first deploy for BOTH runners. The serial side's train
@@ -1616,6 +1768,17 @@ def _stdout_record(record: dict) -> dict:
         }
         if digest:
             out["restart_spinup"] = digest
+    ms = out.get("model_sharded")
+    if isinstance(ms, dict) and "error" not in ms:
+        # Stdout carries the two ratios + the parity delta (the
+        # sentinel's series + the memory story as one number); the
+        # per-variant sps/RSS detail and the config dict stay in the
+        # partial (env-reconstructible constants).
+        out["model_sharded"] = {
+            k: ms[k]
+            for k in ("sharded_sps_ratio", "peak_rss_ratio", "loss_delta")
+            if k in ms
+        }
     cf = out.get("cycle_freshness")
     if isinstance(cf, dict) and "error" not in cf:
         # Stdout carries the architecture comparison (speedup, both
@@ -1739,8 +1902,10 @@ def _shrink_to_budget(out: dict) -> dict:
         ("host_dataplane", ("rows_speedup", "windows_speedup")),
         ("serving", ()),
         ("probe", ("platform", "attempts", "fallback_reason")),
-        ("val_parity", ("protocol", "torch_val_loss", "jax_val_loss",
-                        "abs_diff")),
+        # The protocol pointer is a constant ("BASELINE.md row 1" —
+        # recoverable from the partial); under squeeze the three parity
+        # NUMBERS are what must ride.
+        ("val_parity", ("torch_val_loss", "jax_val_loss", "abs_diff")),
         ("scaled_legs", ("attn_blockwise_ms", "attn_flash_ms",
                          "moe_sorted_ms", "moe_einsum_ms",
                          "serving_load_qps")),
@@ -1764,6 +1929,10 @@ def _shrink_to_budget(out: dict) -> dict:
                              "serial_mean_freshness_s",
                              "loop_mean_freshness_s",
                              "goodput_serial", "goodput_loop")),
+        # Sharded-vs-DP: the sentinel's tracked throughput ratio
+        # survives tier 1; the memory-story ratio and parity delta
+        # yield to the partial under squeeze.
+        ("model_sharded", ("sharded_sps_ratio",)),
         # Late probe squeeze: the fallback-reason prose yields before
         # the serving levels do (the partial keeps the full reason; a
         # cpu `platform` on the record already says a fallback
@@ -1808,6 +1977,7 @@ def _shrink_to_budget(out: dict) -> dict:
         ("val_parity", ("abs_diff",)),
         ("restart_spinup", ("step_speedup", "score_speedup")),
         ("cycle_freshness", ("freshness_speedup", "loop_mean_freshness_s")),
+        ("model_sharded", ("sharded_sps_ratio",)),
         ("moe", ("sorted_speedup",)),
         ("trainer_gap", ("fused_over_fit", "prefetch_spans")),
         ("scaled", ("step_time_ms", "attn_blockwise_ms",
@@ -2304,6 +2474,19 @@ def main():
             )
             _flush_partial(record)
 
+        # Sharded vs DP at matched config (ISSUE 11): two subprocess
+        # worlds on the virtual CPU mesh — throughput ratio, peak host
+        # RSS per variant. DCT_BENCH_SHARDED=0 skips (the in-process
+        # smoke's knob, like DCT_BENCH_SPINUP).
+        skip_sharded = os.environ.get(
+            "DCT_BENCH_SHARDED", "1"
+        ).strip().lower() in ("0", "false", "no")
+        if not (skip_sharded or _gate("model_sharded", frac=0.97)):
+            record["model_sharded"] = _optional(
+                "model_sharded", bench_model_sharded
+            )
+            _flush_partial(record)
+
         if not _gate("host_dataplane"):
             dataplane = _optional(
                 "host_dataplane", bench_host_dataplane
@@ -2323,7 +2506,8 @@ def main():
     # of this bench" — and the partial file must match the printed record.
     for skippable in (
         "scaled", "moe", "val_parity", "serving", "serving_load",
-        "restart_spinup", "cycle_freshness", "host_dataplane",
+        "restart_spinup", "cycle_freshness", "model_sharded",
+        "host_dataplane",
     ):
         record.setdefault(skippable, None)
     _flush_partial(record)

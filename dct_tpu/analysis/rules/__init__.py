@@ -3,8 +3,9 @@
 One module per concern:
 
 - :mod:`io_rules` — ``rank0-io`` (coordinator-gated writes in SPMD
-  modules) and ``atomic-publish`` (tmp-then-``os.replace`` into
-  checkpoint/package/registry paths).
+  modules), ``atomic-publish`` (tmp-then-``os.replace`` into
+  checkpoint/package/registry paths), and ``gather-on-publish``
+  (TrainState params gathered dense before packaging/serving).
 - :mod:`purity_rules` — ``span-sync`` (no blocking host sync inside the
   trainer's marked pipelined-dispatch region) and ``trace-purity`` (no
   impure calls inside ``jit``/``shard_map``/``pallas_call`` bodies).

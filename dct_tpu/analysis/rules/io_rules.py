@@ -1,4 +1,5 @@
-"""I/O discipline rules: rank-0-only writes and atomic publishes.
+"""I/O discipline rules: rank-0-only writes, atomic publishes, and
+gathered publishes.
 
 ``rank0-io`` — the platform's core SPMD contract (inherited from the
 reference's DDP design): in code that runs on every rank, shared
@@ -11,6 +12,13 @@ package / tracking registry path must be written to a tmp-suffixed
 sibling and ``os.replace``d into place (the PR 3 crash-safety
 convention): a reader (or a preemption) must never observe a
 half-written file where a complete one is expected.
+
+``gather-on-publish`` — modules under deploy/ and serving/ that read a
+TrainState's ``.params`` must route them through the partition rules'
+gather fns (``gather_tree``/``gather_leaf``/``to_host``): under a
+sharded mesh layout a raw ``np.asarray``/``device_get`` of a
+cross-process leaf fails — or worse, one shard's bytes ship as the
+model. Sharded arrays must never leak into a package.
 """
 
 from __future__ import annotations
@@ -265,3 +273,75 @@ class AtomicPublishRule(Rule):
             src = unparse(node)
             return (None, "") if _tmp_flavored(src) else (src, name)
         return None, ""
+
+
+#: Layers whose modules build/ship serving artifacts from model state:
+#: a TrainState read there is a publish in the making.
+_GATHER_LAYERS = ("dct_tpu/deploy/", "dct_tpu/serving/")
+
+#: The partition rules' gather surface (sharding_rules +
+#: checkpoint.manager.to_host): a ``.params`` read flowing through any
+#: of these produces dense host arrays whatever the mesh layout.
+_GATHER_FNS = {
+    "gather_tree",
+    "gather_leaf",
+    "to_host",
+    "make_shard_and_gather_fns",
+}
+
+
+@register
+class GatherOnPublishRule(Rule):
+    id = "gather-on-publish"
+    name = "TrainState params gathered before packaging/serving"
+    doc = (
+        "In modules under deploy/ and serving/, reading a TrainState's "
+        "`.params` must go through the partition rules' gather fns "
+        "(`gather_tree(state.params)` / `to_host(...)`): under a "
+        "sharded mesh layout a raw read ships one shard's bytes as the "
+        "model (or fails on a cross-process leaf). Mark deliberate "
+        "exceptions with `# dct: noqa[gather-on-publish] — <why the "
+        "leaves are host-dense here>`."
+    )
+
+    def check(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in project.contexts:
+            if ctx.tree is None or not ctx.relpath.startswith(_GATHER_LAYERS):
+                continue
+            parents = ctx.parents()
+            for node in ast.walk(ctx.tree):
+                if not (
+                    isinstance(node, ast.Attribute)
+                    and node.attr == "params"
+                    and isinstance(node.ctx, ast.Load)
+                ):
+                    continue
+                if self._gathered(node, parents):
+                    continue
+                out.append(
+                    ctx.finding(
+                        self.id,
+                        node,
+                        f"`{unparse(node)}` reads TrainState params in a "
+                        "publish layer without the gather fns — a sharded "
+                        "layout would leak shard-local (or unreadable "
+                        "cross-process) arrays into the package; wrap it "
+                        "in `gather_tree(...)` / `to_host(...)`, or mark "
+                        "`# dct: noqa[gather-on-publish] — <why dense>`",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _gathered(node: ast.AST, parents: dict) -> bool:
+        """True when the read sits inside a call to a gather fn (any
+        ancestor call whose callee tail is in :data:`_GATHER_FNS`)."""
+        anc = parents.get(node)
+        while anc is not None:
+            if isinstance(anc, ast.Call):
+                tail = func_repr(anc).rsplit(".", 1)[-1]
+                if tail in _GATHER_FNS:
+                    return True
+            anc = parents.get(anc)
+        return False

@@ -47,25 +47,19 @@ def needs_cross_process_gather(tree) -> bool:
 
 
 def to_host(tree):
-    """Device tree -> host numpy tree.
-
-    Arrays sharded across processes (tensor/sequence parallelism spanning
-    hosts) are not fully addressable and cannot be ``device_get``; they are
-    assembled with a cross-process allgather instead. NB: the allgather is
-    a COLLECTIVE — when any leaf is non-addressable
+    """Device tree -> dense host numpy tree, through the partition
+    rules' gather fns (:func:`dct_tpu.parallel.sharding_rules
+    .gather_tree`): arrays sharded across processes (tensor/sequence
+    parallelism spanning hosts) are assembled with a cross-process
+    allgather, everything else is a device_get. NB: the allgather is a
+    COLLECTIVE — when any leaf is non-addressable
     (:func:`needs_cross_process_gather`), every process must call this
     function (the Trainer does: it gathers on all ranks, then gates the
     file write on the coordinator).
     """
+    from dct_tpu.parallel.sharding_rules import gather_tree
 
-    def one(a):
-        if isinstance(a, jax.Array) and not a.is_fully_addressable:
-            from jax.experimental import multihost_utils
-
-            return np.asarray(multihost_utils.process_allgather(a, tiled=True))
-        return np.asarray(jax.device_get(a))
-
-    return jax.tree.map(one, tree)
+    return gather_tree(tree)
 
 
 def save_checkpoint(path: str, params: Any, meta: dict) -> str:  # dct: noqa[rank0-io] — caller-gated: the trainer invokes the deploy tier under its coordinator gate; the write itself must stay rank-agnostic for tests and single-process tools
@@ -212,6 +206,49 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
         once, fanned back out on restore."""
         return tuple(sl.start or 0 for sl in index)
 
+    def _layout(self, state) -> dict:
+        """The LAYOUT MANIFEST saved beside the arrays (``layout.json``):
+        per-leaf global shape + declared PartitionSpec + whether the
+        leaf was saved whole or as local shards, plus the saving run's
+        mesh shape and process topology. Restore uses it to (a) name a
+        topology change precisely and (b) re-map saved shards onto a
+        DIFFERENT mesh (``shard.topology_remap``) instead of refusing —
+        docs/PARALLELISM.md §layout manifest."""
+        from dct_tpu.parallel.sharding_rules import leaf_spec, spec_to_json
+
+        leaves = jax.tree.leaves(self._tree(state))
+        mesh_shape = None
+        entries = []
+        for i, leaf in enumerate(leaves):
+            sharding = getattr(leaf, "sharding", None)
+            if mesh_shape is None and hasattr(sharding, "mesh"):
+                try:
+                    mesh_shape = {
+                        str(k): int(v)
+                        for k, v in dict(sharding.mesh.shape).items()
+                    }
+                except (TypeError, ValueError):
+                    mesh_shape = None
+            spec = leaf_spec(leaf)
+            entries.append({
+                "leaf": i,
+                "shape": [int(s) for s in getattr(leaf, "shape", ())],
+                "spec": spec_to_json(spec) if spec is not None else None,
+                "saved": (
+                    "shards"
+                    if isinstance(leaf, jax.Array)
+                    and not leaf.is_fully_addressable
+                    else "whole"
+                ),
+            })
+        return {
+            "version": 1,
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "mesh": mesh_shape,
+            "leaves": entries,
+        }
+
     def save(self, state, meta: dict | None = None) -> str:
         """Persist this process's ADDRESSABLE view of the train state.
 
@@ -239,7 +276,7 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
         and needs zero cross-process coordination.
         """
         self.wait()
-        return self._publish(self._entries(state), meta)
+        return self._publish(self._entries(state), meta, self._layout(state))
 
     def _entries(self, state) -> dict:
         """Device state -> host {key: ndarray} dict (the npz payload).
@@ -264,8 +301,12 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
                 entries[str(i)] = np.asarray(jax.device_get(leaf))
         return entries
 
-    def _publish(self, entries: dict, meta: dict | None = None) -> str:
-        """Write ``entries`` (+ meta) into state.next, then rotate."""
+    def _publish(
+        self, entries: dict, meta: dict | None = None,
+        layout: dict | None = None,
+    ) -> str:
+        """Write ``entries`` (+ meta + layout) into state.next, then
+        rotate."""
         # Span from whichever thread publishes (save_async's worker
         # included): the resume-save I/O window on the trace timeline.
         # try/finally so a FAILED write (ENOSPC — exactly the window an
@@ -275,14 +316,17 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
             epochs_completed=(meta or {}).get("epochs_completed"),
         )
         try:
-            return self._publish_inner(entries, meta)
+            return self._publish_inner(entries, meta, layout)
         except BaseException as e:
             span.attrs["error"] = type(e).__name__
             raise
         finally:
             span.end()
 
-    def _publish_inner(self, entries: dict, meta: dict | None = None) -> str:
+    def _publish_inner(
+        self, entries: dict, meta: dict | None = None,
+        layout: dict | None = None,
+    ) -> str:
         import shutil
 
         next_dir = self._dir(self._NEXT)
@@ -312,6 +356,14 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
             with open(mtmp, "w") as f:
                 json.dump(meta, f)
             os.replace(mtmp, mfinal)
+        if layout is not None:
+            import json
+
+            lfinal = os.path.join(next_dir, "layout.json")
+            ltmp = lfinal + ".tmp"
+            with open(ltmp, "w") as f:
+                json.dump(layout, f)
+            os.replace(ltmp, lfinal)
 
         live, old = self._dir(self._LIVE), self._dir(self._OLD)
         if os.path.isdir(old):
@@ -342,10 +394,11 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
 
         self.wait()
         entries = self._entries(state)
+        layout = self._layout(state)
 
         def work():
             try:
-                self._publish(entries, meta)
+                self._publish(entries, meta, layout)
             except BaseException as e:  # surfaced by the next wait()
                 self._error = e
 
@@ -368,13 +421,62 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
                 f"async train-state checkpoint write failed: {err!r}"
             ) from err
 
+    def _sibling_candidate_dirs(self) -> list[str]:
+        """Sibling ranks' newest restorable rotation dirs (``p<rank>/``
+        siblings under the shared ``train_state`` parent). A topology-
+        change restore reads shards the SAVING topology placed in other
+        processes' files — possible exactly when the resume tier sits
+        on a shared filesystem (the test rig and pod-slice NFS case);
+        private-disk pods keep the loud same-topology contract."""
+        parent = os.path.dirname(self.dirpath)
+        out: list[str] = []
+        try:
+            names = os.listdir(parent)
+        except OSError:
+            return out
+        for n in sorted(names):
+            d = os.path.join(parent, n)
+            if os.path.abspath(d) == self.dirpath:
+                continue
+            if not (n.startswith("p") and n[1:].isdigit()):
+                continue
+            for rot in (self._LIVE, self._NEXT, self._OLD):
+                cand = os.path.join(d, rot)
+                if os.path.exists(os.path.join(cand, "state.npz")):
+                    out.append(cand)
+                    break
+        return out
+
+    def load_layout(self) -> dict:
+        """The layout manifest saved beside the newest restorable
+        checkpoint (own dir first, siblings as fallback; empty dict for
+        pre-manifest checkpoints)."""
+        import json
+
+        self.wait()
+        for d in self._restore_candidates() + self._sibling_candidate_dirs():
+            path = os.path.join(d, "layout.json")
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        return dict(json.load(f))
+                except (OSError, ValueError):
+                    return {}
+        return {}
+
     def load_meta(self) -> dict:
         """Run facts saved beside the newest restorable checkpoint
-        (empty dict when the checkpoint predates meta support)."""
+        (empty dict when the checkpoint predates meta support). Falls
+        back to a SIBLING rank's meta when this process has no
+        checkpoint of its own — the topology-growth restore (e.g. 2
+        saving processes resumed as 4) must agree on epochs_completed
+        with the ranks that do."""
         import json
 
         self.wait()
         candidates = self._restore_candidates()
+        if not candidates:
+            candidates = self._sibling_candidate_dirs()[:1]
         if not candidates:
             return {}
         # candidates[0] to stay paired with restore(), which reads the
@@ -394,38 +496,100 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
         # protocol itself creates those and a fresh start is correct.
         if self._restore_candidates():
             return True
-        return any(
+        if any(
             os.path.isdir(d) and not self._dir_is_torn(d)
             for d in self._rotation_dirs()
-        )
+        ):
+            return True
+        # Topology growth: a rank with no checkpoint of its own can
+        # still restore from sibling ranks' files (shared fs) — resume
+        # must say yes or the new rank would restart epoch 0 while the
+        # old ranks resume, and the start-epoch allgather check in
+        # Trainer.fit would abort the whole world.
+        return bool(self._sibling_candidate_dirs())
 
-    def _reassemble(self, template, part_by_key: dict):
+    @staticmethod
+    def _assemble_dense(gshape: tuple, part_by_key: dict):
+        """Offset-keyed shards -> one dense host array, or None when
+        the shards do not cleanly tile the global shape (out-of-bounds
+        placement, gaps, overlaps). Replicated copies saved under the
+        same offsets by different processes have already deduped to one
+        entry per distinct offset key."""
+        first = next(iter(part_by_key.values()))
+        dense = np.zeros(gshape, dtype=first.dtype)
+        covered = 0
+        for off, arr in part_by_key.items():
+            off = tuple(off) + (0,) * (len(gshape) - len(off))
+            if arr.ndim != len(gshape) or any(
+                o + s > g for o, s, g in zip(off, arr.shape, gshape)
+            ):
+                return None
+            dense[tuple(
+                slice(o, o + s) for o, s in zip(off, arr.shape)
+            )] = arr
+            covered += arr.size
+        if covered != dense.size:
+            return None
+        return dense
+
+    def _reassemble(self, template, part_by_key: dict, extra_shards=None):
         """Offset-keyed local shards -> global jax.Array with the
-        template's sharding. Shards are matched by their stored global
-        offsets, so a topology whose local shard positions differ from the
-        saving run fails loudly instead of permuting data."""
+        template's sharding.
+
+        Fast path: the stored global offsets match the current
+        topology's shard positions exactly — each shard device_puts
+        straight onto its device (no dense copy). Otherwise the shards
+        are RE-MAPPED: the dense global array is assembled from every
+        available shard (this process's file plus, via
+        ``extra_shards``, sibling ranks' files on a shared filesystem)
+        and re-placed under the template's sharding — a checkpoint
+        saved on data=2/model=2 resumes on data=4/model=1 with the
+        values bit-identical. Shards that cannot tile the full global
+        shape (private-disk pod, missing sibling files) fail loudly
+        instead of permuting data."""
         sharding = template.sharding
-        gshape = template.shape
+        gshape = tuple(template.shape)
         dev_idx = sharding.addressable_devices_indices_map(gshape)
         want = {self._index_key(ix) for ix in dev_idx.values()}
-        if want != set(part_by_key):
+
+        def _extent(ix) -> tuple:
+            return tuple(
+                len(range(*sl.indices(g))) for sl, g in zip(ix, gshape)
+            )
+
+        # Same-topology fast path needs offsets AND extents to match: a
+        # saving topology's shard can share offset (0, 0) with the new
+        # topology's (every layout has a shard there) while holding a
+        # different slice of the array.
+        if want == set(part_by_key) and all(
+            tuple(part_by_key[self._index_key(ix)].shape) == _extent(ix)
+            for ix in dev_idx.values()
+        ):
+            arrays = [
+                jax.device_put(part_by_key[self._index_key(ix)], d)
+                for d, ix in dev_idx.items()
+            ]
+            return jax.make_array_from_single_device_arrays(
+                gshape, sharding, arrays
+            ), False
+        merged = dict(part_by_key)
+        for key, arr in (extra_shards() if extra_shards else {}).items():
+            merged.setdefault(key, arr)
+        dense = self._assemble_dense(gshape, merged)
+        if dense is None:
             raise ValueError(
                 f"Shard-saved leaf holds offsets {sorted(part_by_key)} but "
-                f"the current topology needs {sorted(want)}; resume "
-                "requires the same mesh/process topology that saved the "
-                "state. (If the topology is unchanged, this checkpoint "
-                "may predate declared-layout saves — written while the "
-                "step's output layout had drifted, e.g. ZeRO-1 sharded "
-                "output params; clear the train_state dir to restart "
-                "from the deploy checkpoint.)"
+                f"the current topology needs {sorted(want)}, and the "
+                "available shards (this process's file + any sibling "
+                "p<rank>/ files) do not tile the full global shape "
+                f"{gshape} — a topology re-map needs every saving rank's "
+                "state file on a shared filesystem. Restore with the "
+                "saving mesh/process topology, or clear the train_state "
+                "dir to restart from the deploy checkpoint."
             )
-        arrays = [
-            jax.device_put(part_by_key[self._index_key(ix)], d)
-            for d, ix in dev_idx.items()
-        ]
-        return jax.make_array_from_single_device_arrays(
-            gshape, sharding, arrays
-        )
+        return jax.make_array_from_callback(
+            gshape, sharding, lambda idx: dense[idx]
+        ), True
 
     def restore(self, state):
         """Restore into the structure (and shardings) of ``state``
@@ -438,7 +602,55 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
         ):
             return self._restore(state)
 
+    @staticmethod
+    def _dir_meta(d: str) -> dict:
+        import json
+
+        try:
+            with open(os.path.join(d, "meta.json")) as f:
+                return dict(json.load(f))
+        except (OSError, ValueError):
+            return {}
+
+    def _sibling_entries(self) -> dict:
+        """Every CONSISTENT sibling rank's npz entries, merged (first
+        sibling wins per key) — the shard pool a topology re-map draws
+        from. Loaded lazily, once per restore.
+
+        Consistency gate: a sibling is admitted only when its saved
+        ``epochs_completed`` matches the reference meta (this process's
+        own checkpoint when it has one, else the first sibling's). A
+        rank that died before publishing its last rotation leaves a
+        one-save-older file behind — tiling ITS shards next to the
+        others' would silently assemble a parameter array mixed across
+        two optimizer steps, exactly the torn state the loud offset
+        refusal used to prevent. A stale sibling here means the re-map
+        falls back to "cannot tile" and raises instead."""
+        cached = getattr(self, "_sibling_cache", None)
+        if cached is not None:
+            return cached
+        own = self._restore_candidates()
+        ref_epochs = self._dir_meta(own[0]).get("epochs_completed") if own else None
+        merged: dict[str, np.ndarray] = {}
+        for d in self._sibling_candidate_dirs():
+            sib_epochs = self._dir_meta(d).get("epochs_completed")
+            if ref_epochs is None:
+                # Growth restore (no own checkpoint): the first
+                # readable sibling sets the reference generation.
+                ref_epochs = sib_epochs
+            if sib_epochs != ref_epochs:
+                continue
+            try:
+                npz = np.load(os.path.join(d, "state.npz"))
+            except (OSError, ValueError):
+                continue
+            for k in npz.files:
+                merged.setdefault(k, npz[k])
+        self._sibling_cache = merged
+        return merged
+
     def _restore(self, state):
+        self._sibling_cache = None
         candidates = self._restore_candidates()
         if not candidates:
             legacy = [
@@ -453,9 +665,19 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
                     "Delete them to restart from scratch, or restore with "
                     "the version that wrote them."
                 )
+            # Topology growth: this rank saved nothing, but sibling
+            # ranks' files on the shared filesystem can rebuild the
+            # full state (whole leaves from any sibling, shard-saved
+            # leaves re-mapped below).
+            if self._sibling_entries():
+                restored = dict(self._sibling_entries())
+                return self._restore_from(state, restored, source="siblings")
             raise FileNotFoundError(f"No train-state checkpoint under {self.dirpath}")
         npz = np.load(os.path.join(candidates[0], "state.npz"))
         restored = {k: npz[k] for k in npz.files}
+        return self._restore_from(state, restored, source=candidates[0])
+
+    def _restore_from(self, state, restored: dict, *, source: str):
         template = self._tree(state)
         treedef = jax.tree.structure(template)
         tleaves = jax.tree.leaves(template)
@@ -467,7 +689,7 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
             # template. Name that instead of a bare index; a silent
             # misaligned restore would train from garbage weights.
             return KeyError(
-                f"Checkpoint {candidates[0]} does not match this run's "
+                f"Checkpoint {source} does not match this run's "
                 f"TrainState: {detail}. Typically DCT_OPTIMIZER (or "
                 "another state-shaping knob) changed since the "
                 "checkpoint was written. Restore the original setting, "
@@ -485,7 +707,19 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
                 f"{len(saved_groups)} leaf groups saved, "
                 f"{len(tleaves)} expected"
             )
+        def _parts_for(entries: dict, i: int) -> dict:
+            prefix = f"{i}_s"
+            return {
+                # 0-d leaves have an empty offset suffix -> key ().
+                tuple(
+                    int(o) for o in k[len(prefix):].split("x")
+                ) if k[len(prefix):] else (): v
+                for k, v in entries.items()
+                if k.startswith(prefix)
+            }
+
         leaves = []
+        remapped: list[int] = []
         for i, t in enumerate(tleaves):
             if str(i) in restored:
                 whole = restored[str(i)]
@@ -497,19 +731,46 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
                     )
                 leaves.append(whole)
                 continue
-            prefix = f"{i}_s"
-            part_by_key = {
-                # 0-d leaves have an empty offset suffix -> key ().
-                tuple(
-                    int(o) for o in k[len(prefix):].split("x")
-                ) if k[len(prefix):] else (): v
-                for k, v in restored.items()
-                if k.startswith(prefix)
-            }
+            part_by_key = _parts_for(restored, i)
             if not part_by_key:
                 raise _mismatch(f"no data for template leaf {i}")
-            leaves.append(self._reassemble(t, part_by_key))
+            arr, was_remapped = self._reassemble(
+                t, part_by_key,
+                extra_shards=lambda i=i: _parts_for(
+                    self._sibling_entries(), i
+                ),
+            )
+            if was_remapped:
+                remapped.append(i)
+            leaves.append(arr)
+        if remapped:
+            # A different mesh topology adopted this trajectory: on the
+            # record (docs/PARALLELISM.md §topology re-map), values
+            # bit-identical by construction (pure data movement).
+            saved_layout = self.load_layout()
+            to_mesh = None
+            for t in tleaves:
+                sh = getattr(t, "sharding", None)
+                if hasattr(sh, "mesh"):
+                    to_mesh = {
+                        str(k): int(v)
+                        for k, v in dict(sh.mesh.shape).items()
+                    }
+                    break
+            self.last_remap = {
+                "leaves": len(remapped),
+                "from_mesh": saved_layout.get("mesh"),
+                "from_processes": saved_layout.get("process_count"),
+                "to_mesh": to_mesh,
+            }
+            _events.get_default().emit(
+                "shard", "shard.topology_remap",
+                dir=source, **self.last_remap,
+            )
         tree = jax.tree.unflatten(treedef, leaves)
+        # Drop the sibling shard pool: it holds full copies of every
+        # sibling's arrays and is only valid for THIS restore.
+        self._sibling_cache = None
         return state.replace(
             step=jax.numpy.asarray(tree["step"]),
             params=tree["params"],

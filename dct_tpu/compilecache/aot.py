@@ -173,7 +173,7 @@ class ExecutableStore:
         return os.path.join(self.root, name)
 
     # -- save ----------------------------------------------------------
-    def save(self, program: str, signature: str, compiled) -> bool:  # dct: noqa[rank0-io] — single-process by construction: store_from_env disables the store whenever jax.process_count() > 1, so this write path never runs on a multi-rank world; the pid-suffixed tmp + os.replace publish also makes concurrent single-host writers (serving workers) tear-proof
+    def save(self, program: str, signature: str, compiled) -> bool:  # dct: noqa[rank0-io] — per-rank BY DESIGN: in a multi-process world store_from_env stamps proc=<rank> into the identity, so every rank writes DISTINCT artifact names (a rank-0 gate would lose all nonzero ranks' executables); the pid-suffixed tmp + os.replace publish also makes concurrent single-host writers (serving workers) tear-proof
         """Serialize ``compiled`` under (program, signature); atomic
         publish. Returns False (with a stderr note) when the backend
         does not support executable serialization or the write fails —
@@ -392,22 +392,30 @@ def store_from_env(
     extra: dict | None = None,
     emit=None,
 ) -> ExecutableStore:
-    """An :class:`ExecutableStore` under the env contract: enabled only
-    when the compile cache is armed (``cache.enabled``), AOT is on, a
-    root is given, and the process is single-host (multi-process
-    executables reference cross-host topology; the persistent XLA
-    cache still covers that case)."""
+    """An :class:`ExecutableStore` under the env contract: enabled when
+    the compile cache is armed (``cache.enabled``), AOT is on, and a
+    root is given.
+
+    Multi-process worlds are supported with PER-RANK artifacts: a
+    multi-process executable references cross-host topology from its
+    own rank's perspective, so ``proc=<rank>`` joins the identity —
+    rank 0's artifact can never be loaded by rank 1, and a relaunched
+    world's rank N deserializes exactly the executable its dead
+    predecessor rank N compiled (the sharded supervised-relaunch path).
+    The runtime fingerprint already pins ``process_count``, so a world
+    resized between runs is a loud miss, never a wrong execution."""
     from dct_tpu.compilecache.cache import aot_enabled
 
     on = bool(root) and aot_enabled()
+    identity = {"family": family, "config_hash": config_hash, "mesh": mesh}
     if on:
         try:
             import jax
 
-            on = jax.process_count() == 1
+            if jax.process_count() > 1:
+                identity["proc"] = jax.process_index()
         except Exception:  # noqa: BLE001 — no backend = nothing to cache
             on = False
-    identity = {"family": family, "config_hash": config_hash, "mesh": mesh}
     if extra:
         identity["extra"] = json.dumps(extra, sort_keys=True, default=str)
     return ExecutableStore(root, identity=identity, enabled=on, emit=emit)

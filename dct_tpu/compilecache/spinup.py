@@ -109,12 +109,16 @@ def measure_relaunch(
     model_env: dict | None = None,
     backoff_s: float = 0.2,
     timeout: float = 600.0,
+    world_size: int = 1,
 ) -> dict:
     """One supervised crash-and-relaunch cycle; returns the restart
     metrics dict (see module docstring). ``prewarm`` runs a plain
     1-epoch training first (separate models dir, SAME cache dirs) so
     even the crashing attempt starts warm — the configuration the
-    steady-state continuous-training loop lives in."""
+    steady-state continuous-training loop lives in. ``world_size > 1``
+    supervises a real multi-process world (pass the mesh/device knobs
+    via ``model_env``) — the sharded-relaunch proof path: per-rank AOT
+    artifacts must warm the healed attempt exactly like DP ones."""
     tag = ("warm" if cache_on else "cold") + ("_pw" if prewarm else "")
     env = _measure_env(workdir, tag, cache_on=cache_on, model_env=model_env)
     train = [sys.executable, os.path.join(REPO_ROOT, "jobs", "train_tpu.py")]
@@ -135,7 +139,7 @@ def measure_relaunch(
     proc = subprocess.run(
         [
             sys.executable, "-m", "dct_tpu.resilience.supervise",
-            "--world-size", "1", "--max-restarts", "1",
+            "--world-size", str(world_size), "--max-restarts", "1",
             "--backoff", str(backoff_s), "--jitter", "0",
             "--", *train,
         ],

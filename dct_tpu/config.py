@@ -918,6 +918,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_USE_SCAN": "lax.scan the epoch into one dispatch",
     "DCT_SHARD_OPT_STATE": "ZeRO-1 weight-update sharding over data axis",
     "DCT_SHARD_PARAMS": "FSDP/ZeRO-3 param + moment sharding",
+    "DCT_SHARD_RULES": "partition-rule overrides: pattern=axes[;...] (docs/PARALLELISM.md)",
     "DCT_GRAD_ACCUM_STEPS": "microbatches summed per optimizer update",
     "DCT_EARLY_STOP_PATIENCE": "epochs without val_loss improvement (0 = off)",
     "DCT_EARLY_STOP_MIN_DELTA": "improvement threshold for early stop",
@@ -1074,6 +1075,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_BENCH_SCALED": "bench scaled-transformer leg on/off",
     "DCT_BENCH_SPINUP": "bench restart_spinup (cold/warm relaunch) leg on/off",
     "DCT_BENCH_FRESHNESS": "bench cycle_freshness (serial vs loop) leg on/off",
+    "DCT_BENCH_SHARDED": "bench model_sharded (sharded vs DP) leg on/off",
     "DCT_BENCH_DEADLINE": "bench wall-clock deadline (s); legs self-gate",
     "DCT_BENCH_PARTIAL": "path for the partial-results stash",
     "DCT_VAL_PARITY_EPOCHS": "val-loss parity leg epoch budget",

@@ -184,7 +184,27 @@ class AlwaysOnLoop:
             "DCT_MODELS_DIR": self.cfg.data.models_dir,
             "DCT_EVENTS_DIR": self.cfg.obs.events_dir,
             "DCT_HEARTBEAT_DIR": self.cfg.obs.heartbeat_dir,
+            # Sharded continuous training: the mesh layout and the
+            # partition-rule knobs THIS loop was configured with must
+            # travel into every child rank, or a programmatic RunConfig
+            # would train data-parallel while the evaluator (and the
+            # checkpoints it watches) expect the sharded layout — and a
+            # mid-run promotion on a sharded trajectory would judge the
+            # wrong model.
+            "DCT_MESH_DATA": str(self.cfg.mesh.data),
+            "DCT_MESH_MODEL": str(self.cfg.mesh.model),
+            "DCT_MESH_SEQ": str(self.cfg.mesh.seq),
+            "DCT_MESH_PIPE": str(self.cfg.mesh.pipe),
+            "DCT_SHARD_OPT_STATE": (
+                "1" if self.cfg.train.shard_opt_state else "0"
+            ),
+            "DCT_SHARD_PARAMS": "1" if self.cfg.train.shard_params else "0",
         }
+        # Env-only knob: an operator's rule overrides ride along when
+        # set (os.environ inheritance covers the CLI path; this covers
+        # a launcher given a scrubbed env).
+        if os.environ.get("DCT_SHARD_RULES"):
+            env["DCT_SHARD_RULES"] = os.environ["DCT_SHARD_RULES"]
         launcher = LocalProcessLauncher()
         res = launcher.supervise(
             [sys.executable, os.path.join(_REPO_ROOT, "jobs", "train_tpu.py")],

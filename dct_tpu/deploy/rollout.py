@@ -114,7 +114,7 @@ def prepare_package(
                 # parameters travel in the artifact. The seed comes
                 # from the training run's OWN logged params when
                 # available (authoritative), env otherwise.
-                "split": _split_params(best.params),
+                "split": _split_params(best.params),  # dct: noqa[gather-on-publish] — tracking-run hyperparameter dict (tracking.client.Run.params), not a TrainState; nothing here is a device array
             },
             f,
             indent=2,

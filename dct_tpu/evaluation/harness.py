@@ -203,6 +203,21 @@ def _batched_probs_jax(
     else:
         model = get_model(cfg, input_dim=input_dim, compute_dtype=jnp.float32)
     params = _unflatten_weights(weights, family)
+    # The challenger scores under the SAME partition rules the trainer
+    # uses (docs/PARALLELISM.md): on a model/seq mesh the params take
+    # their tensor-parallel placement instead of replicating — the eval
+    # harness can judge a model bigger than one chip's memory. On a
+    # pure-data mesh every rule resolves to replication and the math
+    # (and bits) are unchanged.
+    from dct_tpu.parallel.sharding_rules import (
+        match_partition_rules, rules_for_family,
+    )
+    from jax.sharding import NamedSharding
+
+    param_specs = match_partition_rules(rules_for_family(family), params)
+    params = jax.device_put(
+        params, jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs)
+    )
     causal = is_causal_model(family)
 
     @jax.jit

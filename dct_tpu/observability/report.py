@@ -54,6 +54,11 @@ SERIES = (
     ("loop_freshness_s", ("cycle_freshness", "loop_mean_freshness_s"),
      "down"),
     ("freshness_speedup", ("cycle_freshness", "freshness_speedup"), "up"),
+    # Sharded continuous training (the model_sharded bench leg):
+    # partition-rule sharded throughput as a fraction of pure DP at
+    # matched config — a drop past the >10% threshold means the sharded
+    # layouts started paying for collectives they previously amortized.
+    ("sharded_sps_ratio", ("model_sharded", "sharded_sps_ratio"), "up"),
 )
 
 

@@ -1,72 +1,218 @@
-"""Param-sharding rules: name patterns -> PartitionSpecs over the mesh.
+"""Declarative partition rules: regex path patterns -> PartitionSpecs.
 
-The scaling-book recipe, made concrete: modules carry load-bearing NAMES
-(``qkv_proj``/``ffn_in`` = column-parallel, ``o_proj``/``ffn_out`` =
-row-parallel), this module maps names to ``PartitionSpec``s, and ``jit``
-inserts the collectives. No imperative communication anywhere — the analog
-of the reference's gloo all-reduce is a compiler decision.
+The scaling-book recipe, made first-class for the CONTINUOUS-training
+path (ROADMAP item 1): modules carry load-bearing NAMES (``qkv_proj``/
+``ffn_in`` = column-parallel, ``o_proj``/``ffn_out`` = row-parallel), a
+per-family RULE TABLE maps ``/``-joined parameter paths to
+``PartitionSpec``s over the ``data``/``model``/``seq``/``pipe`` mesh
+axes, and ``jit`` inserts the collectives. No imperative communication
+anywhere — the analog of the reference's gloo all-reduce is a compiler
+decision.
 
-Applied to the WHOLE TrainState: Adam's ``mu``/``nu`` mirror the param tree,
-so the same path-pattern match shards optimizer state identically — giving
-tensor-parallel training a fully sharded optimizer for free.
+Applied to the WHOLE TrainState: Adam's ``mu``/``nu`` mirror the param
+tree, so the same path-pattern match shards optimizer state identically
+— giving tensor-parallel training a fully sharded optimizer for free;
+``shard_opt``/``shard_params`` additionally split the unmatched leaves'
+leading dim over ``data`` (ZeRO-1 / FSDP, per "Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training").
+
+The rule surface (docs/PARALLELISM.md §partition rules):
+
+- :data:`FAMILY_RULES` — the per-family default tables (regex, spec);
+- ``DCT_SHARD_RULES`` — operator overrides prepended to the family
+  table: ``pattern=axes[;pattern=axes...]`` where ``axes`` is a
+  comma-separated per-dimension axis list (``data``/``model``/``seq``/
+  ``pipe``; ``-`` = replicated dim; the empty string = fully
+  replicated leaf). First match wins.
+- :func:`match_partition_rules` / :func:`make_shard_and_gather_fns` —
+  the snippet-style primitives: a spec tree from the rules, and paired
+  place/gather callables per leaf (gather is what the publish path —
+  checkpoint deploy tier, package export — runs so serving artifacts
+  stay dense).
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
+import re
+
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# (pattern, kernel spec, bias spec): column-parallel shards the OUTPUT dim,
-# row-parallel shards the INPUT dim (its bias stays replicated — it is added
-# after the row all-reduce).
-_RULES = (
-    ("qkv_proj", P(None, "model"), P("model")),
-    ("ffn_in", P(None, "model"), P("model")),
-    ("o_proj", P("model", None), P()),
-    ("ffn_out", P("model", None), P()),
+AXIS_NAMES = ("data", "model", "seq", "pipe")
+
+# The transformer-family name rules (column-parallel shards the OUTPUT
+# dim, row-parallel the INPUT dim; a row-parallel bias stays replicated
+# — it is added after the row all-reduce), plus expert parallelism:
+# MoE expert weights are [E, ...] stacks whose leading expert dim
+# shards over ``model`` (each shard owns whole experts; the dispatch
+# einsum's token exchange compiles to an all-to-all over the same
+# axis). The router stays replicated (no rule matches it). Patterns
+# are regexes over the ``/``-joined path (params AND their opt_state
+# moment mirrors — the moments embed the same path tail).
+_TENSOR_PARALLEL_RULES = (
+    (r"(^|/)experts_in_kernel$", P("model", None, None)),
+    (r"(^|/)experts_in_bias$", P("model", None)),
+    (r"(^|/)experts_out_kernel$", P("model", None, None)),
+    (r"(^|/)experts_out_bias$", P("model", None)),
+    (r"(qkv_proj|ffn_in).*/kernel$", P(None, "model")),
+    (r"(qkv_proj|ffn_in).*/bias$", P("model")),
+    (r"(o_proj|ffn_out).*/kernel$", P("model", None)),
+    (r"(o_proj|ffn_out).*/bias$", P()),
 )
 
-# Expert parallelism: MoE expert weights are [E, ...] stacks; sharding the
-# leading expert dim over ``model`` gives each shard whole experts (the
-# dispatch einsum's token exchange compiles to an all-to-all over the same
-# axis). The router stays replicated (no rule matches it).
-_EXPERT_RULES = {
-    "experts_in_kernel": P("model", None, None),
-    "experts_in_bias": P("model", None),
-    "experts_out_kernel": P("model", None, None),
-    "experts_out_bias": P("model", None),
+#: Per-family default rule tables. Families without an entry use
+#: ``None``'s table (the tensor-parallel name rules — a family whose
+#: params match no pattern, like the MLP, replicates everywhere, which
+#: is exactly pure DP). Override or extend via ``DCT_SHARD_RULES``.
+FAMILY_RULES: dict = {
+    None: _TENSOR_PARALLEL_RULES,
+    "weather_mlp": _TENSOR_PARALLEL_RULES,
+    "weather_gru": _TENSOR_PARALLEL_RULES,
+    "weather_transformer": _TENSOR_PARALLEL_RULES,
+    "weather_transformer_causal": _TENSOR_PARALLEL_RULES,
+    "weather_transformer_pp": _TENSOR_PARALLEL_RULES,
+    "weather_moe": _TENSOR_PARALLEL_RULES,
 }
 
 
-def spec_for_path(path, ndim: int | None = None) -> P:
+def parse_rules(text: str):
+    """``DCT_SHARD_RULES`` grammar -> tuple of (regex, PartitionSpec).
+
+    ``pattern=axes[;pattern=axes...]``: ``pattern`` is a regex matched
+    (``re.search``) against the leaf's ``/``-joined path; ``axes`` is a
+    comma-separated per-dimension list of mesh axis names (``-`` for a
+    replicated dimension, the empty string for a fully replicated
+    leaf). Examples::
+
+        .*dense.*/kernel$=-,model      # shard the output dim
+        head/kernel$=                  # force-replicate
+    Malformed specs raise ``ValueError`` naming the offending clause —
+    a typo'd layout must never silently train replicated.
+    """
+    rules = []
+    for clause in (text or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            raise ValueError(
+                f"DCT_SHARD_RULES clause {clause!r} has no '=': expected "
+                "pattern=axis,axis,..."
+            )
+        pattern, _, axes = clause.rpartition("=")
+        pattern = pattern.strip()
+        try:
+            re.compile(pattern)
+        except re.error as e:
+            raise ValueError(
+                f"DCT_SHARD_RULES pattern {pattern!r} is not a valid "
+                f"regex: {e}"
+            ) from e
+        dims = []
+        if axes.strip():
+            for tok in axes.split(","):
+                tok = tok.strip()
+                if tok in ("-", "", "none", "None"):
+                    dims.append(None)
+                elif tok in AXIS_NAMES:
+                    dims.append(tok)
+                else:
+                    raise ValueError(
+                        f"DCT_SHARD_RULES clause {clause!r}: unknown mesh "
+                        f"axis {tok!r} (valid: {', '.join(AXIS_NAMES)}, "
+                        "'-' for a replicated dim)"
+                    )
+        rules.append((pattern, P(*dims)))
+    return tuple(rules)
+
+
+#: parse_rules memo keyed by the raw env string: rule resolution runs
+#: once per TREE LEAF (spec_for_path inside the sharding tree-map), and
+#: re-validating every regex clause per leaf is pure waste — the env
+#: string is invariant within a placement pass.
+_PARSE_CACHE: dict[str, tuple] = {}
+
+
+def rules_for_family(family: str | None = None):
+    """The ACTIVE rule table for ``family``: any ``DCT_SHARD_RULES``
+    overrides first (first match wins), then the family's defaults."""
+    base = FAMILY_RULES.get(family, FAMILY_RULES[None])
+    env = os.environ.get("DCT_SHARD_RULES")
+    if not env:
+        return tuple(base)
+    cached = _PARSE_CACHE.get(env)
+    if cached is None:
+        cached = parse_rules(env)
+        if len(_PARSE_CACHE) > 8:  # bound: env strings are few
+            _PARSE_CACHE.clear()
+        _PARSE_CACHE[env] = cached
+    return cached + tuple(base)
+
+
+def rules_digest(family: str | None = None) -> str:
+    """Content digest of the active rule table — part of the AOT
+    executable identity (a layout change recompiles; the same layout
+    warm-relaunches) and the checkpoint layout manifest."""
+    blob = "|".join(
+        f"{pat}={','.join(str(a) for a in spec)}"
+        for pat, spec in rules_for_family(family)
+    )
+    return hashlib.sha1(blob.encode()).hexdigest()[:10]
+
+
+def path_str(path) -> str:
+    """A tree path -> the ``/``-joined string the rule regexes match."""
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "name", k))) for k in path
+    )
+
+
+def match_partition_rules(rules, tree):
+    """Spec tree for ``tree`` under ``rules`` (the snippet-style
+    primitive): scalars and unmatched leaves replicate (``P()`` — the
+    pure-DP MLP matches nothing and fully replicates), first matching
+    rule wins. Works over params alone or a whole TrainState tree
+    (optimizer-state moment mirrors embed the same path tails)."""
+
+    def one(path, leaf):
+        if getattr(leaf, "ndim", 0) == 0:
+            return P()
+        name = path_str(path)
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def spec_for_path(path, ndim: int | None = None, family: str | None = None) -> P:
     names = [str(getattr(k, "key", k)) for k in path]
     leaf = names[-1] if names else ""
     if "pp_stages" in names:
         # Pipeline stages: stacked [n_stages, ...] leaves, stage dim on
         # ``pipe`` — one stage per pipeline device. The INNER dims keep
-        # their tensor-parallel name-rule placement (PP x TP compose:
+        # their tensor-parallel rule placement (PP x TP compose:
         # pipeline_apply's shard_map is manual only over pipe/data, so
         # the model-axis sharding survives into the stage compute).
+        # Structural, not regex: the pad depends on the leaf's ndim.
         inner_names = names[names.index("pp_stages") + 1:]
+        inner_path = "/".join(inner_names)
         inner = P()
-        for pattern, kernel_spec, bias_spec in _RULES:
-            if any(pattern in n for n in inner_names):
-                if leaf == "kernel":
-                    inner = kernel_spec
-                elif leaf == "bias":
-                    inner = bias_spec
+        for pattern, spec in rules_for_family(family):
+            if re.search(pattern, inner_path):
+                inner = spec
                 break
         n = ndim if ndim is not None else 2
         pad = n - 1 - len(inner)
         return P("pipe", *inner, *([None] * max(pad, 0)))
-    if leaf in _EXPERT_RULES:
-        return _EXPERT_RULES[leaf]
-    for pattern, kernel_spec, bias_spec in _RULES:
-        if any(pattern in n for n in names):
-            if leaf == "kernel":
-                return kernel_spec
-            if leaf == "bias":
-                return bias_spec
+    name = "/".join(names)
+    for pattern, spec in rules_for_family(family):
+        if re.search(pattern, name):
+            return spec
     return P()
 
 
@@ -87,9 +233,10 @@ def _data_shard_spec(leaf, mesh: Mesh) -> P | None:
 
 
 def state_shardings(
-    state, mesh: Mesh, *, shard_opt: bool = False, shard_params: bool = False
+    state, mesh: Mesh, *, shard_opt: bool = False, shard_params: bool = False,
+    family: str | None = None,
 ):
-    """NamedSharding tree for a TrainState under the name-pattern rules.
+    """NamedSharding tree for a TrainState under the family rule table.
     Scalars/rngs/unmatched params replicate; matched params (and their
     mirrored Adam moments) shard over ``model``. With ``shard_opt``,
     otherwise-replicated optimizer-state leaves additionally shard their
@@ -103,7 +250,9 @@ def state_shardings(
     def one(path, leaf):
         if getattr(leaf, "ndim", 0) == 0:
             return NamedSharding(mesh, P())
-        spec = spec_for_path(path, ndim=getattr(leaf, "ndim", None))
+        spec = spec_for_path(
+            path, ndim=getattr(leaf, "ndim", None), family=family
+        )
         if spec == P():
             names = {
                 str(getattr(k, "key", getattr(k, "name", k))) for k in path
@@ -122,7 +271,8 @@ def state_shardings(
 
 
 def shard_state_with_rules(
-    state, mesh: Mesh, *, shard_opt: bool = False, shard_params: bool = False
+    state, mesh: Mesh, *, shard_opt: bool = False, shard_params: bool = False,
+    family: str | None = None,
 ):
     """Place a TrainState: tensor-parallel where rules match, replicated
     elsewhere (the pure-DP MLP matches nothing and fully replicates,
@@ -133,6 +283,130 @@ def shard_state_with_rules(
     return jax.device_put(
         state,
         state_shardings(
-            state, mesh, shard_opt=shard_opt, shard_params=shard_params
+            state, mesh, shard_opt=shard_opt, shard_params=shard_params,
+            family=family,
         ),
     )
+
+
+# ----------------------------------------------------------------------
+# Shard/gather fns: the paired place/publish callables (snippet [1]/[2]
+# idiom). ``gather`` is the publish contract: every path that exports
+# TrainState params out of the mesh (checkpoint deploy tier, package
+# export, serving) must produce DENSE host arrays — a sharded jax.Array
+# leaking into a package would serve one shard's weights as the model.
+# dct-lint rule ``gather-on-publish`` enforces the call sites.
+
+
+def gather_leaf(leaf) -> np.ndarray:
+    """One leaf -> a dense host ndarray, whatever its placement.
+
+    Arrays sharded across processes (TP/SP spanning hosts) are not
+    fully addressable and cannot be ``device_get``; they are assembled
+    with a cross-process allgather instead. NB: the allgather is a
+    COLLECTIVE — when any leaf is non-addressable, every process must
+    run the gather (the Trainer does: it gathers on all ranks, then
+    gates the file write on the coordinator)."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(jax.device_get(leaf))
+
+
+def gather_tree(tree):
+    """Device tree -> dense host numpy tree via :func:`gather_leaf`
+    (the gather half of :func:`make_shard_and_gather_fns`, applied
+    uniformly — what ``checkpoint.manager.to_host`` delegates to)."""
+    return jax.tree.map(gather_leaf, tree)
+
+
+def make_shard_and_gather_fns(shardings):
+    """(shard_fns, gather_fns) trees from a tree of NamedShardings.
+
+    ``shard_fn(host_array)`` places a leaf under its declared sharding
+    (``jax.device_put`` — XLA splits/replicates as the spec says);
+    ``gather_fn(device_array)`` brings it back as a dense host ndarray
+    (cross-process allgather where the layout spans hosts). The pair is
+    the checkpoint/publish contract: save/restore and package export go
+    through these, never through raw per-leaf copies."""
+
+    def make_shard_fn(s):
+        return lambda x: jax.device_put(x, s)
+
+    def make_gather_fn(_s):
+        return gather_leaf
+
+    shard_fns = jax.tree.map(
+        make_shard_fn, shardings,
+        is_leaf=lambda x: isinstance(x, NamedSharding),
+    )
+    gather_fns = jax.tree.map(
+        make_gather_fn, shardings,
+        is_leaf=lambda x: isinstance(x, NamedSharding),
+    )
+    return shard_fns, gather_fns
+
+
+# ----------------------------------------------------------------------
+# Layout introspection: the declared-vs-actual reconciliation surface
+# (trainer fit start) and the checkpoint layout manifest.
+
+
+def spec_to_json(spec) -> list:
+    """PartitionSpec -> JSON-able per-dim axis list (nested tuples —
+    multiple axes on one dim — become lists)."""
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(a) for a in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def leaf_spec(leaf):
+    """The PartitionSpec a jax.Array leaf actually carries (None for
+    host arrays / non-named shardings)."""
+    sharding = getattr(leaf, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return sharding.spec
+    return None
+
+
+def layout_mismatches(state, declared) -> list[dict]:
+    """Where the live state's layout drifted from the DECLARED rule
+    layout: [{path, actual, declared}] per mismatched leaf. The jitted
+    step's OUTPUT shardings can legitimately drift (under ZeRO-1 XLA
+    keeps the weight update — and therefore the output params — sharded
+    over ``data`` instead of all-gathering); the trainer reconciles by
+    re-pinning to the declared layout before checkpointing, and emits
+    ``shard.layout_mismatch`` so the drift is on the record instead of
+    silently checkpointed."""
+    out: list[dict] = []
+
+    def one(path, leaf, want):
+        actual = leaf_spec(leaf)
+        if actual is None:
+            return
+        want_spec = want.spec if isinstance(want, NamedSharding) else want
+        # Compare normalized: trailing Nones are layout-equivalent.
+        def norm(s):
+            dims = list(tuple(s))
+            while dims and dims[-1] is None:
+                dims.pop()
+            return tuple(dims)
+
+        if norm(actual) != norm(want_spec):
+            out.append({
+                "path": path_str(path),
+                "actual": spec_to_json(actual),
+                "declared": spec_to_json(want_spec),
+            })
+
+    jax.tree_util.tree_map_with_path(
+        lambda p, a, b: one(p, a, b), state, declared
+    )
+    return out

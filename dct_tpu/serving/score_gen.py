@@ -38,6 +38,37 @@ def _flatten_params(tree: dict, prefix: str = "") -> dict:
     return out
 
 
+def _serving_weights(p: dict, family: str) -> dict:
+    """A dense host param tree -> the serving weights dict for
+    ``family`` (shared by the checkpoint and live-state exporters)."""
+    from dct_tpu.serving.runtime import _SEQUENCE_FAMILIES
+
+    # Single source of truth with runtime's dispatch (a family in one
+    # list but not the other would export through the wrong branch).
+    if family in _SEQUENCE_FAMILIES:
+        return _flatten_params(p)
+
+    def layer_index(name: str) -> int:
+        tail = name.rsplit("_", 1)[-1]
+        return int(tail) if tail.isdigit() else -1
+
+    layers = sorted(p, key=layer_index)
+    if not all(
+        isinstance(p[n], dict) and {"kernel", "bias"} <= set(p[n])
+        for n in layers
+    ):
+        raise ValueError(
+            f"Serving export for model={family!r} expects a sequential "
+            f"dense stack; checkpoint has param tree {sorted(p)} — "
+            "register a dedicated exporter for this family"
+        )
+    weights = {}
+    for i, name in enumerate(layers):
+        weights[f"w{i}"] = np.asarray(p[name]["kernel"], np.float32)
+        weights[f"b{i}"] = np.asarray(p[name]["bias"], np.float32)
+    return weights
+
+
 def weights_from_checkpoint(ckpt_path: str) -> tuple[dict, dict]:
     """model.ckpt (flax msgpack) -> (serving weights dict, meta).
 
@@ -48,36 +79,29 @@ def weights_from_checkpoint(ckpt_path: str) -> tuple[dict, dict]:
     :func:`runtime.forward_numpy` dispatches on ``meta["model"]``.
     """
     from dct_tpu.checkpoint.manager import load_checkpoint
-    from dct_tpu.serving.runtime import _SEQUENCE_FAMILIES
 
     params, meta = load_checkpoint(ckpt_path)
-    p = params["params"]
     family = meta.get("model", "weather_mlp")
+    return _serving_weights(params["params"], family), meta
 
-    # Single source of truth with runtime's dispatch (a family in one
-    # list but not the other would export through the wrong branch).
-    if family in _SEQUENCE_FAMILIES:
-        weights = _flatten_params(p)
-    else:
-        def layer_index(name: str) -> int:
-            tail = name.rsplit("_", 1)[-1]
-            return int(tail) if tail.isdigit() else -1
 
-        layers = sorted(p, key=layer_index)
-        if not all(
-            isinstance(p[n], dict) and {"kernel", "bias"} <= set(p[n])
-            for n in layers
-        ):
-            raise ValueError(
-                f"Serving export for model={family!r} expects a sequential "
-                f"dense stack; checkpoint has param tree {sorted(p)} — "
-                "register a dedicated exporter for this family"
-            )
-        weights = {}
-        for i, name in enumerate(layers):
-            weights[f"w{i}"] = np.asarray(p[name]["kernel"], np.float32)
-            weights[f"b{i}"] = np.asarray(p[name]["bias"], np.float32)
-    return weights, meta
+def weights_from_state(state, meta: dict) -> tuple[dict, dict]:
+    """A LIVE TrainState -> (serving weights dict, meta): the direct
+    publish path for rigs that package without a checkpoint file
+    round-trip (benches, eval harnesses over in-memory states).
+
+    Gather-on-publish contract (docs/PARALLELISM.md): the params go
+    through the partition rules' gather fns, so a state sharded over
+    any mesh layout exports DENSE host arrays — a sharded jax.Array
+    must never leak into a package. Enforced tree-wide by the dct-lint
+    ``gather-on-publish`` rule.
+    """
+    from dct_tpu.parallel.sharding_rules import gather_tree
+
+    dense = gather_tree(state.params)
+    family = dict(meta).get("model", "weather_mlp")
+    p = dense["params"] if "params" in dense else dense
+    return _serving_weights(p, family), dict(meta)
 
 
 def _publish_text(path: str, text: str) -> None:

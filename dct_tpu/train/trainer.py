@@ -49,6 +49,8 @@ from dct_tpu.parallel.mesh import (
     process_data_block,
 )
 from dct_tpu.parallel.sharding_rules import (
+    layout_mismatches,
+    rules_digest,
     shard_state_with_rules,
     state_shardings,
 )
@@ -419,14 +421,16 @@ class Trainer:
             grad_clip_norm=cfg.train.grad_clip_norm,
             optimizer=cfg.train.optimizer, momentum=cfg.train.momentum,
         )
-        # Name-pattern rules: tensor-parallel placement for the transformer
-        # family, full replication for the MLP (no patterns match). TP/SP
-        # axes may span processes: the checkpoint tier assembles such
-        # params with a cross-process allgather (checkpoint.manager.to_host),
-        # called on EVERY rank before the coordinator-gated write.
+        # Declarative partition rules: the per-family rule table (env-
+        # overridable via DCT_SHARD_RULES) gives tensor-parallel
+        # placement for the transformer family, full replication for
+        # the MLP (no patterns match). TP/SP axes may span processes:
+        # the checkpoint tier assembles such params with a cross-process
+        # allgather (checkpoint.manager.to_host), called on EVERY rank
+        # before the coordinator-gated write.
         state = shard_state_with_rules(
             state, self.mesh, shard_opt=cfg.train.shard_opt_state,
-            shard_params=cfg.train.shard_params,
+            shard_params=cfg.train.shard_params, family=cfg.model.name,
         )
         # The DECLARED layout. The jitted step's OUTPUT shardings can
         # drift from it — under ZeRO-1, XLA keeps the weight update (and
@@ -435,10 +439,12 @@ class Trainer:
         # shards of whatever layout the state actually has. Checkpoints
         # must be written in the declared layout, or a resumed process
         # (whose fresh template is the declared layout) cannot match the
-        # saved shards to its topology.
+        # saved shards to its topology. The first consumed span's output
+        # is reconciled against this layout and any drift emitted as a
+        # loud ``shard.layout_mismatch`` event (see _consume_span).
         declared_shardings = state_shardings(
             state, self.mesh, shard_opt=cfg.train.shard_opt_state,
-            shard_params=cfg.train.shard_params,
+            shard_params=cfg.train.shard_params, family=cfg.model.name,
         )
 
         # Continuous-training semantics (the reference re-trains from
@@ -474,6 +480,7 @@ class Trainer:
                 state_ckptr.restore(state), self.mesh,
                 shard_opt=cfg.train.shard_opt_state,
                 shard_params=cfg.train.shard_params,
+                family=cfg.model.name,
             )
             if "epochs_completed" in saved:
                 start_epoch = int(saved["epochs_completed"])
@@ -562,6 +569,11 @@ class Trainer:
             )
         }
         _train_identity["decay_resolved"] = int(resolved_decay)
+        # The partition-rule table is part of the program: a layout
+        # change (DCT_SHARD_RULES, a family-table edit) compiles a
+        # DIFFERENT executable — it must miss; the same layout must
+        # warm-relaunch, sharded exactly like DP.
+        _train_identity["shard_rules"] = rules_digest(cfg.model.name)
         aot_store = _compilecache.store_from_env(
             os.environ.get("DCT_COMPILE_CACHE_AOT_DIR")
             or os.path.join(cfg.data.models_dir, "aot"),
@@ -759,6 +771,7 @@ class Trainer:
         pending = None
         consumed_through = start_epoch
         timer_running = False
+        layout_checked = False
 
         def _bookkeep_span(sp, sub_epochs, epoch_stats, span_updates):
             """Every host-side consequence of a finished span: goodput
@@ -768,8 +781,25 @@ class Trainer:
             span's device compute) and the eager path. Returns
             ``stop_early``."""
             nonlocal es_best, es_stale, span_end_vl_min
-            nonlocal consumed_through, ckpt_span
+            nonlocal consumed_through, ckpt_span, layout_checked
             e0, k = sp.epoch0, sp.k
+            # Declared-vs-actual layout reconciliation, once, on the
+            # FIRST span the jitted step produced: its output shardings
+            # can drift from the declared rule layout (ZeRO-1 keeps the
+            # updated params data-sharded), and silently checkpointing
+            # whatever layout fell out is how a resume refusal is born.
+            # The drift goes on the record LOUDLY; the device_put re-pin
+            # below reconciles the checkpoint to the declared layout.
+            if not layout_checked:
+                layout_checked = True
+                _drift = layout_mismatches(sp.state, declared_shardings)
+                if _drift:
+                    events.emit(
+                        "shard", "shard.layout_mismatch",
+                        leaves=len(_drift),
+                        reconciled=True,
+                        examples=_drift[:3],
+                    )
             # Per-span goodput: category deltas since the previous
             # report, logged to the tracker next to val_loss so a
             # goodput regression is queryable like an accuracy one.

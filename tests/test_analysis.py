@@ -303,6 +303,74 @@ class TestAtomicPublish:
 
 
 # ----------------------------------------------------------------------
+# gather-on-publish
+
+
+BAD_GATHER = """\
+import numpy as np
+
+def export(state):
+    return {k: np.asarray(v) for k, v in state.params.items()}
+"""
+
+GOOD_GATHER = """\
+from dct_tpu.parallel.sharding_rules import gather_tree
+
+def export(state):
+    return gather_tree(state.params)
+"""
+
+GOOD_GATHER_TO_HOST = """\
+from dct_tpu.checkpoint.manager import to_host
+
+def export(state):
+    dense = to_host(state.params)
+    return dense
+"""
+
+NOQA_GATHER = """\
+def split(best):
+    return dict(best.params)  # dct: noqa[gather-on-publish] — a tracking run's hyperparameter dict, not a TrainState
+"""
+
+
+class TestGatherOnPublish:
+    def test_raw_params_read_flagged(self, tmp_path):
+        found = run_rule(
+            tmp_path, {"dct_tpu/deploy/pkg.py": BAD_GATHER},
+            "gather-on-publish",
+        )
+        assert len(found) == 1
+        assert "state.params" in found[0].message
+
+    def test_serving_layer_also_checked(self, tmp_path):
+        assert run_rule(
+            tmp_path, {"dct_tpu/serving/exp.py": BAD_GATHER},
+            "gather-on-publish",
+        )
+
+    @pytest.mark.parametrize(
+        "src", [GOOD_GATHER, GOOD_GATHER_TO_HOST], ids=["gather", "to_host"]
+    )
+    def test_gather_fn_wrapped_clean(self, tmp_path, src):
+        assert not run_rule(
+            tmp_path, {"dct_tpu/deploy/pkg.py": src}, "gather-on-publish"
+        )
+
+    def test_justified_noqa_clean(self, tmp_path):
+        assert not run_rule(
+            tmp_path, {"dct_tpu/deploy/rollup.py": NOQA_GATHER},
+            "gather-on-publish",
+        )
+
+    def test_outside_publish_layers_exempt(self, tmp_path):
+        assert not run_rule(
+            tmp_path, {"dct_tpu/train/foo.py": BAD_GATHER},
+            "gather-on-publish",
+        )
+
+
+# ----------------------------------------------------------------------
 # span-sync
 
 

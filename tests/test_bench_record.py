@@ -275,6 +275,17 @@ def _worst_case_record() -> dict:
             "goodput_serial": 0.1357, "goodput_loop": 0.0381,
             "freshness_speedup": 3.92, "train_throughput_ratio": 1.11,
         },
+        "model_sharded": {
+            "devices": 4,
+            "config": {
+                "seq_len": 16, "d_model": 64, "n_heads": 2,
+                "n_layers": 2, "d_ff": 128, "batch": 32, "scan_len": 8,
+            },
+            "dp_sps": 2100.5, "sharded_sps": 1772.0,
+            "dp_peak_rss_mb": 302.8, "sharded_peak_rss_mb": 315.1,
+            "loss_delta": 0.00083673,
+            "sharded_sps_ratio": 0.844, "peak_rss_ratio": 0.961,
+        },
         "host_dataplane": {
             "rows_native_ms": 0.23, "rows_numpy_ms": 0.51,
             "rows_speedup": 2.18, "windows_native_ms": 1.43,
@@ -365,6 +376,12 @@ def test_stdout_record_typical_round_is_not_collapsed(bench_mod):
         "warm_step_s": 4.866, "step_speedup": 3.25,
         "warm_score_s": 0.8364, "score_speedup": 2.4,
     }
+    # ...the model_sharded digest keeps the sentinel's throughput
+    # ratio (the memory ratio/parity delta may yield to the partial
+    # under a full-record squeeze)...
+    ms = out["model_sharded"]
+    assert ms["sharded_sps_ratio"] == 0.844
+    assert "config" not in ms and "dp_sps" not in ms
     # ...serving keeps (at least) its speedup headlines...
     assert out["serving"]["single_row"] in (
         1.97, record["serving"]["single_row"]
