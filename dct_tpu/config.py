@@ -819,6 +819,51 @@ class LoopConfig:
 
 
 @dataclass
+class SchedulerConfig:
+    """Multi-tenant workload scheduler (dct_tpu.scheduler;
+    docs/SCHEDULER.md): N always-on tenants sharing one pod with
+    chip-time quota, priority classes, and fault isolation.
+
+    ``spec`` is the tenant roster — inline JSON or a ``tenants.json``
+    path (grammar in scheduler/spec.py). Training rounds time-share the
+    chips through round leases granted by strict priority class then
+    weighted deficit; ``concurrent`` leases may run at once (1 = the
+    whole pod is one shared mesh, the default). A starved higher-class
+    waiter preempts a running lower-class round gracefully after
+    ``preempt_wait_s`` (0 = never preempt — strictly boundary-granted).
+    ``shared_cache`` pins one compile/AOT store under ``root`` so
+    same-family tenants amortize each other's compiles. Budgets
+    (``max_*``) exist for smokes and benches; production leaves them 0.
+    """
+
+    spec: str = ""
+    root: str = "data/tenants"
+    concurrent: int = 1
+    poll_s: float = 0.5
+    preempt_wait_s: float = 0.0
+    shared_cache: bool = True
+    max_wall_s: float = 0.0
+    max_rounds: int = 0
+
+    @classmethod
+    def from_env(cls) -> "SchedulerConfig":
+        c = cls()
+        c.spec = _env("DCT_TENANTS", c.spec, str)
+        c.root = _env("DCT_SCHED_ROOT", c.root, str)
+        c.concurrent = max(1, _env("DCT_SCHED_CONCURRENT", c.concurrent, int))
+        c.poll_s = _env("DCT_SCHED_POLL_S", c.poll_s, float)
+        c.preempt_wait_s = _env(
+            "DCT_SCHED_PREEMPT_WAIT_S", c.preempt_wait_s, float
+        )
+        c.shared_cache = _env(
+            "DCT_SCHED_SHARED_CACHE", c.shared_cache, bool
+        )
+        c.max_wall_s = _env("DCT_SCHED_MAX_WALL_S", c.max_wall_s, float)
+        c.max_rounds = _env("DCT_SCHED_MAX_ROUNDS", c.max_rounds, int)
+        return c
+
+
+@dataclass
 class RunConfig:
     """Top-level bundle passed to the Trainer."""
 
@@ -834,6 +879,7 @@ class RunConfig:
     evaluation: EvaluationConfig = field(default_factory=EvaluationConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     loop: LoopConfig = field(default_factory=LoopConfig)
+    sched: SchedulerConfig = field(default_factory=SchedulerConfig)
 
     @classmethod
     def from_env(cls) -> "RunConfig":
@@ -850,6 +896,7 @@ class RunConfig:
             evaluation=EvaluationConfig.from_env(),
             serving=ServingConfig.from_env(),
             loop=LoopConfig.from_env(),
+            sched=SchedulerConfig.from_env(),
         )
 
     def to_dict(self) -> dict:
@@ -964,6 +1011,17 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_LOOP_MAX_PROMOTIONS": "loop stop budget: promotions (0 = unbounded)",
     "DCT_LOOP_DAG_HOURS": "always-on DAG: one task occupancy before re-trigger",
     "DCT_LOOP_SMOKE_WAIT_S": "continuous-loop CI smoke: wall budget (s)",
+    # --- multi-tenant scheduler (dct_tpu.scheduler; docs/SCHEDULER.md) -
+    "DCT_TENANTS": "tenant roster: inline JSON or tenants.json path",
+    "DCT_SCHED_ROOT": "per-tenant run-dir root (+ shared cache home)",
+    "DCT_SCHED_CONCURRENT": "round leases running at once (1 = one shared mesh)",
+    "DCT_SCHED_POLL_S": "scheduler monitor cadence (budgets, preemption)",
+    "DCT_SCHED_PREEMPT_WAIT_S": "starved higher-class wait before graceful preempt (0 = never)",
+    "DCT_SCHED_SHARED_CACHE": "pin one compile/AOT store for same-family tenants",
+    "DCT_SCHED_MAX_WALL_S": "scheduler stop budget: wall seconds (0 = unbounded)",
+    "DCT_SCHED_MAX_ROUNDS": "scheduler stop budget: total leases (0 = unbounded)",
+    "DCT_SCHED_DAG_HOURS": "multi-tenant DAG: one task occupancy before re-trigger",
+    "DCT_SCHED_SMOKE_WAIT_S": "scheduler CI smoke: wall budget (s)",
     "DCT_SPARK_MASTER_HOST": "Spark master hostname for the ETL DAG",
     "DCT_SOAK_SECONDS": "auto-deploy DAG: canary soak dwell",
     "DCT_ENDPOINT_NAME": "serve the named LOCAL rollout endpoint",
@@ -1076,6 +1134,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_BENCH_SPINUP": "bench restart_spinup (cold/warm relaunch) leg on/off",
     "DCT_BENCH_FRESHNESS": "bench cycle_freshness (serial vs loop) leg on/off",
     "DCT_BENCH_SHARDED": "bench model_sharded (sharded vs DP) leg on/off",
+    "DCT_BENCH_TENANTS": "bench multi_tenant (2-tenant scheduler) leg on/off",
     "DCT_BENCH_DEADLINE": "bench wall-clock deadline (s); legs self-gate",
     "DCT_BENCH_PARTIAL": "path for the partial-results stash",
     "DCT_VAL_PARITY_EPOCHS": "val-loss parity leg epoch budget",

@@ -82,6 +82,9 @@ class AlwaysOnLoop:
         sleep_fn=time.sleep,
         on_promotion=None,
         on_round=None,
+        round_gate=None,
+        extra_round_env=None,
+        launcher_kwargs=None,
     ):
         from dct_tpu.observability.events import current_run_id
 
@@ -90,6 +93,23 @@ class AlwaysOnLoop:
         self._clock = clock
         self._sleep = sleep_fn
         self._on_round = on_round
+        # Multi-tenant hooks (dct_tpu.scheduler; docs/SCHEDULER.md):
+        # ``round_gate`` is consulted before EVERY round — it blocks
+        # until the scheduler grants this loop a round lease (False =
+        # the session is draining); ``extra_round_env`` rides into every
+        # supervised round's child ranks (per-tenant DCT_* overrides —
+        # family, fault drills, world size); ``launcher_kwargs`` lets
+        # each tenant's supervised worlds use their own coordinator
+        # port. All default to the single-tenant behavior.
+        self._round_gate = round_gate
+        self._extra_round_env = dict(extra_round_env or {})
+        self._launcher_kwargs = dict(launcher_kwargs or {})
+        # Scheduler-initiated graceful ROUND preemption: set by
+        # preempt_round(); the in-flight round checkpoints and ends
+        # early, and the loop returns to the gate instead of draining.
+        self._round_preempt = threading.Event()
+        self._inline_guard = None
+        self.preempted_rounds = 0
         self.run_id = cfg.obs.run_id or current_run_id()
         # Every inline fit (and the checkpoint/tracking layers under it)
         # stamps the SAME run-correlation ID: one grep spans the whole
@@ -140,22 +160,47 @@ class AlwaysOnLoop:
     def stopping(self) -> bool:
         return self._stop.is_set()
 
+    def preempt_round(self) -> None:
+        """Gracefully preempt the IN-FLIGHT round (scheduler lease
+        revocation): the trainer finishes its step and makes the resume
+        snapshot durable — the PR 3 preemption contract — then the loop
+        returns to the round gate with the session still alive. A no-op
+        when no round is running (the flag is cleared at the next round
+        start)."""
+        self._round_preempt.set()
+        guard = self._inline_guard
+        if guard is not None:
+            guard.request()
+
     # -- training rounds ------------------------------------------------
     def _run_round_inline(self) -> dict:
+        from dct_tpu.resilience.preempt import PreemptionGuard
         from dct_tpu.train.trainer import Trainer
 
         cfg = _round_config(self.cfg, self.loop_cfg.epochs_per_round)
+        # The loop owns the round's preemption guard so preempt_round()
+        # can request a graceful stop from another thread (in the main
+        # thread the guard still installs the SIGTERM handler exactly
+        # as a trainer-built one would).
+        guard = PreemptionGuard(clock=self._clock)
+        self._inline_guard = guard
+        if self._round_preempt.is_set():
+            guard.request()
         try:
-            result = Trainer(cfg).fit()
-        except FileNotFoundError:
-            # The ingest thread's full-rebuild swap has a two-rename
-            # window with no parquet dir; a round starting inside it
-            # must retry, not kill the always-on session (supervised
-            # mode heals the same race via the PR 3 relauncher).
-            self._sleep(0.2)
-            result = Trainer(cfg).fit()
+            try:
+                result = Trainer(cfg, preempt_guard=guard).fit()
+            except FileNotFoundError:
+                # The ingest thread's full-rebuild swap has a two-rename
+                # window with no parquet dir; a round starting inside it
+                # must retry, not kill the always-on session (supervised
+                # mode heals the same race via the PR 3 relauncher).
+                self._sleep(0.2)
+                result = Trainer(cfg, preempt_guard=guard).fit()
+        finally:
+            self._inline_guard = None
         cats = (result.goodput or {}).get("categories") or {}
-        self.train_step_wall_s += float(cats.get("train_step", 0.0))
+        train_step_s = float(cats.get("train_step", 0.0))
+        self.train_step_wall_s += train_step_s
         if result.steady_samples_per_sec_per_chip:
             self.train_samples_per_sec_per_chip.append(
                 result.steady_samples_per_sec_per_chip
@@ -165,12 +210,18 @@ class AlwaysOnLoop:
             "epochs": self.loop_cfg.epochs_per_round,
             "val_loss": result.val_loss,
             "val_acc": result.val_acc,
+            # Scheduler quota accounting: useful seconds in the lease
+            # (sub-ms dispatches on toy rounds — keep the precision).
+            "goodput_s": round(train_step_s, 4),
         }
 
     def _run_round_supervised(self) -> dict:
         from dct_tpu.launch.launcher import LocalProcessLauncher
 
-        world_size = int(os.environ.get("DCT_WORLD_SIZE", "1") or 1)
+        world_size = int(
+            self._extra_round_env.get("DCT_WORLD_SIZE")
+            or os.environ.get("DCT_WORLD_SIZE", "1") or 1
+        )
         # The child ranks rebuild RunConfig.from_env(): every path THIS
         # loop was constructed with must travel, or a programmatic
         # RunConfig would train into env-default dirs while the
@@ -205,7 +256,12 @@ class AlwaysOnLoop:
         # a launcher given a scrubbed env).
         if os.environ.get("DCT_SHARD_RULES"):
             env["DCT_SHARD_RULES"] = os.environ["DCT_SHARD_RULES"]
-        launcher = LocalProcessLauncher()
+        # Per-tenant overrides (scheduler mode) ride UNDER the loop's
+        # own cfg-derived keys: the tenant env shaped this loop's cfg in
+        # the first place, and the cfg is the operative round contract.
+        if self._extra_round_env:
+            env = {**self._extra_round_env, **env}
+        launcher = LocalProcessLauncher(**self._launcher_kwargs)
         res = launcher.supervise(
             [sys.executable, os.path.join(_REPO_ROOT, "jobs", "train_tpu.py")],
             world_size=world_size,
@@ -214,8 +270,44 @@ class AlwaysOnLoop:
             backoff_s=self.cfg.resilience.restart_backoff_s,
             backoff_factor=self.cfg.resilience.restart_backoff_factor,
             jitter=self.cfg.resilience.restart_jitter,
+            preempt_event=self._round_preempt,
         )
+        attempts = getattr(res, "attempts", None)
+        if res.restarts and "DCT_FAULT_SPEC" in self._extra_round_env:
+            from dct_tpu.resilience.faults import FAULT_CRASH_EXIT
+
+            # Per-session drill semantics, one level above the PR 3
+            # supervisor's per-cycle rule: once the tenant's fault plan
+            # PROVABLY fired (a rank died with the injected-crash exit
+            # code) and was healed inside this round, later rounds run
+            # clean — otherwise a resumed trajectory whose epoch index
+            # passed the trigger would re-fire the drill every round.
+            # A healed restart the drill did NOT cause (evidenced by
+            # the exit codes) must not cancel a drill that has yet to
+            # reach its trigger.
+            fired = any(
+                getattr(r, "returncode", None) == FAULT_CRASH_EXIT
+                for a in (attempts or [])
+                for r in getattr(a, "results", [])
+            )
+            if fired:
+                self._extra_round_env.pop("DCT_FAULT_SPEC", None)
+        rec = {
+            "mode": "supervised",
+            "epochs": self.loop_cfg.epochs_per_round,
+            "restarts": res.restarts,
+            "classification": res.classification,
+        }
+        if attempts:
+            # Quota accounting: the successful attempt's wall is the
+            # round's useful window; everything before it was healing.
+            rec["goodput_s"] = round(attempts[-1].wall_seconds, 3)
         if res.classification == "preempted" and not res.success:
+            if self._round_preempt.is_set() and not self._stop.is_set():
+                # Scheduler lease revocation: the world checkpointed
+                # and exited 75 — the round ends early, the loop lives.
+                rec["preempted"] = True
+                return rec
             # The supervisor itself caught SIGTERM (it forwards our
             # process signals while a round is in flight): the world
             # saved its resume snapshot — drain.
@@ -226,12 +318,7 @@ class AlwaysOnLoop:
                 f"supervised round gave up: {res.classification} "
                 f"(restarts={res.restarts})"
             )
-        return {
-            "mode": "supervised",
-            "epochs": self.loop_cfg.epochs_per_round,
-            "restarts": res.restarts,
-            "classification": res.classification,
-        }
+        return rec
 
     def _budget_exhausted(self, t0: float) -> str | None:
         lc = self.loop_cfg
@@ -287,27 +374,79 @@ class AlwaysOnLoop:
                 if reason is not None:
                     self.request_stop(reason)
                     break
+                if self._round_gate is not None:
+                    # Scheduler mode: block until a round lease is
+                    # granted. False = the session is draining (the
+                    # scheduler already called request_stop; the
+                    # fallback reason covers a gate closing first).
+                    try:
+                        granted = self._round_gate()
+                    except Exception as e:  # noqa: BLE001 — a broken gate stops THIS loop only
+                        error = f"{type(e).__name__}: {e}"[:300]
+                        self.events.emit(
+                            "loop", "loop.error", where="round_gate",
+                            error=error,
+                        )
+                        self.request_stop("gate_error")
+                        break
+                    if not granted:
+                        self.request_stop("gate_closed")
+                        break
+                self._round_preempt.clear()
+                round_t0 = self._clock()
+                preempted_round = False
                 try:
                     if lc.train_mode == "inline":
                         rec = self._run_round_inline()
                     else:
                         rec = self._run_round_supervised()
+                    preempted_round = bool(rec.get("preempted"))
                 except PreemptedError:
-                    # Inline round honored SIGTERM: resume snapshot is
-                    # durable; drain and exit clean.
-                    self.request_stop("preempted")
-                    break
+                    if (
+                        self._round_preempt.is_set()
+                        and not self._stop.is_set()
+                    ):
+                        # Scheduler lease revocation (inline round): the
+                        # trainer saved a durable resume snapshot — the
+                        # round ends early, the loop returns to the
+                        # gate. Progress is retained by the resume.
+                        rec = {
+                            "mode": lc.train_mode,
+                            "epochs": lc.epochs_per_round,
+                            "preempted": True,
+                        }
+                        preempted_round = True
+                    else:
+                        # Inline round honored SIGTERM: resume snapshot
+                        # is durable; drain and exit clean.
+                        self.request_stop("preempted")
+                        break
                 except Exception as e:  # noqa: BLE001 — name it, then stop cleanly
                     error = f"{type(e).__name__}: {e}"[:300]
                     self.events.emit(
                         "loop", "loop.error", where="train", error=error
                     )
                     self.request_stop("train_error")
+                    if self._on_round is not None:
+                        # The scheduler must still release the lease a
+                        # failed round was holding.
+                        try:
+                            self._on_round({"error": error})
+                        except Exception:  # noqa: BLE001 — a bad callback must not mask the error
+                            pass
                     break
+                rec["round_wall_s"] = round(self._clock() - round_t0, 3)
                 self.rounds += 1
+                if preempted_round:
+                    self.preempted_rounds += 1
                 rec["round"] = self.rounds
                 self.round_results.append(rec)
                 self.events.emit("loop", "loop.round", **rec)
+                if self._on_round is not None:
+                    try:
+                        self._on_round(rec)
+                    except Exception:  # noqa: BLE001 — a bad callback must not kill the loop
+                        pass
         finally:
             self.request_stop("completed")
             for t in threads:
@@ -337,6 +476,7 @@ class AlwaysOnLoop:
             "reason": self.stop_reason,
             "error": error,
             "rounds": self.rounds,
+            "preempted_rounds": self.preempted_rounds,
             "wall_s": round(wall_s, 3),
             "ingested_generations": self.ingest.processed,
             "promotions": len(promos),

@@ -441,6 +441,7 @@ class LocalProcessLauncher:
         *,
         world_size: int,
         env: dict[str, str] | None = None,
+        preempt_event=None,
     ) -> list[RankResult]:
         procs: list[subprocess.Popen] = []
         self._stall_killed = False
@@ -569,6 +570,17 @@ class LocalProcessLauncher:
                     )
                     if rc != 0 and self.fail_fast and not killed:
                         _teardown_world()
+                if (
+                    preempt_event is not None
+                    and preempt_event.is_set()
+                    and not killed
+                ):
+                    # Cooperative preemption (the multi-tenant
+                    # scheduler's lease revocation): the graceful half
+                    # of the escalation — every rank's PreemptionGuard
+                    # saves-and-exits-75, classifying the world
+                    # "preempted" with checkpointed progress intact.
+                    _teardown_world()
                 if killed and not escalated and (
                     time.monotonic() >= kill_deadline
                 ):
@@ -753,6 +765,7 @@ class LocalProcessLauncher:
         max_attempts: int = 50,
         sleep_fn=time.sleep,
         clock=time.monotonic,
+        preempt_event=None,
     ) -> SuperviseResult:
         """Supervised relaunch-and-resume: run :meth:`launch` until the
         world succeeds, classifying every failure
@@ -854,7 +867,8 @@ class LocalProcessLauncher:
                 t0 = clock()
                 t0_wall = time.time()
                 results = self.launch(
-                    argv, world_size=world_size, env=base_env
+                    argv, world_size=world_size, env=base_env,
+                    preempt_event=preempt_event,
                 )
                 wall = clock() - t0
                 cls = classify_failure(
@@ -875,6 +889,25 @@ class LocalProcessLauncher:
                     return SuperviseResult(
                         results=results, attempts=attempts,
                         restarts=restarts, success=True, classification=cls,
+                    )
+                if (
+                    preempt_event is not None
+                    and preempt_event.is_set()
+                    and cls == "preempted"
+                ):
+                    # Scheduler lease revocation, not a failure: the
+                    # world checkpointed and exited 75 by contract.
+                    # Returning (instead of the free preempted
+                    # relaunch) hands the chips back to the grant loop;
+                    # the caller's next lease resumes the trajectory.
+                    events.emit(
+                        "launcher", "supervise_preempted",
+                        attempts=len(attempts), restarts_used=restarts,
+                    )
+                    return SuperviseResult(
+                        results=results, attempts=attempts,
+                        restarts=restarts, success=False,
+                        classification="preempted",
                     )
                 if not policy.allows(restarts, cls) or (
                     len(attempts) >= max_attempts

@@ -200,6 +200,9 @@ def build_report(
         # Always-on loop actors (docs/CONTINUOUS.md): rounds, ingested
         # generations and mid-run promotions are cycle landmarks.
         "loop.", "ingest.",
+        # Multi-tenant scheduler (docs/SCHEDULER.md): leases, preempts
+        # and tenant lifecycle are session landmarks.
+        "sched.", "tenant.",
     )
     shown = 0
     for r in ev:
@@ -243,6 +246,15 @@ def build_report(
             extra = (
                 f" generation={r.get('generation')} mode={r.get('mode')}"
                 f" rows={r.get('rows')}"
+            )
+        if name in ("sched.grant", "sched.release", "sched.preempt",
+                    "tenant.parked"):
+            extra = " " + " ".join(
+                f"{k}={r[k]}" for k in (
+                    "tenant", "wait_s", "waited_s", "outcome", "chip_s",
+                    "waiter", "classification",
+                )
+                if r.get(k) is not None
             )
         lines.append(
             f"  {_fmt_ts(r.get('ts'), t0)}  "
@@ -382,6 +394,69 @@ def build_report(
             lines.append(
                 f"  stopped: reason={s.get('reason')} "
                 f"goodput={_fmt_num(s.get('goodput'))} "
+                f"wall={_fmt_num(s.get('wall_s'))}s"
+            )
+
+    # -- multi-tenant scheduler ---------------------------------------
+    sched_ev = [
+        r for r in ev
+        if str(r.get("event", "")).startswith(("sched.", "tenant."))
+    ]
+    if sched_ev:
+        lines.append("")
+        lines.append("Tenants:")
+        starts = [r for r in sched_ev if r.get("event") == "sched.start"]
+        if starts:
+            s = starts[-1]
+            lines.append(
+                f"  session: {len(s.get('tenants') or [])} tenant(s), "
+                f"concurrent={s.get('concurrent')} "
+                f"preempt_wait_s={s.get('preempt_wait_s')} "
+                f"shared_cache={s.get('shared_cache')}"
+            )
+        names = sorted({
+            r.get("tenant") for r in sched_ev if r.get("tenant")
+        })
+        for name in names:
+            mine = [r for r in sched_ev if r.get("tenant") == name]
+            grants = [r for r in mine if r["event"] == "sched.grant"]
+            rels = [r for r in mine if r["event"] == "sched.release"]
+            chip = sum(float(r.get("chip_s") or 0.0) for r in rels)
+            waits = [
+                float(r.get("wait_s") or 0.0) for r in grants
+            ]
+            preempted = sum(
+                1 for r in rels if r.get("outcome") == "preempted"
+            )
+            restarts = sum(int(r.get("restarts") or 0) for r in rels)
+            parked = [r for r in mine if r["event"] == "tenant.parked"]
+            stops = [r for r in mine if r["event"] == "tenant.stop"]
+            line = (
+                f"  {name}: leases={len(rels)} "
+                f"chip_s={chip:.2f}"
+            )
+            if waits:
+                line += (
+                    f" mean_wait_s={sum(waits) / len(waits):.2f}"
+                )
+            if preempted:
+                line += f" preempted={preempted}"
+            if restarts:
+                line += f" healed_restarts={restarts}"
+            if parked:
+                line += (
+                    f" PARKED ({parked[-1].get('classification')})"
+                )
+            if stops and stops[-1].get("promotions") is not None:
+                line += f" promotions={stops[-1]['promotions']}"
+            lines.append(line)
+        sstops = [r for r in sched_ev if r.get("event") == "sched.stop"]
+        if sstops:
+            s = sstops[-1]
+            lines.append(
+                f"  stopped: reason={s.get('reason')} "
+                f"rounds={s.get('total_rounds')} "
+                f"preempts={s.get('preempts')} "
                 f"wall={_fmt_num(s.get('wall_s'))}s"
             )
 

@@ -59,6 +59,14 @@ SERIES = (
     # matched config — a drop past the >10% threshold means the sharded
     # layouts started paying for collectives they previously amortized.
     ("sharded_sps_ratio", ("model_sharded", "sharded_sps_ratio"), "up"),
+    # Multi-tenant scheduler (the multi_tenant bench leg): the WORST
+    # tenant's goodput fraction over its granted leases (a drop past
+    # the >10% threshold means arbitration overhead started eating
+    # lease time) and the roster's mean round-lease wait (gated like a
+    # latency — a >25% rise means tenants queue longer for chips).
+    ("tenant_goodput_fraction",
+     ("multi_tenant", "min_goodput_fraction"), "up"),
+    ("tenant_round_wait_s", ("multi_tenant", "mean_round_wait_s"), "down"),
 )
 
 

@@ -58,6 +58,16 @@ class PreemptionGuard:
         self.requested = True
         self.signal_time = self._clock()
 
+    def request(self) -> None:
+        """Programmatic preemption — the multi-tenant scheduler's lease
+        revocation (dct_tpu.scheduler). Sets the SAME flag the SIGTERM
+        handler sets, so the trainer's safe-point contract (finish the
+        step, durable snapshot, :class:`PreemptedError`) is identical;
+        callable from any thread (plain attribute writes, like the
+        handler)."""
+        self.requested = True
+        self.signal_time = self._clock()
+
     def uninstall(self) -> None:
         if not self._installed:
             return

@@ -1,0 +1,27 @@
+"""Multi-tenant workload scheduler (docs/SCHEDULER.md): N always-on
+tenants sharing one pod with chip-time quota, priority classes, and
+fault isolation at round-lease granularity."""
+
+from dct_tpu.scheduler.quota import QuotaLedger, TenantLedger
+from dct_tpu.scheduler.scheduler import TenantRuntime, WorkloadScheduler
+from dct_tpu.scheduler.spec import (
+    PRIORITIES,
+    RESERVED_ENV,
+    TenantSpec,
+    TenantSpecError,
+    parse_tenants,
+    tenants_from_env,
+)
+
+__all__ = [
+    "PRIORITIES",
+    "RESERVED_ENV",
+    "QuotaLedger",
+    "TenantLedger",
+    "TenantRuntime",
+    "TenantSpec",
+    "TenantSpecError",
+    "WorkloadScheduler",
+    "parse_tenants",
+    "tenants_from_env",
+]

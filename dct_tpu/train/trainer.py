@@ -194,8 +194,17 @@ class TrainResult:
 
 
 class Trainer:
-    def __init__(self, cfg: RunConfig, *, mesh=None, tracker=None):
+    def __init__(
+        self, cfg: RunConfig, *, mesh=None, tracker=None,
+        preempt_guard=None,
+    ):
         self.cfg = cfg
+        # Caller-owned PreemptionGuard (the multi-tenant scheduler's
+        # lease revocation channel): fit() consults it instead of
+        # building its own, so another thread can request() a graceful
+        # stop of a fit running off the main thread (where SIGTERM
+        # never arrives).
+        self._preempt_guard = preempt_guard
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
         self.coordinator = is_coordinator()
         self.tracker = tracker if tracker is not None else get_tracker(
@@ -246,7 +255,11 @@ class Trainer:
             sleep_s=cfg.resilience.fault_sleep_s,
         )
         _faults.set_default(plan)
-        guard = PreemptionGuard()
+        guard = (
+            self._preempt_guard
+            if self._preempt_guard is not None
+            else PreemptionGuard()
+        )
         if cfg.resilience.graceful_preemption:
             guard.install()
         ledger = GoodputLedger()

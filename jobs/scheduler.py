@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Multi-tenant scheduler entry point: ``python3 jobs/scheduler.py``.
+
+Runs :class:`dct_tpu.scheduler.WorkloadScheduler` from the ``DCT_*``
+env contract — the tenant roster from ``DCT_TENANTS`` (inline JSON or
+a tenants.json path), arbitration knobs from ``DCT_SCHED_*``
+(docs/SCHEDULER.md) — until SIGTERM/SIGINT, a stop budget
+(``DCT_SCHED_MAX_WALL_S`` / ``_MAX_ROUNDS``), or every tenant reaching
+a terminal state.
+
+SIGTERM drains cleanly: every tenant's in-flight round finishes (or
+checkpoints under its own supervisor), each loop runs its final
+evaluator sweep, and the process exits 0 with ``sched.stop`` on the
+scheduler's event log. A relaunch resumes every tenant's trajectory
+and deployed champion unchanged.
+
+Exit code: 0 on a clean drain (including SIGTERM and budgets) with no
+tenant parked; 1 when any tenant parked (crash budget exhausted,
+health halt) or errored — an operator needs to look at THAT tenant,
+the others drained fine.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main() -> int:
+    from dct_tpu.scheduler import TenantSpecError, WorkloadScheduler
+    from dct_tpu.utils.logging import get_logger
+
+    log = get_logger("scheduler")
+    try:
+        sched = WorkloadScheduler()
+    except TenantSpecError as e:
+        log.error("tenant spec rejected: %s", e)
+        return 2
+    log.info(
+        "multi-tenant scheduler starting: run_id=%s tenants=%s "
+        "concurrent=%d root=%s",
+        sched.run_id, [t.name for t in sched.tenants],
+        sched.sched_cfg.concurrent, sched.sched_cfg.root,
+    )
+
+    def _drain(signum, frame):
+        log.info("signal %d: draining all tenants", signum)
+        sched.request_stop(f"signal_{signum}")
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _drain)
+
+    try:
+        summary = sched.run()
+    except TenantSpecError as e:
+        # Per-tenant validation that needs the built config (e.g. an
+        # inline fault drill) rejects at start(): same contract as a
+        # malformed roster — exit 2, clause named, nothing launched.
+        log.error("tenant spec rejected: %s", e)
+        return 2
+    parked = {
+        name: t for name, t in summary["tenants"].items()
+        if t.get("state") == "parked" or t.get("error")
+    }
+    log.info(
+        "scheduler stopped: reason=%s rounds=%d preempts=%d parked=%s",
+        summary["reason"], summary["total_rounds"], summary["preempts"],
+        sorted(parked) or "none",
+    )
+    return 1 if parked else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

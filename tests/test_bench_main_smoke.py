@@ -31,6 +31,10 @@ def test_bench_main_cpu_record_carries_everything(
     # exercises the loop machinery for real, the smoke pins the null
     # marker wiring.
     monkeypatch.setenv("DCT_BENCH_FRESHNESS", "0")
+    # Likewise multi_tenant: the 2-tenant scheduler session runs in
+    # tests/test_scheduler.py and the scheduler CI smoke; the bench
+    # smoke pins the null-marker wiring.
+    monkeypatch.setenv("DCT_BENCH_TENANTS", "0")
     monkeypatch.setenv(
         "DCT_BENCH_PARTIAL", str(tmp_path / "BENCH_PARTIAL.json")
     )
@@ -113,6 +117,7 @@ def test_bench_main_cpu_record_carries_everything(
     # DCT_BENCH_FRESHNESS=0 above), like every skippable section.
     assert record["restart_spinup"] is None
     assert record["cycle_freshness"] is None
+    assert record["multi_tenant"] is None
     with open(tmp_path / "BENCH_PARTIAL.json") as f:
         partial = json.load(f)
     assert partial["trainer_gap"]["fused"] == partial["value"]

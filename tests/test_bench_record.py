@@ -275,6 +275,25 @@ def _worst_case_record() -> dict:
             "goodput_serial": 0.1357, "goodput_loop": 0.0381,
             "freshness_speedup": 3.92, "train_throughput_ratio": 1.11,
         },
+        "multi_tenant": {
+            "tenants": 2, "rounds": 12, "preempts": 1, "wall_s": 14.8,
+            "min_goodput_fraction": 0.0312, "mean_round_wait_s": 0.41,
+            "quota_max_rel_err": 0.11,
+            "per_tenant": {
+                "light": {"weight": 1.0, "priority_rank": 1, "chips": 1,
+                          "rounds": 4, "preempted_rounds": 0,
+                          "granted_chip_s": 4.91, "goodput_s": 0.19,
+                          "badput_s": 4.72, "goodput_fraction": 0.0387,
+                          "mean_wait_s": 0.62, "fair_share": 0.3333,
+                          "granted_share": 0.3602, "state": "stopped"},
+                "heavy": {"weight": 2.0, "priority_rank": 1, "chips": 1,
+                          "rounds": 8, "preempted_rounds": 1,
+                          "granted_chip_s": 8.72, "goodput_s": 0.27,
+                          "badput_s": 8.45, "goodput_fraction": 0.0312,
+                          "mean_wait_s": 0.2, "fair_share": 0.6667,
+                          "granted_share": 0.6398, "state": "stopped"},
+            },
+        },
         "model_sharded": {
             "devices": 4,
             "config": {

@@ -1,0 +1,516 @@
+"""Multi-tenant workload scheduler (ISSUE 12): N always-on tenants
+sharing one pod with quota, priority, and fault isolation.
+
+Pins the acceptance contract:
+
+- tenant spec grammar is strict and front-loaded (reserved keys,
+  duplicate names, bad weights/priorities rejected at parse time);
+- grant policy: strict priority class then weighted deficit; the
+  preemption victim is the most junior strictly-lower-class runner;
+- a REAL 2-tenant inline session time-shares the rig, isolates run
+  dirs / run-ID namespaces / endpoints, and lands the per-tenant
+  ledger on the aggregated /metrics plane;
+- SHARED AOT CACHE: the second same-family tenant's first round
+  deserializes the first tenant's programs (``cache=hit`` on its
+  compile.window events) — amortization proven, not assumed;
+- a starved high-priority tenant preempts a running low-priority
+  round GRACEFULLY (durable snapshot, ``preempted`` outcome, session
+  alive);
+- one tenant's terminal failure parks IT while its peer drains clean.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from dct_tpu.config import (
+    ObservabilityConfig,
+    RunConfig,
+    SchedulerConfig,
+)
+from dct_tpu.scheduler import (
+    QuotaLedger,
+    TenantSpec,
+    TenantSpecError,
+    WorkloadScheduler,
+    parse_tenants,
+)
+
+
+def _tenant_events(root, name, *evs):
+    out = []
+    path = os.path.join(root, name, "events", "events.jsonl")
+    try:
+        with open(path) as f:
+            for line in f:
+                r = json.loads(line)
+                if r.get("event") in evs:
+                    out.append(r)
+    except OSError:
+        pass
+    return out
+
+
+def _sched_events(events_dir, *evs):
+    out = []
+    try:
+        with open(os.path.join(events_dir, "events.jsonl")) as f:
+            for line in f:
+                r = json.loads(line)
+                if r.get("event") in evs:
+                    out.append(r)
+    except OSError:
+        pass
+    return out
+
+
+# ----------------------------------------------------------------------
+# Tenant spec grammar.
+
+
+def test_parse_tenants_inline_and_file(tmp_path):
+    spec = [
+        {"name": "alpha", "family": "weather_mlp", "weight": 2,
+         "priority": "HIGH", "env": {"DCT_LR": "0.005"}},
+        {"name": "beta"},
+    ]
+    for raw in (json.dumps(spec), json.dumps({"tenants": spec})):
+        ts = parse_tenants(raw)
+        assert [t.name for t in ts] == ["alpha", "beta"]
+        assert ts[0].weight == 2.0 and ts[0].priority == "high"
+        assert ts[0].priority_rank == 0 and ts[1].priority_rank == 1
+        assert ts[0].env == {"DCT_LR": "0.005"}
+        assert ts[1].resolved_endpoint() == "beta"
+    p = tmp_path / "tenants.json"
+    p.write_text(json.dumps(spec))
+    assert [t.name for t in parse_tenants(str(p))] == ["alpha", "beta"]
+
+
+@pytest.mark.parametrize("bad,msg", [
+    ("", "empty"),
+    ("[]", "non-empty"),
+    ("{notjson", "not valid JSON"),
+    ('[{"name": "x y"}]', "name"),
+    ('[{"name": "a"}, {"name": "a"}]', "duplicate"),
+    ('[{"name": "a", "weight": 0}]', "weight"),
+    ('[{"name": "a", "weight": "heavy"}]', "weight"),
+    ('[{"name": "a", "priority": "urgent"}]', "priority"),
+    ('[{"name": "a", "unknown_field": 1}]', "unknown"),
+    ('[{"name": "a", "env": {"DCT_RUN_ID": "x"}}]', "reserved"),
+    ('[{"name": "a", "env": {"DCT_SCHED_ROOT": "x"}}]', "reserved"),
+    ('[{"name": "a", "env": {"DCT_MODELS_DIR": "x"}}]', "reserved"),
+    ('[{"name": "a", "env": {"PATH": "x"}}]', "DCT_"),
+    ('[{"name": "a", "family": "m", "env": {"DCT_MODEL": "n"}}]',
+     "not both"),
+    ("/nonexistent/tenants.json", "cannot read"),
+])
+def test_parse_tenants_rejects(bad, msg):
+    with pytest.raises(TenantSpecError, match=msg):
+        parse_tenants(bad)
+
+
+def test_scheduler_config_from_env(monkeypatch):
+    monkeypatch.setenv("DCT_TENANTS", '[{"name":"a"}]')
+    monkeypatch.setenv("DCT_SCHED_ROOT", "/tmp/t")
+    monkeypatch.setenv("DCT_SCHED_CONCURRENT", "2")
+    monkeypatch.setenv("DCT_SCHED_PREEMPT_WAIT_S", "1.5")
+    monkeypatch.setenv("DCT_SCHED_SHARED_CACHE", "0")
+    monkeypatch.setenv("DCT_SCHED_MAX_ROUNDS", "7")
+    c = SchedulerConfig.from_env()
+    assert c.spec == '[{"name":"a"}]' and c.root == "/tmp/t"
+    assert c.concurrent == 2 and c.preempt_wait_s == 1.5
+    assert c.shared_cache is False and c.max_rounds == 7
+
+
+# ----------------------------------------------------------------------
+# Quota ledger / grant policy.
+
+
+def _ledger():
+    led = QuotaLedger()
+    led.register("hi", weight=1.0, priority_rank=0)
+    led.register("a", weight=1.0, priority_rank=1)
+    led.register("b", weight=3.0, priority_rank=1)
+    led.register("low", weight=1.0, priority_rank=2)
+    return led
+
+
+def test_pick_prefers_class_then_deficit_then_name():
+    led = _ledger()
+    # Strict class: hi wins regardless of deficit.
+    led.record_release("hi", wall_s=100.0)
+    assert led.pick(["hi", "a", "b", "low"]) == "hi"
+    # Within a class: lowest granted/weight. b's weight 3 absorbs more
+    # chip time before its deficit passes a's.
+    led.record_release("a", wall_s=10.0)
+    led.record_release("b", wall_s=10.0)
+    assert led.pick(["a", "b"]) == "b"          # 10/3 < 10/1
+    led.record_release("b", wall_s=25.0)
+    assert led.pick(["a", "b"]) == "a"          # 35/3 > 10/1
+    # Deterministic name tie-break at equal class+deficit.
+    led2 = QuotaLedger()
+    led2.register("x", weight=1.0, priority_rank=1)
+    led2.register("y", weight=1.0, priority_rank=1)
+    assert led2.pick(["y", "x"]) == "x"
+
+
+def test_release_accounting_and_shares():
+    led = _ledger()
+    booked = led.record_release("a", wall_s=10.0, goodput_s=7.0)
+    assert booked == {"wall_s": 10.0, "chip_s": 10.0,
+                      "goodput_s": 7.0, "badput_s": 3.0}
+    led.record_release("b", wall_s=30.0, preempted=True)
+    t = led.tenants["b"]
+    assert t.preempted_rounds == 1 and t.goodput_s == 30.0  # None = all
+    assert led.fair_share("b") == pytest.approx(0.5)        # 3/6
+    assert led.granted_share("a") == pytest.approx(0.25)
+    rep = led.report()
+    assert rep["a"]["goodput_fraction"] == pytest.approx(0.7)
+    assert rep["b"]["rounds"] == 1 and rep["b"]["preempted_rounds"] == 1
+
+
+def test_multichip_tenant_books_chip_seconds():
+    led = QuotaLedger()
+    led.register("w2", weight=1.0, priority_rank=1, chips=2)
+    booked = led.record_release("w2", wall_s=5.0)
+    assert booked["chip_s"] == 10.0
+    assert led.tenants["w2"].granted_chip_s == 10.0
+
+
+def test_preemption_victim_only_strictly_lower_class():
+    led = _ledger()
+    # Equal class is never preempted (deficit resolves it at the next
+    # boundary); strictly lower classes are, most junior first.
+    assert led.preemption_victim("a", ["b"]) is None
+    assert led.preemption_victim("hi", ["a", "low"]) == "low"
+    assert led.preemption_victim("hi", ["a", "b"]) in ("a", "b")
+    # Among same-class victims the largest deficit pays.
+    led.record_release("a", wall_s=50.0)
+    assert led.preemption_victim("hi", ["a", "b"]) == "a"
+    assert led.preemption_victim("low", ["a", "b"]) is None
+
+
+# ----------------------------------------------------------------------
+# Loop round-gate contract (no training needed).
+
+
+def test_round_gate_false_stops_loop_cleanly(tmp_path):
+    from dct_tpu.config import DataConfig, LoopConfig
+    from dct_tpu.continuous import AlwaysOnLoop
+
+    cfg = RunConfig(
+        data=DataConfig(
+            processed_dir=str(tmp_path / "p"),
+            raw_csv=str(tmp_path / "missing.csv"),
+            models_dir=str(tmp_path / "m"),
+        ),
+        obs=ObservabilityConfig(events_dir=str(tmp_path / "ev"),
+                                heartbeat_dir=str(tmp_path / "hb")),
+        loop=LoopConfig(poll_s=0, eval_poll_s=0, train_mode="inline",
+                        packages_dir=str(tmp_path / "pkgs")),
+    )
+    loop = AlwaysOnLoop(cfg, round_gate=lambda: False)
+    summary = loop.run()
+    assert summary["rounds"] == 0
+    assert summary["reason"] == "gate_closed"
+    assert summary["error"] is None
+
+
+def test_fault_spec_requires_supervised_mode(tmp_path):
+    cfg = RunConfig(
+        obs=ObservabilityConfig(events_dir=str(tmp_path / "ev"),
+                                heartbeat_dir=str(tmp_path / "hb")),
+        sched=SchedulerConfig(root=str(tmp_path / "tenants")),
+    )
+    sched = WorkloadScheduler(
+        cfg,
+        tenants=[TenantSpec(
+            name="chaos", env={"DCT_FAULT_SPEC": "crash@rank0:epoch1"},
+        )],
+        base_env={"DCT_LOOP_TRAIN_MODE": "inline"},
+    )
+    with pytest.raises(TenantSpecError, match="supervised"):
+        sched.start()
+    sched.request_stop("test")
+
+
+# ----------------------------------------------------------------------
+# A real 2-tenant inline session: isolation, ledger on /metrics, and
+# the shared-AOT amortization proof (module-scoped rig).
+
+
+@pytest.fixture(scope="module")
+def session_rig(tmp_path_factory):
+    from dct_tpu.data.synthetic import generate_weather_csv
+
+    base = str(tmp_path_factory.mktemp("sched_session"))
+    raw = os.path.join(base, "raw", "weather.csv")
+    generate_weather_csv(raw, rows=400, seed=7)
+    saved = os.environ.get("DCT_TRACKING_DIR")
+    os.environ["DCT_TRACKING_DIR"] = os.path.join(base, "mlruns")
+    cfg = RunConfig(
+        obs=ObservabilityConfig(
+            events_dir=os.path.join(base, "events"),
+            heartbeat_dir=os.path.join(base, "hb"),
+            metrics_dir=os.path.join(base, "metrics"),
+            metrics_publish_s=0.2,
+        ),
+        sched=SchedulerConfig(root=os.path.join(base, "tenants"),
+                              poll_s=0.2),
+    )
+    tenants = parse_tenants(json.dumps([
+        {"name": "alpha", "weight": 1.0},
+        {"name": "beta", "weight": 2.0},
+    ]))
+    sched = WorkloadScheduler(cfg, tenants=tenants, base_env={
+        "DCT_RAW_CSV": raw,
+        "DCT_LOOP_TRAIN_MODE": "inline",
+        "DCT_LOOP_EPOCHS_PER_ROUND": "2",
+        "DCT_LOOP_SOAK_S": "0.05",
+        "DCT_LOOP_POLL_S": "0.2",
+        "DCT_LOOP_EVAL_POLL_S": "0.2",
+        "DCT_LOOP_MAX_ROUNDS": "1",
+    })
+    summary = sched.run()
+    yield cfg, sched, summary
+    if saved is None:
+        os.environ.pop("DCT_TRACKING_DIR", None)
+    else:
+        os.environ["DCT_TRACKING_DIR"] = saved
+
+
+def test_session_isolates_tenants(session_rig):
+    cfg, sched, summary = session_rig
+    root = cfg.sched.root
+    assert summary["reason"] == "completed"
+    for name in ("alpha", "beta"):
+        t = summary["tenants"][name]
+        assert t["state"] == "stopped" and t["rounds"] == 1
+        assert t.get("error") is None
+        # Own run dirs, own trained registry.
+        assert os.path.isdir(os.path.join(root, name, "models"))
+        assert os.path.isdir(os.path.join(root, name, "processed"))
+        # Own DCT_RUN_ID namespace on the training telemetry.
+        rounds = _tenant_events(root, name, "loop.round")
+        assert rounds and rounds[0]["run_id"] == f"{sched.run_id}-{name}"
+    # Leases were granted and released through the scheduler.
+    grants = _sched_events(cfg.obs.events_dir, "sched.grant")
+    releases = _sched_events(cfg.obs.events_dir, "sched.release")
+    assert {g["tenant"] for g in grants} == {"alpha", "beta"}
+    assert len(releases) == 2
+    assert all(r["outcome"] == "ok" for r in releases)
+    stops = _sched_events(cfg.obs.events_dir, "tenant.stop")
+    assert len(stops) == 2
+    assert not _sched_events(cfg.obs.events_dir, "tenant.parked")
+
+
+def test_session_shared_aot_cache_hit(session_rig):
+    """SATELLITE: two same-family tenants — the SECOND tenant's first
+    round must deserialize the first's compiled programs (cache=hit on
+    its compile.window events), proving the amortization."""
+    cfg, _sched, _summary = session_rig
+    root = cfg.sched.root
+    # Grant order at zero deficit is deterministic by name: alpha ran
+    # first and paid the compile.
+    alpha = _tenant_events(root, "alpha", "compile.window")
+    beta = _tenant_events(root, "beta", "compile.window")
+    assert alpha and beta
+    assert any(w.get("cache") == "miss" for w in alpha), (
+        "first tenant must publish the artifact (a fresh-compile miss)"
+    )
+    assert all(w.get("cache") == "hit" for w in beta), (
+        f"second tenant must warm-start off the shared store: {beta}"
+    )
+
+
+def test_session_ledger_on_aggregated_metrics(session_rig):
+    """The per-tenant quota/goodput ledger lands under a `tenant`
+    label on ONE aggregated scrape, final snapshot included."""
+    from dct_tpu.observability.aggregate import aggregate_text
+
+    cfg, _sched, summary = session_rig
+    body, merged = aggregate_text(cfg.obs.metrics_dir, stale_s=0)
+    chip = merged.metrics["dct_tenant_chip_seconds_total"]
+    tenants = {dict(k)["tenant"] for k in chip["totals"]}
+    assert tenants == {"alpha", "beta"}
+    for name in tenants:
+        got = chip["totals"][(("tenant", name),)]
+        assert got == pytest.approx(
+            summary["tenants"][name]["granted_chip_s"], rel=0.01
+        )
+    # Share gauges make the quota check one subtraction at scrape time.
+    assert merged.value(
+        "dct_tenant_quota_share", {"tenant": "beta"}
+    ) == pytest.approx(2 / 3, abs=1e-3)
+    assert "dct_tenant_round_wait_seconds_bucket" in body
+    assert 'dct_tenant_rounds_total{outcome="ok",tenant="alpha"}' in body
+
+
+def test_inspector_tenants_section(session_rig):
+    from dct_tpu.observability.inspect import (
+        build_report, load_events,
+    )
+
+    cfg, _sched, _summary = session_rig
+    events = load_events(cfg.obs.events_dir)
+    report = build_report(events, [], [], None, None)
+    assert "Tenants:" in report
+    assert "alpha: leases=1" in report
+    assert "stopped: reason=completed" in report
+
+
+# ----------------------------------------------------------------------
+# Starvation preemption: graceful, once, session survives.
+
+
+def test_high_priority_preempts_running_low_round(tmp_path):
+    from dct_tpu.data.synthetic import generate_weather_csv
+
+    base = str(tmp_path)
+    raw_small = os.path.join(base, "raw", "small.csv")
+    raw_big = os.path.join(base, "raw", "big.csv")
+    # The low tenant's round must still be running when the high
+    # tenant finishes priming its (much larger) ETL and starts
+    # waiting: many epochs on the small set vs one slow ingest.
+    generate_weather_csv(raw_small, rows=3000, seed=7)
+    generate_weather_csv(raw_big, rows=40000, seed=8)
+    os.environ.setdefault("DCT_TRACKING_DIR", os.path.join(base, "mlruns"))
+    cfg = RunConfig(
+        obs=ObservabilityConfig(events_dir=os.path.join(base, "events"),
+                                heartbeat_dir=os.path.join(base, "hb")),
+        sched=SchedulerConfig(root=os.path.join(base, "tenants"),
+                              poll_s=0.1, preempt_wait_s=0.5,
+                              max_rounds=2, max_wall_s=300.0),
+    )
+    tenants = parse_tenants(json.dumps([
+        {"name": "bulk", "priority": "low",
+         "env": {"DCT_RAW_CSV": raw_small,
+                 "DCT_LOOP_EPOCHS_PER_ROUND": "1000"}},
+        {"name": "hot", "priority": "high",
+         "env": {"DCT_RAW_CSV": raw_big,
+                 "DCT_LOOP_EPOCHS_PER_ROUND": "1"}},
+    ]))
+    sched = WorkloadScheduler(cfg, tenants=tenants, base_env={
+        "DCT_LOOP_TRAIN_MODE": "inline",
+        "DCT_LOOP_SOAK_S": "0.05",
+        "DCT_LOOP_POLL_S": "0.3",
+        "DCT_LOOP_EVAL_POLL_S": "0.3",
+    })
+    summary = sched.run()
+    preempts = _sched_events(cfg.obs.events_dir, "sched.preempt")
+    assert preempts and preempts[0]["tenant"] == "bulk"
+    assert preempts[0]["waiter"] == "hot"
+    assert summary["preempts"] >= 1
+    # The preempted round ended gracefully: durable resume snapshot,
+    # round recorded as preempted, tenant NOT parked.
+    root = cfg.sched.root
+    bulk_rounds = _tenant_events(root, "bulk", "loop.round")
+    assert bulk_rounds and bulk_rounds[0].get("preempted") is True
+    assert _tenant_events(root, "bulk", "resume_state_saved")
+    assert summary["tenants"]["bulk"]["state"] == "stopped"
+    assert summary["tenants"]["bulk"]["preempted_rounds"] >= 1
+    # The starved high tenant actually got the chips after.
+    rel = _sched_events(cfg.obs.events_dir, "sched.release")
+    outcomes = [(r["tenant"], r["outcome"]) for r in rel]
+    assert ("bulk", "preempted") in outcomes
+    assert ("hot", "ok") in outcomes
+
+
+# ----------------------------------------------------------------------
+# Fault isolation: one tenant's terminal failure parks IT only.
+
+
+def test_broken_tenant_parks_without_touching_peer(tmp_path):
+    from dct_tpu.data.synthetic import generate_weather_csv
+
+    base = str(tmp_path)
+    raw_ok = os.path.join(base, "raw", "ok.csv")
+    raw_bad = os.path.join(base, "raw", "missing.csv")  # never exists
+    generate_weather_csv(raw_ok, rows=400, seed=9)
+    os.environ.setdefault("DCT_TRACKING_DIR", os.path.join(base, "mlruns"))
+    cfg = RunConfig(
+        obs=ObservabilityConfig(events_dir=os.path.join(base, "events"),
+                                heartbeat_dir=os.path.join(base, "hb")),
+        sched=SchedulerConfig(root=os.path.join(base, "tenants"),
+                              poll_s=0.2),
+    )
+    tenants = parse_tenants(json.dumps([
+        {"name": "broken", "env": {"DCT_RAW_CSV": raw_bad}},
+        {"name": "healthy", "env": {"DCT_RAW_CSV": raw_ok}},
+    ]))
+    sched = WorkloadScheduler(cfg, tenants=tenants, base_env={
+        "DCT_LOOP_TRAIN_MODE": "inline",
+        "DCT_LOOP_EPOCHS_PER_ROUND": "1",
+        "DCT_LOOP_SOAK_S": "0.05",
+        "DCT_LOOP_POLL_S": "0.2",
+        "DCT_LOOP_EVAL_POLL_S": "0.2",
+        "DCT_LOOP_MAX_ROUNDS": "2",
+    })
+    summary = sched.run()
+    assert summary["tenants"]["broken"]["state"] == "parked"
+    assert summary["tenants"]["broken"]["parked_reason"] == "train_error"
+    parked = _sched_events(cfg.obs.events_dir, "tenant.parked")
+    assert parked and parked[0]["tenant"] == "broken"
+    assert parked[0]["classification"] == "error"
+    # The peer finished its budget untouched.
+    h = summary["tenants"]["healthy"]
+    assert h["state"] == "stopped" and h["rounds"] == 2
+    assert h.get("error") is None
+    hr = _tenant_events(cfg.sched.root, "healthy", "loop.round")
+    assert len(hr) == 2
+
+
+# ----------------------------------------------------------------------
+# Direct loop preemption (no scheduler): a preempted round does not
+# drain the session.
+
+
+def test_loop_preempt_round_keeps_session_alive(tmp_path):
+    import threading
+
+    from dct_tpu.config import DataConfig, LoopConfig
+    from dct_tpu.continuous import AlwaysOnLoop
+    from dct_tpu.data.synthetic import generate_weather_csv
+    from dct_tpu.etl.preprocess import preprocess_csv_to_parquet
+
+    base = str(tmp_path)
+    raw = os.path.join(base, "raw", "weather.csv")
+    generate_weather_csv(raw, rows=3000, seed=11)
+    os.environ.setdefault("DCT_TRACKING_DIR", os.path.join(base, "mlruns"))
+    cfg = RunConfig(
+        data=DataConfig(processed_dir=os.path.join(base, "processed"),
+                        raw_csv=raw,
+                        models_dir=os.path.join(base, "models")),
+        obs=ObservabilityConfig(events_dir=os.path.join(base, "ev"),
+                                heartbeat_dir=os.path.join(base, "hb")),
+        loop=LoopConfig(poll_s=0, eval_poll_s=0, train_mode="inline",
+                        epochs_per_round=300, max_rounds=2,
+                        packages_dir=os.path.join(base, "pkgs")),
+    )
+    preprocess_csv_to_parquet(raw, cfg.data.processed_dir)
+    loop = AlwaysOnLoop(cfg)
+
+    def _preempt_round_one():
+        deadline = time.time() + 120
+        while time.time() < deadline and loop._inline_guard is None:
+            time.sleep(0.02)
+        time.sleep(0.3)  # let some epochs run
+        loop.preempt_round()
+
+    t = threading.Thread(target=_preempt_round_one, daemon=True)
+    t.start()
+    summary = loop.run()
+    t.join(timeout=5)
+    # Round 1 preempted, round 2 COMPLETED (the trajectory resumed and
+    # the session outlived the preemption).
+    assert summary["preempted_rounds"] == 1
+    assert summary["rounds"] == 2
+    assert summary["reason"] == "max_rounds"
+    assert summary["error"] is None
+    ev_path = os.path.join(base, "ev", "events.jsonl")
+    recs = [json.loads(line) for line in open(ev_path)]
+    lr = [r for r in recs if r.get("event") == "loop.round"]
+    assert lr[0].get("preempted") is True
+    assert lr[1].get("preempted") is None
