@@ -1212,6 +1212,233 @@ def bench_model_sharded() -> dict:
     return out
 
 
+#: mpmd_pipeline leg shape (ISSUE 13): MPMD-1F1B (distinct per-stage
+#: programs on disjoint device slices, explicit transfers) vs
+#: SPMD-GPipe (the single lockstep tick program) at MATCHED stages /
+#: microbatches / model config, each in an isolated 2-device subprocess
+#: world. Bubble contract (docs/PARALLELISM.md §MPMD): the SPMD GPipe
+#: program's bubble is ``(P-1)/(M+P-1)`` BY CONSTRUCTION of its
+#: lockstep schedule (every device computes every tick, ramp ticks
+#: compute garbage — tier-1 pins the tick model against a slope
+#: measurement); the MPMD side's bubbles are MEASURED from per-stage
+#: busy/idle windows — the whole-step bubble for an apples-to-apples
+#: number, and the steady-state bubble (the always-on trainer's
+#: operating point, where 1F1B keeps every stage saturated) for the
+#: headline. Sizes tuned so per-op compute dominates the thread/queue
+#: overhead on the CPU rig.
+_MPMD_CFG = {
+    "seq_len": 32, "d_model": 128, "n_heads": 4, "n_layers": 2,
+    "d_ff": 512,
+}
+_MPMD_STAGES = 2
+_MPMD_MICROBATCHES = 8
+_MPMD_MB_ROWS = 32
+_MPMD_REPS = 3
+
+
+def _mpmd_bench_batch(m: int):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    b = _MPMD_MB_ROWS * m
+    return (
+        rng.standard_normal(
+            (b, _MPMD_CFG["seq_len"], 5)
+        ).astype(np.float32),
+        rng.integers(0, 2, b).astype(np.int32),
+        np.ones(b, np.float32),
+    )
+
+
+def _mpmd_child():
+    """Subprocess body (``python -c "import bench; bench._mpmd_child()"
+    '<spec json>'``): run one side of the A/B in its own 2-device world
+    and report one JSON line."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    spec_in = json.loads(sys.argv[-1])
+    side = spec_in["side"]
+    m = int(spec_in["microbatches"])
+    input_dim = 5
+
+    from dct_tpu.config import ModelConfig, MpmdConfig
+
+    mc_kwargs = dict(
+        name="weather_transformer_pp", dropout=0.0,
+        n_stages=_MPMD_STAGES, **_MPMD_CFG,
+    )
+    x, y, w = _mpmd_bench_batch(m)
+    b = x.shape[0]
+
+    if side == "mpmd":
+        from dct_tpu.config import RunConfig
+        from dct_tpu.parallel import mpmd
+        from dct_tpu.train import mpmd_trainer as mt
+
+        cfg = RunConfig()
+        cfg.model = ModelConfig(**mc_kwargs)
+        cfg.train.bf16_compute = False
+        cfg.mpmd = MpmdConfig(
+            stages=",".join(["1"] * _MPMD_STAGES), microbatches=m,
+            schedule=spec_in.get("schedule", "1f1b"),
+        )
+        spec = cfg.mpmd.to_spec(n_devices=jax.device_count())
+        meshes = mpmd.carve_stage_meshes(spec.device_counts, model=1)
+        full = mt.build_full_state(cfg, input_dim, compute_dtype=jnp.float32)
+        stage_states = [
+            mt.shard_stage_state(
+                mpmd.split_state(full, k, _MPMD_STAGES), meshes[k]
+            )
+            for k in range(_MPMD_STAGES)
+        ]
+        fns = mt.build_stage_fns(
+            cfg.model, input_dim, compute_dtype=jnp.float32
+        )
+        progs = [
+            mpmd.make_stage_programs(k, _MPMD_STAGES, fns)
+            for k in range(_MPMD_STAGES)
+        ]
+        runner = mpmd.MpmdRunner(spec, stage_states, progs, meshes)
+        # The compile+warm call's loss is the INIT-state loss — the
+        # cross-schedule parity pin (the gpipe child re-steps its init
+        # state every rep; the runner's states advance).
+        loss, _ = runner.train_step(x, y, w)
+        best, bub = None, None
+        for _ in range(_MPMD_REPS):
+            _loss_rep, wall = runner.train_step(x, y, w)
+            if best is None or wall < best:
+                best = wall
+                bub = runner.step_bubble(wall)
+        print(json.dumps({
+            "wall_s": round(best, 4),
+            "samples_per_sec_per_chip": round(b / (best * _MPMD_STAGES), 1),
+            "step_bubble": bub["step_bubble"],
+            "steady_bubble": bub["steady_bubble"],
+            "transfer_wait_s": round(
+                sum(s["transfer_wait_s"] for s in bub["stages"]), 4
+            ),
+            "loss": round(float(loss), 6),
+        }))
+        return
+
+    # SPMD GPipe side: the registry PP model on a pipe=P mesh — ONE
+    # jitted lockstep tick program (gpipe_tick_apply under GSPMD).
+    from dct_tpu.config import MeshConfig
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.parallel.mesh import make_mesh
+    from dct_tpu.parallel.sharding_rules import shard_state_with_rules
+    from dct_tpu.train.state import create_train_state
+    from dct_tpu.train.steps import _train_body
+
+    mesh = make_mesh(
+        MeshConfig(data=1, model=1, seq=1, pipe=_MPMD_STAGES)
+    )
+    cfg = ModelConfig(**mc_kwargs, n_microbatches=m)
+    model = get_model(
+        cfg, input_dim=input_dim, compute_dtype=jnp.float32, mesh=mesh
+    )
+    st = create_train_state(
+        model, input_dim=input_dim, lr=0.01, seed=42,
+        example_shape=(1, cfg.seq_len, input_dim),
+    )
+    st = shard_state_with_rules(st, mesh, family=cfg.name)
+    step = jax.jit(_train_body)
+    out = step(st, x, y, w)
+    jax.block_until_ready(out[0].params)
+    best, loss = None, None
+    for _ in range(_MPMD_REPS):
+        t0 = _time.perf_counter()
+        out = step(st, x, y, w)
+        jax.block_until_ready(out[0].params)
+        wall = _time.perf_counter() - t0
+        if best is None or wall < best:
+            best = wall
+        loss = float(out[1])
+    print(json.dumps({
+        "wall_s": round(best, 4),
+        "samples_per_sec_per_chip": round(b / (best * _MPMD_STAGES), 1),
+        "loss": round(loss, 6),
+    }))
+
+
+def bench_mpmd_pipeline() -> dict:
+    """MPMD-1F1B vs SPMD-GPipe at matched P=2/M=8 (ISSUE 13 headline):
+    bubble fraction for both schedules + samples/s/chip, each side in
+    an isolated 2-device subprocess world. The acceptance bar — the
+    MPMD steady-state bubble at least 15% below the SPMD-GPipe bubble
+    — rides the record as ``bubble_reduction``; the slope-method bubble
+    at a doubled microbatch count rides along as the cross-check that
+    the MPMD step wall really is affine in M."""
+    import subprocess
+
+    from dct_tpu.parallel.mpmd import analytic_bubble, measured_bubble
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(
+            f"--xla_force_host_platform_device_count={_MPMD_STAGES}"
+        ),
+    )
+    env.pop("DCT_SHARD_RULES", None)
+    env.pop("DCT_MPMD_STAGES", None)
+
+    def run(side: str, m: int) -> dict:
+        out = subprocess.run(
+            [
+                sys.executable, "-c",
+                "import bench; bench._mpmd_child()",
+                json.dumps({"side": side, "microbatches": m}),
+            ],
+            env=env, cwd=_REPO_ROOT, capture_output=True, text=True,
+            timeout=900,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"mpmd_pipeline {side}/M={m} child failed: "
+                f"{out.stderr[-400:]}"
+            )
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    m = _MPMD_MICROBATCHES
+    gp = run("gpipe", m)
+    mp = run("mpmd", m)
+    mp2 = run("mpmd", 2 * m)
+    gpipe_bubble = analytic_bubble(_MPMD_STAGES, m)
+    out = {
+        "stages": _MPMD_STAGES,
+        "microbatches": m,
+        "config": dict(_MPMD_CFG, mb_rows=_MPMD_MB_ROWS),
+        # The SPMD lockstep program's bubble is its tick count (the
+        # tier-1 gpipe measured-vs-analytic test pins the tick model).
+        "gpipe_bubble_fraction": round(gpipe_bubble, 4),
+        "mpmd_steady_bubble": mp["steady_bubble"],
+        "mpmd_step_bubble": mp["step_bubble"],
+        "mpmd_slope_bubble": round(
+            measured_bubble(mp["wall_s"], mp2["wall_s"], m, 2 * m), 4
+        ),
+        "mpmd_transfer_wait_s": mp["transfer_wait_s"],
+        "gpipe_sps": gp["samples_per_sec_per_chip"],
+        "mpmd_sps": mp["samples_per_sec_per_chip"],
+        # Cross-schedule parity pin: layout is not math (same init,
+        # same batch, different reduction orders — float tolerance).
+        "loss_delta": round(abs(gp["loss"] - mp["loss"]), 8),
+        "bubble_reduction": round(
+            1.0 - mp["steady_bubble"] / gpipe_bubble, 4
+        ),
+    }
+    if gp["samples_per_sec_per_chip"]:
+        out["mpmd_sps_ratio"] = round(
+            mp["samples_per_sec_per_chip"]
+            / gp["samples_per_sec_per_chip"], 3
+        )
+    return out
+
+
 #: cycle_freshness leg shape: two SCORED generations arriving while the
 #: system is busy, after a bootstrap generation that pays XLA compile
 #: and the first deploy for BOTH runners. The serial side's train
@@ -1879,17 +2106,17 @@ def _stdout_record(record: dict) -> dict:
         }
     cf = out.get("cycle_freshness")
     if isinstance(cf, dict) and "error" not in cf:
-        # Stdout carries the architecture comparison (speedup, both
-        # means, both goodputs); the throughput-parity ratio, the
-        # generation count and the per-side stanzas with freshness
-        # series, cycle walls and stop reasons stay in the partial
-        # (bytes reclaimed to fund the multi_tenant sentinel series).
+        # Stdout carries the architecture comparison (speedup, the loop
+        # mean, both goodputs); the serial mean is derivable
+        # (loop_mean x speedup — bytes reclaimed to fund the
+        # mpmd_pipeline sentinel series), and the throughput-parity
+        # ratio, generation count and per-side stanzas with freshness
+        # series, cycle walls and stop reasons stay in the partial.
         out["cycle_freshness"] = {
             k: cf[k]
             for k in (
-                "freshness_speedup", "serial_mean_freshness_s",
-                "loop_mean_freshness_s", "goodput_serial",
-                "goodput_loop",
+                "freshness_speedup", "loop_mean_freshness_s",
+                "goodput_serial", "goodput_loop",
             )
             if k in cf
         }
@@ -1906,6 +2133,20 @@ def _stdout_record(record: dict) -> dict:
                 "quota_max_rel_err",
             )
             if k in mt
+        }
+    mpp = out.get("mpmd_pipeline")
+    if isinstance(mpp, dict) and "error" not in mpp:
+        # Stdout carries the two sentinel series + the gpipe comparator
+        # bubble (bubble_reduction = 1 - steady/gpipe is derivable);
+        # the config dict, slope cross-check, transfer-wait and
+        # absolute sps detail stay in the partial.
+        out["mpmd_pipeline"] = {
+            k: mpp[k]
+            for k in (
+                "mpmd_steady_bubble", "gpipe_bubble_fraction",
+                "mpmd_sps_ratio",
+            )
+            if k in mpp
         }
     srv = out.get("serving")
     if isinstance(srv, dict) and "error" not in srv:
@@ -2055,10 +2296,10 @@ def _shrink_to_budget(out: dict) -> dict:
         # partial.
         ("restart_spinup", ("warm_step_s", "step_speedup",
                             "warm_score_s", "score_speedup")),
-        # Same guard for the freshness digest: the speedup + both means
-        # + both goodputs survive every tier-1 squeeze.
+        # Same guard for the freshness digest: the speedup + the loop
+        # mean + both goodputs survive every tier-1 squeeze (the
+        # serial mean is derivable: loop_mean x speedup).
         ("cycle_freshness", ("freshness_speedup",
-                             "serial_mean_freshness_s",
                              "loop_mean_freshness_s",
                              "goodput_serial", "goodput_loop")),
         # Sharded-vs-DP: the sentinel's tracked throughput ratio
@@ -2069,11 +2310,24 @@ def _shrink_to_budget(out: dict) -> dict:
         # survive tier 1; counts yield to the partial.
         ("multi_tenant", ("min_goodput_fraction", "mean_round_wait_s",
                           "quota_max_rel_err")),
+        # MPMD pipeline: reachability guard (the digest already keeps
+        # exactly these three — both sentinel series + the comparator).
+        ("mpmd_pipeline", ("mpmd_steady_bubble", "gpipe_bubble_fraction",
+                           "mpmd_sps_ratio")),
         # Late probe squeeze: the fallback-reason prose yields before
         # the serving levels do (the partial keeps the full reason; a
         # cpu `platform` on the record already says a fallback
         # happened).
         ("probe", ("platform", "attempts")),
+        # Late config squeeze: the scaled/moe size-config digest
+        # strings are env-reconstructible constants (and verbatim in
+        # the partial) — they yield before the serving_load level
+        # columns do.
+        ("moe", ("sorted_ms", "einsum_ms", "sorted_speedup",
+                 "deadline_skipped")),
+        ("scaled", ("step_time_ms", "step_time_dispatch_ms",
+                    "attn_blockwise_ms", "attn_flash_ms", "mfu",
+                    "deadline_skipped")),
         # The serving tier's headline stanza goes LAST in tier 1: its
         # per-level qps/p50/p99 columns outlive every other stanza's
         # detail (the acceptance contract wants >= 2 levels on the
@@ -2115,6 +2369,7 @@ def _shrink_to_budget(out: dict) -> dict:
         ("cycle_freshness", ("freshness_speedup", "loop_mean_freshness_s")),
         ("model_sharded", ("sharded_sps_ratio",)),
         ("multi_tenant", ("min_goodput_fraction",)),
+        ("mpmd_pipeline", ("mpmd_steady_bubble", "mpmd_sps_ratio")),
         ("moe", ("sorted_speedup",)),
         ("trainer_gap", ("fused_over_fit", "prefetch_spans")),
         ("scaled", ("step_time_ms", "attn_blockwise_ms",
@@ -2638,6 +2893,19 @@ def main():
             )
             _flush_partial(record)
 
+        # MPMD pipeline A/B (ISSUE 13): MPMD-1F1B on disjoint slices vs
+        # the SPMD-GPipe lockstep program at matched P=2/M=8 — bubble
+        # fraction both schedules + samples/s/chip. Subprocess-isolated
+        # 2-device worlds like model_sharded; DCT_BENCH_MPMD=0 skips.
+        skip_mpmd = os.environ.get(
+            "DCT_BENCH_MPMD", "1"
+        ).strip().lower() in ("0", "false", "no")
+        if not (skip_mpmd or _gate("mpmd_pipeline", frac=0.97)):
+            record["mpmd_pipeline"] = _optional(
+                "mpmd_pipeline", bench_mpmd_pipeline
+            )
+            _flush_partial(record)
+
         if not _gate("host_dataplane"):
             dataplane = _optional(
                 "host_dataplane", bench_host_dataplane
@@ -2658,7 +2926,7 @@ def main():
     for skippable in (
         "scaled", "moe", "val_parity", "serving", "serving_load",
         "restart_spinup", "cycle_freshness", "model_sharded",
-        "multi_tenant", "host_dataplane",
+        "multi_tenant", "mpmd_pipeline", "host_dataplane",
     ):
         record.setdefault(skippable, None)
     _flush_partial(record)

@@ -864,6 +864,55 @@ class SchedulerConfig:
 
 
 @dataclass
+class MpmdConfig:
+    """MPMD pipeline-parallel trainer knobs (dct_tpu.parallel.mpmd;
+    docs/PARALLELISM.md §MPMD): distinct per-stage programs on disjoint
+    device slices with explicit inter-stage transfers.
+
+    ``stages`` is the stage map — a stage count (``"2"``, devices split
+    evenly) or explicit per-stage device counts (``"2,1,1"`` — stages
+    may be heterogeneous). The grammar is validated LOUDLY at parse
+    time (:func:`dct_tpu.parallel.mpmd.parse_stage_spec`), like
+    ``DCT_SHARD_RULES``: a typo'd stage map raises, it never silently
+    trains single-stage. ``schedule`` picks the per-stage op order:
+    ``1f1b`` (PipeDream-flush — bubble confined to fill/drain, steady
+    state saturated) or ``gpipe`` (all-forward-then-all-backward, the
+    A/B comparator). ``microbatches`` 0 = 2x the stage count.
+    """
+
+    stages: str = "2"
+    microbatches: int = 0
+    schedule: str = "1f1b"
+    transfer_timeout_s: float = 120.0
+    port_base: int = 29600
+
+    @classmethod
+    def from_env(cls) -> "MpmdConfig":
+        c = cls()
+        c.stages = _env("DCT_MPMD_STAGES", c.stages, str)
+        c.microbatches = _env("DCT_MPMD_MICROBATCHES", c.microbatches, int)
+        c.schedule = _env(
+            "DCT_MPMD_SCHEDULE", c.schedule, str
+        ).strip().lower()
+        c.transfer_timeout_s = _env(
+            "DCT_MPMD_TRANSFER_TIMEOUT_S", c.transfer_timeout_s, float
+        )
+        c.port_base = _env("DCT_MPMD_PORT_BASE", c.port_base, int)
+        return c
+
+    def to_spec(self, *, n_devices: int | None = None):
+        """Parse/validate into an :class:`dct_tpu.parallel.mpmd
+        .MpmdSpec` — every malformed clause raises ``MpmdSpecError``
+        naming the offending knob."""
+        from dct_tpu.parallel.mpmd import spec_from_env_values
+
+        return spec_from_env_values(
+            self.stages, self.microbatches, self.schedule,
+            self.transfer_timeout_s, self.port_base, n_devices=n_devices,
+        )
+
+
+@dataclass
 class RunConfig:
     """Top-level bundle passed to the Trainer."""
 
@@ -880,6 +929,7 @@ class RunConfig:
     serving: ServingConfig = field(default_factory=ServingConfig)
     loop: LoopConfig = field(default_factory=LoopConfig)
     sched: SchedulerConfig = field(default_factory=SchedulerConfig)
+    mpmd: MpmdConfig = field(default_factory=MpmdConfig)
 
     @classmethod
     def from_env(cls) -> "RunConfig":
@@ -897,6 +947,7 @@ class RunConfig:
             serving=ServingConfig.from_env(),
             loop=LoopConfig.from_env(),
             sched=SchedulerConfig.from_env(),
+            mpmd=MpmdConfig.from_env(),
         )
 
     def to_dict(self) -> dict:
@@ -1022,6 +1073,14 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_SCHED_MAX_ROUNDS": "scheduler stop budget: total leases (0 = unbounded)",
     "DCT_SCHED_DAG_HOURS": "multi-tenant DAG: one task occupancy before re-trigger",
     "DCT_SCHED_SMOKE_WAIT_S": "scheduler CI smoke: wall budget (s)",
+    # --- MPMD pipeline trainer (dct_tpu.parallel.mpmd; docs/PARALLELISM.md §MPMD) -
+    "DCT_MPMD_STAGES": "stage map: stage count or per-stage device counts (loud parse)",
+    "DCT_MPMD_MICROBATCHES": "microbatches per optimizer step (0 = 2x stages)",
+    "DCT_MPMD_SCHEDULE": "per-stage op order: 1f1b | gpipe",
+    "DCT_MPMD_TRANSFER_TIMEOUT_S": "inter-stage transfer wait before loud failure (s)",
+    "DCT_MPMD_PORT_BASE": "multi-process transfer plane base port (stage k = base+k)",
+    "DCT_MPMD_STAGE_ID": "worker plumbing: this process's stage index (NODE_RANK fallback)",
+    "DCT_MPMD_SMOKE_WAIT_S": "MPMD CI smoke: wall budget (s)",
     "DCT_SPARK_MASTER_HOST": "Spark master hostname for the ETL DAG",
     "DCT_SOAK_SECONDS": "auto-deploy DAG: canary soak dwell",
     "DCT_ENDPOINT_NAME": "serve the named LOCAL rollout endpoint",
@@ -1135,6 +1194,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_BENCH_FRESHNESS": "bench cycle_freshness (serial vs loop) leg on/off",
     "DCT_BENCH_SHARDED": "bench model_sharded (sharded vs DP) leg on/off",
     "DCT_BENCH_TENANTS": "bench multi_tenant (2-tenant scheduler) leg on/off",
+    "DCT_BENCH_MPMD": "bench mpmd_pipeline (MPMD-1F1B vs SPMD-GPipe bubble) leg on/off",
     "DCT_BENCH_DEADLINE": "bench wall-clock deadline (s); legs self-gate",
     "DCT_BENCH_PARTIAL": "path for the partial-results stash",
     "DCT_VAL_PARITY_EPOCHS": "val-loss parity leg epoch budget",

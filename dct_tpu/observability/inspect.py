@@ -203,6 +203,11 @@ def build_report(
         # Multi-tenant scheduler (docs/SCHEDULER.md): leases, preempts
         # and tenant lifecycle are session landmarks.
         "sched.", "tenant.",
+        # MPMD pipeline trainer (docs/PARALLELISM.md §MPMD): stage
+        # lifecycle, cross-topology pivots, and transfer faults are
+        # session landmarks (per-epoch mpmd.step_report stays off the
+        # timeline — the MPMD section below summarizes it).
+        "mpmd.",
     )
     shown = 0
     for r in ev:
@@ -211,6 +216,8 @@ def build_report(
             interesting_prefixes
         ):
             continue
+        if name == "mpmd.step_report":
+            continue  # per-epoch; the MPMD section summarizes it
         who = (
             f"rank {r['rank']}" if r.get("rank") is not None else "host"
         )
@@ -459,6 +466,54 @@ def build_report(
                 f"preempts={s.get('preempts')} "
                 f"wall={_fmt_num(s.get('wall_s'))}s"
             )
+
+    # -- MPMD pipeline ------------------------------------------------
+    mpmd_ev = [
+        r for r in ev if str(r.get("event", "")).startswith("mpmd.")
+    ]
+    if mpmd_ev:
+        lines.append("")
+        lines.append("MPMD pipeline:")
+        starts = [
+            r for r in mpmd_ev if r.get("event") == "mpmd.stage_start"
+        ]
+        if starts:
+            s = starts[-1]
+            lines.append(
+                f"  stages: {s.get('n_stages')} "
+                f"schedule={s.get('schedule')}"
+            )
+        reports = [
+            r for r in mpmd_ev if r.get("event") == "mpmd.step_report"
+        ]
+        if reports:
+            last = reports[-1]
+            lines.append(
+                f"  epochs reported: {len(reports)}; last bubble: "
+                f"steady={_fmt_num(last.get('steady_bubble'))} "
+                f"step={_fmt_num(last.get('step_bubble'))} "
+                f"analytic={_fmt_num(last.get('analytic_bubble'))}"
+            )
+            for st in last.get("stages") or []:
+                lines.append(
+                    f"    stage {st.get('stage')}: "
+                    f"busy={_fmt_num(st.get('busy_s'))}s "
+                    f"fill={_fmt_num(st.get('fill_s'))}s "
+                    f"steady={_fmt_num(st.get('steady_s'))}s "
+                    f"drain={_fmt_num(st.get('drain_s'))}s "
+                    f"transfer_wait={_fmt_num(st.get('transfer_wait_s'))}s"
+                )
+        for r in mpmd_ev:
+            if r.get("event") == "mpmd.pivot":
+                lines.append(
+                    f"  pivot: {r.get('direction')} "
+                    f"@epochs={r.get('epochs_completed')}"
+                )
+            if r.get("event") == "mpmd.transfer_timeout":
+                lines.append(
+                    f"  TRANSFER TIMEOUT stage {r.get('stage')}: "
+                    f"{str(r.get('error'))[:120]}"
+                )
 
     # -- deploy gates / SLO -------------------------------------------
     lines.append("")

@@ -67,6 +67,16 @@ SERIES = (
     ("tenant_goodput_fraction",
      ("multi_tenant", "min_goodput_fraction"), "up"),
     ("tenant_round_wait_s", ("multi_tenant", "mean_round_wait_s"), "down"),
+    # MPMD pipeline trainer (the mpmd_pipeline bench leg): the
+    # 1F1B steady-state bubble fraction — gated like a latency (a >25%
+    # rise means the per-stage saturation regressed: transfer waits or
+    # schedule skew crept into the steady window) — and MPMD throughput
+    # as a fraction of the SPMD-GPipe comparator at matched config
+    # (a >10% drop means the explicit transfer plane started costing
+    # what the lockstep collectives used to).
+    ("mpmd_bubble_fraction", ("mpmd_pipeline", "mpmd_steady_bubble"),
+     "down"),
+    ("mpmd_sps_ratio", ("mpmd_pipeline", "mpmd_sps_ratio"), "up"),
 )
 
 

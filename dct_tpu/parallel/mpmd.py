@@ -1,0 +1,973 @@
+"""MPMD pipeline parallelism: distinct per-stage programs on disjoint
+device slices (ROADMAP item 3 / ISSUE 13).
+
+Everything else in the platform is single-program SPMD over one mesh —
+the GPipe path in :mod:`dct_tpu.parallel.pipeline` forces all stages
+into one stacked-pytree program (identical shapes, one precision, a
+lockstep tick schedule whose bubble is ``(P-1)/(M+P-1)``). Per "Scaling
+Deep Learning Training with MPMD Pipeline Parallelism" (PAPERS.md),
+this module runs each stage as its OWN compiled program owning a
+disjoint slice of the pod's devices, with explicit inter-stage
+activation/gradient transfers and a 1F1B (PipeDream-flush) steady-state
+schedule:
+
+- :func:`parse_stage_spec` — the ``DCT_MPMD_STAGES`` grammar (stage
+  count or per-stage device counts), loud ``ValueError`` on any
+  malformed clause, like ``DCT_SHARD_RULES``;
+- :func:`carve_stage_meshes` — per-stage sub-meshes carved from the
+  device pool (the PR 11 mesh layer, one ``(data, model)`` mesh per
+  stage — stages may have HETEROGENEOUS slice sizes);
+- :func:`build_schedule` — per-stage op lists (``1f1b`` | ``gpipe``)
+  with every op tagged ``fill`` / ``steady`` / ``drain``, so the span
+  and goodput layers can attribute exactly where the bubble went;
+- :func:`split_state` / :func:`merge_stage_states` — the SPMD
+  stacked-pytree TrainState <-> per-stage TrainStates pivot (pure data
+  movement, bitwise both ways; optimizer-state param mirrors are
+  discovered structurally so any optax chain splits correctly);
+- :class:`StageExecutor` — runs ONE stage's op list against a pair of
+  neighbor channels; the in-process thread-per-stage runner
+  (:class:`MpmdRunner`) and the multi-process socket worker
+  (:mod:`dct_tpu.train.mpmd_worker`) share it, so the two deployment
+  modes execute the identical schedule;
+- bubble accounting — :func:`analytic_bubble` (the ``(P-1)/(M+P-1)``
+  model both schedules obey in the uniform-tick limit) and
+  :func:`measured_bubble` (the slope method: the fraction of a step's
+  wall not explained by the marginal microbatch cost — measurable for
+  ANY schedule, SPMD or MPMD, without per-tick device introspection).
+
+Stage backward programs RECOMPUTE their forward from the stored input
+activation (``jax.vjp`` inside one jitted program — full-remat style):
+the only cross-op residual is the stage input, which is exactly the
+1F1B in-flight set the schedule bounds at ``P - stage`` activations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SCHEDULES = ("1f1b", "gpipe")
+
+
+class MpmdSpecError(ValueError):
+    """A malformed MPMD spec (stage map / schedule / microbatches) —
+    raised at parse time, naming the offending clause: a typo'd
+    pipeline must never silently train single-stage."""
+
+
+class MpmdTransferTimeout(RuntimeError):
+    """An inter-stage transfer did not arrive within the configured
+    ``DCT_MPMD_TRANSFER_TIMEOUT_S`` window — a dead or wedged neighbor
+    stage."""
+
+
+@dataclasses.dataclass
+class MpmdSpec:
+    """The resolved MPMD run shape (config.MpmdConfig, parsed)."""
+
+    n_stages: int
+    device_counts: tuple  # per-stage device counts, len == n_stages
+    n_microbatches: int
+    schedule: str = "1f1b"
+    transfer_timeout_s: float = 120.0
+    port_base: int = 29600
+
+    @property
+    def total_devices(self) -> int:
+        return int(sum(self.device_counts))
+
+
+def parse_stage_spec(text: str, *, n_devices: int | None = None) -> tuple:
+    """``DCT_MPMD_STAGES`` -> per-stage device counts.
+
+    Grammar (loud failure on anything else):
+
+    - ``"P"`` (one positive int): P stages, devices split evenly —
+      needs ``n_devices`` divisible by P when given;
+    - ``"d0,d1,...,dP-1"``: explicit per-stage device counts (stages
+      may be heterogeneous — a fat embedding stage can own more chips).
+
+    Raises :class:`MpmdSpecError` naming the clause on: empty spec,
+    non-integer tokens, zero/negative counts, fewer than 2 stages, or
+    a device sum exceeding ``n_devices``.
+    """
+    raw = (text or "").strip()
+    if not raw:
+        raise MpmdSpecError(
+            "DCT_MPMD_STAGES is empty: expected a stage count ('2') or "
+            "per-stage device counts ('1,1')"
+        )
+    toks = [t.strip() for t in raw.split(",")]
+    for t in toks:
+        if not (t.lstrip("-").isdigit()):
+            raise MpmdSpecError(
+                f"DCT_MPMD_STAGES token {t!r} is not an integer "
+                f"(spec: {raw!r})"
+            )
+    vals = [int(t) for t in toks]
+    if len(vals) == 1:
+        p = vals[0]
+        if p < 2:
+            raise MpmdSpecError(
+                f"DCT_MPMD_STAGES={p}: an MPMD pipeline needs >= 2 "
+                "stages (use the plain trainer for 1)"
+            )
+        if n_devices is not None:
+            if n_devices % p:
+                raise MpmdSpecError(
+                    f"DCT_MPMD_STAGES={p} does not divide the "
+                    f"{n_devices}-device pool evenly; give explicit "
+                    "per-stage counts instead"
+                )
+            return tuple([n_devices // p] * p)
+        return tuple([1] * p)
+    if any(v < 1 for v in vals):
+        raise MpmdSpecError(
+            f"DCT_MPMD_STAGES={raw!r}: every per-stage device count "
+            "must be >= 1"
+        )
+    if len(vals) < 2:
+        raise MpmdSpecError(
+            f"DCT_MPMD_STAGES={raw!r}: an MPMD pipeline needs >= 2 stages"
+        )
+    if n_devices is not None and sum(vals) > n_devices:
+        raise MpmdSpecError(
+            f"DCT_MPMD_STAGES={raw!r} asks for {sum(vals)} devices but "
+            f"only {n_devices} are available"
+        )
+    return tuple(vals)
+
+
+def spec_from_env_values(
+    stages: str, microbatches: int, schedule: str,
+    transfer_timeout_s: float, port_base: int,
+    *, n_devices: int | None = None,
+) -> MpmdSpec:
+    """Validate the raw MpmdConfig knob values into an :class:`MpmdSpec`
+    (all failures are loud :class:`MpmdSpecError`, at parse time)."""
+    counts = parse_stage_spec(stages, n_devices=n_devices)
+    sched = (schedule or "1f1b").strip().lower()
+    if sched not in SCHEDULES:
+        raise MpmdSpecError(
+            f"DCT_MPMD_SCHEDULE={schedule!r} not in {SCHEDULES}"
+        )
+    m = int(microbatches) if microbatches else 2 * len(counts)
+    if m < len(counts):
+        raise MpmdSpecError(
+            f"DCT_MPMD_MICROBATCHES={m} < {len(counts)} stages: the "
+            "pipeline would never reach steady state"
+        )
+    if transfer_timeout_s <= 0:
+        raise MpmdSpecError(
+            f"DCT_MPMD_TRANSFER_TIMEOUT_S={transfer_timeout_s} must be "
+            "> 0 (a zero timeout is an instant transfer failure)"
+        )
+    return MpmdSpec(
+        n_stages=len(counts), device_counts=counts, n_microbatches=m,
+        schedule=sched, transfer_timeout_s=float(transfer_timeout_s),
+        port_base=int(port_base),
+    )
+
+
+def carve_stage_meshes(counts, devices=None, *, model: int = 1):
+    """Partition the device pool into per-stage sub-meshes.
+
+    Each stage gets a ``jax.sharding.Mesh`` over its OWN contiguous
+    slice of ``devices`` with axes ``(data, model)`` — the PR 11 mesh
+    layer, one mesh per stage, disjoint by construction. ``model`` > 1
+    gives every stage a tensor-parallel axis (its per-stage partition
+    rules place the projection kernels over it)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if sum(counts) > len(devices):
+        raise MpmdSpecError(
+            f"stage device counts {tuple(counts)} need {sum(counts)} "
+            f"devices, have {len(devices)}"
+        )
+    from jax.sharding import Mesh
+
+    meshes, off = [], 0
+    for k, c in enumerate(counts):
+        if c % model:
+            raise MpmdSpecError(
+                f"stage {k}'s {c}-device slice does not tile the "
+                f"model={model} tensor-parallel axis"
+            )
+        grid = np.array(devices[off:off + c]).reshape(c // model, model)
+        meshes.append(Mesh(grid, ("data", "model")))
+        off += c
+    return meshes
+
+
+def slice_descriptor(counts) -> str:
+    """One label value for a stage map's slice topology (part of the
+    per-stage AOT identity: the same stage id on a different carve is a
+    different program)."""
+    return "x".join(str(int(c)) for c in counts)
+
+
+# ----------------------------------------------------------------------
+# Schedules. Ops are (kind, microbatch, phase); per-stage lists execute
+# strictly in order, blocking on the neighbor channels for inputs.
+
+
+@dataclasses.dataclass(frozen=True)
+class Op:
+    kind: str  # "fwd" | "bwd"
+    mb: int
+    phase: str  # "fill" | "steady" | "drain"
+
+
+def build_schedule(n_stages: int, n_microbatches: int, kind: str = "1f1b"):
+    """Per-stage op lists.
+
+    ``1f1b`` (PipeDream-flush): stage ``i`` warms up with
+    ``min(P-1-i, M)`` forwards (phase ``fill``), alternates
+    fwd/bwd in steady state (phase ``steady``), drains the remaining
+    backwards (phase ``drain``). In steady state every stage is
+    saturated — the bubble is confined to fill + drain, which is what
+    the per-phase spans make visible.
+
+    ``gpipe``: all M forwards then all M backwards per stage (the SPMD
+    comparator's order, runnable on the MPMD substrate for A/B); the
+    first ``P-1-i`` fwd slots are still the fill, the trailing
+    backwards past the last aligned one the drain.
+    """
+    p, m = int(n_stages), int(n_microbatches)
+    if kind not in SCHEDULES:
+        raise MpmdSpecError(f"unknown schedule {kind!r} (valid: {SCHEDULES})")
+    out = []
+    for i in range(p):
+        ops: list[Op] = []
+        if kind == "1f1b":
+            warm = min(p - 1 - i, m)
+            for j in range(warm):
+                ops.append(Op("fwd", j, "fill"))
+            for j in range(m - warm):
+                ops.append(Op("fwd", warm + j, "steady"))
+                ops.append(Op("bwd", j, "steady"))
+            for j in range(m - warm, m):
+                ops.append(Op("bwd", j, "drain"))
+        else:  # gpipe
+            warm = min(p - 1 - i, m)
+            for j in range(m):
+                ops.append(Op("fwd", j, "fill" if j < warm else "steady"))
+            drain_from = m - warm
+            for j in range(m):
+                ops.append(
+                    Op("bwd", j, "steady" if j < drain_from else "drain")
+                )
+        out.append(ops)
+    return out
+
+
+def analytic_bubble(n_stages: int, n_microbatches: int) -> float:
+    """The uniform-tick bubble fraction ``(P-1)/(M+P-1)`` BOTH
+    schedules obey over the whole step (GPipe's lockstep ramps and
+    1F1B's fill+drain cost the same wall; 1F1B's win is that its
+    STEADY-STATE window is bubble-free, and that stages are distinct
+    programs — see docs/PARALLELISM.md §MPMD for the measurement
+    contract)."""
+    p, m = int(n_stages), int(n_microbatches)
+    return (p - 1) / float(m + p - 1)
+
+
+def measured_bubble(t_small: float, t_large: float,
+                    m_small: int, m_large: int) -> float:
+    """Slope-method measured bubble at ``m_small`` microbatches.
+
+    Fit ``t(M) = a*M + c`` through two measured step walls; the bubble
+    at M is the wall fraction not explained by the marginal microbatch
+    cost: ``c / t(M) = 1 - a*M/t(M)``. Schedule-agnostic (works for the
+    SPMD lockstep program and the MPMD runner alike) and robust to how
+    the work is spread over devices — for an ideal pipeline it recovers
+    exactly ``(P-1)/(M+P-1)``."""
+    if m_large <= m_small or t_small <= 0:
+        raise ValueError("need m_large > m_small and t_small > 0")
+    slope = (t_large - t_small) / float(m_large - m_small)
+    return max(0.0, min(1.0, 1.0 - slope * m_small / t_small))
+
+
+# ----------------------------------------------------------------------
+# TrainState pivot: SPMD stacked-pytree <-> per-stage states.
+# The SPMD layout is the PP family's param tree:
+#   {"params": {"in_proj": ..., "pp_stages": <stacked, dim0 = stage>,
+#               "ln_out": ..., "head": ...}}
+# Stage k owns pp_stages[k] under the key "stage", stage 0 additionally
+# the embedding head ("in_proj"), the last stage the output head
+# ("ln_out", "head"). Optimizer-state param mirrors (Adam mu/nu, sgd
+# traces, ...) are discovered STRUCTURALLY — any opt_state node whose
+# treedef equals the params treedef splits/merges the same way — so the
+# pivot works for every optax chain the platform configures.
+
+STACKED_KEY = "pp_stages"
+STAGE_KEY = "stage"
+_FIRST_EXTRAS = ("in_proj",)
+_LAST_EXTRAS = ("ln_out", "head")
+
+
+def stage_layers(n_layers: int, n_stages: int) -> int:
+    """Layers per stage, or a loud refusal when the model cannot tile
+    the requested stage map (the untileable-stage contract)."""
+    if n_stages < 2:
+        raise MpmdSpecError(f"n_stages={n_stages}: MPMD needs >= 2 stages")
+    if n_layers % n_stages:
+        raise MpmdSpecError(
+            f"n_layers={n_layers} does not tile n_stages={n_stages} "
+            "homogeneous stages; adjust DCT_N_LAYERS or DCT_MPMD_STAGES"
+        )
+    return n_layers // n_stages
+
+
+def split_params(full_params: dict, k: int, n_stages: int) -> dict:
+    """The stage-``k`` slice of the SPMD param tree (pure indexing —
+    bitwise)."""
+    inner = full_params["params"]
+    if STACKED_KEY not in inner:
+        raise MpmdSpecError(
+            f"param tree has no '{STACKED_KEY}' stacked stage pytree — "
+            "MPMD requires the pipeline-parallel family "
+            "(weather_transformer_pp)"
+        )
+    stacked = inner[STACKED_KEY]
+    lead = int(jax.tree.leaves(stacked)[0].shape[0])
+    if lead != n_stages:
+        raise MpmdSpecError(
+            f"checkpoint holds {lead} stacked stages but the run "
+            f"configures {n_stages} — an untileable stage map; restore "
+            "with the saving stage count or retrain"
+        )
+    out = {STAGE_KEY: jax.tree.map(lambda a: a[k], stacked)}
+    if k == 0:
+        for key in _FIRST_EXTRAS:
+            out[key] = inner[key]
+    if k == n_stages - 1:
+        for key in _LAST_EXTRAS:
+            out[key] = inner[key]
+    return {"params": out}
+
+
+def merge_params(stage_params: list) -> dict:
+    """Per-stage param trees -> the SPMD stacked tree (inverse of
+    :func:`split_params`). Leaves are brought to HOST first — the
+    stages live on disjoint device slices, and the merge is a
+    checkpoint/pivot operation; stacking host copies of the original
+    slices is bitwise the original stack."""
+    def host(leaf):
+        return np.asarray(jax.device_get(leaf))
+
+    n = len(stage_params)
+    slices = [
+        jax.tree.map(host, p["params"][STAGE_KEY]) for p in stage_params
+    ]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *slices)
+    inner = {STACKED_KEY: stacked}
+    for key in _FIRST_EXTRAS:
+        inner[key] = jax.tree.map(host, stage_params[0]["params"][key])
+    for key in _LAST_EXTRAS:
+        inner[key] = jax.tree.map(host, stage_params[n - 1]["params"][key])
+    return {"params": inner}
+
+
+def _map_opt_mirrors(opt_state, params_def, fn):
+    """Rebuild ``opt_state`` with ``fn`` applied to every node whose
+    tree structure equals ``params_def`` (the param mirrors)."""
+    def rec(node):
+        try:
+            if jax.tree.structure(node) == params_def:
+                return fn(node)
+        except Exception:  # noqa: BLE001 — unhashable/odd nodes: descend
+            pass
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*[rec(c) for c in node])
+        if isinstance(node, tuple):
+            return tuple(rec(c) for c in node)
+        if isinstance(node, list):
+            return [rec(c) for c in node]
+        if isinstance(node, dict):
+            return {kk: rec(v) for kk, v in node.items()}
+        return node
+
+    return rec(opt_state)
+
+
+def _zip_opt_mirrors(opt_states, params_defs, fn):
+    """Walk N structurally-parallel opt_states; at every param-mirror
+    node call ``fn([node_0, ..., node_N-1])``. Used by the merge
+    direction (each stage's mirror has a DIFFERENT treedef — its own
+    params)."""
+    def rec(nodes):
+        head = nodes[0]
+        try:
+            if jax.tree.structure(head) == params_defs[0]:
+                for k, nd in enumerate(nodes):
+                    if jax.tree.structure(nd) != params_defs[k]:
+                        raise MpmdSpecError(
+                            f"stage {k}'s optimizer state does not "
+                            "mirror its params — mixed optimizer "
+                            "configs across stages"
+                        )
+                return fn(list(nodes))
+        except MpmdSpecError:
+            raise
+        except Exception:  # noqa: BLE001
+            pass
+        if isinstance(head, tuple) and hasattr(head, "_fields"):
+            return type(head)(
+                *[rec([n[i] for n in nodes]) for i in range(len(head))]
+            )
+        if isinstance(head, tuple):
+            return tuple(
+                rec([n[i] for n in nodes]) for i in range(len(head))
+            )
+        if isinstance(head, list):
+            return [rec([n[i] for n in nodes]) for i in range(len(head))]
+        if isinstance(head, dict):
+            return {kk: rec([n[kk] for n in nodes]) for kk in head}
+        return head
+
+    return rec(list(opt_states))
+
+
+def split_state(full_state, k: int, n_stages: int):
+    """SPMD TrainState -> stage ``k``'s TrainState (same tx; step/rng
+    shared; optimizer mirrors split structurally). Bitwise: every leaf
+    is an index or a pass-through."""
+    params_def = jax.tree.structure(full_state.params)
+    stage_params = split_params(full_state.params, k, n_stages)
+    opt = _map_opt_mirrors(
+        full_state.opt_state, params_def,
+        lambda mirror: split_params(mirror, k, n_stages),
+    )
+    return full_state.replace(params=stage_params, opt_state=opt)
+
+
+def merge_stage_states(stage_states: list, template=None):
+    """Per-stage TrainStates -> the SPMD TrainState (inverse pivot;
+    bitwise). ``template`` (a full-model TrainState) supplies tx /
+    apply_fn; defaults to stage 0's."""
+    params = merge_params([s.params for s in stage_states])
+    defs = [jax.tree.structure(s.params) for s in stage_states]
+    opt = _zip_opt_mirrors(
+        [s.opt_state for s in stage_states], defs, merge_params
+    )
+    base = template if template is not None else stage_states[0]
+    return base.replace(
+        step=stage_states[0].step, params=params, opt_state=opt,
+        rng=stage_states[0].rng,
+    )
+
+
+# ----------------------------------------------------------------------
+# Per-stage programs: fwd / bwd / update, jitted per stage, optionally
+# fronted by a per-stage AOT store. The backward recomputes the forward
+# from the stored stage input (vjp inside one program — full remat).
+
+
+def make_stage_programs(
+    k: int, n_stages: int, stage_fns: dict, *, store=None,
+):
+    """Compile stage ``k``'s program set from the model-level callables
+    (``first_fwd(p, x)``, ``mid_fwd(p, a)``, ``last_fwd(p, a, y, w) ->
+    (loss_sum, count)``, built by the trainer layer).
+
+    Returns ``{"fwd": ..., "bwd": ..., "update": ..., "eval": ...}``
+    where every entry is a jitted program (wrapped by the per-stage AOT
+    ``store`` when given, program keys ``mpmd_<name>_s<k>`` — stage id
+    and slice topology are already part of the store identity)."""
+    first, last = k == 0, k == n_stages - 1
+    if first:
+        fwd_fn = stage_fns["first_fwd"]
+    elif last:
+        fwd_fn = stage_fns["last_fwd"]
+    else:
+        fwd_fn = stage_fns["mid_fwd"]
+
+    if last:
+        def bwd(params, a_in, y, w, acc):
+            def loss_of(p, a):
+                return stage_fns["last_fwd"](p, a, y, w)[0]
+
+            _, vjp = jax.vjp(loss_of, params, a_in)
+            gp, ga = vjp(jnp.ones(()))
+            return jax.tree.map(jnp.add, acc, gp), ga
+    elif first:
+        def bwd(params, x, g, acc):
+            _, vjp = jax.vjp(fwd_fn, params, x)
+            gp, _gx = vjp(g)
+            return jax.tree.map(jnp.add, acc, gp)
+    else:
+        def bwd(params, a_in, g, acc):
+            _, vjp = jax.vjp(fwd_fn, params, a_in)
+            gp, ga = vjp(g)
+            return jax.tree.map(jnp.add, acc, gp), ga
+
+    def update(state, acc, total):
+        grads = jax.tree.map(lambda g: g / total, acc)
+        return state.apply_gradients(grads)
+
+    progs = {
+        "fwd": jax.jit(fwd_fn),
+        "bwd": jax.jit(bwd),
+        "update": jax.jit(update),
+    }
+    if last:
+        progs["eval"] = jax.jit(stage_fns["last_eval"])
+    if store is not None:
+        progs = {
+            name: store.wrap(fn, program=f"mpmd_{name}_s{k}")
+            for name, fn in progs.items()
+        }
+    return progs
+
+
+def zero_grads(params):
+    return jax.tree.map(jnp.zeros_like, params)
+
+
+# ----------------------------------------------------------------------
+# Stage execution: one stage's op list against neighbor channels. The
+# channel protocol is two methods — ``send(payload)`` and
+# ``recv(timeout) -> payload`` — implemented in-process by
+# :class:`QueueChannel` and cross-process by
+# :class:`dct_tpu.parallel.mpmd_transfer.SocketChannel`.
+
+
+class QueueChannel:
+    """In-process channel: a bounded queue of device arrays (the local
+    ``jax.device_put`` transfer happens on the SENDER, so the consumer's
+    wait is genuine transfer wait)."""
+
+    def __init__(self, maxsize: int = 0):
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+
+    def send(self, payload) -> None:
+        self._q.put(payload)
+
+    def recv(self, timeout: float):
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise MpmdTransferTimeout(
+                f"no payload within {timeout}s"
+            ) from None
+
+
+@dataclasses.dataclass
+class StageReport:
+    """One stage's accounting for one pipeline step."""
+
+    stage: int
+    busy_s: float = 0.0
+    transfer_wait_s: float = 0.0
+    send_s: float = 0.0
+    phase_busy: dict = dataclasses.field(
+        default_factory=lambda: {"fill": 0.0, "steady": 0.0, "drain": 0.0}
+    )
+    steady_window_s: float = 0.0
+    steady_busy_s: float = 0.0
+    window_s: float = 0.0
+
+
+class StageExecutor:
+    """Executes ONE stage's schedule for one optimizer step.
+
+    ``channels``: dict with (any of) ``act_in``/``act_out``/``grad_in``/
+    ``grad_out``; ``place_out``/``place_grad`` map an outgoing payload
+    into the neighbor's representation (device_put onto its sub-mesh
+    in-process; host numpy for the socket plane). Timing is measured
+    with ``block_until_ready`` after every program so the per-phase
+    busy/wait attribution is real device time, not dispatch time.
+    """
+
+    def __init__(
+        self, k: int, n_stages: int, programs: dict, *,
+        channels: dict, transfer_timeout_s: float = 120.0,
+        place_in=None, clock=time.perf_counter,
+    ):
+        self.k = k
+        self.n_stages = n_stages
+        self.programs = programs
+        self.channels = channels
+        self.timeout = transfer_timeout_s
+        self.place_in = place_in or (lambda x: x)
+        self.clock = clock
+
+    def _recv(self, name: str, rep: StageReport):
+        t0 = self.clock()
+        try:
+            payload = self.channels[name].recv(self.timeout)
+        except MpmdTransferTimeout as e:
+            raise MpmdTransferTimeout(
+                f"stage {self.k} waited > {self.timeout}s on {name} "
+                f"({e})"
+            ) from e
+        rep.transfer_wait_s += self.clock() - t0
+        return self.place_in(payload)
+
+    def _send(self, name: str, payload, rep: StageReport) -> None:
+        ch = self.channels.get(name)
+        if ch is None:
+            return
+        t0 = self.clock()
+        ch.send(payload)
+        rep.send_s += self.clock() - t0
+
+    def run_step(self, ops, state, microbatches, total) -> tuple:
+        """Run one optimizer step's op list.
+
+        ``microbatches``: for stage 0 a list of x microbatches; for the
+        last stage a list of (y, w) pairs; None for middle stages.
+        Returns (new_state, report, loss_sums) — loss_sums populated on
+        the last stage only."""
+        k, p = self.k, self.n_stages
+        first, last = k == 0, k == p - 1
+        rep = StageReport(stage=k)
+        acc = zero_grads(state.params)
+        saved: dict[int, object] = {}
+        loss_sums: list = []
+        t_start = None
+        steady_t0 = steady_t1 = None
+        for op in ops:
+            if op.kind == "fwd":
+                if first:
+                    a_in = microbatches[op.mb]
+                else:
+                    a_in = self._recv("act_in", rep)
+                t0 = self.clock()
+                if last:
+                    y, w = microbatches[op.mb]
+                    loss_sum, count = self.programs["fwd"](
+                        state.params, a_in, y, w
+                    )
+                    jax.block_until_ready(loss_sum)
+                    out = None
+                    loss_sums.append((loss_sum, count))
+                else:
+                    out = self.programs["fwd"](state.params, a_in)
+                    jax.block_until_ready(out)
+                t1 = self.clock()
+                saved[op.mb] = a_in
+                if out is not None:
+                    self._send("act_out", out, rep)
+            else:  # bwd
+                a_in = saved.pop(op.mb)
+                if last:
+                    y, w = microbatches[op.mb]
+                    t0 = self.clock()
+                    acc, g_in = self.programs["bwd"](
+                        state.params, a_in, y, w, acc
+                    )
+                else:
+                    g = self._recv("grad_in", rep)
+                    t0 = self.clock()
+                    if first:
+                        acc = self.programs["bwd"](
+                            state.params, a_in, g, acc
+                        )
+                        g_in = None
+                    else:
+                        acc, g_in = self.programs["bwd"](
+                            state.params, a_in, g, acc
+                        )
+                jax.block_until_ready(jax.tree.leaves(acc)[0])
+                t1 = self.clock()
+                if g_in is not None and not first:
+                    self._send("grad_out", g_in, rep)
+            if t_start is None:
+                t_start = t0
+            rep.busy_s += t1 - t0
+            rep.phase_busy[op.phase] += t1 - t0
+            if op.phase == "steady":
+                steady_t0 = t0 if steady_t0 is None else steady_t0
+                steady_t1 = t1
+        t0 = self.clock()
+        state = self.programs["update"](state, acc, total)
+        jax.block_until_ready(state.step)
+        t1 = self.clock()
+        rep.busy_s += t1 - t0
+        rep.window_s = t1 - (t_start if t_start is not None else t0)
+        if steady_t0 is not None:
+            rep.steady_window_s = steady_t1 - steady_t0
+            rep.steady_busy_s = rep.phase_busy["steady"]
+        return state, rep, loss_sums
+
+    def run_eval(self, state, microbatches):
+        """Forward-only microbatch pipeline for validation: stage 0
+        feeds x microbatches, the last stage returns the 6 eval sums
+        per microbatch; middle stages just relay."""
+        k, p = self.k, self.n_stages
+        first, last = k == 0, k == p - 1
+        rep = StageReport(stage=k)
+        sums = None
+        n = len(microbatches) if microbatches is not None else None
+        if n is None:
+            # Middle stages learn the count from the stream: the
+            # runner passes the microbatch count explicitly instead.
+            raise ValueError("middle stages need an explicit count")
+        for mb in range(n):
+            if first:
+                a_in = microbatches[mb]
+            else:
+                a_in = self._recv("act_in", rep)
+            if last:
+                y, w = microbatches[mb]
+                out = self.programs["eval"](state.params, a_in, y, w)
+                jax.block_until_ready(out[0])
+                sums = (
+                    out if sums is None
+                    else tuple(a + b for a, b in zip(sums, out))
+                )
+            else:
+                out = self.programs["fwd"](state.params, a_in)
+                jax.block_until_ready(out)
+                self._send("act_out", out, rep)
+        return sums, rep
+
+
+# ----------------------------------------------------------------------
+# The in-process runner: one controller THREAD per stage (the
+# multi-controller structure, single-process form) — stages genuinely
+# overlap on their disjoint device slices, and the per-stage reports
+# carry real fill/steady/drain/transfer-wait windows.
+
+
+class MpmdRunner:
+    def __init__(
+        self, spec: MpmdSpec, stage_states: list, programs: list,
+        meshes: list, *, clock=time.perf_counter,
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.spec = spec
+        self.states = list(stage_states)
+        self.programs = programs
+        self.meshes = meshes
+        self.clock = clock
+        self.ops = build_schedule(
+            spec.n_stages, spec.n_microbatches, spec.schedule
+        )
+        self._act_shardings = [
+            NamedSharding(m, P()) for m in meshes
+        ]
+        self.last_reports: list[StageReport] = []
+
+    def _executors(self):
+        p = self.spec.n_stages
+        act_ch = [QueueChannel() for _ in range(p - 1)]
+        grad_ch = [QueueChannel() for _ in range(p - 1)]
+        execs = []
+        for k in range(p):
+            sh = self._act_shardings
+            channels = {}
+            if k > 0:
+                channels["act_in"] = act_ch[k - 1]
+                # The SENDER places the payload onto the consumer's
+                # sub-mesh (the local device_put transfer); wrap send.
+                channels["grad_out"] = _PlacingChannel(
+                    grad_ch[k - 1], sh[k - 1]
+                )
+            if k < p - 1:
+                channels["act_out"] = _PlacingChannel(
+                    act_ch[k], sh[k + 1]
+                )
+                channels["grad_in"] = grad_ch[k]
+            execs.append(
+                StageExecutor(
+                    k, p, self.programs[k], channels=channels,
+                    transfer_timeout_s=self.spec.transfer_timeout_s,
+                    clock=self.clock,
+                )
+            )
+        return execs
+
+    def _split_mb(self, arr):
+        m = self.spec.n_microbatches
+        b = arr.shape[0]
+        if b % m:
+            raise MpmdSpecError(
+                f"batch {b} does not tile n_microbatches={m}"
+            )
+        return [
+            jnp.asarray(arr[i * (b // m):(i + 1) * (b // m)])
+            for i in range(m)
+        ]
+
+    def train_step(self, x, y, w):
+        """One optimizer step over the whole batch: returns
+        (mean_loss, wall_s); per-stage reports in ``last_reports``."""
+        xs = self._split_mb(np.asarray(x, np.float32))
+        ys = self._split_mb(np.asarray(y))
+        ws = self._split_mb(np.asarray(w, np.float32))
+        positions = 1
+        for d in np.asarray(y).shape[1:]:
+            positions *= d
+        total = max(
+            float(np.asarray(w, np.float32).sum()) * positions, 1.0
+        )
+        execs = self._executors()
+        p = self.spec.n_stages
+        results: list = [None] * p
+        errors: list = []
+
+        def run(k):
+            try:
+                mbs = None
+                if k == 0:
+                    mbs = [
+                        jax.device_put(a, self._act_shardings[0])
+                        for a in xs
+                    ]
+                elif k == p - 1:
+                    mbs = [
+                        (
+                            jax.device_put(ys[i], self._act_shardings[k]),
+                            jax.device_put(ws[i], self._act_shardings[k]),
+                        )
+                        for i in range(len(ys))
+                    ]
+                results[k] = execs[k].run_step(
+                    self.ops[k], self.states[k], mbs,
+                    jnp.asarray(total, jnp.float32),
+                )
+            except BaseException as e:  # noqa: BLE001 — joined below
+                errors.append((k, e))
+
+        t0 = self.clock()
+        threads = [
+            threading.Thread(target=run, args=(k,), daemon=True)
+            for k in range(p)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.spec.transfer_timeout_s * 4)
+        wall = self.clock() - t0
+        if errors:
+            k, e = errors[0]
+            raise RuntimeError(f"MPMD stage {k} failed: {e}") from e
+        stuck = [k for k, t in enumerate(threads) if t.is_alive()]
+        if stuck:
+            raise MpmdTransferTimeout(
+                f"stage thread(s) {stuck} still running past "
+                f"{self.spec.transfer_timeout_s * 4}s — a wedged "
+                "inter-stage dependency"
+            )
+        self.last_reports = [results[k][1] for k in range(p)]
+        for k in range(p):
+            self.states[k] = results[k][0]
+        loss_sums = results[p - 1][2]
+        loss = float(
+            sum(float(np.asarray(s)) for s, _c in loss_sums) / total
+        )
+        return loss, wall
+
+    def eval_pass(self, x, y, w):
+        """Validation sums over one batch (forward-only pipeline):
+        (loss_sum, acc_sum, count, tp, fp, fn) as floats."""
+        xs = self._split_mb(np.asarray(x, np.float32))
+        ys = self._split_mb(np.asarray(y))
+        ws = self._split_mb(np.asarray(w, np.float32))
+        execs = self._executors()
+        p = self.spec.n_stages
+        results: list = [None] * p
+        errors: list = []
+
+        def run(k):
+            try:
+                if k == 0:
+                    mbs = [
+                        jax.device_put(a, self._act_shardings[0])
+                        for a in xs
+                    ]
+                elif k == p - 1:
+                    mbs = [
+                        (
+                            jax.device_put(ys[i], self._act_shardings[k]),
+                            jax.device_put(ws[i], self._act_shardings[k]),
+                        )
+                        for i in range(len(ys))
+                    ]
+                else:
+                    mbs = [None] * len(xs)
+                results[k] = execs[k].run_eval(self.states[k], mbs)
+            except BaseException as e:  # noqa: BLE001
+                errors.append((k, e))
+
+        threads = [
+            threading.Thread(target=run, args=(k,), daemon=True)
+            for k in range(p)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.spec.transfer_timeout_s * 4)
+        if errors:
+            k, e = errors[0]
+            raise RuntimeError(f"MPMD eval stage {k} failed: {e}") from e
+        stuck = [k for k, t in enumerate(threads) if t.is_alive()]
+        if stuck:
+            raise MpmdTransferTimeout(
+                f"eval stage thread(s) {stuck} still running past "
+                f"{self.spec.transfer_timeout_s * 4}s"
+            )
+        sums = results[p - 1][0]
+        return tuple(float(np.asarray(s)) for s in sums)
+
+    def step_bubble(self, wall_s: float) -> dict:
+        """Bubble accounting from the last step's per-stage reports:
+        whole-step bubble, steady-state bubble, and per-stage phase
+        attribution (the ``mpmd.step_report`` payload)."""
+        p = self.spec.n_stages
+        reps = self.last_reports
+        busy = sum(r.busy_s for r in reps)
+        step_bubble = 1.0 - busy / (p * wall_s) if wall_s > 0 else 0.0
+        utils = [
+            r.steady_busy_s / r.steady_window_s
+            for r in reps
+            if r.steady_window_s > 0
+        ]
+        steady_bubble = 1.0 - (sum(utils) / len(utils)) if utils else 0.0
+        return {
+            "schedule": self.spec.schedule,
+            "n_stages": p,
+            "n_microbatches": self.spec.n_microbatches,
+            "wall_s": round(wall_s, 6),
+            "step_bubble": round(max(0.0, step_bubble), 6),
+            "steady_bubble": round(max(0.0, steady_bubble), 6),
+            "analytic_bubble": round(
+                analytic_bubble(p, self.spec.n_microbatches), 6
+            ),
+            "stages": [
+                {
+                    "stage": r.stage,
+                    "busy_s": round(r.busy_s, 6),
+                    "transfer_wait_s": round(r.transfer_wait_s, 6),
+                    "send_s": round(r.send_s, 6),
+                    "fill_s": round(r.phase_busy["fill"], 6),
+                    "steady_s": round(r.phase_busy["steady"], 6),
+                    "drain_s": round(r.phase_busy["drain"], 6),
+                }
+                for r in reps
+            ],
+        }
+
+
+class _PlacingChannel:
+    """Send-side wrapper: place the payload onto the consumer's
+    sub-mesh before enqueueing (the explicit inter-slice transfer)."""
+
+    def __init__(self, inner, sharding):
+        self._inner = inner
+        self._sharding = sharding
+
+    def send(self, payload) -> None:
+        self._inner.send(jax.device_put(payload, self._sharding))
+
+    def recv(self, timeout: float):
+        return self._inner.recv(timeout)

@@ -1,0 +1,209 @@
+"""MPMD inter-stage transfer plane: explicit cross-process send/recv.
+
+Inside one process, stage slices exchange activations/gradients by
+``jax.device_put`` between disjoint device sets
+(:class:`dct_tpu.parallel.mpmd.QueueChannel` + the runner's placing
+wrapper). Across PROCESSES — the multi-controller deployment, one
+process per stage — there is no shared jax world to route through
+(deliberately: an MPMD stage must never join a global SPMD collective),
+so the transfer plane is an explicit framed-array protocol over TCP:
+
+    frame := MAGIC(4) | header_len(u32 be) | header json | raw bytes
+    header := {"dtype": str, "shape": [..], "tag": str}
+
+On a real pod the same wire carries host-staged DCN transfers between
+hosts of different slices (the MPMD paper's transfer layer); on the CPU
+rig it is the loopback. Timeouts are LOUD
+(:class:`dct_tpu.parallel.mpmd.MpmdTransferTimeout` naming the link),
+never a silent hang: a dead neighbor stage must surface within
+``DCT_MPMD_TRANSFER_TIMEOUT_S`` so the supervised launcher's exit-code
+classifier can heal the world.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import time
+
+import numpy as np
+
+from dct_tpu.parallel.mpmd import MpmdTransferTimeout
+
+_MAGIC = b"DCTX"
+
+
+def _send_all(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(data)
+
+
+def _recv_exact(sock: socket.socket, n: int, timeout: float) -> bytes:
+    deadline = time.monotonic() + timeout
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            raise MpmdTransferTimeout(
+                f"socket recv starved: {remaining}/{n} bytes outstanding"
+            )
+        sock.settimeout(min(left, 5.0))
+        try:
+            chunk = sock.recv(min(remaining, 1 << 20))
+        except socket.timeout:
+            continue
+        if not chunk:
+            raise MpmdTransferTimeout(
+                "peer closed the transfer link mid-frame"
+            )
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def send_array(sock: socket.socket, arr: np.ndarray, tag: str = "") -> None:
+    arr = np.ascontiguousarray(arr)
+    header = json.dumps(
+        {"dtype": str(arr.dtype), "shape": list(arr.shape), "tag": tag}
+    ).encode()
+    _send_all(
+        sock,
+        _MAGIC + struct.pack(">I", len(header)) + header + arr.tobytes(),
+    )
+
+
+def recv_array(sock: socket.socket, timeout: float) -> np.ndarray:
+    magic = _recv_exact(sock, 4, timeout)
+    if magic != _MAGIC:
+        raise MpmdTransferTimeout(
+            f"bad frame magic {magic!r} on the transfer link "
+            "(foreign traffic or a torn stream)"
+        )
+    (hlen,) = struct.unpack(">I", _recv_exact(sock, 4, timeout))
+    header = json.loads(_recv_exact(sock, hlen, timeout).decode())
+    dtype = np.dtype(header["dtype"])
+    shape = tuple(int(s) for s in header["shape"])
+    n = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+    raw = _recv_exact(sock, n, timeout)
+    return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+
+
+class SocketChannel:
+    """One directed inter-stage link carrying framed host arrays.
+
+    Satisfies the :class:`dct_tpu.parallel.mpmd.StageExecutor` channel
+    protocol (``send`` / ``recv``); payloads cross as dense numpy — the
+    executor's ``place_in`` re-places them onto the stage's sub-mesh.
+    """
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        try:
+            self._sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+        except OSError:
+            pass  # non-TCP transports (AF_UNIX test rigs) have no Nagle
+
+    def send(self, payload) -> None:
+        # recv_array leaves a short poll timeout installed on the
+        # shared socket; restore blocking mode so a large frame's
+        # sendall never spuriously times out mid-write (a torn frame
+        # would corrupt the peer's stream). A genuinely dead peer
+        # surfaces through ITS recv timeout / the launcher's stall
+        # monitor; any send-side failure is still loud here.
+        try:
+            self._sock.settimeout(None)
+            send_array(self._sock, np.asarray(payload))
+        except OSError as e:
+            raise MpmdTransferTimeout(
+                f"send on the transfer link failed: {e}"
+            ) from e
+
+    def recv(self, timeout: float):
+        return recv_array(self._sock, timeout)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect_stage_links(
+    stage: int, n_stages: int, *, port_base: int,
+    host: str = "127.0.0.1", timeout: float = 120.0,
+) -> dict:
+    """Establish stage ``stage``'s neighbor links.
+
+    Topology: each neighbor pair shares ONE TCP connection, opened by
+    the lower-numbered stage toward the higher one's listener on
+    ``port_base + k+1``, then used bidirectionally — activations flow
+    down the socket, gradients flow back up it (the two directions are
+    independent TCP byte streams, and each stage drives its side
+    single-threaded, so frames never interleave). Stage k's links:
+
+    - ``up``: to stage k+1 (send activations, recv gradients) — k
+      connects as the client;
+    - ``down``: from stage k-1 (recv activations, send gradients) — k
+      accepts as the server on ``port_base + k``.
+
+    Returns ``{"act_out"/"grad_in": SocketChannel, "act_in"/"grad_out":
+    SocketChannel}`` entries as applicable. Loud
+    :class:`MpmdTransferTimeout` when a neighbor never shows up.
+    """
+    links: dict = {}
+    server = None
+    if stage > 0:
+        server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind((host, port_base + stage))
+        server.listen(1)
+        server.settimeout(timeout)
+    # Listener up BEFORE dialing upward, so the ring establishes in any
+    # start order.
+    if stage < n_stages - 1:
+        deadline = time.monotonic() + timeout
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                up = socket.create_connection(
+                    (host, port_base + stage + 1), timeout=2.0
+                )
+                break
+            except OSError as e:
+                last_err = e
+                time.sleep(0.2)
+        else:
+            raise MpmdTransferTimeout(
+                f"stage {stage} could not reach stage {stage + 1} on "
+                f"port {port_base + stage + 1} within {timeout}s "
+                f"({last_err})"
+            )
+        ch = SocketChannel(up)
+        links["act_out"] = ch
+        links["grad_in"] = ch
+    if server is not None:
+        try:
+            conn, _addr = server.accept()
+        except socket.timeout:
+            server.close()
+            raise MpmdTransferTimeout(
+                f"stage {stage} never heard from stage {stage - 1} on "
+                f"port {port_base + stage} within {timeout}s"
+            ) from None
+        server.close()
+        ch = SocketChannel(conn)
+        links["act_in"] = ch
+        links["grad_out"] = ch
+    return links
+
+
+def close_links(links: dict) -> None:
+    seen = set()
+    for ch in links.values():
+        if id(ch) in seen:
+            continue
+        seen.add(id(ch))
+        ch.close()

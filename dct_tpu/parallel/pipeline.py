@@ -92,6 +92,63 @@ def _pipeline_body(params, xs, *, stage_fn, axis: str, n_stages: int):
     return ys
 
 
+def gpipe_tick_apply(
+    stage_fn,
+    stacked_params,
+    x,
+    *,
+    n_microbatches: int | None = None,
+):
+    """GPipe microbatch streaming WITHOUT shard_map: the tick loop as a
+    plain vmapped scan under GSPMD.
+
+    Semantically identical to :func:`pipeline_apply` (same ``M + P - 1``
+    tick schedule, same bubble ``(P-1)/(M+P-1)``), but the stage axis is
+    an ordinary array dimension: every tick vmaps ``stage_fn`` over the
+    stacked stage dim and rotates activations with ``jnp.roll`` — when
+    the stacked params/activations are sharded ``P('pipe', ...)`` the
+    partitioner turns the vmap into per-shard stage compute and the roll
+    into the neighbor collective-permute, with no shard_map involved.
+    This is the pipeline path on jax 0.4.x rigs where partial-manual
+    shard_map cannot lower (shard_map_compat.PARTIAL_AUTO_SHARD_MAP is
+    False), and the SPMD-GPipe comparator for the MPMD bubble bench
+    (bench.py ``mpmd_pipeline``); the tick structure — and therefore the
+    measured bubble — is the same either way.
+
+    Differentiable: ``jax.grad`` through the scan+roll yields the
+    reverse tick schedule, exactly as with ppermute.
+    """
+    first = jax.tree.leaves(stacked_params)[0]
+    n_stages = first.shape[0]
+    b = x.shape[0]
+    m = n_microbatches or n_stages
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by n_microbatches {m}")
+    xs = x.reshape(m, b // m, *x.shape[1:])
+    ticks = m + n_stages - 1
+
+    def tick(carry, t):
+        act, ys = carry
+        mb = lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
+        )
+        # Stage 0 ingests microbatch t; other stages keep their carry.
+        inp = act.at[0].set(mb)
+        out = jax.vmap(stage_fn)(stacked_params, inp)
+        done_idx = t - (n_stages - 1)
+        banked = lax.dynamic_update_index_in_dim(
+            ys, out[n_stages - 1], jnp.clip(done_idx, 0, m - 1), axis=0
+        )
+        ys = jnp.where(done_idx >= 0, banked, ys)
+        act = jnp.roll(out, 1, axis=0)
+        return (act, ys), None
+
+    act0 = jnp.zeros((n_stages, b // m, *x.shape[1:]), x.dtype)
+    ys0 = jnp.zeros_like(xs)
+    (_, ys), _ = lax.scan(tick, (act0, ys0), jnp.arange(ticks))
+    return ys.reshape(b, *x.shape[1:])
+
+
 def pipeline_apply(
     stage_fn,
     stacked_params,

@@ -1,0 +1,708 @@
+"""MPMD pipeline-parallel continuous trainer (ROADMAP item 3 / ISSUE 13).
+
+The platform side of :mod:`dct_tpu.parallel.mpmd`: train the registry's
+pipeline-parallel family (``weather_transformer_pp``) as P DISTINCT
+compiled programs on disjoint device slices, wired through the same
+continuous-training machinery the SPMD trainer uses —
+
+- **data**: the identical window/split/BatchLoader pipeline as
+  ``Trainer.fit`` (same seed, same batch order), so the per-step
+  semantics pin against the SPMD pipeline oracle;
+- **goodput/spans**: step walls bill the shared
+  :class:`~dct_tpu.observability.goodput.GoodputLedger` categories
+  (first dispatch = compile, as everywhere); every epoch emits one
+  ``mpmd.step_report`` event and an ``mpmd.epoch`` span carrying the
+  per-stage fill/steady/drain/transfer-wait attribution, so the run
+  inspector can show exactly where the bubble went;
+- **checkpoint**: each stage owns a PR 11 resume tier
+  (``<models>/train_state_mpmd/stage<k>/p0`` — per-leaf layout.json
+  manifests included) under one ``manifest.json`` naming the stage map;
+  :func:`adopt_mpmd_checkpoint` re-maps those per-stage files into the
+  SPMD trainer's stacked layout (bitwise — pure data movement) and the
+  MPMD trainer pivots the other way from a plain SPMD ``train_state``
+  (``mpmd.pivot`` events both directions; an untileable stage map is a
+  loud refusal);
+- **AOT**: every stage program keys into the PR 9 executable store with
+  the stage id + slice topology joined to the identity — a warm
+  relaunch deserializes EVERY stage's programs cache=hit.
+
+Constraints enforced loudly (documented in docs/PARALLELISM.md §MPMD):
+the family must be ``weather_transformer_pp`` with ``dropout == 0``
+(stage programs are deterministic; the PP family already keeps dropout
+outside the pipelined region), the lr schedule ``constant``, and
+``grad_clip_norm == 0`` (global-norm clipping couples stages across
+slices — a cross-slice reduction the transfer plane does not carry).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dct_tpu.config import RunConfig
+from dct_tpu.observability import events as _events
+from dct_tpu.observability import spans as _spans
+from dct_tpu.observability.goodput import (
+    GoodputLedger,
+    config_hash as _config_hash,
+    mesh_descriptor as _mesh_descriptor,
+)
+from dct_tpu.parallel import mpmd
+from dct_tpu.parallel.sharding_rules import rules_digest, rules_for_family
+
+MPMD_FAMILY = "weather_transformer_pp"
+MPMD_STATE_DIRNAME = "train_state_mpmd"
+
+
+# ----------------------------------------------------------------------
+# Stage functions: the PP family decomposed into per-stage callables.
+# Values come from the FULL registry model's init (split afterwards), so
+# the decomposition is bitwise the oracle's parameterization.
+
+
+def build_stage_fns(model_cfg, input_dim: int, *, compute_dtype=None):
+    """Model-level stage callables for :func:`mpmd.make_stage_programs`.
+
+    ``first_fwd`` = in_proj + positions + first stage's blocks;
+    ``mid_fwd`` = blocks; ``last_fwd`` = blocks + ln_out + pooled head +
+    masked-CE (loss_sum, count); ``last_eval`` = the 6 eval sums. Same
+    modules, same names, same math as ``WeatherTransformerPP`` minus
+    dropout (MPMD mode requires rate 0 — enforced by the trainer)."""
+    from flax import linen as nn
+
+    from dct_tpu.models.mlp import TorchStyleDense
+    from dct_tpu.models.transformer import _StageBlocks, sincos_positions
+    from dct_tpu.ops.attention import make_attention_fn
+    from dct_tpu.ops.losses import (
+        masked_accuracy,
+        masked_binary_counts,
+        masked_cross_entropy,
+    )
+
+    ct = compute_dtype or jnp.float32
+    n_stages = int(model_cfg.n_stages)
+    layers_per = mpmd.stage_layers(model_cfg.n_layers, n_stages)
+    stage_mod = _StageBlocks(
+        model_cfg.d_model, model_cfg.n_heads, model_cfg.d_ff, layers_per,
+        make_attention_fn(None), dtype=ct, remat=model_cfg.remat,
+        n_kv_heads=model_cfg.n_kv_heads or None,
+        rope=model_cfg.pos_embed == "rope",
+    )
+    in_mod = TorchStyleDense(model_cfg.d_model, dtype=ct)
+    ln_mod = nn.LayerNorm(dtype=ct)
+    head_mod = TorchStyleDense(model_cfg.num_classes, dtype=ct)
+    pos = (
+        sincos_positions(model_cfg.seq_len, model_cfg.d_model)
+        if model_cfg.pos_embed != "rope"
+        else None
+    )
+
+    def first_fwd(p, x):
+        h = jnp.asarray(x, ct)
+        h = in_mod.apply({"params": p["params"]["in_proj"]}, h)
+        if pos is not None:
+            h = h + jnp.asarray(pos, ct)
+        return stage_mod.apply({"params": p["params"]["stage"]}, h)
+
+    def mid_fwd(p, a):
+        return stage_mod.apply({"params": p["params"]["stage"]}, a)
+
+    def _logits(p, a):
+        h = stage_mod.apply({"params": p["params"]["stage"]}, a)
+        h = ln_mod.apply({"params": p["params"]["ln_out"]}, h)
+        pooled = h.mean(axis=1)
+        logits = head_mod.apply({"params": p["params"]["head"]}, pooled)
+        return jnp.asarray(logits, jnp.float32)
+
+    def last_fwd(p, a, y, w):
+        return masked_cross_entropy(_logits(p, a), y, w)
+
+    def last_eval(p, a, y, w):
+        logits = _logits(p, a)
+        loss_sum, count = masked_cross_entropy(logits, y, w)
+        acc_sum, _ = masked_accuracy(logits, y, w)
+        tp, fp, fn = masked_binary_counts(logits, y, w)
+        return loss_sum, acc_sum, count, tp, fp, fn
+
+    return {
+        "first_fwd": first_fwd,
+        "mid_fwd": mid_fwd,
+        "last_fwd": last_fwd,
+        "last_eval": last_eval,
+    }
+
+
+def shard_stage_state(state, mesh, family: str = MPMD_FAMILY):
+    """Place one stage's TrainState on its sub-mesh under the family's
+    partition rules (per-stage tensor parallelism when the slice has a
+    ``model`` axis; leaves whose dims do not tile the axis replicate)."""
+    import re
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rules = rules_for_family(family)
+
+    def one(path, leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 0:
+            return NamedSharding(mesh, P())
+        from dct_tpu.parallel.sharding_rules import path_str
+
+        name = path_str(path)
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                dims = tuple(spec)
+                ok = len(dims) <= ndim
+                if ok:
+                    for d, ax in enumerate(dims):
+                        if ax is None:
+                            continue
+                        size = dict(mesh.shape).get(str(ax), 1)
+                        if size > 1 and leaf.shape[d] % size:
+                            ok = False
+                            break
+                if ok:
+                    return NamedSharding(mesh, spec)
+                return NamedSharding(mesh, P())
+        return NamedSharding(mesh, P())
+
+    shardings = jax.tree_util.tree_map_with_path(one, state)
+    return jax.device_put(state, shardings)
+
+
+# ----------------------------------------------------------------------
+# Checkpoint layout + cross-topology pivots.
+
+
+def mpmd_state_root(models_dir: str) -> str:
+    return os.path.join(models_dir, MPMD_STATE_DIRNAME)
+
+
+def _manifest_path(models_dir: str) -> str:
+    return os.path.join(mpmd_state_root(models_dir), "manifest.json")
+
+
+def read_manifest(models_dir: str) -> dict:
+    try:
+        with open(_manifest_path(models_dir)) as f:
+            return dict(json.load(f))
+    except (OSError, ValueError):
+        return {}
+
+
+def write_manifest(models_dir: str, manifest: dict) -> None:  # dct: noqa[rank0-io] — stage-0-gated by BOTH callers (MpmdTrainer is single-process; mpmd_worker writes only from stage 0), and the pid-suffixed tmp + os.replace publish is tear-proof under concurrent writers anyway
+    root = mpmd_state_root(models_dir)
+    os.makedirs(root, exist_ok=True)
+    final = _manifest_path(models_dir)
+    tmp = f"{final}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, final)
+
+
+def stage_checkpointer(models_dir: str, k: int):
+    from dct_tpu.checkpoint.manager import TrainStateCheckpointer
+
+    return TrainStateCheckpointer(
+        os.path.join(mpmd_state_root(models_dir), f"stage{k}", "p0")
+    )
+
+
+def mpmd_checkpoint_present(models_dir: str) -> bool:
+    return bool(read_manifest(models_dir))
+
+
+def adopt_mpmd_checkpoint(models_dir: str, template_state) -> dict:
+    """Re-map an MPMD per-stage checkpoint set into the SPMD trainer's
+    stacked layout (the MPMD -> SPMD pivot): restore every stage into
+    the template's stage slices, merge (bitwise — pure stacking), and
+    publish a normal ``train_state/p<rank>`` rotation the PR 11 restore
+    path reads like any other. Returns the meta written. Loud refusal
+    when the template cannot tile the saved stage count."""
+    from dct_tpu.checkpoint.manager import TrainStateCheckpointer
+
+    manifest = read_manifest(models_dir)
+    if not manifest:
+        raise FileNotFoundError(
+            f"no MPMD manifest under {mpmd_state_root(models_dir)}"
+        )
+    n_stages = int(manifest["n_stages"])
+    stage_states = []
+    for k in range(n_stages):
+        tmpl_k = mpmd.split_state(template_state, k, n_stages)
+        ckptr = stage_checkpointer(models_dir, k)
+        if not ckptr.exists():
+            raise FileNotFoundError(
+                f"MPMD manifest names {n_stages} stages but stage {k} "
+                f"has no checkpoint under {ckptr.dirpath}"
+            )
+        stage_states.append(ckptr.restore(tmpl_k))
+    merged = mpmd.merge_stage_states(stage_states, template=template_state)
+    meta = dict(stage_checkpointer(models_dir, 0).load_meta())
+    meta.pop("stage", None)
+    spmd_ckptr = TrainStateCheckpointer(
+        os.path.join(
+            models_dir, "train_state", f"p{jax.process_index()}"
+        )
+    )
+    spmd_ckptr.save(merged, meta)
+    _events.get_default().emit(
+        "mpmd", "mpmd.pivot", direction="mpmd_to_spmd",
+        n_stages=n_stages,
+        epochs_completed=meta.get("epochs_completed"),
+    )
+    return meta
+
+
+def _opt_identity(train_cfg) -> dict:
+    from dct_tpu.train.trainer import optimizer_identity
+
+    return optimizer_identity(train_cfg)
+
+
+def _check_opt_identity(saved_meta: dict, train_cfg, where: str) -> None:
+    """The Trainer's exact-compare resume refusal, applied to the MPMD
+    paths: opt_state trees of different optimizer configs can be
+    structurally isomorphic, so a restore must refuse BEFORE training
+    from mismatched moments."""
+    saved_opt = saved_meta.get("optimizer")
+    want = _opt_identity(train_cfg)
+    if saved_opt is not None and saved_opt != want:
+        raise RuntimeError(
+            f"Resume refused: {where} was written by optimizer "
+            f"{saved_opt} but this run configures {want}. Restore the "
+            "original DCT_OPTIMIZER / DCT_MOMENTUM / DCT_WEIGHT_DECAY, "
+            "or clear the checkpoint dir to restart the trajectory."
+        )
+
+
+def _restore_from_spmd(models_dir: str, full_template):
+    """The SPMD -> MPMD pivot source: a plain ``train_state/p<rank>``
+    rotation restored into the full-model template (host values),
+    ready to split per stage."""
+    from dct_tpu.checkpoint.manager import TrainStateCheckpointer
+
+    ckptr = TrainStateCheckpointer(
+        os.path.join(models_dir, "train_state", f"p{jax.process_index()}")
+    )
+    if not ckptr.exists():
+        return None, {}
+    return ckptr.restore(full_template), ckptr.load_meta()
+
+
+# ----------------------------------------------------------------------
+# The trainer.
+
+
+@dataclasses.dataclass
+class MpmdResult:
+    train_losses: list
+    val_losses: list
+    epochs_completed: int
+    goodput: dict
+    bubble: dict
+    cache_states: dict
+
+
+def _validate_cfg(cfg: RunConfig) -> None:
+    if cfg.model.name != MPMD_FAMILY:
+        raise mpmd.MpmdSpecError(
+            f"MPMD mode trains the pipeline-parallel family only "
+            f"(DCT_MODEL={cfg.model.name!r}; expected {MPMD_FAMILY!r})"
+        )
+    if cfg.model.dropout != 0.0:
+        raise mpmd.MpmdSpecError(
+            f"MPMD stage programs are deterministic: set DCT_DROPOUT=0 "
+            f"(got {cfg.model.dropout}) — the PP family already applies "
+            "dropout outside the pipelined region"
+        )
+    if cfg.train.grad_clip_norm > 0:
+        raise mpmd.MpmdSpecError(
+            "DCT_GRAD_CLIP_NORM > 0 needs a cross-stage global-norm "
+            "reduction the MPMD transfer plane does not carry; disable "
+            "clipping for MPMD mode"
+        )
+    if cfg.train.lr_schedule != "constant" or cfg.train.warmup_steps:
+        raise mpmd.MpmdSpecError(
+            "MPMD mode supports the constant lr schedule only "
+            f"(DCT_LR_SCHEDULE={cfg.train.lr_schedule!r})"
+        )
+
+
+def build_full_state(cfg: RunConfig, input_dim: int, *, compute_dtype=None):
+    """The ORACLE's TrainState: the full registry PP model, initialized
+    exactly as ``Trainer.fit`` would — the MPMD stage states are slices
+    of this, so the decomposition is bitwise the oracle's."""
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.train.state import create_train_state
+
+    ct = compute_dtype or (
+        jnp.bfloat16 if cfg.train.bf16_compute else jnp.float32
+    )
+    model = get_model(cfg.model, input_dim=input_dim, compute_dtype=ct)
+    return create_train_state(
+        model, input_dim=input_dim, lr=cfg.train.lr, seed=cfg.train.seed,
+        example_shape=(1, cfg.model.seq_len, input_dim),
+        weight_decay=cfg.train.weight_decay,
+        optimizer=cfg.train.optimizer, momentum=cfg.train.momentum,
+    )
+
+
+def stage_store(cfg: RunConfig, spec, k: int, mesh, input_dim: int):
+    """Stage ``k``'s PR 9 AOT store: the stage id and the slice
+    topology JOIN the compile identity — the same stage on a different
+    carve (or schedule, or layout) is a different program and must
+    miss; a warm relaunch of the same shape deserializes cache=hit."""
+    from dct_tpu import compilecache as _cc
+
+    root = (
+        os.environ.get("DCT_COMPILE_CACHE_AOT_DIR")
+        or os.path.join(cfg.data.models_dir, "aot")
+    )
+    return _cc.store_from_env(
+        root,
+        family=cfg.model.name,
+        config_hash=_config_hash(dataclasses.asdict(cfg.model)),
+        mesh=_mesh_descriptor(mesh),
+        extra={
+            "mpmd_stage": k,
+            "mpmd_slice": mpmd.slice_descriptor(spec.device_counts),
+            "mpmd_schedule": spec.schedule,
+            "mpmd_microbatches": spec.n_microbatches,
+            "optimizer": cfg.train.optimizer,
+            "lr": cfg.train.lr,
+            "weight_decay": cfg.train.weight_decay,
+            "bf16": cfg.train.bf16_compute,
+            "shard_rules": rules_digest(cfg.model.name),
+            "input_dim": input_dim,
+        },
+        emit=_events.get_default().emit,
+    )
+
+
+def build_loaders(cfg: RunConfig, spec, data=None):
+    """The SAME window/split/loader construction as ``Trainer.fit``'s
+    sequence-family path (same seed, same order — the oracle pin and
+    every per-stage worker process depend on identical batch streams).
+    In MPMD mode ``DCT_BATCH_SIZE`` is the GLOBAL batch and must tile
+    the microbatch count (loud refusal otherwise)."""
+    from dct_tpu.data.dataset import load_processed_dataset
+    from dct_tpu.data.pipeline import BatchLoader, contiguous_split
+    from dct_tpu.data.windows import make_windows
+
+    if data is None:
+        data = load_processed_dataset(
+            cfg.data.processed_dir,
+            feature_suffix=cfg.data.feature_suffix,
+            label_column=cfg.data.label_column,
+        )
+    data = make_windows(data, cfg.model.seq_len)
+    train_idx, val_idx = contiguous_split(
+        len(data), val_fraction=cfg.data.val_fraction,
+        gap=cfg.model.seq_len,
+    )
+    global_batch = cfg.train.batch_size
+    if global_batch % spec.n_microbatches:
+        raise mpmd.MpmdSpecError(
+            f"DCT_BATCH_SIZE={global_batch} (the global batch in MPMD "
+            f"mode) does not tile DCT_MPMD_MICROBATCHES="
+            f"{spec.n_microbatches}"
+        )
+    train_loader = BatchLoader(
+        data, train_idx, global_batch=global_batch, shuffle=True,
+        seed=cfg.train.seed,
+    )
+    val_loader = BatchLoader(
+        data, val_idx, global_batch=global_batch, shuffle=False,
+        seed=cfg.train.seed,
+    )
+    return data, train_loader, val_loader
+
+
+class MpmdTrainer:
+    """Multi-controller MPMD trainer, in-process form: one controller
+    thread per stage, disjoint device slices, explicit transfers
+    (:class:`dct_tpu.parallel.mpmd.MpmdRunner`). The per-stage-process
+    form lives in :mod:`dct_tpu.train.mpmd_worker` and shares the
+    schedule/executor/checkpoint layout byte for byte."""
+
+    def __init__(self, cfg: RunConfig | None = None):
+        self.cfg = cfg or RunConfig.from_env()
+
+    # -- data (mirrors Trainer.fit's sequence-family path exactly) ----
+    def _loaders(self, data=None):
+        return build_loaders(self.cfg, self._spec, data)
+
+    def _publish_metrics(self, bubble: dict) -> None:
+        """Final metrics-plane snapshot (when ``DCT_METRICS_DIR`` arms
+        the plane): the last step's bubble fractions + per-stage phase
+        seconds under a ``stage`` label — the /metrics side of "where
+        did the bubble go"."""
+        cfg = self.cfg
+        if not (cfg.obs.enabled and cfg.obs.metrics_dir) or not bubble:
+            return
+        from dct_tpu.observability.aggregate import SnapshotPublisher
+        from dct_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        bubble_g = reg.gauge(
+            "dct_mpmd_bubble_fraction",
+            "MPMD pipeline bubble fraction of the last step, by "
+            "window (steady = the 1F1B saturated window; step = whole "
+            "step incl. fill/drain).", agg="last",
+        )
+        bubble_g.set(bubble["steady_bubble"], {"window": "steady"})
+        bubble_g.set(bubble["step_bubble"], {"window": "step"})
+        phase_g = reg.gauge(
+            "dct_mpmd_stage_phase_seconds",
+            "Per-stage busy seconds of the last MPMD step, by phase "
+            "(fill/steady/drain) plus transfer_wait.", agg="last",
+        )
+        for st in bubble.get("stages", []):
+            labels = {"stage": str(st["stage"])}
+            for phase in ("fill", "steady", "drain"):
+                phase_g.set(
+                    st[f"{phase}_s"], {**labels, "phase": phase}
+                )
+            phase_g.set(
+                st["transfer_wait_s"],
+                {**labels, "phase": "transfer_wait"},
+            )
+        pub = SnapshotPublisher(
+            reg, cfg.obs.metrics_dir, proc=f"mpmd-{os.getpid()}",
+            interval_s=cfg.obs.metrics_publish_s, start_timer=False,
+        )
+        pub.close(final=True)
+
+    def _stage_stores(self, spec, input_dim: int):
+        return [
+            stage_store(self.cfg, spec, k, self._meshes[k], input_dim)
+            for k in range(spec.n_stages)
+        ]
+
+    def fit(self, data=None) -> MpmdResult:
+        cfg = self.cfg
+        _validate_cfg(cfg)
+        # Config-built sinks, installed as the process defaults (the
+        # Trainer's pattern): the checkpoint tiers and AOT store stamp
+        # the same run-correlation ID, and a stale default from an
+        # earlier run in this process never shadows cfg.obs.
+        events = _events.event_log_from_config(cfg.obs)
+        tracer = _spans.recorder_from_config(cfg.obs)
+        spec = cfg.mpmd.to_spec(n_devices=jax.device_count())
+        self._spec = spec
+        self._meshes = mpmd.carve_stage_meshes(
+            spec.device_counts,
+            model=max(1, cfg.mesh.model),
+        )
+        ledger = GoodputLedger()
+        ledger.start()
+        t_setup = ledger.clock()
+        data, train_loader, val_loader = self._loaders(data)
+        input_dim = data.input_dim
+        ct = jnp.bfloat16 if cfg.train.bf16_compute else jnp.float32
+        full_state = build_full_state(cfg, input_dim, compute_dtype=ct)
+
+        # Resume: per-stage checkpoints first; a plain SPMD train_state
+        # pivots in (mpmd.pivot); else a fresh split of the oracle init.
+        start_epoch = 0
+        target_epochs = cfg.train.epochs
+        stage_ckptrs = [
+            stage_checkpointer(cfg.data.models_dir, k)
+            for k in range(spec.n_stages)
+        ]
+        manifest = read_manifest(cfg.data.models_dir)
+        stage_states = None
+        if cfg.train.resume and manifest:
+            if int(manifest.get("n_stages", spec.n_stages)) != spec.n_stages:
+                raise mpmd.MpmdSpecError(
+                    f"checkpoint manifest holds "
+                    f"{manifest.get('n_stages')} stages but the run "
+                    f"configures {spec.n_stages} — an untileable stage "
+                    "map; restore the saving DCT_MPMD_STAGES or clear "
+                    f"{mpmd_state_root(cfg.data.models_dir)}"
+                )
+            # A manifest with missing stage files is a TORN set: refuse
+            # loudly (the adoption path does) — a silent fresh start
+            # would overwrite the surviving stages' real progress.
+            missing = [
+                k for k, c in enumerate(stage_ckptrs) if not c.exists()
+            ]
+            if missing:
+                raise FileNotFoundError(
+                    f"MPMD manifest names {spec.n_stages} stages but "
+                    f"stage(s) {missing} have no checkpoint under "
+                    f"{mpmd_state_root(cfg.data.models_dir)} — a torn "
+                    "checkpoint set; restore the files or clear the "
+                    "dir to restart the trajectory"
+                )
+            saved = stage_ckptrs[0].load_meta()
+            _check_opt_identity(
+                saved, cfg.train,
+                f"the MPMD checkpoint set under "
+                f"{mpmd_state_root(cfg.data.models_dir)}",
+            )
+            stage_states = [
+                stage_ckptrs[k].restore(
+                    mpmd.split_state(full_state, k, spec.n_stages)
+                )
+                for k in range(spec.n_stages)
+            ]
+            start_epoch = int(saved.get("epochs_completed", 0))
+            saved_target = int(saved.get("target_epochs", cfg.train.epochs))
+            target_epochs = (
+                start_epoch + cfg.train.epochs
+                if start_epoch >= saved_target else saved_target
+            )
+        elif cfg.train.resume:
+            restored, meta = _restore_from_spmd(
+                cfg.data.models_dir, full_state
+            )
+            if restored is not None:
+                _check_opt_identity(
+                    meta, cfg.train, "the SPMD train_state checkpoint"
+                )
+                stage_states = [
+                    mpmd.split_state(restored, k, spec.n_stages)
+                    for k in range(spec.n_stages)
+                ]
+                start_epoch = int(meta.get("epochs_completed", 0))
+                saved_target = int(
+                    meta.get("target_epochs", cfg.train.epochs)
+                )
+                target_epochs = (
+                    start_epoch + cfg.train.epochs
+                    if start_epoch >= saved_target else saved_target
+                )
+                events.emit(
+                    "mpmd", "mpmd.pivot", direction="spmd_to_mpmd",
+                    n_stages=spec.n_stages, epochs_completed=start_epoch,
+                )
+        if stage_states is None:
+            stage_states = [
+                mpmd.split_state(full_state, k, spec.n_stages)
+                for k in range(spec.n_stages)
+            ]
+        stage_states = [
+            shard_stage_state(s, self._meshes[k], cfg.model.name)
+            for k, s in enumerate(stage_states)
+        ]
+
+        stores = self._stage_stores(spec, input_dim)
+        stage_fns = build_stage_fns(
+            cfg.model, input_dim, compute_dtype=ct
+        )
+        programs = [
+            mpmd.make_stage_programs(
+                k, spec.n_stages, stage_fns, store=stores[k]
+            )
+            for k in range(spec.n_stages)
+        ]
+        runner = mpmd.MpmdRunner(
+            spec, stage_states, programs, self._meshes
+        )
+        ledger.add("startup_recovery", ledger.clock() - t_setup)
+
+        train_losses: list[float] = []
+        val_losses: list[float] = []
+        bubble: dict = {}
+        fit_span = tracer.open(
+            "mpmd.fit", component="mpmd", n_stages=spec.n_stages,
+            schedule=spec.schedule,
+        )
+        try:
+            for epoch in range(start_epoch, target_epochs):
+                ep_span = tracer.start(
+                    "mpmd.epoch", component="mpmd", epoch=epoch,
+                    parent_id=fit_span.span_id,
+                )
+                losses = []
+                last_wall = 0.0
+                for batch in train_loader.epoch(epoch):
+                    with ledger.dispatch("train_step", key="mpmd_step"):
+                        loss, last_wall = runner.train_step(
+                            batch.x, batch.y, batch.weight
+                        )
+                    losses.append(loss)
+                with ledger.span("eval"):
+                    sums = np.zeros(6, np.float64)
+                    for batch in val_loader.epoch(epoch):
+                        sums += np.asarray(
+                            runner.eval_pass(
+                                batch.x, batch.y, batch.weight
+                            ),
+                            np.float64,
+                        )
+                val_loss = float(sums[0] / max(sums[2], 1.0))
+                train_losses.append(float(np.mean(losses)))
+                val_losses.append(val_loss)
+                bubble = runner.step_bubble(last_wall)
+                events.emit(
+                    "mpmd", "mpmd.step_report", epoch=epoch, **bubble
+                )
+                agg = {
+                    f: round(
+                        sum(s[f] for s in bubble["stages"]), 6
+                    )
+                    for f in (
+                        "busy_s", "transfer_wait_s", "fill_s",
+                        "steady_s", "drain_s",
+                    )
+                }
+                with ledger.span("checkpoint"):
+                    meta = {
+                        "epochs_completed": epoch + 1,
+                        "target_epochs": target_epochs,
+                        "family": cfg.model.name,
+                        "val_loss": val_loss,
+                        # The Trainer's cross-optimizer refusal key:
+                        # carried through the pivots so an SPMD resume
+                        # of this trajectory refuses a config change.
+                        "optimizer": _opt_identity(cfg.train),
+                    }
+                    for k in range(spec.n_stages):
+                        stage_ckptrs[k].save(
+                            runner.states[k], dict(meta, stage=k)
+                        )
+                    write_manifest(cfg.data.models_dir, {
+                        "version": 1,
+                        "n_stages": spec.n_stages,
+                        "device_counts": list(spec.device_counts),
+                        "schedule": spec.schedule,
+                        "n_microbatches": spec.n_microbatches,
+                        "family": cfg.model.name,
+                        "n_layers": cfg.model.n_layers,
+                        "shard_rules": rules_digest(cfg.model.name),
+                        "epochs_completed": epoch + 1,
+                    })
+                ep_span.end(
+                    train_loss=train_losses[-1], val_loss=val_loss,
+                    steady_bubble=bubble.get("steady_bubble"),
+                    step_bubble=bubble.get("step_bubble"), **agg,
+                )
+        finally:
+            fit_span.end(epochs=len(train_losses))
+        events.emit(
+            "mpmd", "mpmd.fit_end",
+            epochs_completed=target_epochs,
+            steady_bubble=bubble.get("steady_bubble"),
+            step_bubble=bubble.get("step_bubble"),
+        )
+        self._publish_metrics(bubble)
+        cache_states: dict = {}
+        for st in stores:
+            cache_states.update(st.states)
+        return MpmdResult(
+            train_losses=train_losses,
+            val_losses=val_losses,
+            epochs_completed=target_epochs,
+            goodput=ledger.summary(),
+            bubble=bubble,
+            cache_states=cache_states,
+        )

@@ -1,0 +1,353 @@
+"""``python -m dct_tpu.train.mpmd_worker``: one MPMD stage, one process.
+
+The multi-controller deployment of the MPMD trainer: the supervised
+launcher (``python -m dct_tpu.resilience.supervise --world-size P``)
+babysits P of these — each process owns ONE stage's device slice (its
+own single-process jax world; stages never join a global SPMD
+collective), builds ONLY its stage's programs, and exchanges
+activations/gradients with its neighbors over the explicit transfer
+plane (:mod:`dct_tpu.parallel.mpmd_transfer`). The stage index comes
+from ``DCT_MPMD_STAGE_ID`` (or the launcher's ``NODE_RANK``), so the
+launch block needs no MPMD-specific plumbing — heartbeats, stall-kill,
+the PR 3 exit-code classifier, and relaunch-with-resume all apply
+unchanged:
+
+- SIGTERM: the PR 3 PreemptionGuard semantics — finish the in-flight
+  step, save the stage's resume checkpoint, exit ``EXIT_PREEMPTED``
+  (75): the whole world classifies "preempted" and relaunches resumed;
+- a crashed stage: fail-fast world teardown; the relaunch restores
+  every stage from its own checkpoint tier AND deserializes every
+  stage's programs from the PR 9 AOT store (warm relaunch = per-stage
+  ``cache=hit``);
+- a wedged neighbor: the transfer plane's loud timeout
+  (``DCT_MPMD_TRANSFER_TIMEOUT_S``) turns a silent hang into an exit
+  the classifier can heal.
+
+Every stage process builds the identical loader stream (same seed,
+same order — stage 0 consumes the features, the last stage the
+labels/weights), so microbatches line up across processes with no data
+plane beyond the activation wire.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _bootstrap_devices() -> int:
+    """Pin this process's XLA device count to its stage's slice BEFORE
+    jax initializes a backend (CPU rigs: one virtual device per slice
+    seat). Returns the stage index. Deliberately jax-free: it must run
+    before any jax import touches XLA_FLAGS."""
+    stage = int(
+        os.environ.get("DCT_MPMD_STAGE_ID")
+        or os.environ.get("NODE_RANK")
+        or "0"
+    )
+    raw = (os.environ.get("DCT_MPMD_STAGES") or "2").strip()
+    toks = [t.strip() for t in raw.split(",") if t.strip()]
+    counts = None
+    if all(t.lstrip("-").isdigit() for t in toks):
+        vals = [int(t) for t in toks]
+        counts = vals if len(vals) > 1 else [1] * max(vals[0], 2)
+    n = counts[stage] if counts and 0 <= stage < len(counts) else 1
+    # Only the EXPLICIT CPU rig gets virtual devices; an unset
+    # JAX_PLATFORMS means accelerator auto-detect (the TPU path) and
+    # must stay untouched — pinning cpu here would silently train every
+    # stage on the host.
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+    return stage
+
+
+def main() -> int:
+    stage = _bootstrap_devices()
+    # The worker is its OWN jax world: neutralize the launcher's SPMD
+    # rendezvous env so nothing tries to join a global collective.
+    n_stages_env = int(
+        os.environ.get("WORLD_SIZE")
+        or os.environ.get("DCT_NUM_PROCESSES")
+        or "0"
+    )
+    for k in ("DCT_NUM_PROCESSES", "DCT_PROCESS_ID", "WORLD_SIZE"):
+        os.environ.pop(k, None)
+    os.environ["DCT_MPMD_STAGE_ID"] = str(stage)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dct_tpu.config import RunConfig
+    from dct_tpu.observability import events as _events
+    from dct_tpu.observability.heartbeat import HeartbeatWriter
+    from dct_tpu.parallel import mpmd
+    from dct_tpu.parallel import mpmd_transfer
+    from dct_tpu.resilience.preempt import PreemptionGuard
+    from dct_tpu.resilience.supervisor import EXIT_PREEMPTED
+    from dct_tpu.train import mpmd_trainer as mt
+    from dct_tpu.compilecache import enable_from_env
+
+    cfg = RunConfig.from_env()
+    mt._validate_cfg(cfg)
+    if "," not in (cfg.mpmd.stages or "").strip():
+        # A bare stage count splits "the pool" evenly — but each
+        # worker process is its OWN jax world and cannot see the pod's
+        # device total, so the carve would silently differ from the
+        # in-process trainer's. Multi-process mode requires explicit
+        # per-stage counts (deterministic across processes).
+        raise mpmd.MpmdSpecError(
+            f"DCT_MPMD_STAGES={cfg.mpmd.stages!r}: multi-process MPMD "
+            "needs EXPLICIT per-stage device counts (e.g. '1,1'), not "
+            "a bare stage count — each stage process sizes its own "
+            "device world from its entry"
+        )
+    spec = cfg.mpmd.to_spec()
+    if n_stages_env and n_stages_env != spec.n_stages:
+        raise mpmd.MpmdSpecError(
+            f"launcher world size {n_stages_env} != "
+            f"{spec.n_stages} stages in DCT_MPMD_STAGES"
+        )
+    if not (0 <= stage < spec.n_stages):
+        raise mpmd.MpmdSpecError(
+            f"stage id {stage} out of range for {spec.n_stages} stages"
+        )
+    enable_from_env()
+    events = _events.get_default()
+    hb = HeartbeatWriter(
+        cfg.obs.heartbeat_dir, stage, run_id=cfg.obs.run_id,
+        min_interval=cfg.obs.heartbeat_interval,
+    )
+    guard = PreemptionGuard().install()
+
+    mesh = mpmd.carve_stage_meshes(
+        [spec.device_counts[stage]],
+        devices=jax.devices()[: spec.device_counts[stage]],
+        model=max(1, cfg.mesh.model),
+    )[0]
+    placement = NamedSharding(mesh, P())
+    ct = jnp.bfloat16 if cfg.train.bf16_compute else jnp.float32
+
+    data, train_loader, val_loader = mt.build_loaders(cfg, spec)
+    input_dim = data.input_dim
+    full_state = mt.build_full_state(cfg, input_dim, compute_dtype=ct)
+    tmpl = mpmd.split_state(full_state, stage, spec.n_stages)
+
+    ckptr = mt.stage_checkpointer(cfg.data.models_dir, stage)
+    start_epoch = 0
+    target_epochs = cfg.train.epochs
+    state = tmpl
+
+    def _continue_target(meta: dict) -> tuple:
+        """The Trainer's continuation semantics, shared by every
+        resume path: an interrupted run finishes to its saved target;
+        a completed one extends by this run's budget."""
+        start = int(meta.get("epochs_completed", 0))
+        saved_target = int(meta.get("target_epochs", cfg.train.epochs))
+        return start, (
+            start + cfg.train.epochs
+            if start >= saved_target else saved_target
+        )
+
+    if cfg.train.resume:
+        # Cross-stage agreement BEFORE resolving this stage's path
+        # (the SPMD trainer's start-epoch allgather refusal,
+        # file-based): a teardown between two stages' saves — or a
+        # stage missing its files entirely while peers/the manifest
+        # show progress — is a TORN set; resuming it would pair one
+        # epoch's features with another's labels. Loud.
+        epochs_seen = {}
+        for k in range(spec.n_stages):
+            peer = mt.stage_checkpointer(cfg.data.models_dir, k)
+            if peer.exists():
+                epochs_seen[k] = int(
+                    peer.load_meta().get("epochs_completed", 0)
+                )
+        manifest = mt.read_manifest(cfg.data.models_dir)
+        torn = len(set(epochs_seen.values())) > 1 or (
+            stage not in epochs_seen and (epochs_seen or manifest)
+        )
+        if torn:
+            raise RuntimeError(
+                f"Resume divergence: stage {stage} sees per-stage "
+                f"epochs_completed {epochs_seen} (manifest: "
+                f"{manifest.get('epochs_completed')}) — a teardown "
+                "tore the stage checkpoint set. Clear "
+                f"{mt.mpmd_state_root(cfg.data.models_dir)} or restore "
+                "matching generations on every stage."
+            )
+        if ckptr.exists():
+            saved = ckptr.load_meta()
+            mt._check_opt_identity(
+                saved, cfg.train, f"stage {stage}'s MPMD checkpoint"
+            )
+            state = ckptr.restore(tmpl)
+            start_epoch, target_epochs = _continue_target(saved)
+        else:
+            restored, meta = mt._restore_from_spmd(
+                cfg.data.models_dir, full_state
+            )
+            if restored is not None:
+                mt._check_opt_identity(
+                    meta, cfg.train, "the SPMD train_state checkpoint"
+                )
+                state = mpmd.split_state(restored, stage, spec.n_stages)
+                start_epoch, target_epochs = _continue_target(meta)
+                events.emit(
+                    "mpmd", "mpmd.pivot", direction="spmd_to_mpmd",
+                    n_stages=spec.n_stages, stage=stage,
+                    epochs_completed=start_epoch,
+                )
+    state = mt.shard_stage_state(state, mesh, cfg.model.name)
+
+    store = mt.stage_store(cfg, spec, stage, mesh, input_dim)
+    stage_fns = mt.build_stage_fns(cfg.model, input_dim, compute_dtype=ct)
+    programs = mpmd.make_stage_programs(
+        stage, spec.n_stages, stage_fns, store=store
+    )
+
+    events.emit(
+        "mpmd", "mpmd.stage_start", stage=stage,
+        n_stages=spec.n_stages, devices=spec.device_counts[stage],
+        schedule=spec.schedule,
+    )
+    hb.beat(epoch=start_epoch, phase="startup", force=True)
+    links = mpmd_transfer.connect_stage_links(
+        stage, spec.n_stages, port_base=spec.port_base,
+        timeout=spec.transfer_timeout_s,
+    )
+    executor = mpmd.StageExecutor(
+        stage, spec.n_stages, programs, channels=links,
+        transfer_timeout_s=spec.transfer_timeout_s,
+        place_in=lambda a: jax.device_put(jnp.asarray(a), placement),
+    )
+    ops = mpmd.build_schedule(
+        spec.n_stages, spec.n_microbatches, spec.schedule
+    )[stage]
+    first, last = stage == 0, stage == spec.n_stages - 1
+
+    def _microbatches(batch):
+        m = spec.n_microbatches
+        b = batch.x.shape[0]
+        mb = b // m
+        if first:
+            return [
+                jax.device_put(
+                    jnp.asarray(batch.x[i * mb:(i + 1) * mb], jnp.float32),
+                    placement,
+                )
+                for i in range(m)
+            ]
+        if last:
+            return [
+                (
+                    jax.device_put(
+                        jnp.asarray(batch.y[i * mb:(i + 1) * mb]),
+                        placement,
+                    ),
+                    jax.device_put(
+                        jnp.asarray(
+                            batch.weight[i * mb:(i + 1) * mb], jnp.float32
+                        ),
+                        placement,
+                    ),
+                )
+                for i in range(m)
+            ]
+        return [None] * m
+
+    def _save(epoch_done: int) -> None:
+        ckptr.save(state, {
+            "epochs_completed": epoch_done,
+            "target_epochs": target_epochs,
+            "family": cfg.model.name,
+            "stage": stage,
+            "optimizer": mt._opt_identity(cfg.train),
+        })
+        if stage == 0:
+            mt.write_manifest(cfg.data.models_dir, {
+                "version": 1,
+                "n_stages": spec.n_stages,
+                "device_counts": list(spec.device_counts),
+                "schedule": spec.schedule,
+                "n_microbatches": spec.n_microbatches,
+                "family": cfg.model.name,
+                "n_layers": cfg.model.n_layers,
+                "epochs_completed": epoch_done,
+            })
+
+    rc = 0
+    try:
+        for epoch in range(start_epoch, target_epochs):
+            losses = []
+            for step_i, batch in enumerate(train_loader.epoch(epoch)):
+                # The SAME loss normalizer as MpmdRunner.train_step:
+                # weight sum x supervised positions per row (1 for the
+                # PP family's pooled head; kept in lockstep so the two
+                # deployment modes stay bitwise-identical).
+                positions = 1
+                for d in np.asarray(batch.y).shape[1:]:
+                    positions *= d
+                total = max(
+                    float(np.asarray(batch.weight, np.float32).sum())
+                    * positions,
+                    1.0,
+                )
+                state, rep, loss_sums = executor.run_step(
+                    ops, state, _microbatches(batch),
+                    jnp.asarray(total, jnp.float32),
+                )
+                if last and loss_sums:
+                    losses.append(
+                        sum(float(np.asarray(s)) for s, _ in loss_sums)
+                        / total
+                    )
+                hb.beat(step=step_i, epoch=epoch, phase="train")
+            if last:
+                events.emit(
+                    "mpmd", "mpmd.step_report", epoch=epoch,
+                    schedule=spec.schedule, n_stages=spec.n_stages,
+                    n_microbatches=spec.n_microbatches,
+                    stages=[{
+                        "stage": stage,
+                        "busy_s": round(rep.busy_s, 6),
+                        "transfer_wait_s": round(rep.transfer_wait_s, 6),
+                        "fill_s": round(rep.phase_busy["fill"], 6),
+                        "steady_s": round(rep.phase_busy["steady"], 6),
+                        "drain_s": round(rep.phase_busy["drain"], 6),
+                    }],
+                    train_loss=(
+                        float(np.mean(losses)) if losses else None
+                    ),
+                )
+            _save(epoch + 1)
+            hb.beat(epoch=epoch + 1, phase="checkpoint", force=True)
+            if guard.requested:
+                events.emit(
+                    "mpmd", "mpmd.stage_done", stage=stage,
+                    preempted=True, epochs_completed=epoch + 1,
+                )
+                return EXIT_PREEMPTED
+        events.emit(
+            "mpmd", "mpmd.stage_done", stage=stage, preempted=False,
+            epochs_completed=target_epochs,
+        )
+    except mpmd.MpmdTransferTimeout as e:
+        events.emit(
+            "mpmd", "mpmd.transfer_timeout", stage=stage, error=str(e),
+        )
+        print(f"[mpmd_worker s{stage}] {e}", file=sys.stderr, flush=True)
+        rc = 1
+    finally:
+        mpmd_transfer.close_links(links)
+        hb.beat(phase="exit", force=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

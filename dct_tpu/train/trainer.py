@@ -471,6 +471,21 @@ class Trainer:
         start_epoch = 0
         target_epochs = cfg.train.epochs
         opt_identity = optimizer_identity(cfg.train)
+        if cfg.train.resume and not state_ckptr.exists():
+            # Cross-topology pivot: an MPMD session's per-stage
+            # checkpoints (train_state_mpmd/stage<k>/, ISSUE 13) re-map
+            # into the stacked SPMD layout — bitwise, pure data movement
+            # — and this run resumes the same trajectory. An untileable
+            # stage map (manifest stages != this model's n_stages)
+            # refuses loudly inside the adoption.
+            from dct_tpu.train import mpmd_trainer as _mpmd_tr
+
+            _manifest = _mpmd_tr.read_manifest(cfg.data.models_dir)
+            # Family-gated: a manifest left by a PP session must not
+            # crash an unrelated family's resume in the same models_dir
+            # (that run trains fresh, exactly as before the hook).
+            if _manifest and _manifest.get("family") == cfg.model.name:
+                _mpmd_tr.adopt_mpmd_checkpoint(cfg.data.models_dir, state)
         if cfg.train.resume and state_ckptr.exists():
             saved = state_ckptr.load_meta()
             saved_opt = saved.get("optimizer")

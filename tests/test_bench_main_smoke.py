@@ -35,6 +35,10 @@ def test_bench_main_cpu_record_carries_everything(
     # tests/test_scheduler.py and the scheduler CI smoke; the bench
     # smoke pins the null-marker wiring.
     monkeypatch.setenv("DCT_BENCH_TENANTS", "0")
+    # And mpmd_pipeline: the MPMD machinery runs for real in
+    # tests/test_mpmd.py and the mpmd-pipeline CI smoke; the bench
+    # smoke pins the null-marker wiring.
+    monkeypatch.setenv("DCT_BENCH_MPMD", "0")
     monkeypatch.setenv(
         "DCT_BENCH_PARTIAL", str(tmp_path / "BENCH_PARTIAL.json")
     )
@@ -118,6 +122,7 @@ def test_bench_main_cpu_record_carries_everything(
     assert record["restart_spinup"] is None
     assert record["cycle_freshness"] is None
     assert record["multi_tenant"] is None
+    assert record["mpmd_pipeline"] is None
     with open(tmp_path / "BENCH_PARTIAL.json") as f:
         partial = json.load(f)
     assert partial["trainer_gap"]["fused"] == partial["value"]
