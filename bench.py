@@ -298,6 +298,86 @@ def _time_scanned_step(epoch_step, state, stacks, *, scan_len: int,
     return (time.perf_counter() - t0) / (n * scan_len)
 
 
+def bench_roofline() -> dict:
+    """Locally-computed cost-model MFU (ISSUE 14): the headline MFU the
+    record can never lose to a dead relay.
+
+    A small transformer train-scan is compiled ON THE LOCAL BACKEND,
+    its analytic FLOPs/bytes read from XLA's own cost model
+    (``compiled.cost_analysis()``), its steady step time measured, and
+    MFU = flops / seconds / peak computed against the device table's
+    peak — or, when the device kind is unknown (the CPU fallback rig),
+    against a measured dense-GEMM peak, so the number is ALWAYS a real
+    local measurement, never null and never carried forward. The scaled
+    stanza keeps its on-chip relay MFU (and its stale-stamping); this
+    leg is the sentinel's `program_mfu` series."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dct_tpu.config import ModelConfig
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.observability import roofline as _rf
+    from dct_tpu.train.state import create_train_state
+    from dct_tpu.train.steps import make_epoch_train_step
+
+    shape = dict(
+        d_model=128, n_heads=4, n_layers=2, d_ff=256, seq_len=64,
+    )
+    batch, scan_len, input_dim = 8, 4, 5
+    cfg = ModelConfig(name="weather_transformer", **shape)
+    model = get_model(
+        cfg, input_dim=input_dim, compute_dtype=jnp.float32
+    )
+    state = create_train_state(
+        model, input_dim=input_dim, lr=1e-3, seed=0,
+        example_shape=(1, shape["seq_len"], input_dim),
+    )
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.standard_normal(
+        (scan_len, batch, shape["seq_len"], input_dim)
+    ).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 2, (scan_len, batch)), jnp.int32)
+    ws = jnp.ones((scan_len, batch), jnp.float32)
+
+    epoch_step = make_epoch_train_step(donate=False)
+    compiled = epoch_step.lower(state, xs, ys, ws).compile()
+    cost = _rf.analyze_compiled(compiled) or {}
+    st, losses = compiled(state, xs, ys, ws)
+    jax.block_until_ready(losses)
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st, losses = compiled(state, xs, ys, ws)
+        jax.block_until_ready(losses)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+
+    peak, peak_source = _rf.resolve_peak_flops()
+    hbm = _rf.chip_hbm_bytes_per_sec()
+    flops = cost.get("flops")
+    ba = cost.get("bytes_accessed")
+    out = {
+        "config": {**shape, "batch": batch, "scan_len": scan_len},
+        "step_time_ms": round(best / scan_len * 1e3, 3),
+        "flops_per_dispatch": flops,
+        "peak_source": peak_source,
+    }
+    if peak:
+        out["peak_tflops"] = round(peak / 1e12, 3)
+    if cost.get("hbm_peak_bytes") is not None:
+        out["hbm_peak_bytes"] = cost["hbm_peak_bytes"]
+    if flops and ba:
+        intensity = flops / ba
+        out["arithmetic_intensity"] = round(intensity, 2)
+        out["bound"] = _rf.classify(
+            intensity, (peak / hbm) if peak and hbm else None
+        )
+    if flops and peak and best:
+        out["mfu"] = round(flops / best / peak, 6)
+    return out
+
+
 def bench_scaled_transformer() -> dict:
     """MXU-relevant transformer: step time, MFU, flash vs blockwise.
 
@@ -1422,6 +1502,14 @@ def bench_mpmd_pipeline() -> dict:
             measured_bubble(mp["wall_s"], mp2["wall_s"], m, 2 * m), 4
         ),
         "mpmd_transfer_wait_s": mp["transfer_wait_s"],
+        # Transfer-wait as a fraction of total stage-seconds per step
+        # (wall x stages): the sentinel's inter-stage comms series.
+        "mpmd_transfer_wait_frac": (
+            round(
+                mp["transfer_wait_s"] / (mp["wall_s"] * _MPMD_STAGES), 4
+            )
+            if mp.get("wall_s") else None
+        ),
         "gpipe_sps": gp["samples_per_sec_per_chip"],
         "mpmd_sps": mp["samples_per_sec_per_chip"],
         # Cross-schedule parity pin: layout is not math (same init,
@@ -2144,9 +2232,22 @@ def _stdout_record(record: dict) -> dict:
             k: mpp[k]
             for k in (
                 "mpmd_steady_bubble", "gpipe_bubble_fraction",
-                "mpmd_sps_ratio",
+                "mpmd_sps_ratio", "mpmd_transfer_wait_frac",
             )
             if k in mpp
+        }
+    rf = out.get("roofline")
+    if isinstance(rf, dict) and "error" not in rf:
+        # Stdout carries the sentinel series + the roofline placement;
+        # the size config, step time, flops and peak detail stay in the
+        # partial (the mfu itself is duplicated at top level — that key
+        # is the record's headline and predates this stanza).
+        out["roofline"] = {
+            k: rf[k]
+            for k in (
+                "mfu", "arithmetic_intensity", "bound", "peak_source",
+            )
+            if k in rf
         }
     srv = out.get("serving")
     if isinstance(srv, dict) and "error" not in srv:
@@ -2311,9 +2412,13 @@ def _shrink_to_budget(out: dict) -> dict:
         ("multi_tenant", ("min_goodput_fraction", "mean_round_wait_s",
                           "quota_max_rel_err")),
         # MPMD pipeline: reachability guard (the digest already keeps
-        # exactly these three — both sentinel series + the comparator).
+        # these — both sentinel series, the comparator, and the
+        # transfer-wait fraction; the frac yields first under squeeze).
         ("mpmd_pipeline", ("mpmd_steady_bubble", "gpipe_bubble_fraction",
                            "mpmd_sps_ratio")),
+        # Roofline: the sentinel's program_mfu series + the placement
+        # survive tier 1; intensity/peak-source yield to the partial.
+        ("roofline", ("mfu", "bound")),
         # Late probe squeeze: the fallback-reason prose yields before
         # the serving levels do (the partial keeps the full reason; a
         # cpu `platform` on the record already says a fallback
@@ -2370,6 +2475,7 @@ def _shrink_to_budget(out: dict) -> dict:
         ("model_sharded", ("sharded_sps_ratio",)),
         ("multi_tenant", ("min_goodput_fraction",)),
         ("mpmd_pipeline", ("mpmd_steady_bubble", "mpmd_sps_ratio")),
+        ("roofline", ("mfu",)),
         ("moe", ("sorted_speedup",)),
         ("trainer_gap", ("fused_over_fit", "prefetch_spans")),
         ("scaled", ("step_time_ms", "attn_blockwise_ms",
@@ -2777,6 +2883,23 @@ def main():
                 record["trainer_loop_chunked_samples_per_sec_per_chip"] = None
             _flush_partial(record)
 
+        # Roofline leg (ISSUE 14): cost-model MFU computed LOCALLY —
+        # the headline `mfu` can no longer go stale on a dead relay
+        # (the scaled stanza's on-chip MFU rides separately, stale-
+        # stamping and all). Runs BEFORE the relay-dependent sections
+        # so a wedged tunnel cannot starve it. DCT_BENCH_ROOFLINE=0
+        # skips (the smoke's knob, like DCT_BENCH_SCALED).
+        skip_roofline = os.environ.get(
+            "DCT_BENCH_ROOFLINE", "1"
+        ).strip().lower() in ("0", "false", "no")
+        if not (skip_roofline or _gate("roofline", frac=0.5)):
+            rf = _optional("roofline", bench_roofline)
+            record["roofline"] = rf
+            if isinstance(rf, dict) and rf.get("mfu") is not None:
+                record["mfu"] = rf["mfu"]
+                record["mfu_source"] = "cost_model_local"
+            _flush_partial(record)
+
         if not (skip_scaled or _gate("scaled_transformer")):
             scaled = _section(
                 "scaled_transformer", _run_scaled_with_retries, record
@@ -2786,9 +2909,13 @@ def main():
                 # the streamed legs were a crash hedge; the full dict
                 # supersedes them
                 record.pop("scaled_legs", None)
-            # null mfu = peak unknown (CPU fallback rig) or the section
-            # deadline-skipped, so absence can't read as "not measured".
-            record["mfu"] = scaled.get("mfu")
+            # The headline mfu is the roofline leg's LOCAL cost-model
+            # number; the on-chip scaled mfu only stands in when that
+            # leg failed or was skipped (pre-roofline semantics).
+            if record.get("mfu") is None:
+                record["mfu"] = scaled.get("mfu")
+                if record["mfu"] is not None:
+                    record["mfu_source"] = "scaled_onchip"
             _flush_partial(record)
 
         if not (skip_scaled or _gate("scaled_moe")):
@@ -2926,7 +3053,7 @@ def main():
     for skippable in (
         "scaled", "moe", "val_parity", "serving", "serving_load",
         "restart_spinup", "cycle_freshness", "model_sharded",
-        "multi_tenant", "mpmd_pipeline", "host_dataplane",
+        "multi_tenant", "mpmd_pipeline", "host_dataplane", "roofline",
     ):
         record.setdefault(skippable, None)
     _flush_partial(record)

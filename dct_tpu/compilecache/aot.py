@@ -141,6 +141,11 @@ class ExecutableStore:
         self.identity = dict(identity or {})
         self._emit = emit
         self.states: dict[str, str] = {}
+        # Roofline accounting (observability.roofline): per program key,
+        # the normalized cost_analysis/memory_analysis record captured
+        # at compile (or load) time. Populated regardless of `enabled`
+        # — a disabled store is still every CachedProgram's cost book.
+        self.costs: dict[str, dict] = {}
         self._lock = threading.Lock()
 
     # -- bookkeeping ---------------------------------------------------
@@ -153,11 +158,27 @@ class ExecutableStore:
                 return
             self.states[program] = state
 
-    def _event(self, event: str, program: str, **fields) -> None:
+    def note_cost(self, program: str, cost: dict | None) -> None:
+        """Record a program's analytic cost; first capture per program
+        goes on the event log as ``roofline.program`` so efficiency
+        accounting has the same audit trail as compile accounting."""
+        if not cost:
+            return
+        with self._lock:
+            fresh = program not in self.costs
+            self.costs[program] = cost
+        if fresh:
+            self._event(
+                "roofline.program", program, component="roofline",
+                **cost,
+            )
+
+    def _event(self, event: str, program: str,
+               component: str = "compile", **fields) -> None:
         if self._emit is None:
             return
         try:
-            self._emit("compile", event, program=program, **fields)
+            self._emit(component, event, program=program, **fields)
         except Exception:  # noqa: BLE001 — telemetry never fails a load
             pass
 
@@ -173,11 +194,14 @@ class ExecutableStore:
         return os.path.join(self.root, name)
 
     # -- save ----------------------------------------------------------
-    def save(self, program: str, signature: str, compiled) -> bool:  # dct: noqa[rank0-io] — per-rank BY DESIGN: in a multi-process world store_from_env stamps proc=<rank> into the identity, so every rank writes DISTINCT artifact names (a rank-0 gate would lose all nonzero ranks' executables); the pid-suffixed tmp + os.replace publish also makes concurrent single-host writers (serving workers) tear-proof
+    def save(self, program: str, signature: str, compiled, cost: dict | None = None) -> bool:  # dct: noqa[rank0-io] — per-rank BY DESIGN: in a multi-process world store_from_env stamps proc=<rank> into the identity, so every rank writes DISTINCT artifact names (a rank-0 gate would lose all nonzero ranks' executables); the pid-suffixed tmp + os.replace publish also makes concurrent single-host writers (serving workers) tear-proof
         """Serialize ``compiled`` under (program, signature); atomic
-        publish. Returns False (with a stderr note) when the backend
-        does not support executable serialization or the write fails —
-        never raises."""
+        publish. ``cost`` (the roofline analysis captured at compile
+        time) rides the header as ``roofline`` — NOT part of the
+        load-or-miss contract, just provenance a warm process reads
+        back instead of re-deriving. Returns False (with a stderr note)
+        when the backend does not support executable serialization or
+        the write fails — never raises."""
         if not self.enabled:
             return False
         try:
@@ -191,6 +215,8 @@ class ExecutableStore:
                 "signature": signature,
                 "payload_sha256": hashlib.sha256(payload).hexdigest(),
             }
+            if cost:
+                header["roofline"] = cost
             os.makedirs(self.root, exist_ok=True)
             final = self._path(program, signature)
             tmp = f"{final}.tmp.{os.getpid()}"
@@ -280,7 +306,23 @@ class ExecutableStore:
             out_tree = jax.tree_util.tree_structure(
                 jax.eval_shape(fn, *args)
             )
-            return _se.deserialize_and_load(payload, in_tree, out_tree)
+            loaded = _se.deserialize_and_load(payload, in_tree, out_tree)
+            # Roofline provenance stamped at compile time reads back on
+            # the warm path — a hit run reports the same analytic
+            # FLOPs/HBM as the run that compiled the artifact. (If the
+            # call later demotes this executable, the miss path's fresh
+            # analysis overwrites it.) Same DCT_ROOFLINE gate as the
+            # capture paths: disabled means NO roofline telemetry,
+            # warm or cold.
+            saved_cost = header.get("roofline")
+            if isinstance(saved_cost, dict):
+                from dct_tpu.observability import roofline as _roofline
+
+                if _roofline.roofline_enabled():
+                    self.note_cost(
+                        program, {**saved_cost, "source": "header"}
+                    )
+            return loaded
         except Exception as e:  # noqa: BLE001 — any load defect is a miss
             self._event(
                 "compile.cache_miss", program,
@@ -324,12 +366,33 @@ class CachedProgram:
         self._store = store
         self._program = program or getattr(fn, "__name__", "program")
         self._entries: dict = {}
+        self._analyzed: set = set()
         self._lock = threading.Lock()
+
+    def _analyze_disabled(self, program: str, args) -> None:
+        """Roofline capture on the store-DISABLED path (the default):
+        the plain jit call below never exposes its executable, so the
+        cost model is read off a pre-compile ``lower()`` — one extra
+        trace per program, no extra compile. Once per program key."""
+        with self._lock:
+            if program in self._analyzed:
+                return
+            self._analyzed.add(program)
+        from dct_tpu.observability import roofline as _roofline
+
+        if not _roofline.roofline_enabled():
+            return
+        try:
+            lowered = self._fn.lower(*args)
+        except Exception:  # noqa: BLE001 — non-jit callables have no HLO
+            return
+        self._store.note_cost(program, _roofline.analyze_lowered(lowered))
 
     def __call__(self, *args, key: str | None = None):
         program = key or self._program
         if not self._store.enabled:
             self._store._note(program, "disabled")
+            self._analyze_disabled(program, args)
             return self._fn(*args)
         sig = signature_of(args)
         with self._lock:
@@ -364,6 +427,17 @@ class CachedProgram:
                 store._event(
                     "compile.cache_hit", program, signature=sig,
                 )
+                if program not in store.costs:
+                    # Pre-roofline artifact (no stamped provenance):
+                    # analyze the deserialized executable directly.
+                    from dct_tpu.observability import (
+                        roofline as _roofline,
+                    )
+
+                    if _roofline.roofline_enabled():
+                        store.note_cost(
+                            program, _roofline.analyze_compiled(loaded)
+                        )
                 with self._lock:
                     self._entries[(program, sig)] = loaded
                 return out
@@ -377,7 +451,14 @@ class CachedProgram:
             with self._lock:
                 self._entries[(program, sig)] = self._fn
             return self._fn(*args)
-        store.save(program, sig, compiled)
+        from dct_tpu.observability import roofline as _roofline
+
+        cost = (
+            _roofline.analyze_compiled(compiled)
+            if _roofline.roofline_enabled() else None
+        )
+        store.note_cost(program, cost)
+        store.save(program, sig, compiled, cost=cost)
         with self._lock:
             self._entries[(program, sig)] = compiled
         return compiled(*args)

@@ -384,6 +384,18 @@ class ProfileConfig:
     # Which epoch to trace (0-based). Default 1: epoch 0 pays compilation,
     # which would swamp the steady-state timeline.
     epoch: int = 1
+    # On-demand flight recorder (observability/capture.py): touch this
+    # file (or write a seconds value into it) and every rank starts a
+    # jax.profiler capture at its next span boundary — no restart, no
+    # pre-planned window. Each distinct file mtime fires once. "" turns
+    # the file trigger off (SIGUSR2 still works when sigusr2 is set).
+    trigger_path: str = "logs/profile.trigger"
+    # Default capture length (seconds) when the trigger carries none;
+    # the capture stops at the first span boundary past the deadline.
+    capture_s: float = 5.0
+    # Arm SIGUSR2 as a capture trigger (main thread only; worker-thread
+    # trainers degrade to the file trigger automatically).
+    sigusr2: bool = True
 
     @classmethod
     def from_env(cls) -> "ProfileConfig":
@@ -391,6 +403,9 @@ class ProfileConfig:
         c.enabled = _env("DCT_PROFILE", c.enabled, bool)
         c.trace_dir = _env("DCT_TRACE_DIR", c.trace_dir, str)
         c.epoch = _env("DCT_PROFILE_EPOCH", c.epoch, int)
+        c.trigger_path = _env("DCT_PROFILE_TRIGGER", c.trigger_path, str)
+        c.capture_s = _env("DCT_PROF_CAPTURE_S", c.capture_s, float)
+        c.sigusr2 = _env("DCT_PROF_SIGUSR2", c.sigusr2, bool)
         return c
 
 
@@ -1113,6 +1128,11 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_PROFILE": "jax.profiler one-epoch trace window",
     "DCT_TRACE_DIR": "profiler trace output dir",
     "DCT_PROFILE_EPOCH": "which epoch to trace (0-based)",
+    "DCT_PROFILE_TRIGGER": "flight-recorder trigger file ('' = off)",
+    "DCT_PROF_CAPTURE_S": "flight-recorder default capture length (s)",
+    "DCT_PROF_SIGUSR2": "arm SIGUSR2 as an on-demand capture trigger",
+    "DCT_ROOFLINE": "XLA cost-model roofline accounting on/off",
+    "DCT_HBM_GBPS": "per-chip HBM bandwidth override for roofline math",
     # --- resilience ------------------------------------------------
     "DCT_MAX_RESTARTS": "supervised relaunch budget",
     "DCT_RESTART_BACKOFF_S": "first relaunch backoff",
@@ -1195,6 +1215,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_BENCH_SHARDED": "bench model_sharded (sharded vs DP) leg on/off",
     "DCT_BENCH_TENANTS": "bench multi_tenant (2-tenant scheduler) leg on/off",
     "DCT_BENCH_MPMD": "bench mpmd_pipeline (MPMD-1F1B vs SPMD-GPipe bubble) leg on/off",
+    "DCT_BENCH_ROOFLINE": "bench roofline (local cost-model MFU) leg on/off",
     "DCT_BENCH_DEADLINE": "bench wall-clock deadline (s); legs self-gate",
     "DCT_BENCH_PARTIAL": "path for the partial-results stash",
     "DCT_VAL_PARITY_EPOCHS": "val-loss parity leg epoch budget",

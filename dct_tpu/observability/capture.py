@@ -1,0 +1,297 @@
+"""On-demand flight-recorder profiling: capture mid-run, never stop training.
+
+The PR 1 :class:`~dct_tpu.utils.profiling.Profiler` is a *planned*
+window — one configured epoch, armed before the run starts. Incidents
+are not planned: when step time regresses at hour six, the trace you
+need is the one you cannot have without a restart. The flight recorder
+closes that gap with two asynchronous triggers the trainer polls at
+span boundaries (one ``os.stat`` per span — nothing on the step path):
+
+- **trigger file** (``DCT_PROFILE_TRIGGER``, default
+  ``logs/profile.trigger``): ``touch`` it — or write a number of
+  seconds into it — and every rank starts a ``jax.profiler`` trace at
+  its next span boundary, into a per-rank capture directory under the
+  trace dir. Each distinct file mtime fires exactly once, so one touch
+  is one capture (per rank), however long the file lingers.
+- **SIGUSR2**: same capture, signal-triggered, per process (installed
+  in the main thread only; worker-thread trainers fall back to the
+  file trigger).
+
+A capture runs for ``DCT_PROF_CAPTURE_S`` (or the seconds written into
+the trigger file) and stops at the first span boundary past the
+deadline. Training math is untouched — the capture brackets dispatches
+it never joins, so the loss trajectory is bitwise identical to an
+untriggered run (pinned in tests/test_roofline.py).
+
+The serving tier gets the synchronous form: ``GET
+/debug/profile?seconds=N`` captures the live scoring process for N
+seconds and replies with the trace directory
+(:func:`capture_profile`). One capture at a time per process —
+``jax.profiler`` supports a single session — concurrent requests get a
+loud 409, never a corrupted trace.
+
+Every capture is on the record: ``profile.capture_start`` /
+``profile.capture_end`` (+ ``profile.capture_error``) events carry the
+trigger source, the directory, and the wall seconds actually traced.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+#: One jax.profiler session per process: the recorder and the serving
+#: endpoint share this gate, so triggers can never stack sessions.
+_SESSION_LOCK = threading.Lock()
+
+
+class CaptureBusy(RuntimeError):
+    """A capture is already running in this process."""
+
+
+def _start_trace(trace_dir: str) -> None:
+    import jax.profiler
+
+    os.makedirs(trace_dir, exist_ok=True)
+    jax.profiler.start_trace(trace_dir)
+
+
+def _stop_trace() -> None:
+    import jax.profiler
+
+    jax.profiler.stop_trace()
+
+
+def capture_profile(trace_dir: str, seconds: float, *, emit=None) -> str:
+    """Blocking capture: trace this process for ``seconds`` into a
+    fresh timestamped directory under ``trace_dir`` and return it.
+    Raises :class:`CaptureBusy` when a capture is already active."""
+    if not _SESSION_LOCK.acquire(blocking=False):
+        raise CaptureBusy("a profiler capture is already running")
+    out = os.path.join(trace_dir, f"capture-{int(time.time() * 1e3)}")
+    try:
+        _start_trace(out)
+        if emit:
+            emit(
+                "profile", "profile.capture_start",
+                dir=out, seconds=seconds, trigger="endpoint",
+            )
+        time.sleep(max(0.0, float(seconds)))
+        _stop_trace()
+        if emit:
+            emit(
+                "profile", "profile.capture_end",
+                dir=out, seconds=seconds, trigger="endpoint",
+            )
+    except CaptureBusy:
+        raise
+    except Exception:
+        # A torn session must not wedge the process's only profiler
+        # slot; stop is idempotent enough to try.
+        try:
+            _stop_trace()
+        except Exception:  # noqa: BLE001 — already stopping on error
+            pass
+        raise
+    finally:
+        _SESSION_LOCK.release()
+    return out
+
+
+class FlightRecorder:
+    """Span-boundary polled capture driver for the training loop.
+
+    Construction never touches jax; everything is lazy so a disabled
+    recorder (empty trigger path, no signal) costs nothing. ``poll()``
+    is the only hot-path surface: one stat of the trigger file per call
+    plus a flag read.
+    """
+
+    def __init__(
+        self,
+        trace_dir: str,
+        *,
+        trigger_path: str = "",
+        capture_s: float = 5.0,
+        rank: int = 0,
+        emit=None,
+        clock=time.monotonic,
+    ):
+        self.trace_dir = trace_dir
+        self.trigger_path = trigger_path
+        self.capture_s = max(0.05, float(capture_s))
+        self.rank = int(rank)
+        self._emit = emit
+        self._clock = clock
+        self._signal_flag = False
+        self._consumed_mtime: int | None = None
+        # A trigger observed while the profiler session was busy (the
+        # planned Profiler holds the lock for its whole epoch): kept
+        # PENDING and retried at every span boundary until the session
+        # frees — an operator's touch is deferred, never dropped.
+        self._pending: tuple | None = None
+        self._busy_noted = False
+        self._active_dir: str | None = None
+        self._deadline = 0.0
+        self._t_start = 0.0
+        self._installed_handler = None
+
+    # -- triggers ------------------------------------------------------
+    def install_signal(self) -> "FlightRecorder":
+        """Arm SIGUSR2 (main thread only — ``signal.signal`` raises
+        elsewhere, and the recorder degrades to the file trigger)."""
+        import signal
+
+        def _on_usr2(_signum, _frame):
+            self._signal_flag = True
+
+        try:
+            self._installed_handler = signal.signal(
+                signal.SIGUSR2, _on_usr2
+            )
+        except (ValueError, OSError, AttributeError):
+            self._installed_handler = None
+        return self
+
+    def _read_trigger(self) -> tuple | None:
+        """Peek a freshly-fired trigger: ``(seconds, source, mtime)``
+        (mtime None for the signal), or None. Deliberately does NOT
+        mark the file mtime consumed — the caller consumes it only
+        once a capture actually started, so a trigger landing while
+        the session is busy defers instead of vanishing."""
+        if self.trigger_path:
+            try:
+                mtime = os.stat(self.trigger_path).st_mtime_ns
+            except OSError:
+                mtime = None
+            if mtime is not None and mtime != self._consumed_mtime:
+                try:
+                    with open(self.trigger_path) as f:
+                        txt = f.read().strip()
+                    seconds = float(txt) if txt else self.capture_s
+                except (OSError, ValueError):
+                    seconds = self.capture_s
+                return seconds, "file", mtime
+        if self._signal_flag:
+            self._signal_flag = False
+            return self.capture_s, "signal", None
+        return None
+
+    # -- the poll ------------------------------------------------------
+    def poll(self, **ctx) -> None:
+        """Called at span boundaries: start a pending capture, or stop
+        an active one whose deadline passed. Never raises."""
+        try:
+            if self._active_dir is not None:
+                if self._clock() >= self._deadline:
+                    self._finish(**ctx)
+                return
+            if self._pending is None:
+                self._pending = self._read_trigger()
+            if self._pending is None:
+                return
+            seconds, trigger, mtime = self._pending
+            outcome = self._begin(seconds, trigger, **ctx)
+            if outcome != "busy":
+                # Started, or failed terminally (unwritable dir): the
+                # trigger is spent either way. Busy keeps it pending
+                # for the next boundary.
+                if mtime is not None:
+                    self._consumed_mtime = mtime
+                self._pending = None
+                self._busy_noted = False
+        except Exception:  # noqa: BLE001 — telemetry never fails the run
+            pass
+
+    def _begin(self, seconds: float, trigger: str, **ctx) -> str:
+        if not _SESSION_LOCK.acquire(blocking=False):
+            if not self._busy_noted:
+                # Once per pending trigger — the retry itself is
+                # silent, or a long planned window would spam one
+                # error per span boundary.
+                self._busy_noted = True
+                self._note(
+                    "profile.capture_error", trigger=trigger,
+                    error="a profiler session is already running; "
+                          "capture deferred to the next free span "
+                          "boundary", **ctx,
+                )
+            return "busy"
+        out = os.path.join(
+            self.trace_dir,
+            f"capture-{int(time.time() * 1e3)}-rank{self.rank}",
+        )
+        try:
+            _start_trace(out)
+        except Exception as e:  # noqa: BLE001 — a failed start releases
+            _SESSION_LOCK.release()
+            self._note(
+                "profile.capture_error", trigger=trigger,
+                error=f"{type(e).__name__}: {e}"[:200], **ctx,
+            )
+            return "failed"
+        self._active_dir = out
+        self._t_start = self._clock()
+        self._deadline = self._t_start + max(0.05, float(seconds))
+        self._note(
+            "profile.capture_start", dir=out, seconds=seconds,
+            trigger=trigger, **ctx,
+        )
+        return "started"
+
+    def _finish(self, **ctx) -> None:
+        out, self._active_dir = self._active_dir, None
+        try:
+            _stop_trace()
+        finally:
+            _SESSION_LOCK.release()
+        self._note(
+            "profile.capture_end", dir=out,
+            seconds=round(self._clock() - self._t_start, 3), **ctx,
+        )
+
+    def close(self) -> None:
+        """Crash-path hygiene: stop any active capture (the partial
+        trace is kept — it covers exactly the window that died) and
+        restore the previous SIGUSR2 handler."""
+        try:
+            if self._active_dir is not None:
+                self._finish(at="close")
+        except Exception:  # noqa: BLE001 — cleanup must not mask the exit
+            pass
+        if self._installed_handler is not None:
+            import signal
+
+            try:
+                signal.signal(signal.SIGUSR2, self._installed_handler)
+            except (ValueError, OSError):
+                pass
+            self._installed_handler = None
+
+    def _note(self, event: str, **fields) -> None:
+        if self._emit is None:
+            return
+        try:
+            self._emit("profile", event, rank=self.rank, **fields)
+        except Exception:  # noqa: BLE001 — telemetry never fails the run
+            pass
+
+
+def recorder_from_config(profile_cfg, *, rank: int = 0, emit=None,
+                         install_signal: bool | None = None):
+    """A :class:`FlightRecorder` off :class:`~dct_tpu.config.
+    ProfileConfig`: per-rank captures under ``<trace_dir>``, the shared
+    trigger file, SIGUSR2 armed when the config says so (and we are in
+    the main thread — install degrades gracefully elsewhere)."""
+    rec = FlightRecorder(
+        profile_cfg.trace_dir,
+        trigger_path=profile_cfg.trigger_path,
+        capture_s=profile_cfg.capture_s,
+        rank=rank,
+        emit=emit,
+    )
+    arm = profile_cfg.sigusr2 if install_signal is None else install_signal
+    if arm:
+        rec.install_signal()
+    return rec

@@ -35,6 +35,7 @@ def build_train_registry(
     health: dict | None = None,
     resilience: dict | None = None,
     compile_windows: list | None = None,
+    roofline: list | None = None,
 ) -> MetricsRegistry:
     """The run's final metrics as a registry (shared by the textfile
     dump and the metrics-plane snapshot — one source, two sinks)."""
@@ -131,6 +132,14 @@ def build_train_registry(
             }
             n_fam.inc(w.get("count", 0), wl)
             s_fam.inc(w.get("seconds", 0.0), wl)
+    if roofline:
+        # Roofline accounting (observability.roofline.program_report):
+        # cost-model FLOPs/HBM per compiled program joined with the
+        # ledger's measured dispatch windows — the dct_program_* gauge
+        # families a /metrics scrape reports next to the goodput series.
+        from dct_tpu.observability.roofline import add_roofline_metrics
+
+        add_roofline_metrics(reg, roofline, labels)
     return reg
 
 
@@ -144,6 +153,7 @@ def write_train_metrics_prom(
     health: dict | None = None,
     resilience: dict | None = None,
     compile_windows: list | None = None,
+    roofline: list | None = None,
     metrics_dir: str | None = None,
     proc: str | None = None,
 ) -> str | None:
@@ -160,6 +170,7 @@ def write_train_metrics_prom(
         health=health,
         resilience=resilience,
         compile_windows=compile_windows,
+        roofline=roofline,
     )
     if metrics_dir:
         from dct_tpu.observability.aggregate import write_snapshot

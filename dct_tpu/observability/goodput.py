@@ -69,6 +69,11 @@ class GoodputLedger:
         # (compile.* events + dct_compile_* series — ROADMAP item 5's
         # baseline numbers live here).
         self.compile_windows: list[tuple[str, float]] = []
+        # Steady-state (post-compile) dispatch windows per program key:
+        # key -> [count, seconds]. The measured half of the roofline
+        # join (observability.roofline.program_report): analytic FLOPs
+        # x count / seconds = achieved FLOPs/s = live per-program MFU.
+        self.dispatch_stats: dict[str, list] = {}
 
     # -- clock surface (for callers that bracket non-contiguous code) --
     def clock(self) -> float:
@@ -114,16 +119,38 @@ class GoodputLedger:
             sec = self._clock() - t0
             if cat == "compile":
                 self.compile_windows.append((key, sec))
+            else:
+                st = self.dispatch_stats.setdefault(key, [0, 0.0])
+                st[0] += 1
+                st[1] += sec
             self.add(cat, sec)
 
-    def add_dispatch(self, category: str, key: str, seconds: float) -> None:
+    def add_dispatch(self, category: str, key: str, seconds: float) -> str:
         """Non-contextmanager form for dispatches whose timing window is
         interleaved with other code (the trainer's prefetch submit sits
-        between the fused call and its block_until_ready)."""
+        between the fused call and its block_until_ready). Returns the
+        category the window was billed to, so callers that bill partial
+        (host-blocking-only) windows can true up ``dispatch_stats``
+        with the honest wall window afterwards."""
         cat = self.dispatch_category(category, key)
         if cat == "compile":
             self.compile_windows.append((key, float(seconds)))
+        else:
+            st = self.dispatch_stats.setdefault(key, [0, 0.0])
+            st[0] += 1
+            st[1] += float(seconds)
         self.add(cat, seconds)
+        return cat
+
+    def amend_dispatch_window(self, key: str, extra_seconds: float) -> None:
+        """Widen the last-billed roofline window for ``key`` WITHOUT
+        touching the goodput categories: the pipelined trainer bills
+        only its host-blocking windows to the ledger (overlap is the
+        mode's point), but the roofline join needs the true wall window
+        per dispatch or MFU over-reports."""
+        st = self.dispatch_stats.get(key)
+        if st is not None:
+            st[1] += max(0.0, float(extra_seconds))
 
     # -- epoch feed (EpochTimer calls this) ----------------------------
     def note_epoch(self, epoch: int, seconds: float) -> None:
@@ -231,6 +258,7 @@ def compile_report(
     config_hash: str = "",
     mesh: str = "",
     cache_states: dict | None = None,
+    costs: dict | None = None,
 ) -> list[dict]:
     """Group raw ``(program, seconds)`` compile windows into one record
     per program, carrying the cache-key labels — the shape both the
@@ -240,7 +268,13 @@ def compile_report(
     (the AOT store's per-program resolution,
     :class:`dct_tpu.compilecache.ExecutableStore`); a program the store
     never fronted reports ``disabled`` — its window was a real XLA
-    compile with no cache in the loop."""
+    compile with no cache in the loop.
+
+    ``costs`` maps program key -> the roofline analysis the store
+    captured at compile time (``ExecutableStore.costs``): analytic
+    FLOPs / bytes accessed / peak HBM ride the window record, so a
+    ``compile.window`` event names not just what a program cost to
+    build but what it costs to run."""
     grouped: dict[str, dict] = {}
     for program, sec in windows:
         g = grouped.setdefault(
@@ -260,4 +294,9 @@ def compile_report(
     out = list(grouped.values())
     for g in out:
         g["seconds"] = round(g["seconds"], 6)
+        cost = (costs or {}).get(g["program"])
+        if cost:
+            for k in ("flops", "bytes_accessed", "hbm_peak_bytes"):
+                if cost.get(k) is not None:
+                    g[k] = cost[k]
     return out

@@ -137,8 +137,17 @@ def _bench_mfu_lines(bench: tuple[str, dict] | None) -> list[str]:
     reason = parsed.get("scaled_mfu_stale_reason")
     if mfu is not None:
         line = f"  {name}: mfu={_fmt_num(mfu)}"
+        source = parsed.get("mfu_source")
+        if source:
+            line += f" ({source})"
         if stale:
-            line += f" STALE ({reason or 'reason unrecorded'})"
+            # Post-roofline records: the headline is local, so staleness
+            # only taints the scaled stanza's on-chip number.
+            which = (
+                "scaled on-chip MFU STALE"
+                if source == "cost_model_local" else "STALE"
+            )
+            line += f" [{which}: {reason or 'reason unrecorded'}]"
         lines.append(line)
     elif stale or reason:
         why = reason or "no reason recorded"
@@ -208,6 +217,11 @@ def build_report(
         # session landmarks (per-epoch mpmd.step_report stays off the
         # timeline — the MPMD section below summarizes it).
         "mpmd.",
+        # Flight-recorder captures (docs/OBSERVABILITY.md §roofline):
+        # an operator-triggered mid-run trace is a timeline landmark.
+        # roofline.* stays off it — run-end batch records the Roofline
+        # section below summarizes.
+        "profile.",
     )
     shown = 0
     for r in ev:
@@ -563,6 +577,62 @@ def build_report(
         )
     else:
         lines.append("  (no compile.window events)")
+
+    # -- roofline (cost-model efficiency accounting) ------------------
+    lines.append("")
+    lines.append("Roofline (XLA cost model x measured dispatch):")
+    reports = [r for r in ev if r.get("event") == "roofline.report"]
+    if not reports:
+        # Fall back to the capture-time analytic records so a run that
+        # died before the run-end join still shows its program costs.
+        reports = [r for r in ev if r.get("event") == "roofline.program"]
+    if reports:
+        # Newest record per program name wins (a resumed session can
+        # report a program twice).
+        by_prog: dict[str, dict] = {}
+        for r in reports:
+            by_prog[str(r.get("program"))] = r
+        for name in sorted(by_prog):
+            r = by_prog[name]
+            parts = [f"  {name}:"]
+            if r.get("flops") is not None:
+                parts.append(f"flops={r['flops']:.4g}")
+            if r.get("bytes_accessed") is not None:
+                parts.append(f"bytes={r['bytes_accessed']:.4g}")
+            if r.get("hbm_peak_bytes") is not None:
+                parts.append(f"hbm_peak={r['hbm_peak_bytes']:.4g}")
+            if r.get("arithmetic_intensity") is not None:
+                parts.append(
+                    f"intensity={r['arithmetic_intensity']:.4g}"
+                )
+            if r.get("mfu") is not None:
+                parts.append(f"MFU={r['mfu']:.4g}")
+            if r.get("bound") and r["bound"] != "unknown":
+                parts.append(f"{r['bound']}-bound")
+            lines.append(" ".join(parts))
+    else:
+        lines.append(
+            "  (no roofline.* events — DCT_ROOFLINE=0, or a pre-"
+            "roofline run)"
+        )
+    captures = [
+        r for r in ev
+        if str(r.get("event", "")).startswith("profile.capture")
+    ]
+    if captures:
+        starts = sum(
+            1 for r in captures if r["event"] == "profile.capture_start"
+        )
+        ends = [
+            r for r in captures if r["event"] == "profile.capture_end"
+        ]
+        line = (
+            f"  flight recorder: {starts} capture(s), "
+            f"{len(ends)} completed"
+        )
+        if ends:
+            line += f"; last trace: {ends[-1].get('dir')}"
+        lines.append(line)
 
     # -- spans / trace -------------------------------------------------
     lines.append("")

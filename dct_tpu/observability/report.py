@@ -77,6 +77,14 @@ SERIES = (
     ("mpmd_bubble_fraction", ("mpmd_pipeline", "mpmd_steady_bubble"),
      "down"),
     ("mpmd_sps_ratio", ("mpmd_pipeline", "mpmd_sps_ratio"), "up"),
+    # Roofline introspection (the roofline bench leg): locally-computed
+    # cost-model MFU — the headline efficiency series that can never go
+    # stale on a dead relay (flags at the >10% drop threshold) — and
+    # the MPMD step's transfer-wait fraction, gated like a latency (a
+    # >25% rise means inter-stage comms started eating the step).
+    ("program_mfu", ("roofline", "mfu"), "up"),
+    ("transfer_wait_frac",
+     ("mpmd_pipeline", "mpmd_transfer_wait_frac"), "down"),
 )
 
 
@@ -114,7 +122,12 @@ def load_round(path: str) -> dict:
         v = _dig(parsed, path_keys)
         if v is not None:
             out["series"][label] = float(v)
-    if parsed.get("scaled_mfu_stale"):
+    if parsed.get("scaled_mfu_stale") and parsed.get("mfu") is None:
+        # A dead relay staled the SCALED stanza's on-chip MFU. Since the
+        # roofline leg computes the headline MFU locally, staleness only
+        # matters when the round has NO local number either (the
+        # pre-roofline record shape, e.g. r05) — a round carrying a live
+        # local MFU retires the finding.
         out["mfu_stale_reason"] = parsed.get("scaled_mfu_stale_reason")
     return out
 

@@ -33,6 +33,76 @@ from dct_tpu.parallel.mpmd import MpmdTransferTimeout
 
 _MAGIC = b"DCTX"
 
+# ----------------------------------------------------------------------
+# Transfer accounting (ISSUE 14): byte/latency histograms per link
+# direction, so inter-stage comms show up on /metrics next to the
+# bubble gauges instead of hiding inside transfer_wait_s. Armed by the
+# worker (arm_transfer_metrics with its metrics-plane registry);
+# unarmed, every note is one None check — nothing on the wire path.
+
+#: Frame-size buckets, bytes: 1 KB .. 256 MB in decades + the
+#: activation-sized middle. Part of the metric identity (aggregate.py
+#: merges bucket-wise), so changing them is a schema change.
+TRANSFER_BYTE_BUCKETS = (
+    1e3, 1e4, 1e5, 1e6, 4e6, 1.6e7, 6.4e7, 2.56e8,
+)
+#: Per-frame wall buckets, seconds: loopback microseconds up to the
+#: loud-timeout regime.
+TRANSFER_LATENCY_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
+)
+
+_transfer_metrics: dict | None = None
+
+
+def arm_transfer_metrics(registry) -> None:
+    """Install the transfer histograms/counters on ``registry`` (a
+    :class:`~dct_tpu.observability.metrics.MetricsRegistry`) and start
+    recording every frame this process sends/receives. Call once per
+    process (the MPMD worker does, when ``DCT_METRICS_DIR`` arms the
+    plane); re-arming swaps the sink."""
+    global _transfer_metrics
+    _transfer_metrics = {
+        "bytes_h": registry.histogram(
+            "dct_mpmd_transfer_bytes",
+            "Framed bytes per inter-stage transfer, by direction.",
+            buckets=TRANSFER_BYTE_BUCKETS,
+        ),
+        "seconds_h": registry.histogram(
+            "dct_mpmd_transfer_seconds",
+            "Wall seconds per inter-stage transfer frame, by "
+            "direction (recv includes the wait for the peer's send).",
+            buckets=TRANSFER_LATENCY_BUCKETS,
+        ),
+        "frames_c": registry.counter(
+            "dct_mpmd_transfer_frames_total",
+            "Inter-stage transfer frames, by direction.",
+        ),
+        "bytes_c": registry.counter(
+            "dct_mpmd_transfer_bytes_total",
+            "Cumulative inter-stage transfer bytes, by direction.",
+        ),
+    }
+
+
+def disarm_transfer_metrics() -> None:
+    global _transfer_metrics
+    _transfer_metrics = None
+
+
+def _note_transfer(direction: str, nbytes: int, seconds: float) -> None:
+    m = _transfer_metrics
+    if m is None:
+        return
+    try:
+        labels = {"direction": direction}
+        m["bytes_h"].observe(nbytes, labels)
+        m["seconds_h"].observe(seconds, labels)
+        m["frames_c"].inc(1.0, labels)
+        m["bytes_c"].inc(float(nbytes), labels)
+    except Exception:  # noqa: BLE001 — telemetry never fails a transfer
+        pass
+
 
 def _send_all(sock: socket.socket, data: bytes) -> None:
     sock.sendall(data)
@@ -113,16 +183,22 @@ class SocketChannel:
         # would corrupt the peer's stream). A genuinely dead peer
         # surfaces through ITS recv timeout / the launcher's stall
         # monitor; any send-side failure is still loud here.
+        arr = np.asarray(payload)
+        t0 = time.monotonic()
         try:
             self._sock.settimeout(None)
-            send_array(self._sock, np.asarray(payload))
+            send_array(self._sock, arr)
         except OSError as e:
             raise MpmdTransferTimeout(
                 f"send on the transfer link failed: {e}"
             ) from e
+        _note_transfer("send", arr.nbytes, time.monotonic() - t0)
 
     def recv(self, timeout: float):
-        return recv_array(self._sock, timeout)
+        t0 = time.monotonic()
+        arr = recv_array(self._sock, timeout)
+        _note_transfer("recv", arr.nbytes, time.monotonic() - t0)
+        return arr
 
     def close(self) -> None:
         try:

@@ -167,6 +167,55 @@ class _JsonHandler(BaseHTTPRequestHandler):
         if os.environ.get("DCT_SERVE_LOG"):
             super().log_message(fmt, *args)
 
+    def _reply_profile(self, query: str) -> None:
+        """``GET /debug/profile?seconds=N``: capture a ``jax.profiler``
+        trace of THIS scoring process for N seconds (clamped to
+        [0.05, 60]) and reply with the TensorBoard-loadable trace dir —
+        the serving half of the flight recorder
+        (:mod:`dct_tpu.observability.capture`). The capture brackets
+        live traffic without touching it; only one capture runs at a
+        time per process (a second request gets 409, never a torn
+        trace). In a multi-process pool the kernel routes the request
+        to ONE worker — the captured process's pid is in the reply."""
+        import urllib.parse
+
+        from dct_tpu.observability import capture as _capture
+
+        import math
+
+        qs = urllib.parse.parse_qs(query)
+        try:
+            seconds = float((qs.get("seconds") or ["1.0"])[0])
+        except ValueError:
+            seconds = float("nan")
+        if not math.isfinite(seconds):
+            # nan/inf slip through min/max (not NaN-safe) and a NaN in
+            # the 200 body would be invalid strict JSON.
+            self._reply(400, {"error": "seconds must be a finite number"})
+            return
+        seconds = min(max(seconds, 0.05), 60.0)
+        from dct_tpu.config import ProfileConfig
+
+        trace_dir = os.path.join(
+            ProfileConfig.from_env().trace_dir, f"serve-{os.getpid()}"
+        )
+        try:
+            out = _capture.capture_profile(
+                trace_dir, seconds, emit=_emit_default
+            )
+        except _capture.CaptureBusy as e:
+            self._reply(409, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — a capture failure is a
+            # server fault (profiler unavailable, unwritable dir); the
+            # scoring path is untouched either way.
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(
+            200,
+            {"trace_dir": out, "seconds": seconds, "pid": os.getpid()},
+        )
+
     def _read_data_envelope(self):
         """Parse the request body as ``{"data": ...}``; replies 400 and
         returns None on anything malformed.
@@ -247,10 +296,16 @@ class ScoreHandler(_JsonHandler):
     pure numpy on read-only weights)."""
 
     def do_GET(self):  # noqa: N802 (http.server API)
-        if self.path == "/metrics":
+        import urllib.parse
+
+        parsed = urllib.parse.urlparse(self.path)
+        if parsed.path == "/metrics":
             self._reply_metrics()
             return
-        if self.path != "/healthz":
+        if parsed.path == "/debug/profile":
+            self._reply_profile(parsed.query)
+            return
+        if parsed.path != "/healthz":
             self._reply(404, {"error": f"no route {self.path}"})
             return
         meta = self.server.model_meta
@@ -802,9 +857,13 @@ class EndpointScoreHandler(_JsonHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
         import urllib.parse
 
-        route = urllib.parse.urlparse(self.path).path
+        parsed = urllib.parse.urlparse(self.path)
+        route = parsed.path
         if route == "/metrics":
             self._reply_metrics()
+            return
+        if route == "/debug/profile":
+            self._reply_profile(parsed.query)
             return
         if route != "/healthz":
             self._reply(404, {"error": f"no route {self.path}"})

@@ -354,6 +354,35 @@ def build_full_state(cfg: RunConfig, input_dim: int, *, compute_dtype=None):
     )
 
 
+def stage_mfu_record(
+    costs: dict, *, stage: int, n_microbatches: int, busy_s: float,
+    devices: int, family: str, mesh: str, peak: float | None,
+) -> dict | None:
+    """One ``mpmd_stage<k>`` roofline record: the stage's per-step
+    FLOPs (M fwd+bwd passes + the update, off the stage programs'
+    cost books) joined with its measured per-step busy seconds — the
+    live per-stage MFU both deployment modes publish (the in-process
+    trainer off the bubble report, the worker off its executor's last
+    step report). None when any ingredient is missing."""
+    fwd = (costs.get(f"mpmd_fwd_s{stage}") or {}).get("flops")
+    bwd = (costs.get(f"mpmd_bwd_s{stage}") or {}).get("flops")
+    upd = (costs.get(f"mpmd_update_s{stage}") or {}).get("flops")
+    if not (fwd and bwd and busy_s > 0 and peak):
+        return None
+    step_flops = n_microbatches * (fwd + bwd) + (upd or 0.0)
+    return {
+        "program": f"mpmd_stage{stage}",
+        "family": family,
+        "mesh": mesh,
+        "stage": stage,
+        "flops": step_flops,
+        "seconds": round(busy_s, 6),
+        "calls": 1,
+        "mfu": round(step_flops / busy_s / max(devices, 1) / peak, 6),
+        "bound": "unknown",
+    }
+
+
 def stage_store(cfg: RunConfig, spec, k: int, mesh, input_dim: int):
     """Stage ``k``'s PR 9 AOT store: the stage id and the slice
     topology JOIN the compile identity — the same stage on a different
@@ -439,12 +468,55 @@ class MpmdTrainer:
     def _loaders(self, data=None):
         return build_loaders(self.cfg, self._spec, data)
 
-    def _publish_metrics(self, bubble: dict) -> None:
+    def _stage_roofline(self, bubble: dict, stores, spec) -> list[dict]:
+        """Per-stage roofline records: every stage program's analytic
+        cost (from its store's book), plus one ``mpmd_stage<k>`` record
+        joining the stage's per-step FLOPs (M fwd+bwd passes + the
+        update) with its measured busy seconds from the last step's
+        bubble report — the per-stage MFU leg of the acceptance bar."""
+        from dct_tpu.observability import roofline as _roofline
+
+        if stores is None or spec is None:
+            return []
+        peak, _src = _roofline.resolve_peak_flops()
+        out: list[dict] = []
+        busy = {
+            int(st["stage"]): float(st.get("busy_s") or 0.0)
+            for st in (bubble.get("stages") or [])
+        }
+        for k, store in enumerate(stores):
+            mesh_d = _mesh_descriptor(self._meshes[k])
+            for program in sorted(store.costs):
+                out.append({
+                    "program": program,
+                    "family": self.cfg.model.name,
+                    "mesh": mesh_d,
+                    "stage": k,
+                    **store.costs[program],
+                })
+            rec = stage_mfu_record(
+                store.costs, stage=k,
+                n_microbatches=spec.n_microbatches,
+                busy_s=busy.get(k, 0.0),
+                devices=spec.device_counts[k],
+                family=self.cfg.model.name, mesh=mesh_d, peak=peak,
+            )
+            if rec is not None:
+                out.append(rec)
+        return out
+
+    def _publish_metrics(self, bubble: dict, stores=None, spec=None,
+                         emit=None) -> None:
         """Final metrics-plane snapshot (when ``DCT_METRICS_DIR`` arms
         the plane): the last step's bubble fractions + per-stage phase
         seconds under a ``stage`` label — the /metrics side of "where
-        did the bubble go"."""
+        did the bubble go" — plus the per-stage-program roofline gauges
+        (``dct_program_flops`` / ``dct_program_mfu`` / ...)."""
         cfg = self.cfg
+        emit = emit or _events.get_default().emit
+        roofline_rep = self._stage_roofline(bubble, stores, spec)
+        for r in roofline_rep:
+            emit("roofline", "roofline.report", **r)
         if not (cfg.obs.enabled and cfg.obs.metrics_dir) or not bubble:
             return
         from dct_tpu.observability.aggregate import SnapshotPublisher
@@ -474,6 +546,12 @@ class MpmdTrainer:
                 st["transfer_wait_s"],
                 {**labels, "phase": "transfer_wait"},
             )
+        if roofline_rep:
+            from dct_tpu.observability.roofline import (
+                add_roofline_metrics,
+            )
+
+            add_roofline_metrics(reg, roofline_rep, {})
         pub = SnapshotPublisher(
             reg, cfg.obs.metrics_dir, proc=f"mpmd-{os.getpid()}",
             interval_s=cfg.obs.metrics_publish_s, start_timer=False,
@@ -694,7 +772,7 @@ class MpmdTrainer:
             steady_bubble=bubble.get("steady_bubble"),
             step_bubble=bubble.get("step_bubble"),
         )
-        self._publish_metrics(bubble)
+        self._publish_metrics(bubble, stores, spec, emit=events.emit)
         cache_states: dict = {}
         for st in stores:
             cache_states.update(st.states)

@@ -216,6 +216,24 @@ def main() -> int:
         n_stages=spec.n_stages, devices=spec.device_counts[stage],
         schedule=spec.schedule,
     )
+    # Metrics plane (when DCT_METRICS_DIR arms it): this stage's
+    # transfer byte/latency histograms record live (timer-refreshed
+    # snapshots), and the final snapshot adds the stage programs'
+    # roofline gauges — inter-stage comms and per-program cost land on
+    # the same aggregated /metrics scrape as the bubble gauges.
+    publisher = None
+    metrics_reg = None
+    if cfg.obs.enabled and cfg.obs.metrics_dir:
+        from dct_tpu.observability.aggregate import SnapshotPublisher
+        from dct_tpu.observability.metrics import MetricsRegistry
+
+        metrics_reg = MetricsRegistry()
+        mpmd_transfer.arm_transfer_metrics(metrics_reg)
+        publisher = SnapshotPublisher(
+            metrics_reg, cfg.obs.metrics_dir,
+            proc=f"mpmd-stage{stage}-{os.getpid()}",
+            interval_s=cfg.obs.metrics_publish_s,
+        )
     hb.beat(epoch=start_epoch, phase="startup", force=True)
     links = mpmd_transfer.connect_stage_links(
         stage, spec.n_stages, port_base=spec.port_base,
@@ -282,6 +300,7 @@ def main() -> int:
             })
 
     rc = 0
+    last_rep = None
     try:
         for epoch in range(start_epoch, target_epochs):
             losses = []
@@ -302,6 +321,7 @@ def main() -> int:
                     ops, state, _microbatches(batch),
                     jnp.asarray(total, jnp.float32),
                 )
+                last_rep = rep
                 if last and loss_sums:
                     losses.append(
                         sum(float(np.asarray(s)) for s, _ in loss_sums)
@@ -345,6 +365,54 @@ def main() -> int:
         rc = 1
     finally:
         mpmd_transfer.close_links(links)
+        if publisher is not None:
+            from dct_tpu.observability.goodput import (
+                mesh_descriptor as _mesh_descriptor,
+            )
+            from dct_tpu.observability.roofline import (
+                add_roofline_metrics,
+            )
+
+            try:
+                from dct_tpu.observability.roofline import (
+                    resolve_peak_flops,
+                )
+
+                mesh_d = _mesh_descriptor(mesh)
+                report = [
+                    {
+                        "program": program,
+                        "family": cfg.model.name,
+                        "mesh": mesh_d,
+                        **cost,
+                    }
+                    for program, cost in sorted(store.costs.items())
+                ]
+                # The live per-stage MFU gauge (the acceptance bar's
+                # worker half): this stage's per-step FLOPs over its
+                # executor's last measured step busy window.
+                mfu_rec = mt.stage_mfu_record(
+                    store.costs, stage=stage,
+                    n_microbatches=spec.n_microbatches,
+                    busy_s=(
+                        float(last_rep.busy_s) if last_rep else 0.0
+                    ),
+                    devices=spec.device_counts[stage],
+                    family=cfg.model.name, mesh=mesh_d,
+                    peak=resolve_peak_flops()[0],
+                )
+                if mfu_rec is not None:
+                    report.append(mfu_rec)
+                    events.emit(
+                        "roofline", "roofline.report", **mfu_rec
+                    )
+                add_roofline_metrics(
+                    metrics_reg, report, {"stage": str(stage)},
+                )
+            except Exception:  # noqa: BLE001 — telemetry never
+                pass  # changes the worker's exit code
+            publisher.close(final=True)
+            mpmd_transfer.disarm_transfer_metrics()
         hb.beat(phase="exit", force=True)
     return rc
 

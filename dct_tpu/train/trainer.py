@@ -697,6 +697,17 @@ class Trainer:
             epoch=min(cfg.profile.epoch, target_epochs - 1),
             coordinator=self.coordinator,
         )
+        # On-demand flight recorder (observability/capture.py): a
+        # DCT_PROFILE_TRIGGER touch or SIGUSR2 starts a per-rank
+        # jax.profiler capture at the next span boundary, mid-run,
+        # without stopping training. Polling is one stat per span.
+        from dct_tpu.observability.capture import (
+            recorder_from_config as _flight_from_config,
+        )
+
+        flight = _flight_from_config(
+            cfg.profile, rank=jax.process_index(), emit=events.emit,
+        )
 
         # Pre-staged validation arrays (order is fixed): stacked AND
         # transferred to device once, reused every epoch.
@@ -1041,11 +1052,13 @@ class Trainer:
             # plus the join above. Device time overlapped by host
             # bookkeeping is exactly the overlap the mode buys; it
             # surfaces as the other categories' windows, never twice.
-            ledger.add_dispatch(
-                "train_step", f"scan_k{k}",
+            _billed = (
                 (sp.dispatch_elapsed + (ledger.clock() - _t_join))
                 if pipelined
-                else (ledger.clock() - sp.t_dispatch),
+                else (ledger.clock() - sp.t_dispatch)
+            )
+            _billed_cat = ledger.add_dispatch(
+                "train_step", f"scan_k{k}", _billed,
             )
             sp.dispatch_span.end()
             # The fused program runs the validation pass(es) inside the
@@ -1057,6 +1070,15 @@ class Trainer:
                 e0, k * sp.n_steps * global_batch,
                 eval_samples=k * len(val_idx),
             )
+            if pipelined and _billed_cat != "compile":
+                # Roofline truth-up: the goodput bill above is only the
+                # host-BLOCKING part of the window (the overlap the
+                # pipelined mode buys); the per-program MFU join needs
+                # the wall window the dispatch actually occupied — the
+                # consume-to-consume timer window just closed.
+                ledger.amend_dispatch_window(
+                    f"scan_k{k}", epoch_stats.seconds - _billed,
+                )
             if pipelined:
                 timer.start()
             flat = losses_host.reshape(-1)
@@ -1150,6 +1172,10 @@ class Trainer:
                         "epoch", epoch=epoch, pre_exit=state_ckptr.wait
                     )
                 k = min(chunk, target_epochs - epoch) if use_scan else 1
+                # Span boundary = the flight recorder's poll point: an
+                # operator trigger starts (or a passed deadline stops)
+                # a capture here, between dispatches, never inside one.
+                flight.poll(epoch=epoch)
                 profiler.maybe_start_span(epoch, k)
                 # One span per dispatch unit: the trace's "trainer
                 # epochs" row. Parenting is EXPLICIT (not thread-stack):
@@ -1450,7 +1476,10 @@ class Trainer:
             # running (each guarded so one cleanup failing cannot abandon
             # the others).
             try:
-                profiler.close()
+                try:
+                    flight.close()
+                finally:
+                    profiler.close()
             finally:
                 try:
                     state_ckptr.wait()
@@ -1586,10 +1615,31 @@ class Trainer:
             # cache="hit" windows were deserialized executables, not XLA
             # compiles — the label a warm-relaunch e2e asserts on.
             cache_states=aot_store.states,
+            # Roofline provenance: analytic FLOPs / bytes / peak HBM
+            # captured at compile time ride the window record.
+            costs=aot_store.costs,
         )
         if self.coordinator:
             for w in compile_windows:
                 events.emit("compile", "compile.window", **w)
+        # Roofline join (observability.roofline): the cost-model numbers
+        # against the ledger's measured steady-state dispatch windows —
+        # live per-program MFU, arithmetic intensity, and the compute-
+        # vs-memory-bound placement, as roofline.report events and the
+        # dct_program_* gauges in the metrics dump below.
+        from dct_tpu.observability.roofline import program_report
+
+        roofline_rep = program_report(
+            aot_store.costs,
+            ledger.dispatch_stats,
+            n_chips=self.mesh.size,
+            family=cfg.model.name,
+            config_hash=config_hash(_dataclasses.asdict(cfg.model)),
+            mesh=mesh_descriptor(self.mesh),
+        )
+        if self.coordinator:
+            for r in roofline_rep:
+                events.emit("roofline", "roofline.report", **r)
         # An explicit DCT_METRICS_PROM must work even with the event log
         # disabled (textfile-collector-only rigs clear DCT_EVENTS_DIR).
         if self.coordinator and cfg.obs.enabled and (
@@ -1613,6 +1663,7 @@ class Trainer:
                     "startup_debt_s": cfg.resilience.startup_debt_s,
                 },
                 compile_windows=compile_windows,
+                roofline=roofline_rep,
                 # Metrics plane: leave a final snapshot so a /metrics
                 # scrape of the serving pool reports this run's goodput
                 # and compile debt next to the request series.

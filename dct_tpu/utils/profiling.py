@@ -55,19 +55,44 @@ class Profiler:
     def maybe_start(self, epoch: int) -> None:
         if not self.enabled or self._active or epoch != self.epoch:
             return
-        import jax.profiler
+        # One jax.profiler session per process: the planned window
+        # shares the flight recorder's gate (observability/capture.py).
+        # If an on-demand capture is mid-flight when the target epoch
+        # arrives, the planned trace is SKIPPED with a note — a second
+        # start_trace would raise and fail the run.
+        from dct_tpu.observability.capture import _SESSION_LOCK
 
-        os.makedirs(self.trace_dir, exist_ok=True)
-        jax.profiler.start_trace(self.trace_dir)
+        if not _SESSION_LOCK.acquire(blocking=False):
+            import sys
+
+            print(
+                f"[dct_tpu] planned profile of epoch {self.epoch} "
+                "skipped: an on-demand capture is already running",
+                file=sys.stderr, flush=True,
+            )
+            return
+        try:
+            import jax.profiler
+
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception:
+            _SESSION_LOCK.release()
+            raise
         self._active = True
 
     def maybe_stop(self, epoch: int) -> None:
         if not self._active or epoch != self.epoch:
             return
+        from dct_tpu.observability.capture import _SESSION_LOCK
+
         import jax.profiler
 
-        jax.profiler.stop_trace()
-        self._active = False
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._active = False
+            _SESSION_LOCK.release()
 
     def maybe_start_span(self, epoch: int, k: int) -> None:
         """Span form for epoch-chunked loops: the target epoch fires the
@@ -86,10 +111,15 @@ class Profiler:
         """Stop tracing unconditionally (crash-path hygiene: an abandoned
         trace session would corrupt the output directory)."""
         if self._active:
+            from dct_tpu.observability.capture import _SESSION_LOCK
+
             import jax.profiler
 
-            jax.profiler.stop_trace()
-            self._active = False
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._active = False
+                _SESSION_LOCK.release()
 
 
 def chip_peak_flops() -> float | None:

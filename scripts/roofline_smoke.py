@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""Roofline + flight-recorder smoke (tier1.yml job, ISSUE 14).
+
+One live trainer session on CPU, end to end:
+
+1. a REAL ``Trainer.fit`` run with the metrics plane armed and a
+   pre-written ``DCT_PROFILE_TRIGGER`` file — the flight recorder must
+   capture a TensorBoard-loadable ``plugins/profile`` trace at a span
+   boundary, mid-run, without failing the fit;
+2. ``profile.capture_start`` / ``capture_end`` and ``roofline.report``
+   events on the run's event log, with cost-model FLOPs > 0;
+3. ONE aggregated ``/metrics``-style scrape of the metrics dir must
+   carry the run's ``dct_program_flops`` AND a live ``dct_program_mfu``
+   gauge (peak pinned via ``DCT_PEAK_TFLOPS`` — the CPU rig has no
+   device-table entry);
+4. the trigger fired exactly once (fire-once-per-mtime semantics).
+
+Exit 0 = all gates hold; nonzero with the evidence printed otherwise.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import tempfile
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory() as work:
+        os.environ.update({
+            "DCT_EVENTS_DIR": os.path.join(work, "events"),
+            "DCT_HEARTBEAT_DIR": os.path.join(work, "hb"),
+            "DCT_SPANS_DIR": os.path.join(work, "spans"),
+            "DCT_METRICS_DIR": os.path.join(work, "metrics"),
+            "DCT_TRACE_DIR": os.path.join(work, "traces"),
+            "DCT_PROFILE_TRIGGER": os.path.join(work, "trigger"),
+            "DCT_PROF_CAPTURE_S": "0.05",
+            # The CPU rig has no device-table peak: pin one so the MFU
+            # gauge materializes (any positive value works — the smoke
+            # gates presence, the sentinel gates trajectory).
+            "DCT_PEAK_TFLOPS": "0.05",
+        })
+        from dct_tpu.config import RunConfig
+        from dct_tpu.data.synthetic import generate_weather_csv
+        from dct_tpu.etl.preprocess import preprocess_csv_to_parquet
+        from dct_tpu.observability import aggregate
+        from dct_tpu.tracking.client import LocalTracking
+        from dct_tpu.train.trainer import Trainer
+
+        csv = os.path.join(work, "raw", "weather.csv")
+        generate_weather_csv(csv, rows=600, seed=0)
+        processed = os.path.join(work, "processed")
+        preprocess_csv_to_parquet(csv, processed)
+        # Trigger armed BEFORE the run: the recorder consumes it at the
+        # first span boundary — an on-demand capture of a live trainer.
+        with open(os.environ["DCT_PROFILE_TRIGGER"], "w") as f:
+            f.write("0.05")
+
+        cfg = RunConfig.from_env()
+        cfg.data.processed_dir = processed
+        cfg.data.models_dir = os.path.join(work, "models")
+        cfg.train.epochs = 5
+        cfg.train.batch_size = 16
+        tracker = LocalTracking(
+            root=os.path.join(work, "runs"), experiment="smoke"
+        )
+        res = Trainer(cfg, tracker=tracker).fit()
+        print(f"fit done: val_loss={res.val_loss:.4f} "
+              f"epochs={len(res.history)}")
+
+        # 1. TensorBoard-loadable capture dir.
+        traces = glob.glob(os.path.join(
+            work, "traces", "capture-*", "plugins", "profile", "*"
+        ))
+        print("capture dirs:", traces)
+        if not traces:
+            failures.append("no plugins/profile capture dir produced")
+
+        # 2. Events.
+        with open(os.path.join(work, "events", "events.jsonl")) as f:
+            events = [json.loads(line) for line in f]
+        names = [e["event"] for e in events]
+        starts = names.count("profile.capture_start")
+        if starts != 1:
+            failures.append(
+                f"expected exactly 1 capture_start, saw {starts}"
+            )
+        if "profile.capture_end" not in names:
+            failures.append("no profile.capture_end event")
+        roof = [e for e in events if e["event"] == "roofline.report"]
+        if not roof or not roof[0].get("flops"):
+            failures.append(f"no roofline.report with flops: {roof}")
+        else:
+            print("roofline.report:", json.dumps(roof[0]))
+
+        # 3. One aggregated scrape: flops + live MFU gauges.
+        text, _merged = aggregate.aggregate_text(
+            os.path.join(work, "metrics")
+        )
+        flops_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("dct_program_flops{") and "proc=" not in ln
+        ]
+        mfu_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("dct_program_mfu{") and "proc=" not in ln
+        ]
+        print("scrape flops:", flops_lines)
+        print("scrape mfu:", mfu_lines)
+        if not flops_lines:
+            failures.append("no dct_program_flops on the aggregated scrape")
+        if not mfu_lines:
+            failures.append("no dct_program_mfu on the aggregated scrape")
+
+    if failures:
+        print("ROOFLINE SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("roofline smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,7 +83,12 @@ def test_miss_publishes_artifact_then_fresh_store_hits(tmp_path):
     prog2 = store2.wrap(_jit_fn(), program="p")
     out_hit = np.asarray(prog2(*ARGS))
     assert store2.states == {"p": "hit"}
-    assert [e["event"] for e in events2] == ["compile.cache_hit"]
+    # The hit reads the roofline provenance off the artifact header
+    # (ISSUE 14) before announcing the hit.
+    assert [e["event"] for e in events2] == [
+        "roofline.program", "compile.cache_hit",
+    ]
+    assert store2.costs["p"]["source"] == "header"
     np.testing.assert_array_equal(out_miss, out_hit)
     # Steady state: the in-memory entry dispatches without re-loading.
     np.testing.assert_array_equal(np.asarray(prog2(*ARGS)), out_hit)
