@@ -735,6 +735,40 @@ class ServingConfig:
     loadgen_duration_s: float = 2.0
     loadgen_requests: int = 300
     loadgen_concurrency: str = "1,4,16"
+    # --- elasticity (docs/SERVING.md §elasticity) ---------------------
+    # Admission control: bounded queues + priority shedding. Off by
+    # default — a library-built server keeps PR 7 semantics unless the
+    # operator arms the control loop.
+    admit: bool = False
+    # Request header carrying the priority class (high|normal|low).
+    priority_header: str = "x-dct-priority"
+    # Queue budget in ROWS: low sheds at 50%, normal at 80%, high at
+    # the cap (admission.CLASS_BUDGET_FRACTIONS).
+    admit_max_queue: int = 256
+    # Queue-wait budget (ms) estimated from the batcher's recent
+    # service rate; 0 disables the wait leg (depth-only shedding).
+    admit_wait_ms: float = 500.0
+    # Base Retry-After for shed 429s; consecutive sheds of a class
+    # escalate it exponentially with jitter (the PR 3 retry curve).
+    retry_after_s: float = 0.25
+    # Closed-loop autoscaler: scales ServerPool PROCESSES (pool mode)
+    # or batcher WORKER threads (in-process) between min/max off the
+    # queue-depth / SLO-burn / shed signals.
+    autoscale: bool = False
+    scale_min: int = 1
+    scale_max: int = 4
+    # Queue-rows thresholds: sustained >= up scales out, <= down scales
+    # in (between them the controller holds).
+    scale_up_queue: float = 32.0
+    scale_down_queue: float = 2.0
+    scale_poll_s: float = 1.0
+    # Consecutive agreeing polls before a scale step (anti-flap).
+    scale_hysteresis: int = 2
+    # Seconds after any scale event before the next may fire.
+    scale_cooldown_s: float = 5.0
+    # Self-healing pool: respawn budget before the circuit breaks and
+    # the pool exits nonzero (exponential backoff between respawns).
+    max_restarts: int = 3
 
     @classmethod
     def from_env(cls) -> "ServingConfig":
@@ -758,6 +792,40 @@ class ServingConfig:
         )
         c.loadgen_concurrency = _env(
             "DCT_SERVE_LOADGEN_CONCURRENCY", c.loadgen_concurrency, str
+        )
+        c.admit = _env("DCT_SERVE_ADMIT", c.admit, bool)
+        c.priority_header = _env(
+            "DCT_SERVE_PRIORITY_HEADER", c.priority_header, str
+        ).strip().lower()
+        c.admit_max_queue = _env(
+            "DCT_SERVE_ADMIT_MAX_QUEUE", c.admit_max_queue, int
+        )
+        c.admit_wait_ms = _env(
+            "DCT_SERVE_ADMIT_WAIT_MS", c.admit_wait_ms, float
+        )
+        c.retry_after_s = _env(
+            "DCT_SERVE_RETRY_AFTER_S", c.retry_after_s, float
+        )
+        c.autoscale = _env("DCT_SERVE_AUTOSCALE", c.autoscale, bool)
+        c.scale_min = _env("DCT_SERVE_SCALE_MIN", c.scale_min, int)
+        c.scale_max = _env("DCT_SERVE_SCALE_MAX", c.scale_max, int)
+        c.scale_up_queue = _env(
+            "DCT_SERVE_SCALE_UP_Q", c.scale_up_queue, float
+        )
+        c.scale_down_queue = _env(
+            "DCT_SERVE_SCALE_DOWN_Q", c.scale_down_queue, float
+        )
+        c.scale_poll_s = _env(
+            "DCT_SERVE_SCALE_POLL_S", c.scale_poll_s, float
+        )
+        c.scale_hysteresis = _env(
+            "DCT_SERVE_SCALE_HYSTERESIS", c.scale_hysteresis, int
+        )
+        c.scale_cooldown_s = _env(
+            "DCT_SERVE_SCALE_COOLDOWN_S", c.scale_cooldown_s, float
+        )
+        c.max_restarts = _env(
+            "DCT_SERVE_MAX_RESTARTS", c.max_restarts, int
         )
         return c
 
@@ -1186,6 +1254,22 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_SERVE_LOADGEN_DURATION_S": "loadgen per-level wall budget (s)",
     "DCT_SERVE_LOADGEN_REQUESTS": "loadgen requests per concurrency level",
     "DCT_SERVE_LOADGEN_CONCURRENCY": "loadgen sweep levels (comma-separated)",
+    # Elastic serving controls (docs/SERVING.md §elasticity).
+    "DCT_SERVE_ADMIT": "priority admission control on/off",
+    "DCT_SERVE_PRIORITY_HEADER": "request header carrying high|normal|low",
+    "DCT_SERVE_ADMIT_MAX_QUEUE": "admission queue budget in rows",
+    "DCT_SERVE_ADMIT_WAIT_MS": "admission queue-wait budget (ms; 0 = off)",
+    "DCT_SERVE_RETRY_AFTER_S": "base Retry-After for shed 429s",
+    "DCT_SERVE_AUTOSCALE": "closed-loop capacity autoscaler on/off",
+    "DCT_SERVE_SCALE_MIN": "autoscaler floor (procs or workers)",
+    "DCT_SERVE_SCALE_MAX": "autoscaler ceiling (procs or workers)",
+    "DCT_SERVE_SCALE_UP_Q": "queue rows that vote scale-up",
+    "DCT_SERVE_SCALE_DOWN_Q": "queue rows that vote scale-down",
+    "DCT_SERVE_SCALE_POLL_S": "autoscaler poll interval (s)",
+    "DCT_SERVE_SCALE_HYSTERESIS": "consecutive agreeing polls per scale step",
+    "DCT_SERVE_SCALE_COOLDOWN_S": "min seconds between scale events",
+    "DCT_SERVE_MAX_RESTARTS": "pool respawn budget before circuit-break",
+    "DCT_SERVE_PROC_INDEX": "pool-exported child index (set by ServerPool)",
     # --- platform probing / caches / native ------------------------
     "DCT_REQUIRE_TPU": "fail fast when no TPU backend is available",
     "DCT_BACKEND_PROBE_TIMEOUT": "backend liveness probe timeout (s)",
@@ -1216,6 +1300,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_BENCH_TENANTS": "bench multi_tenant (2-tenant scheduler) leg on/off",
     "DCT_BENCH_MPMD": "bench mpmd_pipeline (MPMD-1F1B vs SPMD-GPipe bubble) leg on/off",
     "DCT_BENCH_ROOFLINE": "bench roofline (local cost-model MFU) leg on/off",
+    "DCT_BENCH_ELASTIC": "bench elastic_serving (overload controls A/B) leg on/off",
     "DCT_BENCH_DEADLINE": "bench wall-clock deadline (s); legs self-gate",
     "DCT_BENCH_PARTIAL": "path for the partial-results stash",
     "DCT_VAL_PARITY_EPOCHS": "val-loss parity leg epoch budget",

@@ -222,6 +222,11 @@ def build_report(
         # roofline.* stays off it — run-end batch records the Roofline
         # section below summarizes.
         "profile.",
+        # Elastic serving (docs/SERVING.md §elasticity): pool deaths /
+        # respawns / circuit-breaks, scale steps and (throttled) shed
+        # episodes are rare and load-bearing — unlike per-flush
+        # serve.batch_* they belong on the landmark timeline.
+        "serve.pool_", "autoscale.", "admission.",
     )
     shown = 0
     for r in ev:
@@ -377,6 +382,25 @@ def build_report(
         lines.append(
             "  (no serve.* events — traffic untraced or none served; "
             "serving telemetry is opt-in via DCT_SERVE_TRACE)"
+        )
+    sheds = [r for r in ev if r.get("event") == "admission.shed"]
+    scales = [
+        r for r in ev
+        if str(r.get("event", "")).startswith("autoscale.scale_")
+    ]
+    heals = [
+        r for r in ev if r.get("event") == "serve.pool_respawn"
+    ]
+    if sheds or scales or heals:
+        shed_total = sum(int(r.get("count") or 0) for r in sheds)
+        ups = sum(
+            1 for r in scales if r.get("event") == "autoscale.scale_up"
+        )
+        lines.append(
+            f"  elasticity: {shed_total} shed "
+            f"({len(sheds)} admission.shed records), "
+            f"{ups} scale-up / {len(scales) - ups} scale-down, "
+            f"{len(heals)} respawned workers"
         )
 
     # -- always-on loop -----------------------------------------------

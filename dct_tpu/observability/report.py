@@ -85,6 +85,15 @@ SERIES = (
     ("program_mfu", ("roofline", "mfu"), "up"),
     ("transfer_wait_frac",
      ("mpmd_pipeline", "mpmd_transfer_wait_frac"), "down"),
+    # Elastic serving (the elastic_serving bench leg): p99 of ADMITTED
+    # traffic during the 4x overload spike with the controls armed —
+    # gated like a latency (a >25% rise means the admission budget or
+    # the autoscaler's time-to-capacity regressed) — and the fraction
+    # of the spike's offered load shed to keep it bounded (a >25% rise
+    # means capacity or scale-up responsiveness dropped, pushing more
+    # of the burden onto shedding).
+    ("overload_p99_s", ("elastic_serving", "overload_p99_s"), "down"),
+    ("shed_fraction", ("elastic_serving", "shed_fraction"), "down"),
 )
 
 

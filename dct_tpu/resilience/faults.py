@@ -9,8 +9,12 @@ and a chaos job in CI.
 Spec grammar (comma-separated clauses)::
 
     DCT_FAULT_SPEC = clause[,clause...]
-    clause         = ACTION[@rankR][:TRIGGER]
-    TRIGGER        = epochN | stepN | saveN
+    clause         = ACTION[@rankR|@procR][:TRIGGER]
+    TRIGGER        = epochN | stepN | saveN | reqN | msM
+
+``@procR`` is the serving spelling of ``@rankR`` (a ServerPool child's
+pool index rides the same rank slot — the pool exports it as
+``DCT_PROCESS_ID`` into each forked worker).
 
 Actions and the hook points that consult them:
 
@@ -38,14 +42,32 @@ crash_save   save       ``os._exit`` inside the same window — the torn
                         save itself: only ``*.tmp`` debris may remain.
 slow_epoch   epoch      sleep ``DCT_FAULT_SLEEP_S`` at epoch start — makes
                         "SIGTERM arrives mid-epoch" deterministic in tests.
+crash_worker score      ``os._exit(FAULT_CRASH_EXIT)`` inside the serving
+                        micro-batcher's flush path — a serving worker
+                        process dying mid-traffic, the case the ServerPool's
+                        self-healing respawn exists for (docs/SERVING.md).
+                        ``:reqN`` fires at the Nth scored logical request
+                        (default: the first). NOTE: in a no-fork in-process
+                        server this kills the host process — arm it only
+                        against forked pools.
+slow_score   score      sleep inside every flush — deterministic overload
+                        (the knee moves wherever the test wants it).
+                        ``:msM`` sets the per-flush sleep in milliseconds
+                        (default ``DCT_FAULT_SLEEP_S``). Unlike every other
+                        action this clause REPEATS: it fires on every
+                        flush, with ``fault.injected`` emitted only once.
 ===========  =========  ====================================================
 
 Trigger semantics: ``epochN`` fires when epoch index N starts; ``stepN``
 fires at the first step hook with global step >= N; ``saveN`` fires on
 the Nth call of the save hook in this process (both checkpoint tiers
-share the counter); no trigger = the first opportunity. ``@rankR``
-restricts the clause to one rank (default: every rank). Each clause
-fires at most once per process.
+share the counter); ``reqN`` fires at the first score hook with
+cumulative scored-request count >= N; ``msM`` is a PARAMETER, not a
+trigger (the ``slow_score`` sleep in milliseconds); no trigger = the
+first opportunity. ``@rankR``/``@procR`` restricts the clause to one
+rank / pool worker (default: every one). Each clause fires at most once
+per process — except ``slow_score``, which repeats by design (it models
+a persistently slow scorer, not a one-shot glitch).
 
 Like the rest of the observability plane, the default plan is resolved
 lazily from the environment (:func:`get_default`) so layers without
@@ -76,12 +98,17 @@ _ACTION_POINTS = {
     "slow_save": ("save",),
     "crash_save": ("save",),
     "slow_epoch": ("epoch",),
+    "crash_worker": ("score",),
+    "slow_score": ("score",),
 }
+
+#: Actions that fire on EVERY matching hook call instead of once.
+_REPEATING_ACTIONS = ("slow_score",)
 
 _CLAUSE_RE = re.compile(
     r"^(?P<action>[a-z_]+)"
-    r"(?:@rank(?P<rank>\d+))?"
-    r"(?::(?P<trigger>epoch|step|save)(?P<at>\d+))?$"
+    r"(?:@(?:rank|proc)(?P<rank>\d+))?"
+    r"(?::(?P<trigger>epoch|step|save|req|ms)(?P<at>\d+))?$"
 )
 
 
@@ -94,20 +121,27 @@ class FaultClause:
     raw: str = ""
     fired: bool = False
 
+    @property
+    def repeats(self) -> bool:
+        return self.action in _REPEATING_ACTIONS
+
     def matches(self, point: str, rank: int | None, coords: dict) -> bool:
-        if self.fired or point not in _ACTION_POINTS[self.action]:
+        if point not in _ACTION_POINTS[self.action]:
+            return False
+        if self.fired and not self.repeats:
             return False
         if self.rank is not None and rank is not None and self.rank != rank:
             return False
-        if self.trigger is None:
+        if self.trigger is None or self.trigger == "ms":
+            # ``ms`` is the slow_score sleep parameter, not a trigger.
             return True
         value = coords.get(self.trigger)
         if value is None:
             return False
-        # step triggers fire on REACHING the step (the exact value may
-        # be skipped by accumulation/span granularity); epoch and save
-        # ordinals are exact.
-        if self.trigger == "step":
+        # step/req triggers fire on REACHING the count (the exact value
+        # may be skipped by accumulation/batch granularity); epoch and
+        # save ordinals are exact.
+        if self.trigger in ("step", "req"):
             return int(value) >= self.at
         return int(value) == self.at
 
@@ -146,8 +180,19 @@ class FaultPlan:
             if m is None or m.group("action") not in _ACTION_POINTS:
                 raise ValueError(
                     f"Unparseable fault clause {part!r}; grammar: "
-                    "ACTION[@rankR][:epochN|stepN|saveN] with ACTION in "
-                    f"{sorted(_ACTION_POINTS)}"
+                    "ACTION[@rankR|@procR][:epochN|stepN|saveN|reqN|msM] "
+                    f"with ACTION in {sorted(_ACTION_POINTS)}"
+                )
+            action, trigger = m.group("action"), m.group("trigger")
+            if trigger == "ms" and action != "slow_score":
+                raise ValueError(
+                    f"Fault clause {part!r}: :msM is the slow_score "
+                    "sleep parameter, not a trigger"
+                )
+            if trigger == "req" and "score" not in _ACTION_POINTS[action]:
+                raise ValueError(
+                    f"Fault clause {part!r}: :reqN only triggers "
+                    "serving-side (score-point) actions"
                 )
             clauses.append(
                 FaultClause(
@@ -186,19 +231,33 @@ class FaultPlan:
         if point == "save":
             self._counts["save"] = self._counts.get("save", 0) + 1
             coords.setdefault("save", self._counts["save"])
-        for clause in self.clauses:
-            if clause.matches(point, self.rank, coords):
-                clause.fired = True
-                # On the record BEFORE the fault acts: a crash must not
-                # be able to outrun its own evidence.
-                _events.get_default().emit(
-                    "fault", "fault.injected",
-                    action=clause.action, point=point, spec=clause.raw,
-                    injected_rank=self.rank,
-                    **{k: v for k, v in coords.items() if v is not None},
-                )
-                return clause
-        return None
+        # A repeating clause (slow_score) matches EVERY call at its
+        # point: first-match-wins would permanently shadow any one-shot
+        # clause listed after it ("slow_score,crash_worker:req50" would
+        # never crash). One-shot matches therefore take priority; the
+        # repeating clause covers every call they don't claim.
+        matched = [
+            c for c in self.clauses
+            if c.matches(point, self.rank, coords)
+        ]
+        if not matched:
+            return None
+        one_shot = [c for c in matched if not c.repeats]
+        clause = (one_shot or matched)[0]
+        already_fired = clause.fired
+        clause.fired = True
+        # On the record BEFORE the fault acts: a crash must not be able
+        # to outrun its own evidence. Repeating clauses (slow_score)
+        # emit once — a per-flush disk append would itself distort the
+        # overload they model.
+        if not already_fired:
+            _events.get_default().emit(
+                "fault", "fault.injected",
+                action=clause.action, point=point, spec=clause.raw,
+                injected_rank=self.rank,
+                **{k: v for k, v in coords.items() if v is not None},
+            )
+        return clause
 
     def maybe_fire(self, point: str, *, pre_exit=None, **coords):
         """``check`` + execute. ``pre_exit`` runs before a ``crash``
@@ -208,7 +267,7 @@ class FaultPlan:
         clause = self.check(point, **coords)
         if clause is None:
             return None
-        if clause.action in ("crash", "crash_save", "hang"):
+        if clause.action in ("crash", "crash_save", "crash_worker", "hang"):
             # ``os._exit`` skips atexit and a hang never reaches it:
             # drain buffered telemetry NOW so the fault.injected record
             # (and every record before it) survives the fault it
@@ -228,11 +287,19 @@ class FaultPlan:
                 except Exception:  # noqa: BLE001 — exit anyway: it's a crash
                     pass
             os._exit(FAULT_CRASH_EXIT)
-        if clause.action == "crash_save":
+        if clause.action in ("crash_save", "crash_worker"):
             os._exit(FAULT_CRASH_EXIT)
         if clause.action == "hang":
             while True:  # PID-alive, progress-dead: the monitor's case
                 self._sleep(60.0)
+        if clause.action == "slow_score":
+            # :msM parameterizes the per-flush sleep; default falls back
+            # to the plan-wide DCT_FAULT_SLEEP_S like the other sleeps.
+            self._sleep(
+                clause.at / 1e3 if clause.trigger == "ms" and clause.at
+                else self.sleep_s
+            )
+            return None
         if clause.action in ("slow_save", "slow_epoch"):
             self._sleep(self.sleep_s)
             return None

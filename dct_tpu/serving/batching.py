@@ -261,15 +261,15 @@ class MicroBatcher:
         self._closed = False
         self._jax_scorers: dict = {}
         self.flushes = 0  # lifetime flush count (tests/diagnostics)
-        self._threads = [
-            threading.Thread(
-                target=self._worker, name=f"dct-serve-worker-{i}",
-                daemon=True,
-            )
-            for i in range(max(0, int(workers)))
-        ]
-        for t in self._threads:
-            t.start()
+        self.scored_requests = 0  # lifetime logical requests scored
+        self._shrink = 0  # workers asked to exit (set_workers)
+        self._spawned = 0  # lifetime worker-thread ordinal (names)
+        # (t_done, rows) of recent flush completions — the service-rate
+        # window the admission controller's queue-wait estimate reads.
+        self._done: deque = deque(maxlen=256)
+        self._threads: list[threading.Thread] = []
+        for _ in range(max(0, int(workers))):
+            self._spawn_worker()
 
     # -- request side ---------------------------------------------------
 
@@ -302,10 +302,77 @@ class MicroBatcher:
 
     def _score_one(self, weights: dict, meta: dict,
                    x: np.ndarray) -> np.ndarray:
+        with self._cond:
+            self.scored_requests += 1
+            seq = self.scored_requests
+        self._fire_score_faults(seq)
         probs = self._dispatch(weights, meta, [x])[0]
         if not np.isfinite(probs).all():
             raise ScoringError("non-finite probabilities")
         return probs
+
+    # -- saturation introspection (admission control / autoscaling) -----
+
+    def queued_rows(self) -> int:
+        """Rows currently queued behind in-flight flushes — the
+        admission controller's primary overload signal."""
+        with self._cond:
+            return sum(g.rows for g in self._groups.values())
+
+    #: Flush completions older than this stop informing the rate.
+    _RATE_WINDOW_S = 10.0
+
+    def service_rate(self) -> float | None:
+        """Recent rows/second over all workers (None until at least two
+        flush completions land inside the window — no evidence must not
+        read as zero capacity)."""
+        now = time.monotonic()
+        with self._cond:
+            while self._done and now - self._done[0][0] > self._RATE_WINDOW_S:
+                self._done.popleft()
+            if len(self._done) < 2:
+                return None
+            rows = sum(r for _, r in self._done)
+            span = now - self._done[0][0]
+        if span <= 0:
+            return None
+        return rows / span
+
+    def estimated_wait_s(self) -> float | None:
+        """Queue-wait estimate: queued rows over the recent service
+        rate. None when there is no rate evidence yet."""
+        return self.saturation()[1]
+
+    def saturation(self) -> tuple:
+        """(queued_rows, est_wait_s|None) in ONE lock pass — the
+        admission gate's per-request read. A self-consistent snapshot
+        (depth and the rate window observed together), and one
+        acquisition of the contended condition instead of three on the
+        exact path that runs hottest during overload."""
+        now = time.monotonic()
+        with self._cond:
+            queued = sum(g.rows for g in self._groups.values())
+            while self._done and now - self._done[0][0] > self._RATE_WINDOW_S:
+                self._done.popleft()
+            if len(self._done) < 2:
+                return queued, None
+            rows = sum(r for _, r in self._done)
+            span = now - self._done[0][0]
+        if span <= 0 or rows <= 0:
+            return queued, None
+        return queued, queued / (rows / span)
+
+    def _fire_score_faults(self, seq: int) -> None:
+        """The serving-side ``DCT_FAULT_SPEC`` hook point (``score``):
+        ``crash_worker`` kills this process mid-traffic (the ServerPool
+        respawn drill), ``slow_score`` sleeps per flush (deterministic
+        overload). Consulted only while a plan is armed — the unarmed
+        check is one attribute read."""
+        from dct_tpu.resilience import faults as _faults
+
+        plan = _faults.get_default()
+        if plan.enabled:
+            plan.maybe_fire("score", req=seq)
 
     # -- worker side ----------------------------------------------------
 
@@ -371,11 +438,57 @@ class MicroBatcher:
                 pass
         return g.weights, g.meta, take
 
+    def _spawn_worker(self) -> None:
+        t = threading.Thread(
+            target=self._worker,
+            name=f"dct-serve-worker-{self._spawned}", daemon=True,
+        )
+        self._spawned += 1
+        self._threads.append(t)
+        t.start()
+
+    @property
+    def workers(self) -> int:
+        """Target worker count (live threads minus pending shrinks)."""
+        with self._cond:
+            return max(0, len(self._threads) - self._shrink)
+
+    def set_workers(self, n: int) -> None:
+        """Scale the scoring pool to ``n`` threads — the autoscaler's
+        in-process capacity axis. Scale-down is cooperative: surplus
+        workers exit at their next loop visit (never mid-flush), so
+        in-flight requests finish normally."""
+        n = max(0, int(n))
+        with self._cond:
+            if self._closed:
+                return
+            current = len(self._threads) - self._shrink
+            if n < current:
+                self._shrink += current - n
+                self._cond.notify_all()
+                return
+            spawn = n - current
+        for _ in range(max(0, spawn)):
+            with self._cond:
+                if self._shrink > 0:  # an unserved shrink cancels out
+                    self._shrink -= 1
+                    continue
+            self._spawn_worker()
+
     def _worker(self) -> None:
         while True:
             batch = None
             with self._cond:
                 while batch is None:
+                    if self._shrink > 0:
+                        # A scale-down claimed this worker: leave the
+                        # pool between flushes.
+                        self._shrink -= 1
+                        try:
+                            self._threads.remove(threading.current_thread())
+                        except ValueError:
+                            pass
+                        return
                     if self._closed and not self._groups:
                         return
                     now = time.monotonic()
@@ -418,6 +531,10 @@ class MicroBatcher:
         waited_ms = round(
             (time.monotonic() - min(req.t for req in items)) * 1e3, 3
         )
+        with self._cond:
+            self.scored_requests += len(items)
+            seq = self.scored_requests
+        self._fire_score_faults(seq)
         try:
             results = self._dispatch(weights, meta, [r.x for r in items])
             for req, probs in zip(items, results):
@@ -443,6 +560,11 @@ class MicroBatcher:
         finally:
             for req in items:
                 req.done.set()
+            with self._cond:
+                # Completion record AFTER any injected slow_score sleep,
+                # so the service-rate window prices the real (possibly
+                # degraded) capacity the queue-wait estimate divides by.
+                self._done.append((time.monotonic(), rows))
         if self.metrics is not None:
             try:
                 self.metrics.observe_batch(rows, len(items), queue_depth)

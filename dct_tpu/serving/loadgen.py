@@ -36,16 +36,29 @@ import numpy as np
 
 
 class _Collector:
-    """Thread-safe latency/error sink shared by generator threads."""
+    """Thread-safe latency/error/shed sink shared by generator threads.
+
+    Admitted and shed traffic are SEPARATE populations: a 429 from
+    admission control (docs/SERVING.md §elasticity) is neither a
+    success nor an error — folding its (deliberately fast) turnaround
+    into the latency list would flatter p50/p99, and counting it as an
+    error would page on behavior the server chose. ``latencies`` holds
+    admitted (200) requests only; ``shed_latencies`` the 429
+    turnarounds; ``errors`` everything actually broken."""
 
     def __init__(self):
         self.lock = threading.Lock()
         self.latencies: list[float] = []
+        self.shed_latencies: list[float] = []
         self.errors = 0
 
     def ok(self, seconds: float) -> None:
         with self.lock:
             self.latencies.append(seconds)
+
+    def shed(self, seconds: float) -> None:
+        with self.lock:
+            self.shed_latencies.append(seconds)
 
     def fail(self) -> None:
         with self.lock:
@@ -58,9 +71,12 @@ class _Client:
     serving tier)."""
 
     def __init__(self, host: str, port: int, path: str = "/score",
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, headers: dict | None = None):
         self.host, self.port, self.path = host, port, path
         self.timeout = timeout
+        self.headers = dict(headers or {})
+        #: Retry-After (seconds) off the most recent response, or None.
+        self.last_retry_after: float | None = None
         self._conn: http.client.HTTPConnection | None = None
 
     def _connect(self) -> http.client.HTTPConnection:
@@ -88,20 +104,45 @@ class _Client:
             self._conn = None
 
     def post(self, body: bytes) -> tuple[int, bytes]:
-        """(status, body); raises on transport failure after one
-        reconnect attempt (keep-alive connections drop legitimately)."""
-        for attempt in (0, 1):
+        """(status, body); raises on transport failure after two
+        reconnect attempts. Keep-alive connections drop legitimately,
+        and a dying SO_REUSEPORT pool worker RSTs both its in-flight
+        responses AND connections still in its accept queue — an
+        IMMEDIATE reconnect can race that teardown window onto the same
+        dying socket, so the second retry backs off a beat before
+        dialing (by then the kernel routes to a surviving sibling)."""
+        for attempt in (0, 1, 2):
+            if attempt > 1:
+                time.sleep(0.05)
             conn = self._connect()
             try:
                 conn.request(
                     "POST", self.path, body,
-                    {"Content-Type": "application/json"},
+                    {"Content-Type": "application/json", **self.headers},
                 )
                 resp = conn.getresponse()
-                return resp.status, resp.read()
+                body_out = resp.read()
+                self.last_retry_after = None
+                if resp.status == 429:
+                    # Prefer the precise jittered value in the JSON
+                    # body (the header is RFC delta-seconds — integer,
+                    # coarse); fall back to the header.
+                    try:
+                        self.last_retry_after = float(
+                            json.loads(body_out)["retry_after_s"]
+                        )
+                    except (ValueError, KeyError, TypeError):
+                        ra = resp.getheader("Retry-After")
+                        try:
+                            self.last_retry_after = (
+                                float(ra) if ra is not None else None
+                            )
+                        except ValueError:
+                            pass
+                return resp.status, body_out
             except (http.client.HTTPException, OSError):
                 self.close()
-                if attempt:
+                if attempt >= 2:
                     raise
         raise RuntimeError("unreachable")
 
@@ -127,6 +168,14 @@ def _result(mode: str, concurrency: int, col: _Collector,
         "p50_ms": _percentile_ms(col.latencies, 0.50),
         "p99_ms": _percentile_ms(col.latencies, 0.99),
     }
+    shed = len(col.shed_latencies)
+    if shed:
+        # Admitted-vs-shed reported separately (the percentiles above
+        # cover ADMITTED traffic only); keys appear only when admission
+        # control actually fired, so unshedded sweeps stay byte-stable.
+        out["shed"] = shed
+        out["shed_fraction"] = round(shed / max(1, shed + n), 4)
+        out["shed_p50_ms"] = _percentile_ms(col.shed_latencies, 0.50)
     out.update(extra)
     return out
 
@@ -135,18 +184,28 @@ def run_closed_loop(
     host: str, port: int, body: bytes, *,
     concurrency: int, total_requests: int = 300,
     duration_s: float = 30.0, path: str = "/score",
+    headers: dict | None = None,
 ) -> dict:
     """``concurrency`` keep-alive clients ping-ponging until
-    ``total_requests`` land or ``duration_s`` elapses (whichever
-    first — the wall budget keeps a wedged server from wedging the
-    bench)."""
+    ``total_requests`` ADMITTED requests land or ``duration_s`` elapses
+    (whichever first — the wall budget keeps a wedged or persistently
+    overloaded server from wedging the bench).
+
+    A 429 from admission control is honored, not hammered: the client
+    backs off for the server's ``Retry-After`` (plus a small client-side
+    jitter so a shed herd de-synchronizes), re-credits the request
+    quota, and retries — the well-behaved-client contract the shed
+    shape exists for. Sheds are reported separately and never poison
+    the admitted percentiles (:class:`_Collector`)."""
+    import random
+
     col = _Collector()
     remaining = [max(1, int(total_requests))]
     quota_lock = threading.Lock()
     deadline = time.perf_counter() + duration_s
 
     def worker():
-        client = _Client(host, port, path)
+        client = _Client(host, port, path, headers=headers)
         try:
             while time.perf_counter() < deadline:
                 with quota_lock:
@@ -161,6 +220,17 @@ def run_closed_loop(
                     continue
                 if status == 200:
                     col.ok(time.perf_counter() - t0)
+                elif status == 429:
+                    col.shed(time.perf_counter() - t0)
+                    with quota_lock:
+                        remaining[0] += 1  # the admitted quota is unmet
+                    pause = (client.last_retry_after or 0.05) * (
+                        1.0 + 0.1 * random.random()
+                    )
+                    time.sleep(
+                        min(pause,
+                            max(0.0, deadline - time.perf_counter()))
+                    )
                 else:
                     col.fail()
         finally:
@@ -181,17 +251,20 @@ def run_closed_loop(
 def run_open_loop(
     host: str, port: int, body: bytes, *,
     qps: float, duration_s: float = 2.0, max_inflight: int = 64,
-    path: str = "/score",
+    path: str = "/score", headers: dict | None = None,
 ) -> dict:
     """Arrivals paced at ``qps`` for ``duration_s``; each request runs
     on a pooled keep-alive client. If the pool is saturated
     (``max_inflight``), the arrival counts as a drop (reported) rather
     than silently back-pressuring the clock — an open-loop generator
-    that waits is a closed loop in disguise."""
+    that waits is a closed loop in disguise. A 429 counts as SHED
+    offered load (separate from errors; open-loop arrivals do not
+    retry — the next arrival is already scheduled)."""
     col = _Collector()
     dropped = [0]
     pool: list[_Client] = [
-        _Client(host, port, path) for _ in range(max_inflight)
+        _Client(host, port, path, headers=headers)
+        for _ in range(max_inflight)
     ]
     free = list(range(max_inflight))
     free_lock = threading.Lock()
@@ -203,6 +276,8 @@ def run_open_loop(
             status, _ = pool[idx].post(body)
             if status == 200:
                 col.ok(time.perf_counter() - t0)
+            elif status == 429:
+                col.shed(time.perf_counter() - t0)
             else:
                 col.fail()
         except Exception:  # noqa: BLE001

@@ -109,7 +109,8 @@ class _JsonHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     disable_nagle_algorithm = True
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict,
+               headers: dict | None = None) -> None:
         try:
             # Strict JSON: a bare NaN/Infinity token in a 200 body would
             # be unparsable by spec-compliant clients.
@@ -120,8 +121,53 @@ class _JsonHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
+
+    def _admit(self) -> bool:
+        """Admission gate (docs/SERVING.md §elasticity): True when the
+        request may proceed to scoring. Otherwise a fast 429 with a
+        backoff-shaped ``Retry-After`` has already been sent — shedding
+        happens BEFORE parsing, validation and enqueueing, so a shed
+        request costs the raw body read (keep-alive framing demands
+        that much) and nothing else; an overloaded server spends its
+        cycles on admitted traffic."""
+        ctl = getattr(self.server, "admission", None)
+        if ctl is None:
+            return True
+        queued, est_wait = self.server.batcher.saturation()
+        decision = ctl.decide(
+            ctl.parse_class(self.headers),
+            queued,
+            est_wait,
+            deadline_s=ctl.parse_deadline_s(self.headers),
+        )
+        if decision.admitted:
+            return True
+        import math
+
+        # The HTTP header speaks RFC delta-seconds (integer — a
+        # fractional value is ignored by standard retry stacks, which
+        # would re-arrive unthrottled); the JSON body carries the
+        # precise jittered value for clients that can use it (the
+        # repo's loadgen prefers it).
+        self._reply(
+            429,
+            {
+                "error": "overloaded: request shed by admission control",
+                "priority": decision.cls,
+                "reason": decision.reason,
+                "retry_after_s": round(decision.retry_after_s, 3),
+            },
+            headers={
+                "Retry-After": str(
+                    max(1, math.ceil(decision.retry_after_s))
+                ),
+            },
+        )
+        return False
 
     def _reply_metrics(self) -> None:
         """``GET /metrics``: Prometheus text exposition of the server's
@@ -216,9 +262,21 @@ class _JsonHandler(BaseHTTPRequestHandler):
             {"trace_dir": out, "seconds": seconds, "pid": os.getpid()},
         )
 
-    def _read_data_envelope(self):
-        """Parse the request body as ``{"data": ...}``; replies 400 and
-        returns None on anything malformed.
+    def _read_body(self) -> bytes:
+        """Drain the raw request body — keep-alive framing demands the
+        body be consumed even for a request that will be shed (an
+        unread body would be parsed as the next request's head)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(length) or b"{}"
+        except (ValueError, TypeError):
+            # A bogus Content-Length parses as an empty envelope; the
+            # parse step's 400 contract reports it.
+            return b"{}"
+
+    def _parse_data_envelope(self, body: bytes):
+        """Parse an already-read body as ``{"data": ...}``; replies 400
+        and returns None on anything malformed.
 
         Fast path (``DCT_SERVE_FAST_PARSE``, default on): a rectangular
         numeric envelope parses straight into a float32 ndarray from the
@@ -227,8 +285,6 @@ class _JsonHandler(BaseHTTPRequestHandler):
         anything irregular falls back to ``json.loads``, whose error
         reporting stays the 400 contract."""
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            body = self.rfile.read(length) or b"{}"
             if getattr(self.server, "fast_parse", False):
                 arr = parse_envelope_array(body)
                 if arr is not None:
@@ -325,7 +381,10 @@ class ScoreHandler(_JsonHandler):
         if self.path != "/score":
             self._reply(404, {"error": f"no route {self.path}"})
             return
-        data = self._read_data_envelope()
+        body = self._read_body()
+        if not self._admit():
+            return
+        data = self._parse_data_envelope(body)
         if data is None:
             return
         t0 = time.perf_counter()
@@ -360,6 +419,9 @@ class _BatchedHTTPServer(ThreadingHTTPServer):
 
     def server_close(self):  # noqa: N802 (http.server API)
         super().server_close()
+        autoscaler = getattr(self, "autoscaler", None)
+        if autoscaler is not None:
+            autoscaler.close()
         batcher = getattr(self, "batcher", None)
         if batcher is not None:
             batcher.close()
@@ -397,16 +459,47 @@ class ServerPool:
     bench rigs. The pool reserves its port with a bound-but-unlistened
     ``SO_REUSEPORT`` socket (receives no connections; only parks the
     port number) so ``port=0`` works like the single-server modes.
+
+    **Self-healing** (docs/SERVING.md §elasticity): with a
+    ``restart_policy`` (a PR 3 :class:`~dct_tpu.resilience.supervisor.
+    RestartPolicy`), an unexpected child death is classified with the
+    PR 3 exit-code classifier, put on the event log
+    (``serve.pool_child_death``) and healed by an exponential-backoff
+    respawn (``serve.pool_respawn``) — the kernel keeps routing new
+    connections to the surviving SO_REUSEPORT siblings meanwhile, so
+    admitted traffic sees at most one torn connection (which keep-alive
+    clients retry). The restart budget circuit-breaks
+    (``serve.pool_circuit_open`` + ``wait() == 1``) when deaths outrun
+    it — a pool that cannot hold capacity must page, not flap forever.
+    Without a policy, the original contract stands: the FIRST child
+    death tears the pool down with exit 1.
+
+    **Elastic scaling**: :meth:`scale_up` forks fresh workers (warm AOT
+    spin-up when the compile cache is armed); :meth:`scale_down`
+    SIGTERMs the newest child into a graceful drain — children install
+    a drain handler (finish in-flight requests, ``server_close``, exit
+    0), and :meth:`wait` distinguishes a deliberately-drained child
+    from a crashed one, so scale-down never trips the failure path.
     """
 
     def __init__(self, build_server, *, processes: int = 1,
-                 host: str = "127.0.0.1", port: int = 0):
-        import signal
+                 host: str = "127.0.0.1", port: int = 0,
+                 restart_policy=None, emit=None):
         import socket as _socket
         import threading
 
         self.host = host
         self.pids: list[int] = []
+        self.restart_policy = restart_policy
+        self.restarts_used = 0
+        self.circuit_open = False
+        self._build_server = build_server
+        self._emit = emit
+        self._lock = threading.Lock()
+        self._draining: set[int] = set()
+        self._index: dict[int, int] = {}
+        self._spawned = 0
+        self._closing = False
         self._thread = None
         self._server = None
         self._reserve = _socket.socket()
@@ -427,50 +520,238 @@ class ServerPool:
             self._thread.start()
             return
         for _ in range(int(processes)):
-            pid = os.fork()
-            if pid == 0:  # child: serve until SIGTERM
-                code = 0
-                try:
-                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
-                    server = build_server(
-                        host, self.port, reuse_port=True
-                    )
-                    server.serve_forever()
-                except BaseException:  # noqa: BLE001 — a child must
-                    # never fall back into the parent's code; it reports
-                    # (stderr + nonzero exit, which wait() surfaces) and
-                    # dies.
-                    import traceback
+            self._spawn()
 
-                    traceback.print_exc()
-                    code = 1
-                finally:
-                    os._exit(code)
-            self.pids.append(pid)
+    def _emit_event(self, event: str, **fields) -> None:
+        try:
+            (self._emit or _emit_default)("serve", event, **fields)
+        except Exception:  # noqa: BLE001 — telemetry never fails the pool
+            pass
+
+    def _spawn(self) -> int:
+        """Fork one serving child at the next pool index (-1 when the
+        pool is closing — a scale-up racing close() must not fork a
+        child nothing will ever reap). The child exports its index as
+        ``DCT_SERVE_PROC_INDEX`` AND ``DCT_PROCESS_ID`` (the fault
+        plan's rank slot — so ``crash_worker@proc1`` binds to pool
+        worker 1) and installs a SIGTERM drain handler: finish
+        in-flight requests, close the server (batcher drained, metrics
+        snapshot retired), exit 0.
+
+        The fork AND the pid bookkeeping happen under the pool lock:
+        the ``wait()`` reaper classifies a death only for pids it
+        knows, so a child that crashes instantly must not be reapable
+        before its pid is on the books (an unknown-pid death would be
+        ignored and the stale pid counted as live capacity forever)."""
+        import signal
+        import threading as _threading
+
+        with self._lock:
+            if self._closing:
+                return -1
+            index = self._spawned
+            self._spawned += 1
+            pid = os.fork()
+            if pid != 0:
+                # Parent: on the books BEFORE the lock drops, so the
+                # reaper's membership check (which also takes this
+                # lock) cannot see an instantly-dead child's pid as
+                # unknown. The child's copy of the held lock dies with
+                # the child (it never touches pool state).
+                self.pids.append(pid)
+                self._index[pid] = index
+                return pid
+
+        # ---- forked child from here on: serve until SIGTERM, drain --
+        code = 0
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            try:
+                # A SIGKILLed pool parent cannot clean up: without
+                # this, its children keep serving as orphans and
+                # hold the port forever (observed via an OOM-style
+                # hard kill). Linux parent-death signal turns that
+                # into an ordinary graceful drain; elsewhere this
+                # is a no-op and orphan cleanup stays operational.
+                import ctypes
+
+                libc = ctypes.CDLL(None, use_errno=True)
+                libc.prctl(1, signal.SIGTERM, 0, 0, 0)  # PR_SET_PDEATHSIG
+                if os.getppid() == 1:
+                    os._exit(0)  # parent died before prctl landed
+            except Exception:  # noqa: BLE001 — best-effort, non-Linux
+                pass
+            os.environ["DCT_SERVE_PROC_INDEX"] = str(index)
+            os.environ["DCT_PROCESS_ID"] = str(index)
+            server = self._build_server(
+                self.host, self.port, reuse_port=True
+            )
+
+            def _drain(signum, frame):
+                # shutdown() blocks until serve_forever returns, so
+                # it must not run on the signal-interrupted main
+                # thread (that IS serve_forever's thread).
+                _threading.Thread(
+                    target=server.shutdown, daemon=True
+                ).start()
+
+            signal.signal(signal.SIGTERM, _drain)
+            server.serve_forever()
+            server.server_close()
+        except BaseException:  # noqa: BLE001 — a child must
+            # never fall back into the parent's code; it reports
+            # (stderr + nonzero exit, which wait() surfaces) and
+            # dies.
+            import traceback
+
+            traceback.print_exc()
+            code = 1
+        finally:
+            os._exit(code)
+        raise RuntimeError("unreachable")  # keeps the int contract honest
+
+    def size(self) -> int:
+        """Live (non-draining) child count — the autoscaler's view. 1
+        in in-process mode (one server thread is the whole pool)."""
+        if self._server is not None:
+            return 1
+        with self._lock:
+            return len([p for p in self.pids if p not in self._draining])
+
+    def scale_up(self, n: int = 1) -> list[int]:
+        """Fork ``n`` fresh workers onto the shared port; returns their
+        pids. New children spin from the same warmed AOT/package state
+        as the originals (the compile cache is process-shared on disk),
+        so time-to-capacity is bounded by spin-up, not compilation."""
+        spawned = []
+        for _ in range(max(0, int(n))):
+            pid = self._spawn()
+            if pid < 0:  # closing: nothing would ever reap the child
+                break
+            self._emit_event(
+                "serve.pool_spawn", pid=pid,
+                index=self._index.get(pid), size=self.size(),
+            )
+            spawned.append(pid)
+        return spawned
+
+    def scale_down(self, n: int = 1) -> list[int]:
+        """Gracefully drain the ``n`` newest workers (never below one):
+        mark them draining, SIGTERM them, and let :meth:`wait` reap the
+        clean exits WITHOUT tripping the child-death failure path."""
+        import signal
+
+        victims: list[int] = []
+        with self._lock:
+            live = [p for p in self.pids if p not in self._draining]
+            while live and len(live) > 1 and len(victims) < max(0, int(n)):
+                pid = live.pop()  # newest first
+                victims.append(pid)
+                self._draining.add(pid)
+        for pid in victims:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+        return victims
 
     def wait(self) -> int:
         """Block until the pool stops serving.
 
         In-process mode joins the server thread (returns 0 once
-        :meth:`close` shuts it down). Forked mode blocks until ANY
-        child exits — a healthy pool never returns — then tears the
-        rest down and returns 1: a pool whose children died (bad
-        checkpoint, unreadable state) must exit nonzero, not sit
-        behind a healthy-looking banner refusing every connection."""
+        :meth:`close` shuts it down). Forked mode supervises the
+        children: a deliberately-drained child (scale-down / close) is
+        reaped silently; an unexpected death either tears the pool down
+        (no restart policy — exit 1, the original contract) or is
+        classified and respawned under the policy's backoff until the
+        budget circuit-breaks (exit 1). Returns 0 only for a clean
+        close/drain."""
+        import time as _time
+
+        from dct_tpu.resilience.supervisor import (
+            FREE_RESTARTS,
+            classify_failure,
+        )
+
         if self._server is not None:
             if self._thread is not None:
                 self._thread.join()
             return 0
         if not self.pids:
             return 1
-        try:
-            pid, _status = os.waitpid(-1, 0)
-        except OSError:
-            return 0
-        if pid in self.pids:
-            self.pids.remove(pid)
-        self.close()
-        return 1
+        while True:
+            try:
+                pid, status = os.waitpid(-1, 0)
+            except OSError:
+                # No children left to reap: a clean close() got them
+                # all first. Anything else is an inconsistency.
+                return 0 if self._closing else 1
+            code = os.waitstatus_to_exitcode(status)
+            with self._lock:
+                known = pid in self.pids
+                if known:
+                    self.pids.remove(pid)
+                index = self._index.pop(pid, None)
+                draining = pid in self._draining
+                self._draining.discard(pid)
+                closing = self._closing
+                remaining = len(self.pids)
+            if not known:
+                continue
+            if closing:
+                if remaining == 0:
+                    return 0
+                continue
+            if draining:
+                # A scaled-down child finished its drain: expected,
+                # logged, NOT a failure — whatever its exit code (a
+                # SIGTERM that landed before the drain handler was
+                # installed shows as a signal death; the intent was
+                # still ours).
+                self._emit_event(
+                    "serve.pool_drained", pid=pid, code=code,
+                    index=index, size=remaining,
+                )
+                if remaining == 0:
+                    return 0
+                continue
+            classification = classify_failure([code])
+            if classification == "success":
+                # A serving child has no business exiting cleanly on
+                # its own; lost capacity is lost capacity.
+                classification = "crash"
+            self._emit_event(
+                "serve.pool_child_death", pid=pid, code=code,
+                classification=classification, index=index,
+                size=remaining,
+            )
+            if self.restart_policy is None:
+                self.close()
+                return 1
+            if not self.restart_policy.allows(
+                self.restarts_used, classification
+            ):
+                self.circuit_open = True
+                self._emit_event(
+                    "serve.pool_circuit_open",
+                    restarts_used=self.restarts_used,
+                    classification=classification,
+                )
+                self.close()
+                return 1
+            delay = self.restart_policy.delay(self.restarts_used)
+            if classification not in FREE_RESTARTS:
+                self.restarts_used += 1
+            _time.sleep(delay)
+            new_pid = self._spawn()
+            if new_pid < 0:  # close() won the race mid-backoff
+                continue
+            self._emit_event(
+                "serve.pool_respawn", pid=new_pid, died=pid,
+                backoff_s=round(delay, 3),
+                restarts_used=self.restarts_used,
+                classification=classification,
+            )
 
     def close(self) -> None:
         import signal
@@ -482,17 +763,22 @@ class ServerPool:
                 self._thread.join(10.0)
             self._server = None
             self._thread = None
-        for pid in self.pids:
+        with self._lock:
+            self._closing = True
+            pids = list(self.pids)
+        for pid in pids:
             try:
                 os.kill(pid, signal.SIGTERM)
             except OSError:
                 pass
-        for pid in self.pids:
+        for pid in pids:
             try:
                 os.waitpid(pid, 0)
             except OSError:
-                pass
-        self.pids = []
+                pass  # the wait() loop reaped it first
+        with self._lock:
+            self.pids = [p for p in self.pids if p not in pids]
+            self._draining.clear()
         try:
             self._reserve.close()
         except OSError:
@@ -586,6 +872,45 @@ def _new_score_server(handler_cls, host: str, port: int, serving=None,
         metrics=server.slot_metrics,
     )
     server.fast_parse = serving.fast_parse
+    server.admission = None
+    server.autoscaler = None
+    if serving.admit:
+        from dct_tpu.serving.admission import AdmissionController
+
+        if serving.workers <= 0:
+            import sys as _sys
+
+            # Inline scoring (workers=0) has no queue: queued_rows is
+            # structurally 0 and the wait estimate never materializes,
+            # so the gate can never fire. Say so instead of letting the
+            # operator believe overload protection is armed.
+            print(
+                "[serving] DCT_SERVE_ADMIT=1 with DCT_SERVE_WORKERS=0: "
+                "inline scoring has no queue to bound — admission "
+                "control cannot shed in this mode",
+                file=_sys.stderr, flush=True,
+            )
+        server.admission = AdmissionController.from_config(
+            serving,
+            metrics_registry=server.slot_metrics.registry,
+            emit=_emit_default,
+        )
+    if serving.autoscale and serving.processes <= 1:
+        # In-process mode: the autoscaler's capacity axis is the
+        # batcher's scoring threads. In pool mode (processes > 1) each
+        # child must NOT run its own controller — the pool parent
+        # scales processes instead (jobs/serve.py).
+        from dct_tpu.serving.autoscale import (
+            Autoscaler,
+            WorkerScaleTarget,
+            batcher_signal_fn,
+        )
+
+        server.autoscaler = Autoscaler.from_config(
+            WorkerScaleTarget(server.batcher), serving,
+            signal_fn=batcher_signal_fn(server), emit=_emit_default,
+            registry=server.slot_metrics.registry,
+        ).start()
     _arm_metrics_plane(server)
     return server
 
@@ -894,7 +1219,10 @@ class EndpointScoreHandler(_JsonHandler):
         if parsed.path != "/score":
             self._reply(404, {"error": f"no route {self.path}"})
             return
-        data = self._read_data_envelope()
+        body = self._read_body()
+        if not self._admit():
+            return
+        data = self._parse_data_envelope(body)
         if data is None:
             return
         client = self._client()

@@ -42,15 +42,75 @@ if _REPO_ROOT not in sys.path:
 def _serve_pool(build_server, what: str, serving, host: str,
                 port: int) -> int:
     """Run a multi-process ServerPool until SIGTERM/SIGINT (clean exit
-    0) or until a child dies on its own (exit 1 — a pool whose workers
-    are gone must not sit behind a healthy-looking banner)."""
+    0) or until its restart budget circuit-breaks (exit 1 — a pool
+    that cannot hold capacity must not sit behind a healthy-looking
+    banner). The dedicated entry point arms the resilience plane by
+    default: child deaths are classified and respawned with backoff
+    (``DCT_SERVE_MAX_RESTARTS`` budget), and ``DCT_SERVE_AUTOSCALE=1``
+    runs the closed-loop proc autoscaler off the fleet queue-depth /
+    SLO-burn / shed signals (docs/SERVING.md §elasticity)."""
     import signal
 
+    from dct_tpu.resilience.supervisor import RestartPolicy
     from dct_tpu.serving.server import ServerPool
 
     pool = ServerPool(
-        build_server, processes=serving.processes, host=host, port=port
+        build_server, processes=serving.processes, host=host, port=port,
+        restart_policy=RestartPolicy(max_restarts=serving.max_restarts),
     )
+    autoscaler = None
+    publisher = None
+    if serving.autoscale:
+        from dct_tpu.config import ObservabilityConfig
+
+        obs = ObservabilityConfig.from_env()
+        if not obs.metrics_dir:
+            # A proc autoscaler without the metrics plane is BLIND: it
+            # would read "queue 0" forever and drain a loaded pool to
+            # the floor. Refuse loudly — no controller thread, no
+            # unpublished gauge registry, the process state matches
+            # this message.
+            print(
+                "[serving] DCT_SERVE_AUTOSCALE=1 needs DCT_METRICS_DIR "
+                "(the fleet queue/shed signals) — autoscaler disabled",
+                file=sys.stderr, flush=True,
+            )
+    if serving.autoscale and obs.metrics_dir:
+        from dct_tpu.observability.metrics import MetricsRegistry
+        from dct_tpu.serving import autoscale as _autoscale
+
+        registry = MetricsRegistry()
+        publisher = _autoscale.controller_publisher(registry)
+        slo_monitor = None
+        if obs.slo_spec:
+            from dct_tpu.observability.slo import (
+                SLOSpecError,
+                SLOMonitor,
+                parse_slo_spec,
+            )
+
+            try:
+                specs = parse_slo_spec(obs.slo_spec)
+                if specs:
+                    # Alerting stays the scrape side's job: the
+                    # controller only READS burn state as a signal.
+                    slo_monitor = SLOMonitor(
+                        specs,
+                        fast_window_s=obs.slo_fast_window_s,
+                        slow_window_s=obs.slo_slow_window_s,
+                        burn_threshold=obs.slo_burn_threshold,
+                    )
+            except SLOSpecError:
+                pass  # the serving children already report it loudly
+        autoscaler = _autoscale.Autoscaler.from_config(
+            _autoscale.PoolScaleTarget(pool), serving,
+            signal_fn=_autoscale.pool_signal_fn(
+                obs.metrics_dir, stale_s=obs.metrics_stale_s,
+                slo_monitor=slo_monitor,
+            ),
+            emit=_autoscale.emit_default,
+            registry=registry,
+        ).start()
 
     def _term(signum, frame):
         raise SystemExit(0)
@@ -65,11 +125,16 @@ def _serve_pool(build_server, what: str, serving, host: str,
         rc = pool.wait()
         if rc:
             print(
-                "serving pool: a worker process died — shutting down",
+                "serving pool: worker deaths exhausted the restart "
+                "budget — shutting down",
                 file=sys.stderr, flush=True,
             )
         return rc
     finally:
+        if autoscaler is not None:
+            autoscaler.close()
+        if publisher is not None:
+            publisher.close()
         pool.close()
 
 

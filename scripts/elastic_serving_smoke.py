@@ -1,0 +1,234 @@
+#!/usr/bin/env python3
+"""Elastic-serving chaos smoke — the tier1.yml ``elastic-serving`` job.
+
+A REAL forked SO_REUSEPORT pool (2 children, numpy + stdlib only) under
+open-loop load, exercising every leg of the ISSUE 15 control plane:
+
+1. **Heal**: a worker is SIGKILLed mid-traffic. The pool must classify
+   the death, respawn with backoff (``serve.pool_respawn`` on the event
+   log) and keep serving — zero failed ADMITTED requests across the
+   kill (keep-alive clients retry the one torn connection onto a
+   surviving sibling).
+2. **Shed + bound**: a 4x overload spike (capacity is pinned by a
+   ``slow_score:msN`` fault clause, so the knee is deterministic on any
+   host). Admission control must shed (429s with Retry-After,
+   ``admission.shed`` on the log, ``dct_serve_shed_total`` on the
+   scrape) while the p99 of admitted traffic stays bounded — orders of
+   magnitude under the no-controls queue-everything collapse.
+3. **Scale round-trip**: the proc autoscaler must step up during the
+   spike and back down after it (``autoscale.scale_up`` AND
+   ``autoscale.scale_down`` events), with the ``dct_serve_procs`` gauge
+   visible on ONE aggregated ``/metrics`` scrape of any child.
+4. **Drain**: ``close()`` must end the supervised ``wait()`` with rc 0
+   — deliberate teardown is never the failure path.
+
+Run: ``python scripts/elastic_serving_smoke.py`` (exit 0 = pass).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _events(path: str) -> list[dict]:
+    try:
+        with open(path) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
+    except OSError:
+        return []
+
+
+def _event_names(path: str) -> set:
+    return {e.get("event") for e in _events(path)}
+
+
+def _scrape(port: int, attempts: int = 5) -> str:
+    """One /metrics body. A fresh connection can race a scale-down
+    drain (the kernel hands it to a child that exits before answering
+    — RST); surviving siblings answer the retry."""
+    last: Exception | None = None
+    for i in range(attempts):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("GET", "/metrics")
+            return conn.getresponse().read().decode()
+        except (http.client.HTTPException, OSError) as e:
+            last = e
+            if i + 1 >= attempts:
+                raise
+            time.sleep(0.2)
+        finally:
+            conn.close()
+    raise last  # unreachable; keeps type-checkers honest
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="elastic-smoke-")
+    events_path = os.path.join(tmp, "events", "events.jsonl")
+    os.environ["DCT_OBSERVABILITY"] = "1"
+    os.environ["DCT_EVENTS_DIR"] = os.path.join(tmp, "events")
+    os.environ["DCT_METRICS_DIR"] = os.path.join(tmp, "metrics")
+    # Deterministic capacity: every flush (max_batch=1 => every request)
+    # costs 10 ms, so one worker serves ~100 rows/s on ANY host.
+    os.environ["DCT_FAULT_SPEC"] = "slow_score:ms10"
+    # Fresh fleet signals: the controller's shed/queue deltas are only
+    # as fresh as the children's snapshot publishes — the default 2 s
+    # throttle would starve a 4 s spike of its hysteresis evidence.
+    os.environ["DCT_METRICS_PUBLISH_S"] = "0.25"
+
+    from dct_tpu.config import ObservabilityConfig, ServingConfig
+    from dct_tpu.observability.metrics import MetricsRegistry
+    from dct_tpu.resilience.supervisor import RestartPolicy
+    from dct_tpu.serving import loadgen
+    from dct_tpu.serving.autoscale import (
+        Autoscaler,
+        PoolScaleTarget,
+        controller_publisher,
+        emit_default,
+        pool_signal_fn,
+    )
+    from dct_tpu.serving.server import ServerPool, make_server_from_weights
+
+    weights, meta = loadgen.synthetic_mlp()
+    serving = ServingConfig(
+        max_batch=1, workers=1, processes=2,
+        admit=True, admit_max_queue=8, admit_wait_ms=60.0,
+        retry_after_s=0.05,
+    )
+    body = json.dumps({"data": [[0.1, -0.2, 0.3, 0.0, 1.1]]}).encode()
+
+    pool = ServerPool(
+        lambda h, p, reuse_port: make_server_from_weights(
+            weights, meta, host=h, port=p, serving=serving,
+            reuse_port=reuse_port,
+        ),
+        processes=serving.processes, host="127.0.0.1",
+        restart_policy=RestartPolicy(max_restarts=3, backoff_s=0.1),
+    )
+    rc = [None]
+    wait_thread = threading.Thread(
+        target=lambda: rc.__setitem__(0, pool.wait()), daemon=True
+    )
+    wait_thread.start()
+
+    obs = ObservabilityConfig.from_env()
+    registry = MetricsRegistry()
+    publisher = controller_publisher(registry, proc="serve-ctl")
+    autoscaler = Autoscaler(
+        PoolScaleTarget(pool),
+        min_size=2, max_size=4, poll_s=0.25,
+        up_queue_rows=3.0, down_queue_rows=0.5,
+        hysteresis_polls=2, cooldown_s=0.6,
+        signal_fn=pool_signal_fn(obs.metrics_dir, stale_s=obs.metrics_stale_s),
+        emit=emit_default, registry=registry,
+    ).start()
+
+    failures: list[str] = []
+
+    def check(cond: bool, what: str) -> None:
+        print(("PASS " if cond else "FAIL ") + what, flush=True)
+        if not cond:
+            failures.append(what)
+
+    try:
+        # Readiness: the shared port must answer before traffic starts
+        # (the kernel round-robins SO_REUSEPORT accepts, so repeated
+        # probes cover both children).
+        deadline = time.time() + 20
+        answered = 0
+        while time.time() < deadline and answered < 4:
+            try:
+                _scrape(pool.port)
+                answered += 1
+            except OSError:
+                time.sleep(0.2)
+        check(answered >= 4, f"pool came up ({answered} probes answered)")
+
+        # --- 1. kill a worker mid-traffic --------------------------------
+        base = {}
+
+        def run_base():
+            base["out"] = loadgen.run_open_loop(
+                "127.0.0.1", pool.port, body, qps=80.0, duration_s=4.0,
+                max_inflight=200,
+            )
+
+        t = threading.Thread(target=run_base)
+        t.start()
+        time.sleep(1.0)
+        victim = pool.pids[0]
+        os.kill(victim, signal.SIGKILL)
+        t.join(30)
+        out = base["out"]
+        check(out["errors"] == 0,
+              f"zero failed admitted requests across the kill ({out})")
+        check(out["requests"] > 150, f"continued 200s ({out['requests']})")
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+            "serve.pool_respawn" not in _event_names(events_path)
+        ):
+            time.sleep(0.2)
+        names = _event_names(events_path)
+        check("serve.pool_child_death" in names, "child death on the log")
+        check("serve.pool_respawn" in names, "respawn on the log")
+        check(rc[0] is None, "pool survived the kill (wait() still live)")
+
+        # --- 2. 4x spike: shed fires, admitted p99 bounded ---------------
+        spike = loadgen.run_open_loop(
+            "127.0.0.1", pool.port, body, qps=800.0, duration_s=4.0,
+            max_inflight=400, headers={"x-dct-priority": "low"},
+        )
+        check(spike.get("shed", 0) > 0, f"shed fired ({spike})")
+        check(spike["errors"] == 0, "zero 5xx on admitted spike traffic")
+        check(
+            spike["p99_ms"] is not None and spike["p99_ms"] < 400.0,
+            f"admitted p99 bounded ({spike['p99_ms']} ms; the "
+            "queue-everything collapse at this trace is multiple seconds)",
+        )
+
+        # --- 3. autoscale round-trip + gauge on one scrape ---------------
+        deadline = time.time() + 12
+        while time.time() < deadline and (
+            "autoscale.scale_down" not in _event_names(events_path)
+        ):
+            time.sleep(0.3)
+        names = _event_names(events_path)
+        check("autoscale.scale_up" in names, "scale_up on the log")
+        check("autoscale.scale_down" in names, "scale_down on the log")
+        text = _scrape(pool.port)
+        check("dct_serve_procs" in text,
+              "dct_serve_procs on one aggregated scrape")
+        check("dct_serve_shed_total" in text,
+              "shed counters on one aggregated scrape")
+        check("admission.shed" in names, "admission.shed on the log")
+    finally:
+        autoscaler.close()
+        if publisher is not None:
+            publisher.close()
+        pool.close()
+        wait_thread.join(15)
+
+    # --- 4. clean drain -------------------------------------------------
+    print(f"drain rc: {rc[0]}", flush=True)
+    if rc[0] != 0:
+        failures.append(f"clean drain rc (got {rc[0]})")
+    if failures:
+        print("FAILURES: " + "; ".join(failures), flush=True)
+        return 1
+    print("elastic serving smoke: all checks passed", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

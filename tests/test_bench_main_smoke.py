@@ -39,6 +39,10 @@ def test_bench_main_cpu_record_carries_everything(
     # tests/test_mpmd.py and the mpmd-pipeline CI smoke; the bench
     # smoke pins the null-marker wiring.
     monkeypatch.setenv("DCT_BENCH_MPMD", "0")
+    # And elastic_serving: the overload A/B replay runs for real in
+    # tests/test_serving_elastic.py and the elastic-serving CI smoke;
+    # the bench smoke pins the null-marker wiring.
+    monkeypatch.setenv("DCT_BENCH_ELASTIC", "0")
     monkeypatch.setenv(
         "DCT_BENCH_PARTIAL", str(tmp_path / "BENCH_PARTIAL.json")
     )
@@ -90,7 +94,10 @@ def test_bench_main_cpu_record_carries_everything(
     assert all(q > 0 for q in sl["levels"]["qps"])
     assert all(p > 0 for p in sl["levels"]["p99_ms"])
     assert sl["knee_concurrency"] in sl["levels"]["concurrency"]
-    assert sl["saturated_qps"] > 0 and sl["baseline_qps"] > 0
+    # baseline_qps is derivable (saturated / batched_over_single) and
+    # yielded to fund the elastic_serving series; the partial keeps it
+    # verbatim (asserted below).
+    assert sl["saturated_qps"] > 0 and "baseline_qps" not in sl
     assert sl["batched_over_single"] > 0
     assert sl["score_batched_over_single"] > 1
     assert sl["parity"] is True
@@ -123,11 +130,13 @@ def test_bench_main_cpu_record_carries_everything(
     assert record["cycle_freshness"] is None
     assert record["multi_tenant"] is None
     assert record["mpmd_pipeline"] is None
+    assert record["elastic_serving"] is None
     with open(tmp_path / "BENCH_PARTIAL.json") as f:
         partial = json.load(f)
     assert partial["trainer_gap"]["fused"] == partial["value"]
     assert partial["trainer_gap"]["fit"] > 0
     assert isinstance(partial["serving_load"]["levels"], list)
+    assert partial["serving_load"]["baseline_qps"] > 0
     assert partial["serving_load"]["snapshot_publish"]["plain_p50_ms"] > 0
     assert partial["serving_load"]["snapshot_publish"]["publish_p50_ms"] > 0
     assert partial["prior_onchip"]["record"] == onchip

@@ -323,6 +323,41 @@ def _worst_case_record() -> dict:
             "rows_speedup": 2.18, "windows_native_ms": 1.43,
             "windows_numpy_ms": 11.05, "windows_speedup": 7.71,
         },
+        "elastic_serving": {
+            "trace": {"base_qps": 60.0, "spike_qps": 240.0,
+                      "base_s": 1.5, "spike_s": 2.5, "service_ms": 8.0},
+            "off": {
+                phase: {"mode": "open", "concurrency": 400,
+                        "requests": n, "errors": 0, "duration_s": d,
+                        "qps": q, "p50_ms": p50, "p99_ms": p99,
+                        "target_qps": tq, "dropped": 0}
+                for phase, n, d, q, p50, p99, tq in (
+                    ("base", 90, 1.5, 59.9, 9.1, 11.0, 60.0),
+                    ("spike", 600, 5.01, 119.7, 1272.0, 2497.0, 240.0),
+                    ("recover", 90, 1.5, 59.8, 10.2, 14.1, 60.0),
+                )
+            },
+            "on": {
+                phase: {"mode": "open", "concurrency": 400,
+                        "requests": n, "errors": 0, "duration_s": d,
+                        "qps": q, "p50_ms": p50, "p99_ms": p99,
+                        "shed": s, "shed_fraction": sf,
+                        "shed_p50_ms": 0.65, "target_qps": tq,
+                        "dropped": 0}
+                for phase, n, d, q, p50, p99, s, sf, tq in (
+                    ("base", 90, 1.5, 59.9, 9.3, 11.0, 0, 0.0, 60.0),
+                    ("spike", 510, 2.51, 203.4, 13.3, 26.2, 90, 0.15,
+                     240.0),
+                    ("recover", 90, 1.5, 59.8, 9.8, 13.2, 0, 0.0, 60.0),
+                )
+            },
+            "pre_spike_p99_ms": 10.98, "pre_spike_p99_off_ms": 10.62,
+            "spike_p99_off_ms": 2497.01, "spike_p99_on_ms": 26.25,
+            "p99_ratio_off": 227.46, "p99_ratio_on": 2.39,
+            "overload_p99_s": 0.0262, "shed": 90, "admitted": 690,
+            "shed_fraction": 0.1154, "admitted_errors": 0,
+            "scale_events": 4, "bounded": True,
+        },
     }
 
 
@@ -437,6 +472,13 @@ def test_stdout_record_typical_round_is_not_collapsed(bench_mod):
     assert sl["levels"]["p99_ms"] == [0.9883, 3.7727, 11.4212]
     assert sl["batched_over_single"] == 1.14
     assert sl["score_batched_over_single"] == 15.96
+    # ...elastic_serving keeps both sentinel series + the A/B ratio
+    # pair on stdout; the per-phase replay dicts stay in the partial.
+    es = out["elastic_serving"]
+    assert es["overload_p99_s"] == 0.0262
+    assert es["shed_fraction"] == 0.1154
+    assert es["p99_ratio_on"] == 2.39 and es["p99_ratio_off"] == 227.46
+    assert "off" not in es and "on" not in es and "trace" not in es
 
 
 def test_stdout_record_bounds_error_strings(bench_mod):
