@@ -2866,6 +2866,18 @@ def main():
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         ),
     }
+    # Join the bench to the provenance plane: the run-correlation ID ties
+    # it to the event log, the ledger head pins WHICH lineage graph state
+    # the numbers were measured against (both None-safe when disabled).
+    try:
+        from dct_tpu.observability import events as _events
+        from dct_tpu.observability import lineage as _lineage
+
+        record["run_id"] = _events.current_run_id()
+        record["lineage_head"] = _lineage.head_hash()
+    except Exception:
+        record["run_id"] = None
+        record["lineage_head"] = None
     global _LIVE_RECORD
     _LIVE_RECORD = record
     # Stash any previous run's partial BEFORE overwriting it: if the

@@ -13,6 +13,9 @@ One module per concern:
   ``config.py`` ⇄ documented in ``.env.example`` ⇄ actually read) and
   ``event-names`` (``EventLog.emit`` sites vs the
   ``docs/OBSERVABILITY.md`` event table).
+- :mod:`lineage_rules` — ``lineage-publish`` (``os.replace``
+  artifact-publish sites in the data/ETL, checkpoint and deploy
+  layers record provenance in the lineage ledger).
 
 To add a rule: subclass :class:`dct_tpu.analysis.core.Rule`, decorate
 with :func:`dct_tpu.analysis.core.register`, import the module here,
@@ -22,6 +25,7 @@ and pair it with good/bad fixtures in ``tests/test_analysis.py``
 
 from dct_tpu.analysis.rules import (  # noqa: F401 — imported to register
     io_rules,
+    lineage_rules,
     purity_rules,
     registry_rules,
 )

@@ -1,0 +1,90 @@
+"""Provenance discipline: artifact-publish sites record lineage.
+
+``lineage-publish`` — the lineage plane (docs/OBSERVABILITY.md §8) is
+only as complete as its emit hooks: a tmp+``os.replace`` publish in the
+data/ETL, checkpoint or deploy layers that never touches the lineage
+ledger is an artifact the ``trace``/``audit`` CLIs cannot see — a hole
+in the provenance graph that looks exactly like tampering. Any module
+in those layers that publishes via ``os.replace`` must reference the
+lineage module (import it and record a node/edge near the publish, or
+delegate to a helper in the same module that does). State files that
+are deliberately NOT artifacts (e.g. endpoint traffic-state
+bookkeeping whose lineage is recorded by the orchestrator that drives
+it) carry a reviewed ``# dct: noqa[lineage-publish]``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dct_tpu.analysis.core import Finding, Project, Rule, register
+from dct_tpu.analysis.rules._helpers import func_repr, iter_calls
+
+#: Layers whose ``os.replace`` publishes hand artifacts between stages
+#: of the continuous cycle — exactly the hand-offs the ledger records.
+_LINEAGE_LAYERS = (
+    "dct_tpu/data/",
+    "dct_tpu/etl/",
+    "dct_tpu/checkpoint/",
+    "dct_tpu/deploy/",
+)
+
+
+def _references_lineage(tree: ast.AST) -> bool:
+    """True when the module imports or names the lineage module
+    anywhere (top-level or lazy in-function import, aliased or not)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if "lineage" in (node.module or ""):
+                return True
+            if any("lineage" in a.name for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any("lineage" in a.name for a in node.names):
+                return True
+        elif isinstance(node, ast.Attribute) and "lineage" in node.attr:
+            return True
+        elif isinstance(node, ast.Name) and "lineage" in node.id:
+            return True
+    return False
+
+
+@register
+class LineagePublishRule(Rule):
+    id = "lineage-publish"
+    name = "os.replace publish sites record lineage"
+    doc = (
+        "Modules under data/, etl/, checkpoint/ and deploy/ that "
+        "publish artifacts via tmp+`os.replace` must record them in "
+        "the lineage ledger (`dct_tpu.observability.lineage`): an "
+        "unrecorded publish is invisible to `lineage trace` and reads "
+        "as an integrity hole in `lineage audit`. Record a node/edge "
+        "at (or on the orchestrating path of) the publish, or mark a "
+        "deliberate non-artifact state file with "
+        "`# dct: noqa[lineage-publish]`."
+    )
+
+    def check(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in project.contexts:
+            if ctx.tree is None or not ctx.relpath.startswith(
+                _LINEAGE_LAYERS
+            ):
+                continue
+            if _references_lineage(ctx.tree):
+                continue
+            for call in iter_calls(ctx.tree):
+                if func_repr(call) != "os.replace":
+                    continue
+                out.append(
+                    ctx.finding(
+                        self.id,
+                        call,
+                        "artifact published via os.replace but the "
+                        "module never records lineage — import "
+                        "dct_tpu.observability.lineage and record a "
+                        "node/edge for the published artifact (or "
+                        "noqa a deliberate non-artifact state file)",
+                    )
+                )
+        return out

@@ -25,6 +25,7 @@ Two tiers, mirroring and extending the reference:
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Any
 
@@ -33,6 +34,7 @@ import numpy as np
 from flax import serialization
 
 from dct_tpu.observability import events as _events
+from dct_tpu.observability import lineage as _lineage
 from dct_tpu.observability import spans as _spans
 from dct_tpu.resilience import faults as _faults
 
@@ -83,6 +85,19 @@ def save_checkpoint(path: str, params: Any, meta: dict) -> str:  # dct: noqa[ran
     # non-atomic write.
     _faults.get_default().maybe_fire("save", save_kind="deploy", path=path)
     os.replace(tmp, path)  # atomic: no torn ckpt if a rank dies mid-write
+    lin = _lineage.get_default()
+    if lin.enabled:
+        # Content address from the serialized bytes already in hand (no
+        # file re-read); edges to whatever training inputs the trainer
+        # declared (dataset snapshot, a restored trajectory) make every
+        # published checkpoint a walkable graph hop.
+        nid = lin.node(
+            "checkpoint", path=path,
+            sha256=hashlib.sha256(data).hexdigest(),
+            attrs={"epoch": dict(meta).get("epoch")},
+        )
+        for src in _lineage.run_inputs():
+            lin.edge("consumed", nid, src)
     return path
 
 
@@ -135,6 +150,12 @@ class BestLastCheckpointer:
                 if self.best_model_path and os.path.exists(self.best_model_path):
                     if os.path.abspath(self.best_model_path) != os.path.abspath(new_path):
                         os.remove(self.best_model_path)
+                        # Retention tombstone: the pruned best is gone on
+                        # purpose; without this the integrity audit would
+                        # flag it MISSING.
+                        _lineage.get_default().retire(
+                            self.best_model_path, reason="superseded_best",
+                        )
                 self.best_value = value
                 self.best_model_path = new_path
             sp.set(improved=improved)
@@ -380,6 +401,17 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
             "checkpoint", "resume_state_saved", dir=live,
             epochs_completed=(meta or {}).get("epochs_completed"),
         )
+        lin = _lineage.get_default()
+        if lin.enabled:
+            nid = lin.node(
+                "checkpoint", path=os.path.join(live, "state.npz"),
+                attrs={
+                    "tier": "resume",
+                    "epochs_completed": (meta or {}).get("epochs_completed"),
+                },
+            )
+            for src in _lineage.run_inputs():
+                lin.edge("consumed", nid, src)
         return live
 
     def save_async(self, state, meta: dict | None = None) -> None:
@@ -771,6 +803,15 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
         # Drop the sibling shard pool: it holds full copies of every
         # sibling's arrays and is only valid for THIS restore.
         self._sibling_cache = None
+        lin = _lineage.get_default()
+        if lin.enabled and source != "siblings":
+            # The adopted trajectory becomes a training input: the next
+            # checkpoint this run publishes gets a ``consumed`` edge to
+            # the state it resumed from — lineage across preemptions.
+            _lineage.add_run_input(lin.node(
+                "checkpoint", path=os.path.join(source, "state.npz"),
+                attrs={"tier": "resume", "restored": True},
+            ))
         return state.replace(
             step=jax.numpy.asarray(tree["step"]),
             params=tree["params"],

@@ -1178,6 +1178,8 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_HEARTBEAT_STALL_SECONDS": "heartbeat age that marks a rank stalled",
     "DCT_METRICS_PROM": "end-of-run Prometheus textfile dump path",
     "DCT_SPANS_DIR": "distributed-tracing span files dir",
+    "DCT_LINEAGE": "content-addressed provenance ledger (on by default)",
+    "DCT_LINEAGE_DIR": "lineage ledger dir (default: the events dir)",
     "DCT_SERVE_TRACE": "opt-in per-request serving.score spans",
     "DCT_SERVE_LOG": "per-request serving access log",
     "DCT_HALT_ON_NAN": "halt training on non-finite loss",

@@ -92,7 +92,11 @@ class LocalEndpointClient:
         tmp = self.state_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(raw, f, indent=2)
-        os.replace(tmp, self.state_path)
+        # Traffic-state bookkeeping, not an artifact: slot-flip lineage
+        # is recorded by RolloutOrchestrator (deployed/served_by edges),
+        # and this file mutates on every traffic change so a content
+        # hash would never be stable.
+        os.replace(tmp, self.state_path)  # dct: noqa[lineage-publish]
 
     # -- control plane -------------------------------------------------
     def endpoint_exists(self, endpoint: str) -> bool:

@@ -30,6 +30,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Protocol
 
+from dct_tpu.observability import lineage as _lineage
+
 BLUE, GREEN = "blue", "green"
 
 
@@ -123,6 +125,26 @@ def prepare_package(
     # half-written one must be unobservable (the gate would fail open
     # on a torn read as "pre-observability package").
     os.replace(info_tmp, info_path)
+    lin = _lineage.get_default()
+    if lin.enabled:
+        # Package lineage: the staged model.ckpt hashes to the SAME node
+        # the trainer saved and the tracking store copied (content
+        # addressing — no ID plumbing across the three layers), and the
+        # package dir node is what the gate verdicts and the serving
+        # model-load hang their edges on.
+        ckpt_nid = lin.node(
+            "checkpoint", path=model_ckpt,
+            attrs={"tracking_run_id": best.run_id},
+        )
+        pkg_nid = lin.node(
+            "deploy_package", path=deploy_dir,
+            attrs={
+                "tracking_run_id": best.run_id,
+                "run_correlation_id": best.run_correlation_id,
+                "val_loss": best.metrics.get("val_loss"),
+            },
+        )
+        lin.edge("consumed", pkg_nid, ckpt_nid)
     return {
         "run_id": best.run_id,
         "run_correlation_id": best.run_correlation_id,
@@ -412,6 +434,38 @@ class RolloutOrchestrator:
                     )
             sp.set(decision=decision.decision, reason=decision.reason)
         ev = decision.evidence or {}
+        lin = _lineage.get_default()
+        if lin.enabled:
+            # The verdict joins the graph content-addressed from its own
+            # record: ``consumed`` edges to the packages it judged (and
+            # the evidence report), plus a ``promoted`` edge into the
+            # challenger when it passed — so "is the artifact on disk
+            # the one the gate promoted?" is an audit over this node.
+            ch_nid = (
+                lin.node("deploy_package", path=challenger_dir)
+                if challenger_dir else None
+            )
+            champ_nid = (
+                lin.node("deploy_package", path=champion_dir)
+                if champion_dir else None
+            )
+            verdict_nid = lin.node(
+                "gate_verdict", content=decision.to_dict(),
+                attrs={
+                    "stage": to_stage, "decision": decision.decision,
+                    "reason": decision.reason, "endpoint": self.endpoint,
+                },
+            )
+            rep_nid = (
+                lin.node("eval_report", content=ev,
+                         attrs={"stage": to_stage})
+                if ev else None
+            )
+            lin.edge("consumed", verdict_nid, rep_nid)
+            lin.edge("consumed", verdict_nid, ch_nid)
+            lin.edge("consumed", verdict_nid, champ_nid)
+            if decision.promoted:
+                lin.edge("promoted", verdict_nid, ch_nid, stage=to_stage)
         self.events.append(RolloutEvent(stage=f"gate_{to_stage}"))
         self._cycle_log().emit(
             "deploy", "deploy.gate", endpoint=self.endpoint,
@@ -479,6 +533,27 @@ class RolloutOrchestrator:
                 self._call(self.client.delete_deployment, self.endpoint,
                            old_slot, op="delete_deployment")
             self._record("full_rollout")
+        lin = _lineage.get_default()
+        if lin.enabled:
+            # The flip on the record: package --deployed--> the slot
+            # assignment (a model_load node keyed by endpoint/slot/
+            # package, which the serving process's own load will attach
+            # its ``served_by`` sighting next to).
+            pkg_dir = self._slot_package_dir(new_slot)
+            if pkg_dir:
+                pkg_nid = lin.node("deploy_package", path=pkg_dir)
+                slot_nid = lin.node(
+                    "model_load",
+                    content={
+                        "endpoint": self.endpoint, "slot": new_slot,
+                        "package": pkg_nid,
+                    },
+                    attrs={
+                        "endpoint": self.endpoint, "slot": new_slot,
+                        "stage": "full_rollout",
+                    },
+                )
+                lin.edge("deployed", pkg_nid, slot_nid)
 
     def rollback(self, new_slot: str, old_slot: str | None, *, stage: str) -> None:
         """Auto-revert to the prior deployment: old slot back to 100%

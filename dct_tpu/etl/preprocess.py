@@ -514,9 +514,14 @@ def _process_full(
         return parquet_dir
     if digest is None:
         digest = _digest_input(input_csv, limit=limit)
+    generation = int(state.get("generation") or 0) + 1
+    snap_nid = _record_lineage(
+        input_csv, parquet_dir, digest, basis, state,
+        generation=generation, mode="full", rows=stats["rows"],
+    )
     _write_etl_state(output_dir, {
         "version": ETL_STATE_VERSION,
-        "generation": int(state.get("generation") or 0) + 1,
+        "generation": generation,
         "mode": "full",
         "input": {
             "size": digest["size"],
@@ -529,8 +534,61 @@ def _process_full(
         "rows": stats["rows"],
         "norm_basis": basis,
         "accum": accum,
+        "lineage_node": snap_nid,
     })
     return parquet_dir
+
+
+def _record_lineage(
+    input_csv: str,
+    parquet_dir: str,
+    digest: dict,
+    basis: dict,
+    prev_state: dict,
+    *,
+    generation: int,
+    mode: str,
+    rows: int,
+) -> str | None:
+    """Record this generation's provenance into the lineage ledger
+    (:mod:`dct_tpu.observability.lineage`): the ingest delta (the raw
+    CSV at its already-computed content digest), the frozen
+    normalization basis (content-addressed from the basis dict, so a
+    delta run under the same basis lands on the SAME node the full run
+    minted), and the published snapshot directory — with the edges that
+    make "which runs consumed delta X?" a graph walk. Returns the
+    snapshot's node id, which the caller stamps into ``etl_state.json``
+    so the trainer links its checkpoints to the exact snapshot without
+    re-hashing gigabytes of parquet. Best-effort by the ledger's own
+    contract: a disabled/dead ledger makes this a no-op returning None.
+    """
+    from dct_tpu.observability import lineage as _lineage
+
+    lin = _lineage.get_default()
+    if not lin.enabled:
+        return None
+    delta_nid = lin.node(
+        "ingest_delta", path=input_csv, sha256=digest["sha256"],
+        attrs={"mode": mode, "generation": generation, "rows": rows},
+    )
+    basis_nid = lin.node(
+        "etl_basis", content=basis, attrs={"generation": generation},
+    )
+    snap_nid = lin.node(
+        "dataset_snapshot", path=parquet_dir,
+        attrs={"generation": generation, "mode": mode, "rows": rows},
+    )
+    lin.edge("produced", delta_nid, snap_nid)
+    if mode == "full":
+        # A full pass derives the basis FROM this delta; a delta run
+        # reuses the frozen basis (consumed, below) without re-producing.
+        lin.edge("produced", delta_nid, basis_nid)
+    lin.edge("consumed", snap_nid, basis_nid)
+    # Generation chain: an appended snapshot grew out of the previous
+    # one, so ancestry from any checkpoint reaches every delta that
+    # ever fed its training data.
+    lin.edge("consumed", snap_nid, prev_state.get("lineage_node"))
+    return snap_nid
 
 
 def _process_delta(
@@ -582,9 +640,14 @@ def _process_delta(
     # Ordering: part published BEFORE stats/state, so a reader that saw
     # generation N in the state can always load generation N's rows.
     persist_stats_and_drift(output_dir, stats, prev_stats)
+    generation = int(state.get("generation") or 0) + 1
+    snap_nid = _record_lineage(
+        input_csv, parquet_dir, digest, basis, state,
+        generation=generation, mode="delta", rows=stats["rows"],
+    )
     _write_etl_state(output_dir, {
         "version": ETL_STATE_VERSION,
-        "generation": int(state.get("generation") or 0) + 1,
+        "generation": generation,
         "mode": "delta",
         "input": {
             "size": digest["size"],
@@ -598,6 +661,7 @@ def _process_delta(
         "rows_delta": int(len(delta_labels)),
         "norm_basis": basis,
         "accum": accum,
+        "lineage_node": snap_nid,
     })
     return parquet_dir
 

@@ -171,6 +171,7 @@ def build_report(
     run_id: str | None,
     trace_path: str | None,
     bench: tuple[str, dict] | None = None,
+    lineage: list[dict] | None = None,
 ) -> str:
     """The cycle report as one printable string (pure function of the
     artifacts — unit-testable without capturing stdout)."""
@@ -658,6 +659,49 @@ def build_report(
             line += f"; last trace: {ends[-1].get('dir')}"
         lines.append(line)
 
+    # -- lineage -------------------------------------------------------
+    if lineage:
+        from dct_tpu.observability import lineage as _lineage
+
+        lines.append("")
+        lines.append("Lineage:")
+        graph = _lineage.build_graph(lineage)
+        kinds: dict[str, int] = {}
+        for recs in graph["nodes"].values():
+            kind = recs[-1].get("kind", "?")
+            kinds[kind] = kinds.get(kind, 0) + 1
+        counted = "  ".join(
+            f"{k}={kinds[k]}" for k in sorted(kinds)
+        )
+        lines.append(
+            f"  {len(graph['nodes'])} node(s), "
+            f"{len(graph['edges'])} edge(s): {counted}"
+        )
+        loads = [
+            r for r in lineage
+            if r.get("type") == "node" and r.get("kind") == "model_load"
+        ]
+        if loads:
+            head = max(loads, key=lambda r: r.get("ts") or 0.0)
+            lines.append(f"  serving now: {head['id']}")
+            anc = _lineage.ancestors(graph, head["id"])
+            order = (
+                "deploy_package", "gate_verdict", "eval_report",
+                "checkpoint", "dataset_snapshot", "etl_basis",
+                "ingest_delta",
+            )
+            for kind in order:
+                hits = [
+                    nid for nid in anc
+                    if graph["nodes"][nid][-1].get("kind") == kind
+                ]
+                for nid in sorted(hits):
+                    lines.append(f"    <- {nid}")
+        lines.append(
+            "  (query: python -m dct_tpu.observability.lineage "
+            "trace|explain-serving|audit)"
+        )
+
     # -- spans / trace -------------------------------------------------
     lines.append("")
     lines.append("Spans by component:")
@@ -729,9 +773,15 @@ def main(argv: list[str] | None = None) -> int:
         trace_path, spans = export_run(
             args.run_dir, out_path=args.out, trace_id=run_id
         )
+    from dct_tpu.observability import lineage as _lineage
+
+    lineage_records = _lineage.read_ledger(
+        os.path.join(args.run_dir, _lineage.LEDGER_NAME)
+    )
     print(build_report(
         events, heartbeats, spans, run_id, trace_path,
         bench=load_bench_record(args.run_dir),
+        lineage=lineage_records,
     ))
     return 0
 

@@ -1,0 +1,795 @@
+"""Content-addressed lineage ledger: the causal graph of the loop.
+
+The event log (:mod:`dct_tpu.observability.events`) answers *when*: one
+cycle's timeline, keyed by run-correlation ID. This module answers
+*which* and *why* across cycles: every artifact the continuous loop
+produces or consumes — ingest delta, frozen ETL basis, dataset
+snapshot, checkpoint, eval report, gate verdict, deploy package,
+serving model-load — becomes a **node** identified by the sha256 of its
+content, and every producer/consumer relationship becomes a typed
+**edge** (``consumed``, ``produced``, ``promoted``, ``deployed``,
+``served_by``). Content addressing makes identity transitive for free:
+the checkpoint the trainer saved, the tracking artifact copy, and the
+``model.ckpt`` staged into a deploy package hash to the SAME node, so
+the graph connects layers that never exchange an ID.
+
+Ledger discipline is exactly the event log's: single-line JSON appended
+``O_APPEND`` through one :class:`~dct_tpu.observability.buffered
+.BufferedAppender` (atomic for lines under ``PIPE_BUF``; concurrent
+ranks/processes can share one ``lineage.jsonl``), every record stamped
+with ``run_id``/``trace_id`` so graph hops cross-link with events and
+the Perfetto timeline, and any OS error kills the ledger for the rest
+of the process — lineage degrades to silence, never a failed run.
+
+Record schema::
+
+    {"ts": ..., "run_id": "dct-...", "trace_id": "dct-...", "rank": ...,
+     "type": "node", "kind": "checkpoint", "id": "checkpoint:ab12...",
+     "sha256": "<full hex>", "path": "/abs/path", "attrs": {...}}
+    {"ts": ..., "run_id": ..., "trace_id": ..., "rank": ...,
+     "type": "edge", "edge": "consumed", "src": "<node id>",
+     "dst": "<node id>", "attrs": {...}}
+
+Edge direction contract (what the ancestry walk implements): for a
+``consumed`` edge the *dst* is upstream of the *src* ("src consumed
+dst"); for every other type the *src* is upstream of the *dst* ("src
+produced/promoted/deployed/is-served-by dst").
+
+Query CLI (``python -m dct_tpu.observability.lineage``):
+
+- ``trace <node-id | id-prefix | path>`` — walk ancestry (and, with
+  ``--down``, descendants) from any artifact;
+- ``explain-serving`` — "why is this model serving?": the newest
+  model-load node's full chain back to the ingest delta;
+- ``audit`` — re-hash every on-disk artifact against the ledger and
+  report tampered / missing / orphaned nodes (exit 1 on tampered or
+  missing).
+
+Env knobs: ``DCT_LINEAGE`` (default on, and subordinate to
+``DCT_OBSERVABILITY``), ``DCT_LINEAGE_DIR`` (ledger directory; default
+``DCT_EVENTS_DIR``) — registered in config.ENV_REGISTRY and policed by
+dct-lint's env-registry rule like every other knob.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+from dct_tpu.observability import events as _events
+
+LEDGER_NAME = "lineage.jsonl"
+AUDIT_NAME = "lineage_audit.json"
+
+NODE_KINDS = (
+    "ingest_delta",
+    "etl_basis",
+    "dataset_snapshot",
+    "checkpoint",
+    "eval_report",
+    "gate_verdict",
+    "deploy_package",
+    "model_load",
+)
+
+EDGE_KINDS = ("consumed", "produced", "promoted", "deployed", "served_by")
+
+#: Edge types whose *src* end is the upstream artifact ("src produced
+#: dst"); ``consumed`` is the one inverted spelling ("src consumed dst"
+#: puts dst upstream). The ancestry walk and the audit's orphan check
+#: both read this table — one place to get direction right.
+_SRC_IS_UPSTREAM = ("produced", "promoted", "deployed", "served_by")
+
+_ID_HEX = 16  # sha256 prefix length in node ids — 64 bits, plenty
+
+
+# ----------------------------------------------------------------------
+# Content addressing
+
+
+def sha256_file(path: str, *, chunk: int = 1 << 20) -> str:
+    """Streaming sha256 of one file (the same digest discipline as the
+    ETL's input fingerprint — constant memory whatever the size)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+#: Mutable annotations written INTO an artifact dir after publish; they
+#: must not move the artifact's address. ``eval_report.json`` is the
+#: promotion gate's cache, dropped into the challenger package it
+#: judges — including it would give the same package a different id
+#: before and after gating, severing the served-model -> checkpoint
+#: chain.
+_DIR_HASH_SKIP = ("eval_report.json",)
+
+
+def sha256_dir(path: str) -> str:
+    """Deterministic sha256 of a directory artifact (dataset snapshot,
+    deploy package): sorted relative paths, each contributing its name
+    and file digest. In-flight publish debris (``*.tmp.*`` siblings,
+    ``.build.<pid>`` staging) and post-publish annotations
+    (:data:`_DIR_HASH_SKIP`) are skipped — the address covers the
+    published artifact itself."""
+    h = hashlib.sha256()
+    for root, dirs, files in sorted(os.walk(path)):
+        dirs.sort()
+        for name in sorted(files):
+            if ".tmp" in name or name in _DIR_HASH_SKIP:
+                continue
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, path)
+            h.update(rel.encode())
+            h.update(b"\0")
+            h.update(sha256_file(full).encode())
+            h.update(b"\n")
+    return h.hexdigest()
+
+
+def sha256_path(path: str) -> str:
+    """File or directory -> content digest (dispatch on what's there)."""
+    return sha256_dir(path) if os.path.isdir(path) else sha256_file(path)
+
+
+def sha256_json(obj) -> str:
+    """Canonical digest of a JSON-able value (gate verdicts, eval
+    reports — artifacts whose identity is their content, not a file)."""
+    payload = json.dumps(
+        _events._jsonable(obj), sort_keys=True, separators=(",", ":")
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def node_id(kind: str, sha: str) -> str:
+    return f"{kind}:{sha[:_ID_HEX]}"
+
+
+# ----------------------------------------------------------------------
+# The ledger
+
+
+class LineageLedger:
+    """Append-only JSONL lineage writer; ``path=None`` disables (every
+    record method no-ops and returns None). Same failure contract as
+    :class:`~dct_tpu.observability.events.EventLog`: any OS error —
+    full disk, unwritable ledger dir — kills the ledger for the rest of
+    the process; provenance degrades to silence, the run continues."""
+
+    def __init__(
+        self,
+        path: str | None,
+        *,
+        run_id: str,
+        rank: int | None = None,
+        clock=time.time,
+        flush_interval: float = 0.0,
+        max_records: int = 128,
+    ):
+        self.path = path
+        self.run_id = run_id
+        self.rank = rank
+        self._clock = clock
+        self._dead = False
+        self._appender = None
+        if path:
+            from dct_tpu.observability.buffered import BufferedAppender
+
+            self._appender = BufferedAppender(
+                path, flush_interval=flush_interval, max_records=max_records
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.path) and not self._dead
+
+    def _emit(self, rec: dict) -> bool:
+        rec = {
+            "ts": round(self._clock(), 6),
+            "run_id": self.run_id,
+            "trace_id": self.run_id,
+            "rank": self.rank,
+            **rec,
+        }
+        try:
+            line = json.dumps(
+                _events._jsonable(rec), allow_nan=False
+            ) + "\n"
+        except ValueError:
+            self._dead = True
+            return False
+        if not self._appender.append(line):
+            self._dead = True
+            return False
+        return True
+
+    def node(
+        self,
+        kind: str,
+        *,
+        path: str | None = None,
+        content=None,
+        sha256: str | None = None,
+        attrs: dict | None = None,
+    ) -> str | None:
+        """Record one artifact node; returns its content-addressed id
+        (``"<kind>:<sha256 prefix>"``), or None when the ledger is
+        disabled/dead or the artifact cannot be hashed (a racing delete
+        is an absent fact, not an error).
+
+        Identity source, in precedence order: an explicit ``sha256``
+        (the ETL already digested its input — don't re-read gigabytes),
+        ``content`` (a JSON-able value for file-less artifacts like
+        gate verdicts), else ``path`` (file or directory re-hash).
+        Re-recording the same content is idempotent at the graph level:
+        readers merge records by id, so duplicate nodes only add a
+        sighting (new path / new attrs), never a new vertex.
+        """
+        if not self.enabled:
+            return None
+        if sha256 is None:
+            try:
+                if content is not None:
+                    sha256 = sha256_json(content)
+                elif path is not None:
+                    sha256 = sha256_path(path)
+            except OSError:
+                return None
+        if sha256 is None:
+            return None
+        nid = node_id(kind, sha256)
+        self._emit({
+            "type": "node",
+            "kind": kind,
+            "id": nid,
+            "sha256": sha256,
+            "path": os.path.abspath(path) if path else None,
+            "attrs": dict(attrs or {}),
+        })
+        return nid if self.enabled else None
+
+    def edge(
+        self, edge: str, src: str | None, dst: str | None, **attrs
+    ) -> None:
+        """Record one typed edge. None endpoints no-op: hook sites pass
+        node() results straight through, and a node that could not be
+        recorded must not fabricate half an edge."""
+        if not self.enabled or not src or not dst:
+            return
+        self._emit({
+            "type": "edge",
+            "edge": edge,
+            "src": src,
+            "dst": dst,
+            "attrs": dict(attrs),
+        })
+
+    def retire(self, path: str, **attrs) -> None:
+        """Record that an artifact path was deliberately deleted
+        (checkpoint retention pruning a superseded best). A tombstone,
+        not a node: the audit stops expecting bytes at this path, while
+        the retired content's node — and every edge through it — stays
+        on the graph. A later publish at the same path re-arms the
+        audit for it."""
+        if not self.enabled or not path:
+            return
+        self._emit({
+            "type": "retire",
+            "path": os.path.abspath(path),
+            "attrs": dict(attrs),
+        })
+
+    def flush(self) -> None:
+        if self._appender is not None:
+            self._appender.flush()
+
+    def close(self) -> None:
+        if self._appender is not None:
+            self._appender.close()
+
+
+# ----------------------------------------------------------------------
+# Run-input context: the trainer declares which dataset snapshot (and
+# restored trajectory) this process is learning from; the checkpoint
+# manager — which has no data-layer plumbing — then stamps ``consumed``
+# edges from every checkpoint it publishes. Process-local by design
+# (one training run per process, like the run-correlation ID).
+
+_run_inputs: list[str] = []
+_run_inputs_lock = threading.Lock()
+
+
+def set_run_inputs(ids: list[str | None]) -> None:
+    """Replace the process's training-input node set (trainer start)."""
+    with _run_inputs_lock:
+        _run_inputs[:] = [i for i in ids if i]
+
+
+def add_run_input(nid: str | None) -> None:
+    """Append one input (e.g. the resume checkpoint a restore adopted)."""
+    if not nid:
+        return
+    with _run_inputs_lock:
+        if nid not in _run_inputs:
+            _run_inputs.append(nid)
+
+
+def run_inputs() -> list[str]:
+    with _run_inputs_lock:
+        return list(_run_inputs)
+
+
+# ----------------------------------------------------------------------
+# Process default (same shape as events.get_default: explicit install
+# wins; otherwise env-built and rebuilt whenever the relevant env
+# changes, so monkeypatched tests see their own sink).
+
+_explicit: LineageLedger | None = None
+_cached: tuple[tuple, LineageLedger] | None = None
+_default_lock = threading.Lock()
+
+_ENV_KEYS = (
+    "DCT_OBSERVABILITY",
+    "DCT_LINEAGE",
+    "DCT_LINEAGE_DIR",
+    "DCT_EVENTS_DIR",
+    "DCT_RUN_ID",
+    "DCT_PROCESS_ID",
+    "NODE_RANK",
+)
+
+
+def lineage_enabled(env=None) -> bool:
+    """THE parse of ``DCT_LINEAGE`` (default on), subordinate to the
+    observability master switch — a rig that silenced telemetry must
+    not keep paying artifact hashing."""
+    if not _events.observability_enabled(env):
+        return False
+    raw = (env if env is not None else os.environ).get("DCT_LINEAGE")
+    if raw is None:
+        return True
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def ledger_dir(env=None) -> str:
+    """The ledger directory: ``DCT_LINEAGE_DIR`` when set, else the
+    event-log directory (one grep-able place per run by default)."""
+    e = env if env is not None else os.environ
+    return e.get("DCT_LINEAGE_DIR") or e.get("DCT_EVENTS_DIR", "logs/events")
+
+
+def default_ledger_path(env=None) -> str:
+    return os.path.join(ledger_dir(env), LEDGER_NAME)
+
+
+def set_default(ledger: LineageLedger | None) -> None:
+    global _explicit
+    _explicit = ledger
+
+
+def get_default() -> LineageLedger:
+    global _cached
+    if _explicit is not None:
+        return _explicit
+    with _default_lock:
+        rid = _events.current_run_id()
+        key = tuple(os.environ.get(k) for k in _ENV_KEYS)
+        if _cached is not None and _cached[0] == key:
+            return _cached[1]
+        ledger = LineageLedger(
+            default_ledger_path() if lineage_enabled() else None,
+            run_id=rid,
+            rank=_events._rank_from_env(),
+        )
+        _cached = (key, ledger)
+        return ledger
+
+
+def ledger_from_config(cfg, *, rank: int | None = None) -> LineageLedger:
+    """Build the process ledger from an ``ObservabilityConfig`` and
+    install it as the default — the trainer's analog of
+    :func:`~dct_tpu.observability.events.event_log_from_config`, so
+    layers without config plumbing (checkpoint manager) stamp the same
+    run ID into the same file."""
+    rid = cfg.run_id or _events.current_run_id()
+    directory = os.environ.get("DCT_LINEAGE_DIR") or cfg.events_dir
+    path = (
+        os.path.join(directory, LEDGER_NAME)
+        if cfg.enabled and lineage_enabled() and directory
+        else None
+    )
+    ledger = LineageLedger(path, run_id=rid, rank=rank)
+    set_default(ledger)
+    return ledger
+
+
+# ----------------------------------------------------------------------
+# Reading + graph walks (the CLI, the inspector, and tests)
+
+
+def read_ledger(path: str) -> list[dict]:
+    """Every parseable record, in append order. A torn final line (a
+    writer killed mid-append on a no-append-atomicity filesystem) is
+    skipped, not fatal — same reader tolerance as the event log's."""
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+def build_graph(records: list[dict]) -> dict:
+    """Records -> ``{"nodes": {id: [records]}, "edges": [records],
+    "parents": {id: set}, "children": {id: set}}``. Node sightings
+    merge by id (content addressing); direction per the module edge
+    contract."""
+    nodes: dict[str, list[dict]] = {}
+    edges: list[dict] = []
+    parents: dict[str, set] = {}
+    children: dict[str, set] = {}
+    for rec in records:
+        if rec.get("type") == "node" and rec.get("id"):
+            nodes.setdefault(rec["id"], []).append(rec)
+        elif rec.get("type") == "edge" and rec.get("src") and rec.get("dst"):
+            edges.append(rec)
+            if rec.get("edge") in _SRC_IS_UPSTREAM:
+                up, down = rec["src"], rec["dst"]
+            else:  # consumed (and any unknown type reads as consumed)
+                up, down = rec["dst"], rec["src"]
+            parents.setdefault(down, set()).add(up)
+            children.setdefault(up, set()).add(down)
+    return {
+        "nodes": nodes, "edges": edges,
+        "parents": parents, "children": children,
+    }
+
+
+def _walk(start: str, link: dict[str, set]) -> list[str]:
+    """BFS over one direction's adjacency; cycle-safe; excludes start."""
+    seen = {start}
+    order: list[str] = []
+    frontier = [start]
+    while frontier:
+        nxt: list[str] = []
+        for nid in frontier:
+            for peer in sorted(link.get(nid, ())):
+                if peer not in seen:
+                    seen.add(peer)
+                    order.append(peer)
+                    nxt.append(peer)
+        frontier = nxt
+    return order
+
+
+def ancestors(graph: dict, nid: str) -> list[str]:
+    """Everything upstream of ``nid`` (BFS order, nearest first)."""
+    return _walk(nid, graph["parents"])
+
+
+def descendants(graph: dict, nid: str) -> list[str]:
+    """Everything downstream of ``nid`` (BFS order, nearest first)."""
+    return _walk(nid, graph["children"])
+
+
+def resolve(graph: dict, artifact: str) -> str | None:
+    """A CLI argument -> node id: exact id, unique id/sha prefix, or a
+    filesystem path (re-hashed and matched by content)."""
+    if artifact in graph["nodes"]:
+        return artifact
+    if os.path.exists(artifact):
+        try:
+            sha = sha256_path(artifact)
+        except OSError:
+            return None
+        for nid, recs in graph["nodes"].items():
+            if any(r.get("sha256") == sha for r in recs):
+                return nid
+        return None
+    hits = [
+        nid
+        for nid, recs in graph["nodes"].items()
+        if nid.startswith(artifact)
+        or nid.split(":", 1)[-1].startswith(artifact)
+        or any((r.get("sha256") or "").startswith(artifact) for r in recs)
+    ]
+    return hits[0] if len(hits) == 1 else None
+
+
+def head_hash(path: str | None = None) -> str | None:
+    """sha256 of the ledger's newest record line — the append-only
+    log's "head", cheap to stamp into bench/trajectory records so they
+    join the ledger at a known graph state. None when no ledger."""
+    path = path or default_ledger_path()
+    last = b""
+    try:
+        with open(path, "rb") as f:
+            for line in f:
+                if line.strip():
+                    last = line
+    except OSError:
+        return None
+    if not last:
+        return None
+    return hashlib.sha256(last.rstrip(b"\n")).hexdigest()
+
+
+# ----------------------------------------------------------------------
+# Metrics: exposition rendered from the ledger itself (the writers are
+# short-lived DAG-task processes; the file is the durable aggregate, so
+# the long-lived serving process can scrape totals the same way it
+# scrapes the gate ledger).
+
+
+def render_lineage_metrics(directory: str | None = None) -> str:
+    """Prometheus text for ``dct_lineage_nodes_total`` (per node kind)
+    and ``dct_lineage_audit_failures_total`` (from the last audit's
+    published summary). Best-effort: no ledger -> empty string."""
+    directory = directory or ledger_dir()
+    try:
+        records = read_ledger(os.path.join(directory, LEDGER_NAME))
+        if not records:
+            return ""
+        by_kind: dict[str, int] = {}
+        for rec in records:
+            if rec.get("type") == "node":
+                by_kind[rec.get("kind") or "unknown"] = (
+                    by_kind.get(rec.get("kind") or "unknown", 0) + 1
+                )
+        lines = [
+            "# HELP dct_lineage_nodes_total Lineage ledger artifact "
+            "nodes recorded, by kind.",
+            "# TYPE dct_lineage_nodes_total counter",
+        ]
+        for kind in sorted(by_kind):
+            lines.append(
+                f'dct_lineage_nodes_total{{kind="{kind}"}} {by_kind[kind]}'
+            )
+        failures = 0
+        try:
+            with open(os.path.join(directory, AUDIT_NAME)) as f:
+                audit = json.load(f)
+            failures = int(audit.get("tampered", 0)) + int(
+                audit.get("missing", 0)
+            )
+        except (OSError, ValueError):
+            pass
+        lines += [
+            "# HELP dct_lineage_audit_failures_total Tampered + missing "
+            "artifacts found by the last lineage audit.",
+            "# TYPE dct_lineage_audit_failures_total counter",
+            f"dct_lineage_audit_failures_total {failures}",
+        ]
+        return "\n".join(lines) + "\n"
+    except Exception:  # noqa: BLE001 — scrape surface, never a 500
+        return ""
+
+
+# ----------------------------------------------------------------------
+# Integrity audit
+
+
+def run_audit(ledger_path: str) -> dict:
+    """Re-hash every on-disk artifact against the ledger.
+
+    Per path, only the NEWEST node record is authoritative — mutable
+    publish paths (``last.ckpt``, a growing dataset snapshot) are
+    re-recorded on every publish, and history is history, not tamper.
+    Nodes without a path (gate verdicts, in-memory eval reports) have
+    no bytes to audit and are skipped. ``orphaned`` counts node ids no
+    edge touches — recorded but causally disconnected, usually a hook
+    that forgot its edge.
+
+    Returns the summary dict (also published atomically beside the
+    ledger for the metrics exposition):
+    ``{checked, ok, tampered, missing, orphaned, failures: [...]}``.
+    """
+    records = read_ledger(ledger_path)
+    graph = build_graph(records)
+    newest_by_path: dict[str, dict] = {}
+    for rec in records:
+        if rec.get("type") == "node" and rec.get("path") and rec.get("sha256"):
+            newest_by_path[rec["path"]] = rec
+        elif rec.get("type") == "retire" and rec.get("path"):
+            # Deliberate deletion (retention pruning): stop expecting
+            # bytes here unless a later record re-publishes the path.
+            newest_by_path.pop(rec["path"], None)
+    failures: list[dict] = []
+    ok = 0
+    for path, rec in sorted(newest_by_path.items()):
+        if not os.path.exists(path):
+            failures.append(
+                {"status": "missing", "id": rec["id"], "path": path}
+            )
+            continue
+        try:
+            sha = sha256_path(path)
+        except OSError:
+            failures.append(
+                {"status": "missing", "id": rec["id"], "path": path}
+            )
+            continue
+        if sha != rec["sha256"]:
+            failures.append({
+                "status": "tampered", "id": rec["id"], "path": path,
+                "expected": rec["sha256"], "actual": sha,
+            })
+        else:
+            ok += 1
+    linked = set(graph["parents"]) | set(graph["children"])
+    orphaned = sorted(set(graph["nodes"]) - linked)
+    summary = {
+        "checked": len(newest_by_path),
+        "ok": ok,
+        "tampered": sum(1 for f in failures if f["status"] == "tampered"),
+        "missing": sum(1 for f in failures if f["status"] == "missing"),
+        "orphaned": len(orphaned),
+        "orphaned_ids": orphaned,
+        "failures": failures,
+    }
+    # Publish beside the ledger (atomic: the serving scrape and later
+    # audits must never read a torn summary). Best-effort like every
+    # telemetry write.
+    try:
+        out = os.path.join(os.path.dirname(ledger_path) or ".", AUDIT_NAME)
+        tmp = f"{out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(summary, f, indent=2)
+        os.replace(tmp, out)
+    except OSError:
+        pass
+    _events.get_default().emit(
+        "lineage", "lineage.audit",
+        checked=summary["checked"], ok=ok,
+        tampered=summary["tampered"], missing=summary["missing"],
+        orphaned=summary["orphaned"],
+    )
+    return summary
+
+
+# ----------------------------------------------------------------------
+# CLI
+
+
+def _describe(graph: dict, nid: str) -> str:
+    recs = graph["nodes"].get(nid, [])
+    path = next((r["path"] for r in reversed(recs) if r.get("path")), None)
+    run = next((r["run_id"] for r in reversed(recs) if r.get("run_id")), None)
+    bits = [nid]
+    if path:
+        bits.append(f"path={path}")
+    if run:
+        bits.append(f"run={run}")
+    return "  ".join(bits)
+
+
+def _cmd_trace(graph: dict, artifact: str, down: bool) -> int:
+    nid = resolve(graph, artifact)
+    if nid is None:
+        print(f"lineage: no node matches {artifact!r}")
+        return 2
+    print(_describe(graph, nid))
+    chain = descendants(graph, nid) if down else ancestors(graph, nid)
+    arrow = "->" if down else "<-"
+    for hop in chain:
+        print(f"  {arrow} {_describe(graph, hop)}")
+    _events.get_default().emit(
+        "lineage", "lineage.trace", node=nid,
+        direction="down" if down else "up", hops=len(chain),
+    )
+    return 0
+
+
+def _cmd_explain_serving(graph: dict) -> int:
+    loads = [
+        rec
+        for recs in graph["nodes"].values()
+        for rec in recs
+        if rec.get("kind") == "model_load"
+    ]
+    if not loads:
+        print("lineage: no model_load node in the ledger — nothing serving")
+        return 2
+    newest = max(loads, key=lambda r: r.get("ts") or 0)
+    nid = newest["id"]
+    print(f"serving: {_describe(graph, nid)}")
+    for k, v in sorted((newest.get("attrs") or {}).items()):
+        print(f"  {k}: {v}")
+    anc = ancestors(graph, nid)
+    by_kind: dict[str, str] = {}
+    for hop in anc:
+        kind = hop.split(":", 1)[0]
+        by_kind.setdefault(kind, hop)
+    print("because:")
+    for kind in (
+        "deploy_package", "gate_verdict", "eval_report", "checkpoint",
+        "dataset_snapshot", "etl_basis", "ingest_delta",
+    ):
+        if kind in by_kind:
+            print(f"  {kind:<17} {_describe(graph, by_kind[kind])}")
+    _events.get_default().emit(
+        "lineage", "lineage.trace", node=nid, direction="up",
+        hops=len(anc),
+    )
+    return 0
+
+
+def _cmd_audit(ledger_path: str) -> int:
+    summary = run_audit(ledger_path)
+    print(
+        f"lineage audit: {summary['checked']} artifacts checked, "
+        f"{summary['ok']} ok, {summary['tampered']} tampered, "
+        f"{summary['missing']} missing, {summary['orphaned']} orphaned"
+    )
+    for f in summary["failures"]:
+        print(f"  {f['status'].upper()}: {f['id']}  {f['path']}")
+    for nid in summary["orphaned_ids"]:
+        print(f"  ORPHANED: {nid}")
+    return 1 if summary["tampered"] or summary["missing"] else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    # --ledger is accepted both before and after the subcommand.
+    # SUPPRESS keeps the subparser from clobbering a pre-subcommand
+    # value with its own default (subparsers copy their whole
+    # namespace over the parent's).
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument(
+        "--ledger", default=argparse.SUPPRESS,
+        help=f"ledger path (default <DCT_LINEAGE_DIR>/{LEDGER_NAME})",
+    )
+    parser = argparse.ArgumentParser(
+        prog="python -m dct_tpu.observability.lineage",
+        description="Query the content-addressed lineage ledger.",
+        parents=[common],
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_trace = sub.add_parser(
+        "trace", parents=[common],
+        help="walk ancestry (default) or descendants of an artifact",
+    )
+    p_trace.add_argument("artifact", help="node id, id/sha prefix, or path")
+    p_trace.add_argument(
+        "--down", action="store_true",
+        help="walk descendants instead of ancestors",
+    )
+    sub.add_parser(
+        "explain-serving", parents=[common],
+        help="why is this model serving? (newest model-load's ancestry)",
+    )
+    sub.add_parser(
+        "audit", parents=[common],
+        help="re-hash on-disk artifacts against the ledger "
+        "(exit 1 on tampered/missing)",
+    )
+    args = parser.parse_args(argv)
+    ledger_path = getattr(args, "ledger", None) or default_ledger_path()
+    if args.cmd == "audit":
+        return _cmd_audit(ledger_path)
+    graph = build_graph(read_ledger(ledger_path))
+    if not graph["nodes"]:
+        print(f"lineage: no records in {ledger_path}")
+        return 2
+    if args.cmd == "trace":
+        return _cmd_trace(graph, args.artifact, args.down)
+    return _cmd_explain_serving(graph)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

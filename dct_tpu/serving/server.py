@@ -95,6 +95,41 @@ def _package_trace_id(package_dir: str | None) -> str | None:
     return _pkg_trace_ids[package_dir]
 
 
+_pkg_lineage: dict = {}
+
+
+def _package_lineage_node(package_dir: str | None, **attrs) -> str | None:
+    """The deployed package's content-addressed lineage node id,
+    recorded — together with a ``model_load`` node and its
+    ``served_by`` edge — on this process's first sighting of the
+    package and memoized after (packages are immutable once written, so
+    the one-time directory hash never lands on the request hot path
+    twice). Surfaced in ``/healthz`` so "which artifact is this process
+    serving?" is answerable without touching the box; None when the
+    lineage ledger is disabled."""
+    if not package_dir:
+        return None
+    if package_dir in _pkg_lineage:
+        return _pkg_lineage[package_dir]
+    from dct_tpu.observability import lineage as _lineage
+
+    lin = _lineage.get_default()
+    if not lin.enabled:
+        return None
+    pkg_nid = lin.node("deploy_package", path=package_dir)
+    load_nid = lin.node(
+        "model_load",
+        content={"package": pkg_nid, "pid": os.getpid()},
+        attrs={
+            "package_dir": os.path.abspath(package_dir),
+            "pid": os.getpid(), **attrs,
+        },
+    )
+    lin.edge("served_by", pkg_nid, load_nid)
+    _pkg_lineage[package_dir] = pkg_nid
+    return pkg_nid
+
+
 class _JsonHandler(BaseHTTPRequestHandler):
     """Shared JSON plumbing: strict replies, quiet logs, envelope parse.
 
@@ -202,7 +237,11 @@ class _JsonHandler(BaseHTTPRequestHandler):
             monitor = getattr(self.server, "slo_monitor", None)
             if monitor is not None:
                 text += monitor.render(merged)
-        body = (text + render_gate_metrics()).encode()
+        from dct_tpu.observability.lineage import render_lineage_metrics
+
+        body = (
+            text + render_gate_metrics() + render_lineage_metrics()
+        ).encode()
         self.send_response(200)
         self.send_header("Content-Type", CONTENT_TYPE)
         self.send_header("Content-Length", str(len(body)))
@@ -372,6 +411,10 @@ class ScoreHandler(_JsonHandler):
                 "model": meta.get("model", "weather_mlp"),
                 "input_dim": int(meta.get("input_dim", 0)),
                 "horizon": int(meta.get("horizon", 1)),
+                # The served artifact's lineage node id (None for
+                # in-memory weights or a disabled ledger): the operator
+                # joins /healthz straight to `lineage trace`.
+                "lineage": getattr(self.server, "lineage_node", None),
             },
         )
 
@@ -943,10 +986,29 @@ def make_server(ckpt_path: str, *, host: str = "127.0.0.1", port: int = 0,
     meta["_aot_dir"] = os.path.join(
         os.path.dirname(os.path.abspath(ckpt_path)), "aot"
     )
-    return make_server_from_weights(
+    server = make_server_from_weights(
         weights, meta, host=host, port=port, serving=serving,
         reuse_port=reuse_port,
     )
+    # Model-load lineage: the served checkpoint's node (same id the
+    # trainer minted — content addressing) plus this process's load
+    # sighting; the node id rides on the server for /healthz.
+    from dct_tpu.observability import lineage as _lineage
+
+    lin = _lineage.get_default()
+    if lin.enabled:
+        ckpt_nid = lin.node("checkpoint", path=ckpt_path)
+        load_nid = lin.node(
+            "model_load",
+            content={"artifact": ckpt_nid, "pid": os.getpid()},
+            attrs={
+                "ckpt": os.path.abspath(ckpt_path),
+                "pid": os.getpid(), "mode": "checkpoint",
+            },
+        )
+        lin.edge("served_by", ckpt_nid, load_nid)
+        server.lineage_node = ckpt_nid
+    return server
 
 
 class _PackageCache:
@@ -1172,6 +1234,11 @@ class EndpointScoreHandler(_JsonHandler):
         needs the per-request re-read. Retired packages evict."""
         name = self.server.endpoint_name
         deployments = client.endpoints[name].deployments
+        # First sighting records the served_by lineage hop; memoized
+        # after, so the hot path pays a dict hit.
+        _package_lineage_node(
+            deployments[slot].package_dir, endpoint=name, slot=slot,
+        )
         return self.server.package_cache.get_or_load(
             deployments[slot].package_dir,
             lambda: client.load_slot(name, slot),
@@ -1198,6 +1265,7 @@ class EndpointScoreHandler(_JsonHandler):
         if not client.endpoint_exists(name):
             self._reply(503, {"error": f"endpoint {name} not provisioned"})
             return
+        deployments = client.endpoints[name].deployments
         self._reply(
             200,
             {
@@ -1207,6 +1275,15 @@ class EndpointScoreHandler(_JsonHandler):
                 "mirror_traffic": client.get_mirror_traffic(name),
                 "deployments": client.list_deployments(name),
                 "metrics": self.server.slot_metrics.snapshot(),
+                # Per-slot lineage node ids (content-addressed package
+                # identity): the one-command join from "what is this
+                # endpoint serving?" to `lineage trace <id>`.
+                "lineage": {
+                    slot: _package_lineage_node(
+                        d.package_dir, endpoint=name, slot=slot,
+                    )
+                    for slot, d in deployments.items()
+                },
             },
         )
 

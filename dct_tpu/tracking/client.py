@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from typing import Protocol
 
 from dct_tpu.observability import events as _events
+from dct_tpu.observability import lineage as _lineage
 
 
 @dataclass
@@ -147,6 +148,20 @@ class LocalTracking:
         tmp = f"{dst}.tmp.{os.getpid()}"
         shutil.copy2(local_path, tmp)
         os.replace(tmp, dst)
+        lin = _lineage.get_default()
+        if lin.enabled and dst.endswith(".ckpt"):
+            # Content addressing links the copy to the original for
+            # free: identical bytes -> identical node id, so the
+            # tracking-store sighting and the trainer's checkpoint node
+            # merge, and the deploy side's ancestry walk crosses the
+            # tracking registry without any shared ID plumbing.
+            lin.node(
+                "checkpoint", path=dst,
+                attrs={
+                    "tracking_run_id": self._run_id,
+                    "artifact_path": artifact_path,
+                },
+            )
 
     def end_run(self, status: str = "FINISHED") -> None:
         if not self._active:

@@ -54,6 +54,7 @@ from dct_tpu.parallel.sharding_rules import (
     shard_state_with_rules,
     state_shardings,
 )
+from dct_tpu.observability import lineage as _lineage
 from dct_tpu.observability.events import event_log_from_config
 from dct_tpu.observability.goodput import GoodputLedger
 from dct_tpu.observability.health import HealthMonitor, TrainingHealthError
@@ -294,6 +295,11 @@ class Trainer:
         # Only when this fit loads the data itself: a caller-provided
         # array set has no provable tie to the processed dir.
         _data_provenance: dict = {}
+        # Lineage ledger (installed as the process default alongside the
+        # event log): checkpoints this run publishes get ``consumed``
+        # edges to the dataset snapshot declared below.
+        _lin = _lineage.ledger_from_config(cfg.obs, rank=jax.process_index())
+        _lineage.set_run_inputs([])
         if data is None:
             from dct_tpu.etl.preprocess import read_etl_state
 
@@ -305,6 +311,30 @@ class Trainer:
                         _etl_state.get("arrival_ts") or 0.0
                     ),
                 }
+                # The ETL stamped its snapshot's lineage node id into the
+                # state file — adopt it (no parquet re-hash) and put the
+                # provenance dict on the graph record. A pre-lineage
+                # state file (no stamp) re-addresses the snapshot dir by
+                # content, landing on the same node id the ETL would
+                # have minted.
+                snap_nid = _etl_state.get("lineage_node")
+                if _lin.enabled and not snap_nid:
+                    snap_nid = _lin.node(
+                        "dataset_snapshot",
+                        path=os.path.join(
+                            cfg.data.processed_dir, "data.parquet"
+                        ),
+                        attrs={
+                            "generation": int(_etl_state["generation"]),
+                        },
+                    )
+                elif _lin.enabled and snap_nid:
+                    _lin.node(
+                        "dataset_snapshot",
+                        sha256=snap_nid.split(":", 1)[-1],
+                        attrs=_data_provenance,
+                    )
+                _lineage.set_run_inputs([snap_nid])
         if data is None:
             data = load_processed_dataset(
                 cfg.data.processed_dir,

@@ -1,0 +1,528 @@
+"""Lineage plane (docs/OBSERVABILITY.md §8): content-addressed ledger
+arithmetic, graph walks, the query CLI, the integrity audit — and the
+ISSUE acceptance e2e: one full continuous cycle (ingest delta -> ETL ->
+train -> checkpoint -> gate -> deploy package -> serving load) whose
+``lineage trace`` reconstructs the complete chain from the served model
+back to the ingest delta, and whose ``lineage audit`` passes clean then
+flags a deliberately tampered checkpoint byte."""
+
+import io
+import json
+import os
+import re
+from contextlib import redirect_stdout
+
+import pytest
+
+from dct_tpu.observability import lineage
+
+
+# ----------------------------------------------------------------------
+# Ledger + content addressing
+
+
+def _fresh(monkeypatch, tmp_path):
+    """Route every process-default sink (events + lineage) into tmp and
+    clear defaults installed by other tests' trainers."""
+    monkeypatch.setenv("DCT_EVENTS_DIR", str(tmp_path / "events"))
+    monkeypatch.delenv("DCT_LINEAGE_DIR", raising=False)
+    monkeypatch.delenv("DCT_LINEAGE", raising=False)
+    monkeypatch.delenv("DCT_OBSERVABILITY", raising=False)
+    from dct_tpu.observability import events as _events
+
+    _events.set_default(None)
+    lineage.set_default(None)
+    lineage.set_run_inputs([])
+    return str(tmp_path / "events" / lineage.LEDGER_NAME)
+
+
+def test_content_addressing_merges_identical_bytes(tmp_path):
+    a = tmp_path / "a.bin"
+    b = tmp_path / "copy" / "b.bin"
+    b.parent.mkdir()
+    a.write_bytes(b"model-bytes")
+    b.write_bytes(b"model-bytes")
+    led = lineage.LineageLedger(
+        str(tmp_path / "lineage.jsonl"), run_id="dct-r1"
+    )
+    n1 = led.node("checkpoint", path=str(a))
+    n2 = led.node("checkpoint", path=str(b))
+    assert n1 == n2 and n1.startswith("checkpoint:")
+    assert re.fullmatch(r"checkpoint:[0-9a-f]{16}", n1)
+    graph = lineage.build_graph(
+        lineage.read_ledger(str(tmp_path / "lineage.jsonl"))
+    )
+    # Two sightings, ONE vertex — content addressing is the join.
+    assert len(graph["nodes"]) == 1
+    assert len(graph["nodes"][n1]) == 2
+
+
+def test_dir_hash_skips_publish_debris_and_annotations(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "model.ckpt").write_bytes(b"weights")
+    before = lineage.sha256_dir(str(pkg))
+    # The gate annotates packages in place; in-flight tmp siblings come
+    # and go. Neither may move the artifact's address.
+    (pkg / "eval_report.json").write_text("{}")
+    (pkg / "model.ckpt.tmp.123").write_bytes(b"partial")
+    assert lineage.sha256_dir(str(pkg)) == before
+    (pkg / "extra.txt").write_text("x")
+    assert lineage.sha256_dir(str(pkg)) != before
+
+
+def test_edge_direction_contract_and_walks(tmp_path):
+    led = lineage.LineageLedger(str(tmp_path / "l.jsonl"), run_id="r")
+    delta = led.node("ingest_delta", content={"n": 1})
+    snap = led.node("dataset_snapshot", content={"n": 2})
+    ckpt = led.node("checkpoint", content={"n": 3})
+    pkg = led.node("deploy_package", content={"n": 4})
+    load = led.node("model_load", content={"n": 5})
+    led.edge("produced", delta, snap)   # src upstream
+    led.edge("consumed", ckpt, snap)    # dst upstream
+    led.edge("consumed", pkg, ckpt)
+    led.edge("deployed", pkg, load)     # src upstream
+    graph = lineage.build_graph(lineage.read_ledger(str(tmp_path / "l.jsonl")))
+    assert lineage.ancestors(graph, load) == [pkg, ckpt, snap, delta]
+    assert set(lineage.descendants(graph, delta)) == {snap, ckpt, pkg, load}
+    # Cycle-safe: verdict<->package cycles exist by design.
+    led.edge("consumed", pkg, load)
+    graph = lineage.build_graph(lineage.read_ledger(str(tmp_path / "l.jsonl")))
+    assert pkg in lineage.ancestors(graph, load)
+
+
+def test_disabled_and_dead_ledgers_degrade_to_none(tmp_path):
+    off = lineage.LineageLedger(None, run_id="r")
+    assert not off.enabled
+    assert off.node("checkpoint", content={"x": 1}) is None
+    off.edge("consumed", "a", "b")  # no raise
+
+    # Unwritable sink (the ledger "dir" is a plain file): the first
+    # append kills the ledger; the run proceeds in silence.
+    blocker = tmp_path / "plainfile"
+    blocker.write_text("x")
+    dead = lineage.LineageLedger(
+        str(blocker / "lineage.jsonl"), run_id="r"
+    )
+    assert dead.node("checkpoint", content={"x": 1}) is None
+    assert not dead.enabled
+    dead.edge("consumed", "a", "b")  # still no raise
+
+    # A vanished artifact path is an absent fact, not an error.
+    live = lineage.LineageLedger(str(tmp_path / "l.jsonl"), run_id="r")
+    assert live.node("checkpoint", path=str(tmp_path / "gone")) is None
+    assert live.enabled
+
+
+def test_resolve_by_id_prefix_and_path(tmp_path):
+    f = tmp_path / "artifact.bin"
+    f.write_bytes(b"payload")
+    led = lineage.LineageLedger(str(tmp_path / "l.jsonl"), run_id="r")
+    nid = led.node("checkpoint", path=str(f))
+    other = led.node("eval_report", content={"k": 1})
+    led.edge("consumed", other, nid)
+    graph = lineage.build_graph(lineage.read_ledger(str(tmp_path / "l.jsonl")))
+    assert lineage.resolve(graph, nid) == nid
+    assert lineage.resolve(graph, nid[:24]) == nid
+    assert lineage.resolve(graph, nid.split(":", 1)[1][:10]) == nid
+    assert lineage.resolve(graph, str(f)) == nid
+    assert lineage.resolve(graph, "nope:ffff") is None
+    # Ambiguous prefix -> None, never a guess.
+    assert lineage.resolve(graph, "") is None
+
+
+def test_head_hash_tracks_the_newest_record(tmp_path):
+    path = str(tmp_path / "l.jsonl")
+    assert lineage.head_hash(path) is None
+    led = lineage.LineageLedger(path, run_id="r")
+    led.node("checkpoint", content={"x": 1})
+    h1 = lineage.head_hash(path)
+    assert h1 and len(h1) == 64
+    led.node("checkpoint", content={"x": 2})
+    h2 = lineage.head_hash(path)
+    assert h2 != h1
+
+
+def test_render_lineage_metrics(tmp_path, monkeypatch):
+    _fresh(monkeypatch, tmp_path)
+    d = tmp_path / "led"
+    led = lineage.LineageLedger(
+        str(d / lineage.LEDGER_NAME), run_id="r"
+    )
+    led.node("checkpoint", content={"x": 1})
+    led.node("checkpoint", content={"x": 2})
+    n = led.node("deploy_package", content={"x": 3})
+    led.edge("consumed", n, n)
+    text = lineage.render_lineage_metrics(str(d))
+    assert 'dct_lineage_nodes_total{kind="checkpoint"} 2' in text
+    assert 'dct_lineage_nodes_total{kind="deploy_package"} 1' in text
+    assert "dct_lineage_audit_failures_total 0" in text
+    # After an audit that found failures, the counter reflects it.
+    (d / "gone.bin").write_bytes(b"x")
+    led.node("checkpoint", path=str(d / "gone.bin"))
+    os.remove(d / "gone.bin")
+    lineage.run_audit(str(d / lineage.LEDGER_NAME))
+    text = lineage.render_lineage_metrics(str(d))
+    assert "dct_lineage_audit_failures_total 1" in text
+    # No ledger -> empty scrape contribution, never an error.
+    assert lineage.render_lineage_metrics(str(tmp_path / "empty")) == ""
+
+
+def test_audit_newest_record_wins_and_classifies(tmp_path, monkeypatch):
+    _fresh(monkeypatch, tmp_path)
+    path = str(tmp_path / "l.jsonl")
+    led = lineage.LineageLedger(path, run_id="r")
+    mutable = tmp_path / "last.ckpt"
+    mutable.write_bytes(b"v1")
+    n1 = led.node("checkpoint", path=str(mutable))
+    mutable.write_bytes(b"v2")
+    n2 = led.node("checkpoint", path=str(mutable))
+    led.edge("produced", n1, n2)
+    # Mutable publish path re-recorded per publish: history is history,
+    # not tamper — the audit checks the NEWEST record per path.
+    summary = lineage.run_audit(path)
+    assert summary["tampered"] == 0 and summary["ok"] == 1
+
+    missing = tmp_path / "vanished.bin"
+    missing.write_bytes(b"gone soon")
+    n3 = led.node("checkpoint", path=str(missing))
+    led.edge("produced", n2, n3)
+    os.remove(missing)
+    mutable.write_bytes(b"tampered!")
+    orphan = led.node("eval_report", content={"stray": True})
+    summary = lineage.run_audit(path)
+    assert summary["tampered"] == 1
+    assert summary["missing"] == 1
+    assert orphan in summary["orphaned_ids"]
+    statuses = {f["status"] for f in summary["failures"]}
+    assert statuses == {"tampered", "missing"}
+    # The summary is published beside the ledger for the scrape.
+    with open(tmp_path / lineage.AUDIT_NAME) as f:
+        assert json.load(f)["tampered"] == 1
+
+
+def test_audit_skips_retired_paths(tmp_path, monkeypatch):
+    _fresh(monkeypatch, tmp_path)
+    path = str(tmp_path / "l.jsonl")
+    led = lineage.LineageLedger(path, run_id="r")
+    pruned = tmp_path / "weather-best-00-0.48.ckpt"
+    pruned.write_bytes(b"old best")
+    n1 = led.node("checkpoint", path=str(pruned))
+    kept = tmp_path / "weather-best-01-0.38.ckpt"
+    kept.write_bytes(b"new best")
+    n2 = led.node("checkpoint", path=str(kept))
+    led.edge("produced", n1, n2)
+    os.remove(pruned)
+    summary = lineage.run_audit(path)
+    assert summary["missing"] == 1  # pruned without a tombstone: flagged
+
+    led.retire(str(pruned), reason="superseded_best")
+    summary = lineage.run_audit(path)
+    assert summary["missing"] == 0 and summary["tampered"] == 0
+    # The retired node stays on the graph — history, not tamper —
+    # and a later re-publish at the same path re-arms the audit.
+    assert n1 in lineage.build_graph(lineage.read_ledger(path))["nodes"]
+    pruned.write_bytes(b"republished")
+    led.node("checkpoint", path=str(pruned))
+    os.remove(pruned)
+    summary = lineage.run_audit(path)
+    assert summary["missing"] == 1
+
+
+def test_reader_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "l.jsonl")
+    led = lineage.LineageLedger(path, run_id="r")
+    led.node("checkpoint", content={"x": 1})
+    with open(path, "a") as f:
+        f.write('{"type": "node", "kind": "che')  # writer died mid-append
+    recs = lineage.read_ledger(path)
+    assert len(recs) == 1
+
+
+def test_cli_trace_audit_and_unresolved(tmp_path, monkeypatch):
+    _fresh(monkeypatch, tmp_path)
+    path = str(tmp_path / "l.jsonl")
+    led = lineage.LineageLedger(path, run_id="r")
+    f = tmp_path / "snap.bin"
+    f.write_bytes(b"rows")
+    snap = led.node("dataset_snapshot", path=str(f))
+    ckpt = led.node("checkpoint", content={"w": 1})
+    led.edge("consumed", ckpt, snap)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = lineage.main(["--ledger", path, "trace", ckpt])
+    assert rc == 0
+    assert snap in buf.getvalue() and "<-" in buf.getvalue()
+    with redirect_stdout(io.StringIO()):
+        assert lineage.main(["--ledger", path, "trace", "bogus:123"]) == 2
+        assert lineage.main(["--ledger", path, "audit"]) == 0
+    f.write_bytes(b"tampered")
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert lineage.main(["--ledger", path, "audit"]) == 1
+    assert "TAMPERED" in buf.getvalue()
+    # trace/audit left lineage.* events on the redirected event log.
+    from dct_tpu.observability import events as _events
+
+    _events.get_default().flush()
+    ev_path = tmp_path / "events" / "events.jsonl"
+    names = [
+        json.loads(line)["event"]
+        for line in open(ev_path)
+        if line.strip()
+    ]
+    assert "lineage.trace" in names and "lineage.audit" in names
+
+
+# ----------------------------------------------------------------------
+# The acceptance e2e: one full continuous cycle on the real stack.
+
+
+@pytest.fixture(scope="module")
+def cycle(tmp_path_factory, request):
+    """ingest (full -> appended delta) -> ETL -> champion train ->
+    package -> first rollout -> better challenger train -> gated
+    rollout -> full flip. Every hook writes one shared ledger.
+    Module-scoped: two real trainings are the expensive part; the three
+    acceptance tests below all read the same finished cycle."""
+    monkeypatch = pytest.MonkeyPatch()
+    request.addfinalizer(monkeypatch.undo)
+    tmp_path = tmp_path_factory.mktemp("lineage_e2e")
+    from dct_tpu.config import (
+        DataConfig,
+        EvaluationConfig,
+        ObservabilityConfig,
+        RunConfig,
+        TrainConfig,
+    )
+    from dct_tpu.data.synthetic import append_weather_rows, generate_weather_csv
+    from dct_tpu.deploy.local import LocalEndpointClient
+    from dct_tpu.deploy.rollout import RolloutOrchestrator, prepare_package
+    from dct_tpu.etl.preprocess import preprocess_csv_to_parquet, read_etl_state
+    from dct_tpu.evaluation.gates import PromotionGate
+    from dct_tpu.tracking.client import LocalTracking
+    from dct_tpu.train.trainer import Trainer
+
+    events_dir = tmp_path / "events"
+    ledger_path = _fresh(monkeypatch, tmp_path)
+    request.addfinalizer(lambda: lineage.set_default(None))
+    request.addfinalizer(lambda: lineage.set_run_inputs([]))
+
+    # Ingest: a staged CSV grown by an appended delta, through the
+    # incremental ETL (generation 1 full, generation 2 delta).
+    csv = str(tmp_path / "raw.csv")
+    generate_weather_csv(csv, rows=400, seed=11)
+    processed = str(tmp_path / "processed")
+    preprocess_csv_to_parquet(csv, processed, incremental=True)
+    append_weather_rows(csv, rows=120, seed=12)
+    preprocess_csv_to_parquet(csv, processed, incremental=True)
+    state = read_etl_state(processed)
+    assert state["generation"] == 2 and state["mode"] == "delta"
+    assert state["lineage_node"]
+
+    def train(sub, epochs, seed=42):
+        work = tmp_path / sub
+        cfg = RunConfig(
+            data=DataConfig(
+                processed_dir=processed, models_dir=str(work / "models")
+            ),
+            train=TrainConfig(
+                epochs=epochs, batch_size=8, bf16_compute=False, seed=seed
+            ),
+            obs=ObservabilityConfig(events_dir=str(events_dir)),
+        )
+        tracker = LocalTracking(
+            root=str(work / "mlruns"), experiment="weather_forecasting"
+        )
+        return tracker, Trainer(cfg, tracker=tracker).fit()
+
+    champ_tracker, champ = train("champ", epochs=2)
+    champ_pkg = str(tmp_path / "pkg_champ")
+    prepare_package(champ_tracker, champ_pkg, data_dir=processed)
+
+    client = LocalEndpointClient(
+        state_path=str(tmp_path / "endpoint_state.json")
+    )
+    RolloutOrchestrator(client, "weather-ep", sleep_fn=lambda s: None).run(
+        champ_pkg
+    )
+
+    good_tracker, good = train("good", epochs=5)
+    good_pkg = str(tmp_path / "pkg_good")
+    prepare_package(good_tracker, good_pkg, data_dir=processed)
+    gate = PromotionGate(
+        EvaluationConfig(ledger_path=str(tmp_path / "gate_ledger.json")),
+        processed_dir=processed,
+    )
+    ro = RolloutOrchestrator(
+        client, "weather-ep", sleep_fn=lambda s: None, gate=gate
+    )
+    stages = [e.stage for e in ro.run(good_pkg)]
+    assert "gate_full_rollout" in stages and "full_rollout" in stages
+
+    return {
+        "ledger": ledger_path,
+        "csv": csv,
+        "processed": processed,
+        "good_pkg": good_pkg,
+        "good": good,
+        "client": client,
+    }
+
+
+def test_e2e_trace_reconstructs_served_model_to_ingest_delta(cycle):
+    graph = lineage.build_graph(lineage.read_ledger(cycle["ledger"]))
+    kinds = {
+        recs[-1]["kind"] for recs in graph["nodes"].values()
+    }
+    assert {
+        "ingest_delta", "etl_basis", "dataset_snapshot", "checkpoint",
+        "eval_report", "gate_verdict", "deploy_package", "model_load",
+    } <= kinds
+
+    loads = [
+        rec
+        for recs in graph["nodes"].values()
+        for rec in recs
+        if rec["kind"] == "model_load"
+    ]
+    newest = max(loads, key=lambda r: r["ts"])
+    anc = lineage.ancestors(graph, newest["id"])
+    anc_kinds = {nid.split(":", 1)[0] for nid in anc}
+    # The complete causal chain, served model back to the raw delta.
+    assert {
+        "deploy_package", "gate_verdict", "eval_report", "checkpoint",
+        "dataset_snapshot", "etl_basis", "ingest_delta",
+    } <= anc_kinds
+    # The generation chain: BOTH snapshots (gen-2 delta grew out of
+    # gen-1 full) are upstream of what's serving.
+    snaps = [n for n in anc if n.startswith("dataset_snapshot:")]
+    assert len(snaps) == 2
+
+    # CLI trace from the package DIRECTORY (path -> content -> node)
+    # walks all the way back to the ingest delta.
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = lineage.main(
+            ["--ledger", cycle["ledger"], "trace", cycle["good_pkg"]]
+        )
+    out = buf.getvalue()
+    assert rc == 0
+    delta_ids = [n for n in anc if n.startswith("ingest_delta:")]
+    assert delta_ids and any(d in out for d in delta_ids)
+
+    # explain-serving: the operator's "why is this model serving?".
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = lineage.main(["--ledger", cycle["ledger"], "explain-serving"])
+    out = buf.getvalue()
+    assert rc == 0
+    assert "because:" in out
+    for kind in ("deploy_package", "gate_verdict", "checkpoint",
+                 "dataset_snapshot", "ingest_delta"):
+        assert kind in out
+
+
+def test_e2e_audit_clean_then_flags_tampered_checkpoint(cycle):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = lineage.main(["--ledger", cycle["ledger"], "audit"])
+    assert rc == 0, buf.getvalue()
+    assert " 0 tampered, 0 missing" in buf.getvalue()
+
+    # Flip one byte of the served model's checkpoint on disk.
+    ckpt = cycle["good"].best_model_path
+    blob = bytearray(open(ckpt, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(ckpt, "wb") as f:
+        f.write(bytes(blob))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = lineage.main(["--ledger", cycle["ledger"], "audit"])
+    out = buf.getvalue()
+    assert rc == 1
+    assert "TAMPERED: checkpoint:" in out and ckpt in out
+
+
+def test_e2e_serving_surfaces_lineage(cycle):
+    """The serving layer's own sighting: /healthz carries the lineage
+    node id and /metrics carries the ledger-rendered counters."""
+    import threading
+    import urllib.request
+
+    from dct_tpu.serving.server import make_endpoint_server
+
+    server = make_endpoint_server(
+        "weather-ep", state_path=cycle["client"].state_path
+    )
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as r:
+            health = json.loads(r.read())
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            metrics = r.read().decode()
+    finally:
+        server.shutdown()
+        server.server_close()
+    lin = health.get("lineage") or {}
+    assert any(
+        v and str(v).startswith("deploy_package:") for v in lin.values()
+    ), health
+    assert 'dct_lineage_nodes_total{kind="model_load"}' in metrics
+    assert 'dct_lineage_nodes_total{kind="ingest_delta"}' in metrics
+
+
+def test_unwritable_ledger_dir_never_fails_the_run(tmp_path, monkeypatch):
+    """Telemetry failure isolation (acceptance): pointing the ledger at
+    an unwritable sink degrades every hook to a no-op — the ETL still
+    publishes its generation."""
+    from dct_tpu.data.synthetic import generate_weather_csv
+    from dct_tpu.etl.preprocess import preprocess_csv_to_parquet, read_etl_state
+
+    blocker = tmp_path / "plainfile"
+    blocker.write_text("x")
+    _fresh(monkeypatch, tmp_path)
+    monkeypatch.setenv("DCT_LINEAGE_DIR", str(blocker / "sub"))
+    csv = str(tmp_path / "raw.csv")
+    generate_weather_csv(csv, rows=120, seed=3)
+    processed = str(tmp_path / "processed")
+    preprocess_csv_to_parquet(csv, processed, incremental=True)
+    state = read_etl_state(processed)
+    assert state["generation"] == 1
+    assert state.get("lineage_node") is None
+    assert not os.path.exists(blocker / "sub")
+
+
+def test_lineage_disabled_by_knob(tmp_path, monkeypatch):
+    _fresh(monkeypatch, tmp_path)
+    monkeypatch.setenv("DCT_LINEAGE", "0")
+    assert not lineage.lineage_enabled()
+    assert not lineage.get_default().enabled
+    monkeypatch.setenv("DCT_LINEAGE", "1")
+    monkeypatch.setenv("DCT_OBSERVABILITY", "0")
+    # Subordinate to the master switch.
+    assert not lineage.lineage_enabled()
+
+
+def test_inspector_reports_lineage_section(tmp_path, monkeypatch):
+    _fresh(monkeypatch, tmp_path)
+    from dct_tpu.observability.inspect import build_report
+
+    led = lineage.LineageLedger(str(tmp_path / "l.jsonl"), run_id="r")
+    pkg = led.node("deploy_package", content={"p": 1})
+    load = led.node("model_load", content={"l": 1})
+    led.edge("deployed", pkg, load)
+    records = lineage.read_ledger(str(tmp_path / "l.jsonl"))
+    report = build_report([], [], [], "r", None, lineage=records)
+    assert "Lineage:" in report
+    assert "deploy_package=1" in report and "model_load=1" in report
+    assert f"serving now: {load}" in report
+    assert f"<- {pkg}" in report
+    # No ledger -> no section.
+    assert "Lineage:" not in build_report([], [], [], "r", None, lineage=[])

@@ -92,6 +92,33 @@ def test_span_open_end_and_disabled_recorder(tmp_path):
     assert off.child_env()["DCT_RUN_ID"] == "dct-t3"
 
 
+def test_disabled_recorder_span_contract(monkeypatch):
+    """The disabled recorder (path=None) must stay ID-transparent: spans
+    still mint real 16-hex ids, the thread stack still parents them, and
+    child_env still exports DCT_SPAN_ID — a rig that silenced telemetry
+    must not silently break cross-process span parenting for children
+    whose OWN recorder may be enabled."""
+    import re
+
+    monkeypatch.delenv("DCT_SPAN_ID", raising=False)
+    off = SpanRecorder(None, trace_id="dct-off")
+    assert not off.enabled
+    with off.span("launcher.launch") as outer:
+        assert re.fullmatch(r"[0-9a-f]{16}", outer.span_id)
+        assert off.current_span_id() == outer.span_id
+        with off.span("launcher.rank") as inner:
+            assert inner.parent_id == outer.span_id
+            env = off.child_env({"KEEP": "1"})
+            assert env["DCT_SPAN_ID"] == inner.span_id
+            assert env["DCT_RUN_ID"] == "dct-off"
+            assert env["KEEP"] == "1"
+    # Stack unwound; with no ambient parent there is nothing to export,
+    # but the trace id still rides along.
+    assert off.current_span_id() is None
+    assert "DCT_SPAN_ID" not in off.child_env()
+    assert off.child_env()["DCT_RUN_ID"] == "dct-off"
+
+
 def test_span_recorder_failure_degrades_to_noop(tmp_path):
     blocker = tmp_path / "plainfile"
     blocker.write_text("x")
