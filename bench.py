@@ -1172,6 +1172,203 @@ def bench_elastic_serving(tmp: str) -> dict:
     return out
 
 
+def bench_telemetry_history(tmp: str) -> dict:
+    """Telemetry history plane (ISSUE 17), two bounds per round:
+
+    - **publish overhead** — p50 of ``SnapshotPublisher.publish()``
+      plain vs with the history store teeing every snapshot
+      (``timeseries.HistoryWriter`` at default flush settings). The
+      store's whole design contract is "appends are memory pushes,
+      disk only every flush window"; ``publish_overhead_ms`` is that
+      contract as a tracked number (the sentinel gates it like a
+      latency).
+    - **detection latency** — the real serving chain (metrics plane +
+      history store + anomaly monitor armed off env), baseline load to
+      warm the EWMA, then a planted ``slow_score`` fault overloads the
+      queue: seconds from planting to the ``queue_depth`` watch firing
+      FROM THE ON-DISK HISTORY — the store→flush→read→detect pipeline
+      end to end (``detect_latency_s`` on the sentinel)."""
+    import statistics
+    import threading
+
+    import numpy as np
+
+    from dct_tpu.observability.aggregate import SnapshotPublisher
+    from dct_tpu.observability.metrics import MetricsRegistry
+    from dct_tpu.observability.timeseries import HistoryWriter
+
+    # -- publish overhead: armed vs plain ------------------------------
+    def _registry() -> MetricsRegistry:
+        """A representative live registry: a labelled counter, a busy
+        histogram and a gauge — the shape a serving worker snapshots."""
+        reg = MetricsRegistry()
+        c = reg.counter("dct_requests_total", "bench")
+        h = reg.histogram(
+            "dct_serve_queue_depth", "bench",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+        )
+        g = reg.gauge("dct_train_goodput_fraction", "bench", agg="last")
+        for i in range(64):
+            c.inc(1, {"slot": "serving"})
+            h.observe(float(i % 9))
+        g.set(0.7)
+        return reg
+
+    def _publish_pair() -> tuple[float, float]:
+        """p50 publish latency (plain, armed), measured INTERLEAVED —
+        alternating one plain and one armed publish per iteration so
+        ambient drift (page-cache state, CPU frequency, a noisy
+        neighbour) lands on both medians equally instead of biasing
+        whichever ran second."""
+        pubs = {}
+        for label, history in (
+            ("plain", None),
+            ("armed", HistoryWriter(
+                os.path.join(tmp, "th_store"), proc="bench",
+            )),
+        ):
+            pubs[label] = SnapshotPublisher(
+                _registry(), os.path.join(tmp, f"th_metrics_{label}"),
+                proc="bench", interval_s=1e9, start_timer=False,
+                history=history,
+            )
+        times = {"plain": [], "armed": []}
+        try:
+            for _ in range(160):
+                for label, pub in pubs.items():
+                    t0 = time.perf_counter()
+                    pub.publish()
+                    times[label].append(time.perf_counter() - t0)
+                # Pace the loop: real publishers fire on a seconds-scale
+                # timer, so the history flusher thread's segment writes
+                # happen BETWEEN publishes. Back-to-back publishes with
+                # no gap would instead measure a GIL duel with that
+                # thread — a workload the publish path never sees.
+                time.sleep(0.001)
+        finally:
+            for pub in pubs.values():
+                pub.close(final=False)
+        return (
+            statistics.median(times["plain"]) * 1e3,
+            statistics.median(times["armed"]) * 1e3,
+        )
+
+    plain_ms, armed_ms = _publish_pair()
+
+    # -- detection latency through the real serving chain --------------
+    from dct_tpu.config import ServingConfig
+    from dct_tpu.resilience import faults
+    from dct_tpu.serving import loadgen
+    from dct_tpu.serving.server import make_server_from_weights
+
+    service_ms, fault_ms = 2.0, 30.0
+    base_qps, spike_qps = 40.0, 80.0
+    baseline_s, budget_s = 1.6, 12.0
+    knobs = {
+        "DCT_METRICS_DIR": os.path.join(tmp, "th_e2e_metrics"),
+        "DCT_TS_DIR": os.path.join(tmp, "th_e2e_ts"),
+        "DCT_EVENTS_DIR": os.path.join(tmp, "th_e2e_events"),
+        "DCT_METRICS_PUBLISH_S": "0.1",
+        "DCT_TS_FLUSH_S": "0.15",
+        "DCT_ANOMALY_POLL_S": "0.1",
+        "DCT_ANOMALY_MIN_POINTS": "5",
+        "DCT_ANOMALY_WINDOW_S": "8",
+        "DCT_ANOMALY_Z": "3.5",
+        # No bundle assembly inside the timing loop — the latency being
+        # measured is detection, not evidence collection.
+        "DCT_INCIDENT": "0",
+        "DCT_SLO_SPEC": "",
+    }
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    weights, meta = loadgen.synthetic_mlp()
+    rng = np.random.default_rng(0)
+    body = json.dumps({
+        "data": rng.standard_normal((1, meta["input_dim"])).round(4)
+        .tolist()
+    }).encode()
+    detect_latency = None
+    try:
+        serving = ServingConfig(
+            max_batch=1, workers=1, batch_window_ms=0.0,
+        )
+        faults.set_default(
+            faults.FaultPlan.parse(f"slow_score:ms{int(service_ms)}")
+        )
+        server = make_server_from_weights(weights, meta, serving=serving)
+        monitor = getattr(server, "history_monitor", None)
+        host, port = server.server_address[:2]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            if monitor is None:
+                raise RuntimeError(
+                    "history monitor did not arm (DCT_TS_DIR path)"
+                )
+            # Warm the EWMA baseline under healthy load.
+            loadgen.run_open_loop(
+                host, port, body, qps=base_qps, duration_s=baseline_s,
+                max_inflight=64,
+            )
+            # Plant the fault: every flush now costs fault_ms, the
+            # spike load overloads the single worker, queue depth grows.
+            faults.set_default(
+                faults.FaultPlan.parse(f"slow_score:ms{int(fault_ms)}")
+            )
+            spike = threading.Thread(
+                target=loadgen.run_open_loop,
+                args=(host, port, body),
+                kwargs={
+                    "qps": spike_qps, "duration_s": budget_s,
+                    "max_inflight": 400,
+                },
+                daemon=True,
+            )
+            t_plant = time.perf_counter()
+            spike.start()
+            while time.perf_counter() - t_plant < budget_s:
+                if any(
+                    a.get("signal") == "queue_depth"
+                    for a in monitor.detector.active()
+                ):
+                    detect_latency = time.perf_counter() - t_plant
+                    break
+                time.sleep(0.02)
+            spike.join(timeout=budget_s)
+        finally:
+            faults.set_default(None)
+            server.shutdown()
+            server.server_close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    out = {
+        "plain_publish_p50_ms": round(plain_ms, 4),
+        "armed_publish_p50_ms": round(armed_ms, 4),
+        "publish_overhead_ms": round(max(0.0, armed_ms - plain_ms), 4),
+        "overhead_frac": (
+            round(max(0.0, armed_ms / plain_ms - 1.0), 4)
+            if plain_ms > 0 else None
+        ),
+        "detected": detect_latency is not None,
+        "detect_latency_s": (
+            round(detect_latency, 3) if detect_latency is not None
+            else None
+        ),
+        "rig": {
+            "service_ms": service_ms, "fault_ms": fault_ms,
+            "base_qps": base_qps, "spike_qps": spike_qps,
+            "baseline_s": baseline_s, "budget_s": budget_s,
+        },
+    }
+    _leg("telemetry_detect_latency_s", out["detect_latency_s"])
+    return out
+
+
 #: restart_spinup leg model: a transformer whose fused-epoch program
 #: makes XLA compile the dominant cold-relaunch cost on the CPU rig
 #: (the regime the cache exists for). Serial span consume pins ONE
@@ -2300,6 +2497,10 @@ def _stdout_record(record: dict) -> dict:
     # Derivable duplicate: trainer_loop / baseline, both already on the
     # line byte for byte (the partial keeps the computed field).
     out.pop("trainer_loop_vs_baseline", None)
+    # The unit is a constant of the metric name ("samples/sec/chip",
+    # verbatim in the partial) — bytes reclaimed to fund the
+    # telemetry_history sentinel series.
+    out.pop("unit", None)
     rs = out.get("restart_spinup")
     if isinstance(rs, dict):
         # Stdout carries the warm numbers (the sentinel's tracked
@@ -2438,6 +2639,17 @@ def _stdout_record(record: dict) -> dict:
                 "p99_ratio_off", "bounded",
             )
             if k in es
+        }
+    th = out.get("telemetry_history")
+    if isinstance(th, dict) and "error" not in th:
+        # Stdout carries ONLY the two sentinel series — the stdout line
+        # is near its budget, so the plain/armed p50 pair behind the
+        # overhead and the rig knobs stay in the partial (the overhead
+        # carries the A/B story in one number).
+        out["telemetry_history"] = {
+            k: th[k]
+            for k in ("detect_latency_s", "publish_overhead_ms")
+            if k in th
         }
     hd = out.get("host_dataplane")
     if isinstance(hd, dict) and "error" not in hd:
@@ -2584,6 +2796,10 @@ def _shrink_to_budget(out: dict) -> dict:
         # to the partial under squeeze).
         ("elastic_serving", ("overload_p99_s", "shed_fraction",
                              "p99_ratio_on", "p99_ratio_off")),
+        # Telemetry history: reachability guard (the digest already
+        # keeps exactly these two sentinel series).
+        ("telemetry_history", ("detect_latency_s",
+                               "publish_overhead_ms")),
         # Late probe squeeze: the fallback-reason prose yields before
         # the serving levels do (the partial keeps the full reason; a
         # cpu `platform` on the record already says a fallback
@@ -2605,6 +2821,11 @@ def _shrink_to_budget(out: dict) -> dict:
         ("multi_tenant", ("min_goodput_fraction", "mean_round_wait_s")),
         ("host_dataplane", ("rows_speedup",)),
         ("probe", ("platform",)),
+        # Late squeeze funding the telemetry_history sentinel series:
+        # the elastic A/B ratio pair yields (verbatim in the partial)
+        # before the serving_load level columns do — the two elastic
+        # sentinel series always survive tier 1.
+        ("elastic_serving", ("overload_p99_s", "shed_fraction")),
         # The serving tier's headline stanza goes LAST in tier 1: its
         # per-level qps/p50/p99 columns outlive every other stanza's
         # detail (the acceptance contract wants >= 2 levels on the
@@ -2649,6 +2870,7 @@ def _shrink_to_budget(out: dict) -> dict:
         ("mpmd_pipeline", ("mpmd_steady_bubble", "mpmd_sps_ratio")),
         ("roofline", ("mfu",)),
         ("elastic_serving", ("overload_p99_s", "shed_fraction")),
+        ("telemetry_history", ("detect_latency_s",)),
         ("moe", ("sorted_speedup",)),
         ("trainer_gap", ("fused_over_fit", "prefetch_spans")),
         ("scaled", ("step_time_ms", "attn_blockwise_ms",
@@ -3231,6 +3453,20 @@ def main():
             )
             _flush_partial(record)
 
+        # Telemetry history plane (ISSUE 17): armed-vs-plain snapshot
+        # publish p50 + seconds from a planted slow_score fault to the
+        # anomaly detector firing FROM the on-disk history, through the
+        # real serving chain. Host-CPU leg like elastic_serving;
+        # DCT_BENCH_TELEMETRY=0 skips (the in-process smoke's knob).
+        skip_telemetry = os.environ.get(
+            "DCT_BENCH_TELEMETRY", "1"
+        ).strip().lower() in ("0", "false", "no")
+        if not (skip_telemetry or _gate("telemetry_history", frac=0.97)):
+            record["telemetry_history"] = _optional(
+                "telemetry_history", bench_telemetry_history, tmp
+            )
+            _flush_partial(record)
+
         if not _gate("host_dataplane"):
             dataplane = _optional(
                 "host_dataplane", bench_host_dataplane
@@ -3252,7 +3488,7 @@ def main():
         "scaled", "moe", "val_parity", "serving", "serving_load",
         "elastic_serving", "restart_spinup", "cycle_freshness",
         "model_sharded", "multi_tenant", "mpmd_pipeline",
-        "host_dataplane", "roofline",
+        "telemetry_history", "host_dataplane", "roofline",
     ):
         record.setdefault(skippable, None)
     _flush_partial(record)

@@ -16,6 +16,9 @@ One module per concern:
 - :mod:`lineage_rules` — ``lineage-publish`` (``os.replace``
   artifact-publish sites in the data/ETL, checkpoint and deploy
   layers record provenance in the lineage ledger).
+- :mod:`metric_rules` — ``metric-docs`` (``dct_*`` metric families
+  rendered in ``dct_tpu/`` vs the ``docs/OBSERVABILITY.md`` metric
+  table).
 
 To add a rule: subclass :class:`dct_tpu.analysis.core.Rule`, decorate
 with :func:`dct_tpu.analysis.core.register`, import the module here,
@@ -26,6 +29,7 @@ and pair it with good/bad fixtures in ``tests/test_analysis.py``
 from dct_tpu.analysis.rules import (  # noqa: F401 — imported to register
     io_rules,
     lineage_rules,
+    metric_rules,
     purity_rules,
     registry_rules,
 )

@@ -1,0 +1,119 @@
+"""``metric-docs`` — every ``dct_*`` metric family is documented.
+
+The metric plane is an operator API exactly like the event log: a
+``dct_*`` family rendered on ``/metrics`` (or into the textfile dump)
+that ``docs/OBSERVABILITY.md``'s metric table does not name is a
+series no dashboard, alert or sentinel will ever chart. Mirror of the
+``event-names`` rule, for the other telemetry schema.
+
+What counts as "rendering a family" (statically provable sites only):
+
+- registry definition calls — ``*.counter("dct_x", ...)`` /
+  ``*.gauge(...)`` / ``*.histogram(...)`` (plus the serving tier's
+  local ``hist(...)`` binding of the same method);
+- direct :class:`MetricFamily` construction (the merge/SLO layers);
+- hand-rendered exposition text — any ``# TYPE <family> ...`` literal
+  (the lineage plane renders its families this way).
+
+Dynamic names are invisible by design — the rule checks what it can
+prove; the docs table remains the review checklist for the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from dct_tpu.analysis.core import Finding, Project, Rule, register
+from dct_tpu.analysis.rules._helpers import func_repr
+
+_DOCS_RELPATH = "docs/OBSERVABILITY.md"
+_METRIC_NAME_RE = re.compile(r"^dct_[a-z0-9_]+$")
+_TYPE_LINE_RE = re.compile(r"#\s*TYPE\s+(dct_[a-z0-9_]+)\s")
+_METRIC_TABLE_HEADER_RE = re.compile(r"^\|\s*metric\s*\|", re.I)
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+#: Callee tails that define a metric family with their first argument.
+_DEF_TAILS = ("counter", "gauge", "histogram", "hist", "MetricFamily")
+
+
+def parse_metric_table(markdown: str) -> set[str] | None:
+    """The ``| metric | ... |`` table -> documented family names (every
+    backticked ``dct_*`` token in the first cell). None when absent."""
+    lines = markdown.splitlines()
+    for i, line in enumerate(lines):
+        if not _METRIC_TABLE_HEADER_RE.match(line.strip()):
+            continue
+        names: set[str] = set()
+        for row in lines[i + 1 :]:
+            row = row.strip()
+            if not row.startswith("|"):
+                break
+            cells = [c.strip() for c in row.strip("|").split("|")]
+            if not cells or set(cells[0]) <= {"-", " ", ":"}:
+                continue
+            for token in _BACKTICK_RE.findall(cells[0]):
+                if _METRIC_NAME_RE.match(token):
+                    names.add(token)
+        return names
+    return None
+
+
+def collect_metric_defs(ctx) -> dict[str, int]:
+    """``dct_*`` families this file provably renders -> first line."""
+    out: dict[str, int] = {}
+    if ctx.tree is None:
+        return out
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            tail = func_repr(node).rsplit(".", 1)[-1]
+            if tail in _DEF_TAILS and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    if _METRIC_NAME_RE.match(a.value):
+                        out.setdefault(a.value, node.lineno)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            for m in _TYPE_LINE_RE.finditer(node.value):
+                out.setdefault(m.group(1), node.lineno)
+    return out
+
+
+@register
+class MetricDocsRule(Rule):
+    id = "metric-docs"
+    name = "dct_* metric families are documented"
+    doc = (
+        "Every `dct_*` metric family rendered anywhere in `dct_tpu/` "
+        "(registry counter/gauge/histogram definitions, MetricFamily "
+        "constructions, hand-rendered `# TYPE` exposition lines) must "
+        "appear in docs/OBSERVABILITY.md's metric table. An "
+        "undocumented family is a series no operator query will find — "
+        "document it (one table row) in the same change that adds it."
+    )
+
+    def check(self, project: Project) -> list[Finding]:
+        markdown = project.read(_DOCS_RELPATH)
+        table = parse_metric_table(markdown) if markdown else None
+        if table is None:
+            table = set()
+        out: list[Finding] = []
+        for ctx in project.contexts:
+            if not ctx.relpath.startswith("dct_tpu/"):
+                continue
+            for name, lineno in sorted(collect_metric_defs(ctx).items()):
+                if name not in table:
+                    out.append(
+                        Finding(
+                            rule=self.id,
+                            path=ctx.relpath,
+                            line=lineno,
+                            message=(
+                                f"metric family `{name}` is not in "
+                                f"{_DOCS_RELPATH}'s metric table — add "
+                                "a row documenting it (the metric "
+                                "plane is an operator API)"
+                            ),
+                            snippet=ctx.line(lineno).strip(),
+                        )
+                    )
+        return out

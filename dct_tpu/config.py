@@ -482,6 +482,44 @@ class ObservabilityConfig:
     slo_fast_window_s: float = 300.0
     slo_slow_window_s: float = 3600.0
     slo_burn_threshold: float = 1.0
+    # Telemetry history plane (observability/timeseries.py; ISSUE 17):
+    # every SnapshotPublisher also appends its snapshots to per-process
+    # segment files under this dir. "" = plane off (the default — the
+    # instantaneous metrics plane is untouched).
+    ts_dir: str = ""
+    # Comma-separated fnmatch patterns selecting the recorded families.
+    ts_families: str = ""
+    # Segment seal thresholds: points per raw segment / max segment age.
+    ts_seg_points: int = 240
+    ts_seg_s: float = 600.0
+    # Active-segment republish cadence (appends between flushes are
+    # memory-only — the armed-publish overhead budget lives here).
+    ts_flush_s: float = 10.0
+    # Sealed raw segments older than downsample_s fold into the coarse
+    # ds tier (ds_res_s-wide bins); anything older than retention_s is
+    # deleted at compaction time.
+    ts_retention_s: float = 10800.0
+    ts_downsample_s: float = 900.0
+    ts_ds_res_s: float = 60.0
+    # Online anomaly detection over the history store (detect.py):
+    # EWMA/z-score change detection, edge-triggered like the SLO
+    # monitor. Armed only when ts_dir is set.
+    anomaly: bool = True
+    anomaly_z: float = 4.0
+    anomaly_alpha: float = 0.3
+    anomaly_min_points: int = 8
+    anomaly_window_s: float = 30.0
+    anomaly_poll_s: float = 2.0
+    # Auto-assembled incident bundles (incident.py): anomaly / SLO
+    # triggers snapshot the surrounding window + events + lineage into
+    # incidents/<stamp>-<signal>/. "" dir = sibling of ts_dir.
+    incident: bool = True
+    incident_dir: str = ""
+    incident_window_s: float = 120.0
+    incident_cooldown_s: float = 300.0
+    # Fire the PR 14 flight recorder into each bundle (profile/).
+    incident_profile: bool = False
+    incident_profile_s: float = 2.0
 
     @classmethod
     def from_env(cls) -> "ObservabilityConfig":
@@ -524,6 +562,42 @@ class ObservabilityConfig:
         )
         c.slo_burn_threshold = _env(
             "DCT_SLO_BURN_THRESHOLD", c.slo_burn_threshold, float
+        )
+        c.ts_dir = _env("DCT_TS_DIR", c.ts_dir, str)
+        c.ts_families = _env("DCT_TS_FAMILIES", c.ts_families, str)
+        c.ts_seg_points = _env("DCT_TS_SEG_POINTS", c.ts_seg_points, int)
+        c.ts_seg_s = _env("DCT_TS_SEG_S", c.ts_seg_s, float)
+        c.ts_flush_s = _env("DCT_TS_FLUSH_S", c.ts_flush_s, float)
+        c.ts_retention_s = _env("DCT_TS_RETENTION_S", c.ts_retention_s, float)
+        c.ts_downsample_s = _env(
+            "DCT_TS_DOWNSAMPLE_S", c.ts_downsample_s, float
+        )
+        c.ts_ds_res_s = _env("DCT_TS_DS_RES_S", c.ts_ds_res_s, float)
+        c.anomaly = _env("DCT_ANOMALY", c.anomaly, bool)
+        c.anomaly_z = _env("DCT_ANOMALY_Z", c.anomaly_z, float)
+        c.anomaly_alpha = _env("DCT_ANOMALY_ALPHA", c.anomaly_alpha, float)
+        c.anomaly_min_points = _env(
+            "DCT_ANOMALY_MIN_POINTS", c.anomaly_min_points, int
+        )
+        c.anomaly_window_s = _env(
+            "DCT_ANOMALY_WINDOW_S", c.anomaly_window_s, float
+        )
+        c.anomaly_poll_s = _env(
+            "DCT_ANOMALY_POLL_S", c.anomaly_poll_s, float
+        )
+        c.incident = _env("DCT_INCIDENT", c.incident, bool)
+        c.incident_dir = _env("DCT_INCIDENT_DIR", c.incident_dir, str)
+        c.incident_window_s = _env(
+            "DCT_INCIDENT_WINDOW_S", c.incident_window_s, float
+        )
+        c.incident_cooldown_s = _env(
+            "DCT_INCIDENT_COOLDOWN_S", c.incident_cooldown_s, float
+        )
+        c.incident_profile = _env(
+            "DCT_INCIDENT_PROFILE", c.incident_profile, bool
+        )
+        c.incident_profile_s = _env(
+            "DCT_INCIDENT_PROFILE_S", c.incident_profile_s, float
         )
         return c
 
@@ -1203,6 +1277,26 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_PROF_SIGUSR2": "arm SIGUSR2 as an on-demand capture trigger",
     "DCT_ROOFLINE": "XLA cost-model roofline accounting on/off",
     "DCT_HBM_GBPS": "per-chip HBM bandwidth override for roofline math",
+    "DCT_TS_DIR": "telemetry history store dir ('' = plane off)",
+    "DCT_TS_FAMILIES": "fnmatch patterns of recorded dct_* families",
+    "DCT_TS_SEG_POINTS": "points per raw segment before sealing",
+    "DCT_TS_SEG_S": "max raw segment age before sealing (s)",
+    "DCT_TS_FLUSH_S": "active-segment republish cadence (s)",
+    "DCT_TS_RETENTION_S": "segment age deleted at compaction (s)",
+    "DCT_TS_DOWNSAMPLE_S": "raw-segment age folded to the ds tier (s)",
+    "DCT_TS_DS_RES_S": "downsampled-tier bin width (s)",
+    "DCT_ANOMALY": "EWMA/z-score anomaly detection over the history",
+    "DCT_ANOMALY_Z": "anomaly z-score trigger threshold",
+    "DCT_ANOMALY_ALPHA": "EWMA baseline smoothing factor",
+    "DCT_ANOMALY_MIN_POINTS": "baseline samples before detection arms",
+    "DCT_ANOMALY_WINDOW_S": "history window per detector read (s)",
+    "DCT_ANOMALY_POLL_S": "detector poll cadence (s)",
+    "DCT_INCIDENT": "auto-assembled incident bundles on anomaly/SLO",
+    "DCT_INCIDENT_DIR": "bundle root ('' = sibling of DCT_TS_DIR)",
+    "DCT_INCIDENT_WINDOW_S": "history/event window per bundle (s)",
+    "DCT_INCIDENT_COOLDOWN_S": "min seconds between same-signal bundles",
+    "DCT_INCIDENT_PROFILE": "fire the flight recorder into each bundle",
+    "DCT_INCIDENT_PROFILE_S": "incident profile capture length (s)",
     # --- resilience ------------------------------------------------
     "DCT_MAX_RESTARTS": "supervised relaunch budget",
     "DCT_RESTART_BACKOFF_S": "first relaunch backoff",
@@ -1303,6 +1397,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_BENCH_MPMD": "bench mpmd_pipeline (MPMD-1F1B vs SPMD-GPipe bubble) leg on/off",
     "DCT_BENCH_ROOFLINE": "bench roofline (local cost-model MFU) leg on/off",
     "DCT_BENCH_ELASTIC": "bench elastic_serving (overload controls A/B) leg on/off",
+    "DCT_BENCH_TELEMETRY": "bench telemetry_history (detect latency + publish overhead) leg on/off",
     "DCT_BENCH_DEADLINE": "bench wall-clock deadline (s); legs self-gate",
     "DCT_BENCH_PARTIAL": "path for the partial-results stash",
     "DCT_VAL_PARITY_EPOCHS": "val-loss parity leg epoch budget",

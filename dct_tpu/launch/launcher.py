@@ -508,6 +508,18 @@ class LocalProcessLauncher:
             )
             if metrics_pub is not None else None
         )
+        # Telemetry history plane (ISSUE 17): the launcher is the
+        # training fleet's natural watcher — when DCT_TS_DIR arms the
+        # store, it runs the anomaly detector over the ranks' live
+        # metric history (loss spikes, step-time regressions, goodput
+        # dips) and assembles incident bundles. None when unarmed.
+        anomaly_monitor = None
+        if metrics_pub is not None:
+            from dct_tpu.observability import detect as _detect
+
+            anomaly_monitor = _detect.arm_from_env(
+                registry=metrics_pub.registry, emit=events.emit,
+            )
         flagged: set[tuple[int, str]] = set()
         last_scan = 0.0
         try:
@@ -651,6 +663,8 @@ class LocalProcessLauncher:
                 for r in range(world_size)
             ]
         finally:
+            if anomaly_monitor is not None:
+                anomaly_monitor.close()
             if metrics_pub is not None:
                 # Progress age is a LIVE signal: retire the snapshot so
                 # a post-run scrape never reads a frozen age as current.

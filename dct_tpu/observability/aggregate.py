@@ -337,6 +337,7 @@ class SnapshotPublisher:
         interval_s: float = 2.0,
         clock=time.time,
         start_timer: bool = True,
+        history="env",
     ):
         self.registry = registry
         self.directory = directory
@@ -348,6 +349,15 @@ class SnapshotPublisher:
         self._stop = threading.Event()
         self._closed = False
         self._thread = None
+        # The telemetry history store (timeseries.py) rides the publish
+        # cadence of EVERY publisher: by default the hook self-arms off
+        # DCT_TS_DIR, so no call site needs plumbing. Pass an explicit
+        # HistoryWriter (tests) or None (opt out) to override.
+        if history == "env":
+            from dct_tpu.observability.timeseries import writer_from_env
+
+            history = writer_from_env(proc=proc, clock=clock)
+        self.history = history
         if start_timer and self.interval_s > 0:
             self._thread = threading.Thread(
                 target=self._loop, name=f"dct-metrics-{proc}", daemon=True
@@ -361,10 +371,11 @@ class SnapshotPublisher:
                 # retired snapshot (or clear a final one's flag).
                 return None
             self._last = self._clock()
-            return write_snapshot(
-                self.registry.snapshot(proc=self.proc, final=final),
-                self.directory,
-            )
+            snap = self.registry.snapshot(proc=self.proc, final=final)
+            path = write_snapshot(snap, self.directory)
+            if path is not None and self.history is not None:
+                self.history.append(snap)
+            return path
 
     def maybe_publish(self) -> bool:
         """Publish if the throttle window elapsed; True when written."""
@@ -395,11 +406,15 @@ class SnapshotPublisher:
             self._closed = True
             try:
                 if final:
-                    write_snapshot(
-                        self.registry.snapshot(proc=self.proc, final=True),
-                        self.directory,
-                    )
+                    snap = self.registry.snapshot(proc=self.proc, final=True)
+                    write_snapshot(snap, self.directory)
+                    if self.history is not None:
+                        self.history.append(snap)
                 else:
                     os.remove(snapshot_path(self.directory, self.proc))
             except OSError:
                 pass
+            if self.history is not None:
+                # Seal the active segment either way: the HISTORY of a
+                # retiring process is exactly what must outlive it.
+                self.history.close()

@@ -1,0 +1,349 @@
+"""Online anomaly detection over the on-disk metric history.
+
+The SLO monitor answers "is the service meeting its stated objective";
+this module answers the softer operational question "does this signal
+look like itself" — EWMA/z-score change detection over the
+:mod:`~dct_tpu.observability.timeseries` store, so a queue-depth ramp,
+a step-time regression or a loss spike is flagged without anyone
+having written a threshold for it (ISSUE 17).
+
+Semantics, deliberately mirroring the SLO monitor's edge-triggering:
+
+- every poll, each :class:`Watch` is reduced to ONE scalar from the
+  history store (gauge combined-last / counter rate / histogram window
+  mean — *never* from in-process state, so a detector in the pool
+  parent sees the whole fleet and survives worker restarts);
+- the scalar feeds an exponentially-weighted mean/variance baseline;
+  once ``min_points`` samples are in, a deviation of ``z`` sigmas in
+  the watched direction flips the signal anomalous — the baseline then
+  FREEZES (an anomaly must not teach the detector that anomalous is
+  normal) until the value re-enters ``z/2`` sigmas, which resolves it;
+- edges emit ``anomaly.detected`` / ``anomaly.resolved`` events and
+  drive ``dct_anomaly_active{signal}`` / ``dct_anomaly_total{signal}``
+  on the supplied registry, and the ``on_anomaly`` callback hands the
+  record to the incident assembler.
+
+:func:`arm_from_env` is the one-call wiring used by the serving
+server, the scheduler and the launcher: reader + detector + incident
+manager + poll thread, or None when ``DCT_TS_DIR`` is unset.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+from dct_tpu.observability.timeseries import HistoryReader
+
+#: Variance floor: 5% of the baseline mean (squared), so a perfectly
+#: flat healthy signal does not alert on measurement noise, while a
+#: zero-mean signal (shed rate) still alerts on its first real burst.
+_REL_VAR_FLOOR = 0.05
+_ABS_VAR_FLOOR = 1e-12
+
+
+class Watch:
+    """One watched signal: how to reduce a family to a scalar, and
+    which direction of departure is trouble."""
+
+    __slots__ = ("name", "metric", "kind", "direction", "window_s")
+
+    def __init__(
+        self,
+        name: str,
+        metric: str,
+        *,
+        kind: str = "gauge",
+        direction: str = "both",
+        window_s: float = 30.0,
+    ):
+        if kind not in ("gauge", "rate", "hist_mean"):
+            raise ValueError(f"unknown watch kind: {kind!r}")
+        if direction not in ("high", "low", "both"):
+            raise ValueError(f"unknown watch direction: {direction!r}")
+        self.name = name
+        self.metric = metric
+        self.kind = kind
+        self.direction = direction
+        self.window_s = float(window_s)
+
+
+def default_watches(*, window_s: float = 30.0) -> list[Watch]:
+    """The ISSUE 17 signal set: step time, goodput, queue depth, shed
+    rate, program MFU, grad norm — plus val-loss (the loss-spike
+    detector's fleet-visible twin)."""
+    w = window_s
+    return [
+        Watch("step_time", "dct_train_step_seconds",
+              direction="high", window_s=w),
+        Watch("goodput_fraction", "dct_train_goodput_fraction",
+              direction="low", window_s=w),
+        Watch("queue_depth", "dct_serve_queue_depth",
+              kind="hist_mean", direction="high", window_s=w),
+        Watch("shed_rate", "dct_serve_shed_total",
+              kind="rate", direction="high", window_s=w),
+        Watch("program_mfu", "dct_program_mfu",
+              direction="low", window_s=w),
+        Watch("grad_norm", "dct_train_grad_norm",
+              direction="high", window_s=w),
+        Watch("val_loss", "dct_train_val_loss",
+              direction="high", window_s=w),
+    ]
+
+
+class _WatchState:
+    __slots__ = ("mean", "var", "n", "active", "since", "last")
+
+    def __init__(self):
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+        self.active = False
+        self.since = 0.0
+        self.last = None
+
+
+class AnomalyDetector:
+    """EWMA/z-score change detection over a :class:`HistoryReader`."""
+
+    def __init__(
+        self,
+        reader: HistoryReader,
+        *,
+        watches: list[Watch] | None = None,
+        z: float = 4.0,
+        alpha: float = 0.3,
+        min_points: int = 8,
+        registry=None,
+        emit=None,
+        on_anomaly=None,
+        clock=time.time,
+    ):
+        self.reader = reader
+        self.watches = list(watches) if watches is not None else (
+            default_watches()
+        )
+        self.z = float(z)
+        self.alpha = min(1.0, max(0.001, float(alpha)))
+        self.min_points = max(1, int(min_points))
+        self._emit = emit
+        self._on_anomaly = on_anomaly
+        self._clock = clock
+        self._states = {w.name: _WatchState() for w in self.watches}
+        self._active_g = self._total_c = None
+        if registry is not None:
+            self._active_g = registry.gauge(
+                "dct_anomaly_active",
+                "1 while the named signal is anomalous (EWMA z-score "
+                "over the telemetry history store), else 0.",
+                agg="max",
+            )
+            self._total_c = registry.counter(
+                "dct_anomaly_total",
+                "Anomaly episodes detected per signal since start.",
+            )
+            for w in self.watches:
+                self._active_g.set(0.0, {"signal": w.name})
+
+    # -- one watch, one sample ------------------------------------------
+
+    def _zscore(self, st: _WatchState, value: float) -> float:
+        floor = max(
+            _ABS_VAR_FLOOR, (abs(st.mean) * _REL_VAR_FLOOR) ** 2
+        )
+        return (value - st.mean) / math.sqrt(max(st.var, floor))
+
+    def observe(self, watch: Watch, value: float, *, now: float) -> None:
+        """Feed one scalar; fires/resolves on edges. Exposed for unit
+        tests — :meth:`poll` is the production entry."""
+        st = self._states[watch.name]
+        st.last = value
+        zs = self._zscore(st, value) if st.n >= self.min_points else 0.0
+        directed = (
+            zs if watch.direction == "high"
+            else -zs if watch.direction == "low"
+            else abs(zs)
+        )
+        if st.active:
+            if abs(zs) <= self.z / 2.0:
+                st.active = False
+                self._edge(watch, st, "anomaly.resolved", value, zs, now)
+            else:
+                return  # baseline frozen while anomalous
+        elif st.n >= self.min_points and directed >= self.z:
+            st.active = True
+            st.since = now
+            if self._total_c is not None:
+                self._total_c.inc(1, {"signal": watch.name})
+            self._edge(watch, st, "anomaly.detected", value, zs, now)
+            return  # the anomalous sample must not enter the baseline
+        diff = value - st.mean
+        incr = self.alpha * diff
+        st.mean += incr
+        st.var = (1.0 - self.alpha) * (st.var + diff * incr)
+        st.n += 1
+
+    def _edge(
+        self, watch: Watch, st: _WatchState, event: str,
+        value: float, zs: float, now: float,
+    ) -> None:
+        if self._active_g is not None:
+            self._active_g.set(
+                1.0 if st.active else 0.0, {"signal": watch.name}
+            )
+        rec = {
+            "signal": watch.name,
+            "metric": watch.metric,
+            "kind": watch.kind,
+            "direction": watch.direction,
+            "value": round(float(value), 6),
+            "zscore": round(float(zs), 3),
+            "baseline_mean": round(float(st.mean), 6),
+            "ts": now,
+        }
+        if event == "anomaly.resolved":
+            rec["duration_s"] = round(max(0.0, now - st.since), 3)
+        if self._emit is not None:
+            try:
+                self._emit("anomaly", event, **rec)
+            except Exception:  # noqa: BLE001 — telemetry never fails the run
+                pass
+        if event == "anomaly.detected" and self._on_anomaly is not None:
+            try:
+                self._on_anomaly(rec)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- store-driven polling -------------------------------------------
+
+    def _read(self, watch: Watch, now: float) -> float | None:
+        if watch.kind == "rate":
+            return self.reader.counter_rate(
+                watch.metric, window_s=watch.window_s, now=now
+            )
+        if watch.kind == "hist_mean":
+            return self.reader.hist_mean(
+                watch.metric, window_s=watch.window_s, now=now
+            )
+        return self.reader.gauge_last(
+            watch.metric, window_s=watch.window_s, now=now
+        )
+
+    def poll(self, *, now: float | None = None) -> list[dict]:
+        """One detection pass over every watch; returns the signals
+        currently anomalous (the monitor thread discards this; tests
+        and the incident CLI use it)."""
+        if now is None:
+            now = self._clock()
+        for watch in self.watches:
+            try:
+                value = self._read(watch, now)
+            except Exception:  # noqa: BLE001 — a torn segment or racing
+                continue  # compaction must not kill the poll loop
+            if value is None or not math.isfinite(value):
+                continue
+            self.observe(watch, value, now=now)
+        return self.active()
+
+    def active(self) -> list[dict]:
+        out = []
+        for w in self.watches:
+            st = self._states[w.name]
+            if st.active:
+                out.append({
+                    "signal": w.name, "metric": w.metric,
+                    "since": st.since, "value": st.last,
+                })
+        return out
+
+
+class HistoryMonitor:
+    """Daemon poll loop around a detector (and, via ``on_anomaly``,
+    the incident assembler). One per arming process."""
+
+    def __init__(
+        self,
+        detector: AnomalyDetector,
+        *,
+        poll_s: float = 2.0,
+        incidents=None,
+        reader: HistoryReader | None = None,
+    ):
+        self.detector = detector
+        self.incidents = incidents
+        self.reader = reader if reader is not None else detector.reader
+        self.poll_s = max(0.1, float(poll_s))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "HistoryMonitor":
+        self._thread = threading.Thread(
+            target=self._loop, name="dct-anomaly-monitor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.detector.poll()
+            except Exception:  # noqa: BLE001 — detection never kills a proc
+                continue
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        if self.incidents is not None:
+            self.incidents.close()
+
+
+def arm_from_env(
+    *,
+    registry=None,
+    emit=None,
+    watches: list[Watch] | None = None,
+    clock=time.time,
+) -> HistoryMonitor | None:
+    """Build the whole detection plane from env: history reader +
+    anomaly detector (``DCT_ANOMALY``) + incident assembler
+    (``DCT_INCIDENT``) + started poll thread. None when ``DCT_TS_DIR``
+    is unset or detection is disabled — callers treat None as 'plane
+    off' and keep their in-memory paths."""
+    from dct_tpu.config import ObservabilityConfig
+
+    obs = ObservabilityConfig.from_env()
+    if not obs.ts_dir or not obs.anomaly:
+        return None
+    try:
+        reader = HistoryReader(obs.ts_dir, clock=clock)
+        incidents = None
+        if obs.incident:
+            from dct_tpu.observability.incident import IncidentManager
+
+            incidents = IncidentManager.from_env(
+                obs, reader=reader, emit=emit, clock=clock
+            )
+        detector = AnomalyDetector(
+            reader,
+            watches=watches if watches is not None else default_watches(
+                window_s=obs.anomaly_window_s
+            ),
+            z=obs.anomaly_z,
+            alpha=obs.anomaly_alpha,
+            min_points=obs.anomaly_min_points,
+            registry=registry,
+            emit=emit,
+            on_anomaly=(
+                incidents.on_anomaly if incidents is not None else None
+            ),
+            clock=clock,
+        )
+        return HistoryMonitor(
+            detector, poll_s=obs.anomaly_poll_s,
+            incidents=incidents, reader=reader,
+        ).start()
+    except Exception:  # noqa: BLE001 — telemetry never fails the run
+        return None

@@ -1,0 +1,425 @@
+"""Auto-assembled incident bundles: the "what just happened" directory.
+
+When the anomaly detector (:mod:`~dct_tpu.observability.detect`) or
+the SLO monitor fires, the operator's next five commands are always
+the same — slice the metric history around the edge, grep the event
+log, find which deploy was live, maybe grab a profile. This module
+runs those five commands automatically (ISSUE 17): one trigger becomes
+one self-contained ``incidents/<stamp>-<signal>/`` directory:
+
+    incident.json     trigger record, lineage id, manifest — written
+                      LAST via tmp+``os.replace``, so its existence
+                      marks a complete bundle
+    timeseries.json   the surrounding history-store window
+    events.jsonl      event records inside the window (all logs)
+    spans.jsonl       span records inside the window
+    lineage.json      the newest deploy_package / model_load node from
+                      the PR 16 ledger (the "what was live" answer)
+    profile/          optional (``DCT_INCIDENT_PROFILE=1``): a PR 14
+                      flight-recorder capture fired at trigger time
+
+Triggers are rate-limited per signal (``DCT_INCIDENT_COOLDOWN_S``) —
+a flapping detector must not carpet the disk — and assembly runs on a
+daemon thread: the scrape path and the detector poll loop only pay a
+thread spawn. ``python -m dct_tpu.observability.incident`` lists,
+shows and manually assembles bundles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+from dct_tpu.observability.timeseries import HistoryReader, _write_json
+
+_BUNDLE_MANIFEST = "incident.json"
+#: Per-log tail bound when slicing events/spans — an incident window
+#: never needs more, and an unbounded read of a week-long log would
+#: make assembly cost proportional to uptime.
+_TAIL_LINES = 4000
+
+
+def default_incident_dir(ts_dir: str) -> str:
+    """Sibling of the store (``.../ts`` → ``.../incidents``): bundles
+    must not masquerade as a proc's segment directory."""
+    parent = os.path.dirname(ts_dir.rstrip("/")) or "."
+    return os.path.join(parent, "incidents")
+
+
+def _tail_jsonl(path: str, start_ts: float, end_ts: float) -> list[dict]:
+    out: list[dict] = []
+    try:
+        with open(path, "rb") as f:
+            lines = f.readlines()[-_TAIL_LINES:]
+    except OSError:
+        return out
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)) and start_ts <= ts <= end_ts:
+            out.append(rec)
+    return out
+
+
+def _slice_logs(directory: str, start_ts: float, end_ts: float) -> list[dict]:
+    recs: list[dict] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return recs
+    for name in names:
+        if name.endswith(".jsonl"):
+            recs.extend(
+                _tail_jsonl(os.path.join(directory, name), start_ts, end_ts)
+            )
+    recs.sort(key=lambda r: r.get("ts", 0.0))
+    return recs
+
+
+def _active_lineage(ledger_path: str) -> dict | None:
+    """The newest deploy_package (preferred) or model_load node — the
+    'what was live when it broke' pointer the bundle names."""
+    from dct_tpu.observability import lineage
+
+    try:
+        records = lineage.read_ledger(ledger_path)
+    except Exception:  # noqa: BLE001
+        return None
+    best = None
+    for rec in records:
+        if rec.get("type") != "node":
+            continue
+        if rec.get("kind") == "deploy_package":
+            best = rec
+        elif rec.get("kind") == "model_load" and (
+            best is None or best.get("kind") != "deploy_package"
+        ):
+            best = rec
+    return best
+
+
+class IncidentManager:
+    """Trigger sink + bundle assembler for one arming process."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        reader: HistoryReader | None = None,
+        ts_dir: str | None = None,
+        events_dir: str | None = None,
+        spans_dir: str | None = None,
+        lineage_path: str | None = None,
+        window_s: float = 120.0,
+        cooldown_s: float = 300.0,
+        profile: bool = False,
+        profile_s: float = 2.0,
+        emit=None,
+        clock=time.time,
+    ):
+        self.directory = directory
+        if reader is None and ts_dir:
+            reader = HistoryReader(ts_dir, clock=clock)
+        self.reader = reader
+        self.events_dir = events_dir
+        self.spans_dir = spans_dir
+        self.lineage_path = lineage_path
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self.profile = bool(profile)
+        self.profile_s = float(profile_s)
+        self._emit = emit
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_by_signal: dict[str, float] = {}
+        self._threads: list[threading.Thread] = []
+        self.assembled = 0
+
+    @classmethod
+    def from_env(cls, obs=None, *, reader=None, emit=None, clock=time.time):
+        """Build from :class:`~dct_tpu.config.ObservabilityConfig`
+        (read from env when not supplied); None when unarmed."""
+        from dct_tpu.config import ObservabilityConfig
+        from dct_tpu.observability import lineage
+
+        if obs is None:
+            obs = ObservabilityConfig.from_env()
+        if not obs.ts_dir or not obs.incident:
+            return None
+        return cls(
+            obs.incident_dir or default_incident_dir(obs.ts_dir),
+            reader=reader,
+            ts_dir=obs.ts_dir,
+            events_dir=obs.events_dir,
+            spans_dir=obs.spans_dir or os.path.join(obs.events_dir, "spans"),
+            lineage_path=lineage.default_ledger_path(),
+            window_s=obs.incident_window_s,
+            cooldown_s=obs.incident_cooldown_s,
+            profile=obs.incident_profile,
+            profile_s=obs.incident_profile_s,
+            emit=emit,
+            clock=clock,
+        )
+
+    # -- triggers --------------------------------------------------------
+
+    def on_anomaly(self, rec: dict) -> None:
+        """``AnomalyDetector.on_anomaly`` callback."""
+        self.trigger("anomaly", rec.get("signal", "unknown"), rec)
+
+    def on_slo_alert(self, state: dict) -> None:
+        """``SLOMonitor.on_alert`` callback."""
+        self.trigger("slo", f"slo_{state.get('slo', 'unknown')}", state)
+
+    def trigger(self, kind: str, signal: str, record: dict) -> bool:
+        """Rate-limited async assembly; True when a bundle was started."""
+        now = self._clock()
+        with self._lock:
+            last = self._last_by_signal.get(signal)
+            if last is not None and now - last < self.cooldown_s:
+                return False
+            self._last_by_signal[signal] = now
+            self._threads = [t for t in self._threads if t.is_alive()]
+            t = threading.Thread(
+                target=self._assemble_safe,
+                args=(kind, signal, record, now),
+                name=f"dct-incident-{signal}",
+                daemon=True,
+            )
+            self._threads.append(t)
+        t.start()
+        return True
+
+    def _assemble_safe(self, kind, signal, record, now) -> None:
+        try:
+            self.assemble(kind, signal, record, now=now)
+        except Exception:  # noqa: BLE001 — incident capture never fails
+            pass  # the run it is trying to explain
+
+    # -- assembly --------------------------------------------------------
+
+    def _bundle_dir(self, signal: str, now: float) -> str:
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(now))
+        safe = "".join(
+            c if c.isalnum() or c in "-_." else "_" for c in signal
+        )
+        base = os.path.join(self.directory, f"{stamp}-{safe}")
+        path, n = base, 1
+        while os.path.exists(path):
+            path = f"{base}.{n}"
+            n += 1
+        return path
+
+    def assemble(
+        self, kind: str, signal: str, record: dict, *,
+        now: float | None = None,
+    ) -> str | None:
+        """Synchronous bundle build; returns the bundle path."""
+        if now is None:
+            now = self._clock()
+        bundle = self._bundle_dir(signal, now)
+        os.makedirs(bundle, exist_ok=True)
+        start_ts = now - self.window_s
+        files: list[str] = []
+
+        if self.reader is not None:
+            ts_slice = self.reader.slice(window_s=self.window_s, now=now)
+            if _write_json(
+                os.path.join(bundle, "timeseries.json"), ts_slice
+            ):
+                files.append("timeseries.json")
+
+        for name, directory in (
+            ("events.jsonl", self.events_dir),
+            ("spans.jsonl", self.spans_dir),
+        ):
+            if not directory:
+                continue
+            recs = _slice_logs(directory, start_ts, now)
+            if not recs:
+                continue
+            tmp = os.path.join(bundle, f"{name}.tmp.{os.getpid()}")
+            try:
+                with open(tmp, "w") as f:
+                    for rec in recs:
+                        f.write(json.dumps(rec) + "\n")
+                os.replace(tmp, os.path.join(bundle, name))
+                files.append(name)
+            except OSError:
+                pass
+
+        lineage_node = None
+        if self.lineage_path:
+            lineage_node = _active_lineage(self.lineage_path)
+            if lineage_node is not None and _write_json(
+                os.path.join(bundle, "lineage.json"), lineage_node
+            ):
+                files.append("lineage.json")
+
+        profile_dir = None
+        if self.profile:
+            profile_dir = self._capture_profile(bundle)
+            if profile_dir:
+                files.append("profile/")
+
+        manifest = {
+            "v": 1,
+            "kind": kind,
+            "signal": signal,
+            "ts": now,
+            "window_s": self.window_s,
+            "start_ts": start_ts,
+            "trigger": record,
+            "lineage_id": (
+                lineage_node.get("id") if lineage_node else None
+            ),
+            "files": files,
+            "pid": os.getpid(),
+        }
+        # the manifest lands LAST: its presence == a complete bundle.
+        if not _write_json(
+            os.path.join(bundle, _BUNDLE_MANIFEST), manifest
+        ):
+            return None
+        self.assembled += 1
+        if self._emit is not None:
+            try:
+                self._emit(
+                    "incident", "incident.assembled",
+                    kind=kind, signal=signal, bundle=bundle,
+                    lineage_id=manifest["lineage_id"],
+                    files=files,
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        return bundle
+
+    def _capture_profile(self, bundle: str) -> str | None:
+        """Fire the PR 14 flight recorder into the bundle; also touch
+        the cross-process trigger file so training processes watching
+        ``DCT_PROFILE_TRIGGER`` self-capture their side."""
+        trigger = os.environ.get("DCT_PROFILE_TRIGGER")
+        if trigger:
+            try:
+                os.makedirs(os.path.dirname(trigger) or ".", exist_ok=True)
+                with open(trigger, "a"):
+                    os.utime(trigger, None)
+            except OSError:
+                pass
+        try:
+            from dct_tpu.observability.capture import capture_profile
+
+            out = os.path.join(bundle, "profile")
+            capture_profile(out, self.profile_s, emit=self._emit)
+            return out
+        except Exception:  # noqa: BLE001 — no jax / profiler busy: the
+            return None  # bundle is still useful without the capture
+
+    def close(self) -> None:
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(self.profile_s + 10.0)
+
+
+# ----------------------------------------------------------------------
+# reading bundles (inspector + CLI)
+
+
+def list_bundles(directory: str) -> list[dict]:
+    """Every complete bundle under ``directory``, oldest first."""
+    out: list[dict] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        path = os.path.join(directory, name, _BUNDLE_MANIFEST)
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(manifest, dict):
+            manifest["bundle"] = os.path.join(directory, name)
+            manifest["name"] = name
+            out.append(manifest)
+    out.sort(key=lambda m: m.get("ts", 0.0))
+    return out
+
+
+def _cli_dir(argv_dir: str | None) -> str:
+    if argv_dir:
+        return argv_dir
+    from dct_tpu.config import ObservabilityConfig
+
+    obs = ObservabilityConfig.from_env()
+    if obs.incident_dir:
+        return obs.incident_dir
+    if obs.ts_dir:
+        return default_incident_dir(obs.ts_dir)
+    return "incidents"
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cmd = argv[0] if argv else "list"
+    if cmd == "list":
+        directory = _cli_dir(argv[1] if len(argv) > 1 else None)
+        bundles = list_bundles(directory)
+        if not bundles:
+            print(f"incidents: none under {directory}")
+            return 0
+        for m in bundles:
+            print(
+                f"{m['name']}  kind={m.get('kind')} "
+                f"signal={m.get('signal')} "
+                f"lineage={m.get('lineage_id') or '-'} "
+                f"files={len(m.get('files', []))}"
+            )
+        return 0
+    if cmd == "show":
+        if len(argv) < 2:
+            print("usage: incident show <bundle-dir>", file=sys.stderr)
+            return 2
+        path = argv[1]
+        if os.path.isdir(path):
+            path = os.path.join(path, _BUNDLE_MANIFEST)
+        try:
+            with open(path) as f:
+                print(json.dumps(json.load(f), indent=2, sort_keys=True))
+        except (OSError, ValueError) as e:
+            print(f"incident: cannot read {path}: {e}", file=sys.stderr)
+            return 1
+        return 0
+    if cmd == "assemble":
+        signal = argv[1] if len(argv) > 1 else "manual"
+        mgr = IncidentManager.from_env()
+        if mgr is None:
+            print(
+                "incident: plane unarmed (set DCT_TS_DIR, and leave "
+                "DCT_INCIDENT=1)", file=sys.stderr,
+            )
+            return 1
+        bundle = mgr.assemble("manual", signal, {"argv": argv})
+        if bundle is None:
+            print("incident: assembly failed", file=sys.stderr)
+            return 1
+        print(bundle)
+        return 0
+    print(
+        "usage: python -m dct_tpu.observability.incident "
+        "{list [dir] | show <bundle> | assemble [signal]}",
+        file=sys.stderr,
+    )
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

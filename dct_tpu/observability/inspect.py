@@ -172,6 +172,7 @@ def build_report(
     trace_path: str | None,
     bench: tuple[str, dict] | None = None,
     lineage: list[dict] | None = None,
+    incidents: list[dict] | None = None,
 ) -> str:
     """The cycle report as one printable string (pure function of the
     artifacts — unit-testable without capturing stdout)."""
@@ -223,6 +224,10 @@ def build_report(
         # roofline.* stays off it — run-end batch records the Roofline
         # section below summarizes.
         "profile.",
+        # Telemetry history plane (docs/OBSERVABILITY.md §9): anomaly
+        # edges and assembled incident bundles are exactly the
+        # landmarks an operator reads the timeline for.
+        "anomaly.", "incident.",
         # Elastic serving (docs/SERVING.md §elasticity): pool deaths /
         # respawns / circuit-breaks, scale steps and (throttled) shed
         # episodes are rare and load-bearing — unlike per-flush
@@ -702,6 +707,29 @@ def build_report(
             "trace|explain-serving|audit)"
         )
 
+    # -- incidents -----------------------------------------------------
+    if incidents:
+        lines.append("")
+        lines.append("Incidents:")
+        for b in incidents:
+            parts = [
+                f"  {b.get('name', '?')}:",
+                f"kind={b.get('kind', '?')}",
+                f"signal={b.get('signal', '?')}",
+            ]
+            if b.get("lineage_id"):
+                parts.append(f"serving={b['lineage_id']}")
+            files = b.get("files") or []
+            if files:
+                parts.append(f"files={len(files)}")
+                if "profile" in files:
+                    parts.append("+profile")
+            lines.append(" ".join(parts))
+        lines.append(
+            "  (inspect: python -m dct_tpu.observability.incident "
+            "list|show <bundle>)"
+        )
+
     # -- spans / trace -------------------------------------------------
     lines.append("")
     lines.append("Spans by component:")
@@ -778,10 +806,24 @@ def main(argv: list[str] | None = None) -> int:
     lineage_records = _lineage.read_ledger(
         os.path.join(args.run_dir, _lineage.LEDGER_NAME)
     )
+    from dct_tpu.observability import incident as _incident
+
+    incident_dir = _incident._cli_dir(None)
+    if not os.path.isdir(incident_dir):
+        # Default layout: bundles live in a SIBLING of the events dir
+        # (logs/events vs logs/incidents), same rule as heartbeats.
+        incident_dir = os.path.join(
+            os.path.dirname(os.path.normpath(args.run_dir)), "incidents"
+        )
+    bundles = (
+        _incident.list_bundles(incident_dir)
+        if os.path.isdir(incident_dir) else []
+    )
     print(build_report(
         events, heartbeats, spans, run_id, trace_path,
         bench=load_bench_record(args.run_dir),
         lineage=lineage_records,
+        incidents=bundles,
     ))
     return 0
 

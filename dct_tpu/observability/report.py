@@ -94,6 +94,17 @@ SERIES = (
     # of the burden onto shedding).
     ("overload_p99_s", ("elastic_serving", "overload_p99_s"), "down"),
     ("shed_fraction", ("elastic_serving", "shed_fraction"), "down"),
+    # Telemetry history (the telemetry_history bench leg): seconds from
+    # planting a slow_score fault to the detector flagging queue depth
+    # anomalous FROM THE ON-DISK HISTORY (a rise means the store/flush/
+    # poll pipeline got slower at its one job), and the armed-vs-plain
+    # snapshot-publish overhead (a rise means the history hook crept
+    # onto the hot path — the bound the buffered flush design exists
+    # to hold).
+    ("anomaly_detect_latency_s",
+     ("telemetry_history", "detect_latency_s"), "down"),
+    ("history_publish_overhead_ms",
+     ("telemetry_history", "publish_overhead_ms"), "down"),
 )
 
 

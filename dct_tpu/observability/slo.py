@@ -210,6 +210,8 @@ class SLOMonitor:
         clock=time.time,
         emit=None,
         events_path: str | None = None,
+        history=None,
+        on_alert=None,
     ):
         self.specs = list(specs)
         self.fast_window_s = float(fast_window_s)
@@ -218,6 +220,15 @@ class SLOMonitor:
         self._clock = clock
         self._emit = emit
         self.events_path = events_path
+        # When a timeseries.HistoryReader is supplied, cumulative-kind
+        # burn windows come from the on-disk history — the same "what
+        # happened over the last N seconds" every other consumer sees —
+        # and the in-memory deque is only the no-data fallback. None
+        # (the default) keeps the pre-ISSUE-17 in-memory behaviour.
+        self.history = history
+        # Called once per alert EDGE with the state dict (the incident
+        # assembler's hook); never on re-evaluations while alerting.
+        self._on_alert = on_alert
         self._state = {sp.name: _SpecState() for sp in self.specs}
 
     # -- per-kind observation -----------------------------------------
@@ -271,6 +282,43 @@ class SLOMonitor:
             return 0.0
         return max(0.0, d_bad / d_total) / budget
 
+    def _history_burn(
+        self, sp: SLOSpec, window_s: float, now: float
+    ) -> float | None:
+        """Burn over the trailing window from the on-disk history
+        store; None when the store has no data for this spec (caller
+        falls back to the in-memory observations)."""
+        try:
+            if sp.kind == "availability":
+                total = self.history.counter_delta(
+                    "dct_requests_total", window_s=window_s, now=now
+                )
+                if total is None or total <= 0:
+                    return None
+                bad = self.history.counter_delta(
+                    "dct_request_errors_total", window_s=window_s, now=now
+                ) or 0.0
+                return max(0.0, bad / total) / sp.budget
+            if sp.kind == "latency":
+                got = self.history.hist_counts(
+                    "dct_request_latency_seconds",
+                    window_s=window_s, now=now,
+                )
+                if got is None:
+                    return None
+                buckets, deltas, total = got
+                if total <= 0:
+                    return None
+                under = 0.0  # same conservative boundary rule as the
+                for le, c in zip(buckets, deltas):  # instantaneous path
+                    if le > sp.threshold:
+                        break
+                    under = c
+                return max(0.0, (total - under) / total) / sp.budget
+        except Exception:  # noqa: BLE001 — a torn segment or racing
+            return None  # compaction falls back, never breaks a scrape
+        return None
+
     # -- the scrape-time entry point -----------------------------------
     def evaluate(self, merged, *, now: float | None = None) -> list[dict]:
         """One evaluation pass: update histories, compute burn rates,
@@ -290,12 +338,22 @@ class SLOMonitor:
                 })
                 continue
             if cumulative:
-                burn_fast = self._window_burn(
-                    st.history, now, self.fast_window_s, sp.budget
-                )
-                burn_slow = self._window_burn(
-                    st.history, now, self.slow_window_s, sp.budget
-                )
+                burn_fast = burn_slow = None
+                if self.history is not None:
+                    burn_fast = self._history_burn(
+                        sp, self.fast_window_s, now
+                    )
+                    burn_slow = self._history_burn(
+                        sp, self.slow_window_s, now
+                    )
+                if burn_fast is None:
+                    burn_fast = self._window_burn(
+                        st.history, now, self.fast_window_s, sp.budget
+                    )
+                if burn_slow is None:
+                    burn_slow = self._window_burn(
+                        st.history, now, self.slow_window_s, sp.budget
+                    )
             else:
                 burn_fast = burn_slow = float(st.history[-1][2])
             alerting = (
@@ -308,16 +366,23 @@ class SLOMonitor:
                 "burn_slow": round(burn_slow, 6),
                 "alerting": alerting,
             }
-            if alerting and not st.alerting and self._emit is not None:
-                self._emit(
-                    "slo", "slo.alert",
-                    slo=sp.name, kind=sp.kind,
-                    burn_fast=rec["burn_fast"], burn_slow=rec["burn_slow"],
-                    objective=sp.objective, threshold=sp.threshold,
-                    burn_threshold=self.burn_threshold,
-                    fast_window_s=self.fast_window_s,
-                    slow_window_s=self.slow_window_s,
-                )
+            if alerting and not st.alerting:
+                if self._emit is not None:
+                    self._emit(
+                        "slo", "slo.alert",
+                        slo=sp.name, kind=sp.kind,
+                        burn_fast=rec["burn_fast"],
+                        burn_slow=rec["burn_slow"],
+                        objective=sp.objective, threshold=sp.threshold,
+                        burn_threshold=self.burn_threshold,
+                        fast_window_s=self.fast_window_s,
+                        slow_window_s=self.slow_window_s,
+                    )
+                if self._on_alert is not None:
+                    try:
+                        self._on_alert(rec)
+                    except Exception:  # noqa: BLE001 — incident capture
+                        pass  # never fails the scrape
             elif st.alerting and not alerting and self._emit is not None:
                 self._emit(
                     "slo", "slo.resolved",

@@ -1,0 +1,870 @@
+"""On-disk metric time-series: the longitudinal half of the metrics
+plane.
+
+The PR 8 snapshot protocol is deliberately *instantaneous*: every
+process publishes its current
+:meth:`~dct_tpu.observability.metrics.MetricsRegistry.snapshot` and a
+scrape merges whatever is live right now. Nothing retains what the
+fleet looked like thirty seconds ago, so an SLO burn, a queue-depth
+ramp or a loss spike can only be judged against in-memory state that
+dies with the process (ISSUE 17). This module adds the missing axis:
+
+1. :class:`HistoryWriter` rides the existing
+   :class:`~dct_tpu.observability.aggregate.SnapshotPublisher` cadence
+   (the publisher calls :meth:`HistoryWriter.append` with every
+   snapshot it just published) and records the selected ``dct_*``
+   families into per-process SEGMENT files under ``DCT_TS_DIR``:
+
+       <ts_dir>/<proc>/active.seg.json     in-progress segment
+       <ts_dir>/<proc>/raw-00000003.seg.json   sealed, immutable
+       <ts_dir>/<proc>/ds-00000001.seg.json    downsampled tier
+
+   Points are buffered in memory and the active segment is republished
+   (tmp then ``os.replace``, per the atomic-publish lint) only every
+   ``flush_s`` / ``flush_points`` — and the segment writes themselves
+   run on a background flusher thread (``append`` snapshots the
+   buffer under the lock and enqueues a write job), so the publishing
+   thread never pays disk I/O at all. The common ``append`` is a list
+   push, which is what keeps the armed publish path within the
+   15%-of-plain overhead budget at p50 *and* keeps the flush windows
+   out of its tail.
+
+2. Sealed raw segments older than ``downsample_s`` are folded into a
+   coarse tier (``ds_res_s``-wide bins of min/max/mean/last/count for
+   gauges; last cumulative value for counters and histograms) and the
+   raw file removed; anything whose newest point is older than
+   ``retention_s`` is deleted. Compaction runs opportunistically at
+   seal time, so its cost is amortised over a whole segment of
+   appends.
+
+3. :class:`HistoryReader` answers bounded-overhead window queries —
+   ``range`` / ``gauge_last`` / ``counter_rate`` / ``counter_delta`` /
+   ``hist_mean`` / ``hist_percentile`` — across every process's
+   segments, with parsed segments cached by ``(mtime_ns, size)`` so a
+   poll loop re-reads only files that actually changed. Counter and
+   histogram deltas are reset-tolerant: a restarted process's
+   cumulative value dropping to zero contributes its new total, never
+   a negative delta.
+
+Like every other telemetry surface here, the store never fails the
+run: any OSError flips the writer dead and appends become no-ops.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import threading
+import time
+
+#: Families recorded by default: the signals the anomaly detector and
+#: the control loops (autoscaler, SLO monitor) actually consume.
+DEFAULT_FAMILIES = (
+    "dct_train_*,dct_serve_*,dct_request*,dct_program_*,"
+    "dct_slo_*,dct_anomaly_*,dct_tenant_*,dct_sched_*"
+)
+
+_SEG_SUFFIX = ".seg.json"
+
+
+def _proc_dir(directory: str, proc: str) -> str:
+    safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in proc)
+    return os.path.join(directory, safe)
+
+
+def _label_key(labels: dict | None) -> str:
+    if not labels:
+        return ""
+    return json.dumps(labels, sort_keys=True, separators=(",", ":"))
+
+
+def _write_json(path: str, obj: dict) -> bool:
+    """tmp + ``os.replace`` publish (a reader never sees a torn
+    segment); False when the write failed."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+    except (OSError, ValueError):
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+    return True
+
+
+def parse_families(spec: str | None) -> tuple[str, ...]:
+    """``DCT_TS_FAMILIES`` grammar: comma-separated fnmatch patterns."""
+    out = []
+    for part in (spec or DEFAULT_FAMILIES).split(","):
+        part = part.strip()
+        if part:
+            out.append(part)
+    return tuple(out)
+
+
+# ----------------------------------------------------------------------
+# writer
+
+
+class HistoryWriter:
+    """Per-process segment writer fed at publisher cadence."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        proc: str,
+        families: str | tuple[str, ...] | None = None,
+        seg_points: int = 240,
+        seg_s: float = 600.0,
+        flush_s: float = 10.0,
+        flush_points: int = 8,
+        retention_s: float = 10800.0,
+        downsample_s: float = 900.0,
+        ds_res_s: float = 60.0,
+        clock=time.time,
+    ):
+        self.directory = directory
+        self.proc = proc
+        self.proc_dir = _proc_dir(directory, proc)
+        if isinstance(families, str) or families is None:
+            families = parse_families(families)
+        self.families = tuple(families)
+        self.seg_points = max(1, int(seg_points))
+        self.seg_s = float(seg_s)
+        self.flush_s = float(flush_s)
+        self.flush_points = max(1, int(flush_points))
+        self.retention_s = float(retention_s)
+        self.downsample_s = float(downsample_s)
+        self.ds_res_s = max(1.0, float(ds_res_s))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._dead = False
+        self._match_cache: dict[str, bool] = {}
+        self._points: list[dict] = []
+        self._meta: dict[str, dict] = {}
+        self._start_ts: float | None = None
+        self._since_flush = 0
+        self._last_flush = 0.0
+        self._seq = self._scan_seq()
+        # Disk I/O rides a background flusher: append/flush/seal enqueue
+        # write jobs here (FIFO) and the io thread drains them, so the
+        # publishing thread never blocks on a segment write. Points and
+        # meta entries are immutable once appended, which is what makes
+        # the under-lock shallow snapshot in the enqueue path safe.
+        self._io_jobs: list[tuple] = []
+        self._io_cv = threading.Condition()
+        self._io_stop = False
+        self._io_busy = False
+        self._io_thread: threading.Thread | None = None
+
+    def _scan_seq(self) -> int:
+        """Continue numbering after the segments a predecessor with the
+        same proc name left behind (restart = same stream)."""
+        top = 0
+        try:
+            for name in os.listdir(self.proc_dir):
+                if name.endswith(_SEG_SUFFIX) and "-" in name:
+                    try:
+                        top = max(top, int(name.split("-")[1].split(".")[0]))
+                    except ValueError:
+                        continue
+        except OSError:
+            pass
+        return top + 1
+
+    def _selected(self, name: str) -> bool:
+        hit = self._match_cache.get(name)
+        if hit is None:
+            hit = any(fnmatch.fnmatchcase(name, p) for p in self.families)
+            self._match_cache[name] = hit
+        return hit
+
+    # -- ingest ---------------------------------------------------------
+
+    def append(self, snapshot: dict) -> None:
+        """Record one published snapshot; never raises."""
+        if self._dead:
+            return
+        try:
+            self._append(snapshot)
+        except Exception:  # noqa: BLE001 — telemetry never fails the run
+            self._dead = True
+
+    def _append(self, snapshot: dict) -> None:
+        ts = float(snapshot.get("ts") or self._clock())
+        point: dict = {}
+        for m in snapshot.get("metrics", ()):
+            name = m.get("name")
+            if not name or not self._selected(name):
+                continue
+            mtype = m.get("type")
+            meta = self._meta.get(name)
+            if meta is None:
+                meta = {"type": mtype}
+                if mtype == "gauge":
+                    meta["agg"] = m.get("agg", "sum")
+                elif mtype == "histogram":
+                    meta["buckets"] = list(m.get("buckets") or ())
+                self._meta[name] = meta
+            series: dict = {}
+            for s in m.get("samples", ()):
+                lk = _label_key(s.get("labels"))
+                if mtype == "histogram":
+                    series[lk] = {
+                        "counts": list(s.get("counts") or ()),
+                        "count": s.get("count", 0),
+                        "sum": s.get("sum", 0.0),
+                    }
+                else:
+                    series[lk] = s.get("value", 0.0)
+            if series:
+                point[name] = series
+        if not point:
+            return
+        with self._lock:
+            if self._start_ts is None:
+                self._start_ts = ts
+                self._last_flush = ts
+            self._points.append({"ts": ts, "m": point})
+            self._since_flush += 1
+            if (
+                len(self._points) >= self.seg_points
+                or ts - self._start_ts >= self.seg_s
+            ):
+                self._seal_locked(ts)
+            elif (
+                self._since_flush >= self.flush_points
+                or ts - self._last_flush >= self.flush_s
+            ):
+                self._flush_locked(ts)
+
+    # -- segment lifecycle ----------------------------------------------
+
+    def _segment_obj(self, tier: str) -> dict:
+        # Shallow copies: points and meta entries are immutable once
+        # appended, so the io thread can serialise this object while
+        # the publisher keeps appending to the live buffers.
+        return {
+            "v": 1,
+            "tier": tier,
+            "proc": self.proc,
+            "pid": os.getpid(),
+            "seq": self._seq,
+            "start_ts": self._start_ts,
+            "end_ts": self._points[-1]["ts"] if self._points else None,
+            "meta": dict(self._meta),
+            "points": list(self._points),
+        }
+
+    def _flush_locked(self, now: float) -> None:
+        if not self._points:
+            return
+        self._enqueue(("active", self._segment_obj("raw")))
+        self._last_flush = now
+        self._since_flush = 0
+
+    def _seal_locked(self, now: float) -> None:
+        if not self._points:
+            return
+        self._enqueue(("seal", self._segment_obj("raw"), now))
+        self._seq += 1
+        self._points = []
+        self._start_ts = None
+        self._since_flush = 0
+        self._last_flush = now
+
+    # -- background flusher ---------------------------------------------
+
+    def _enqueue(self, job: tuple) -> None:
+        with self._io_cv:
+            if not self._io_stop and (
+                self._io_thread is None or not self._io_thread.is_alive()
+            ):
+                try:
+                    t = threading.Thread(
+                        target=self._io_loop, name="dct-ts-flush",
+                        daemon=True,
+                    )
+                    t.start()
+                    self._io_thread = t
+                except RuntimeError:
+                    self._io_thread = None
+            if (
+                not self._io_stop
+                and self._io_thread is not None
+                and self._io_thread.is_alive()
+            ):
+                if (
+                    job[0] == "active"
+                    and self._io_jobs
+                    and self._io_jobs[-1][0] == "active"
+                ):
+                    # A full-state active write supersedes a pending
+                    # one — the queue never grows past one flush per
+                    # seal boundary.
+                    self._io_jobs[-1] = job
+                else:
+                    self._io_jobs.append(job)
+                self._io_cv.notify_all()
+                return
+        # No io thread (interpreter shutdown, or closed): write inline.
+        self._run_job(job)
+
+    def _io_loop(self) -> None:
+        while True:
+            with self._io_cv:
+                while not self._io_jobs and not self._io_stop:
+                    self._io_cv.wait()
+                if not self._io_jobs:
+                    return
+                job = self._io_jobs.pop(0)
+                self._io_busy = True
+            try:
+                self._run_job(job)
+            finally:
+                with self._io_cv:
+                    self._io_busy = False
+                    self._io_cv.notify_all()
+
+    def _run_job(self, job: tuple) -> None:
+        kind, obj = job[0], job[1]
+        if kind == "active":
+            path = os.path.join(self.proc_dir, f"active{_SEG_SUFFIX}")
+            if not _write_json(path, obj):
+                self._dead = True
+            return
+        path = os.path.join(
+            self.proc_dir, f"raw-{obj['seq']:08d}{_SEG_SUFFIX}"
+        )
+        if not _write_json(path, obj):
+            self._dead = True
+            return
+        try:
+            os.remove(os.path.join(self.proc_dir, f"active{_SEG_SUFFIX}"))
+        except OSError:
+            pass
+        self.compact(now=job[2])
+
+    def _drain(self, timeout: float = 5.0) -> None:
+        """Wait until every enqueued write has hit disk."""
+        deadline = time.monotonic() + timeout
+        with self._io_cv:
+            while self._io_jobs or self._io_busy:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._io_thread is None:
+                    return
+                if not self._io_thread.is_alive():
+                    return
+                self._io_cv.wait(timeout=left)
+
+    def flush(self) -> None:
+        """Force the active segment to disk (tests, clean shutdown).
+        Synchronous: returns only after the write has landed."""
+        if self._dead:
+            return
+        with self._lock:
+            self._flush_locked(self._clock())
+        self._drain()
+
+    def close(self) -> None:
+        """Seal whatever is buffered; the stream survives the process.
+        Drains the flusher and stops its thread."""
+        try:
+            if not self._dead:
+                with self._lock:
+                    self._seal_locked(self._clock())
+        except Exception:  # noqa: BLE001
+            self._dead = True
+        self._drain()
+        with self._io_cv:
+            self._io_stop = True
+            self._io_cv.notify_all()
+        t = self._io_thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    # -- compaction -----------------------------------------------------
+
+    def compact(self, *, now: float | None = None) -> dict:
+        """Downsample sealed raw segments past ``downsample_s`` and
+        delete anything past ``retention_s``. Returns counts (tests and
+        the incident CLI report them); safe to call any time."""
+        out = {"downsampled": 0, "deleted": 0}
+        if now is None:
+            now = self._clock()
+        try:
+            names = sorted(os.listdir(self.proc_dir))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(_SEG_SUFFIX) or name.startswith("active"):
+                continue
+            path = os.path.join(self.proc_dir, name)
+            seg = _load_segment(path)
+            if seg is None:
+                continue
+            end_ts = seg.get("end_ts") or 0.0
+            if self.retention_s > 0 and now - end_ts > self.retention_s:
+                try:
+                    os.remove(path)
+                    out["deleted"] += 1
+                except OSError:
+                    pass
+                continue
+            if (
+                name.startswith("raw-")
+                and self.downsample_s > 0
+                and now - end_ts > self.downsample_s
+            ):
+                ds = downsample_segment(seg, res_s=self.ds_res_s)
+                ds_path = os.path.join(
+                    self.proc_dir, f"ds-{seg.get('seq', 0):08d}{_SEG_SUFFIX}"
+                )
+                # ds written BEFORE raw removed: a crash between the
+                # two leaves both tiers and the reader prefers raw.
+                if _write_json(ds_path, ds):
+                    try:
+                        os.remove(path)
+                        out["downsampled"] += 1
+                    except OSError:
+                        pass
+        return out
+
+
+def downsample_segment(seg: dict, *, res_s: float = 60.0) -> dict:
+    """Fold a raw segment into ``res_s``-wide bins: gauges keep
+    min/max/mean/last/n, counters and histograms keep the last
+    cumulative value (rates stay computable; bucket detail is the
+    price of the coarse tier)."""
+    res_s = max(1.0, float(res_s))
+    bins: dict[int, dict] = {}
+    meta = seg.get("meta", {})
+    for pt in seg.get("points", ()):
+        ts = pt.get("ts", 0.0)
+        b = int(ts // res_s)
+        bm = bins.setdefault(b, {})
+        for name, series in pt.get("m", {}).items():
+            mtype = meta.get(name, {}).get("type")
+            nm = bm.setdefault(name, {})
+            for lk, val in series.items():
+                if mtype == "gauge":
+                    agg = nm.get(lk)
+                    v = float(val)
+                    if agg is None:
+                        nm[lk] = {
+                            "min": v, "max": v, "mean": v, "last": v, "n": 1,
+                        }
+                    else:
+                        n = agg["n"] + 1
+                        agg["min"] = min(agg["min"], v)
+                        agg["max"] = max(agg["max"], v)
+                        agg["mean"] += (v - agg["mean"]) / n
+                        agg["last"] = v
+                        agg["n"] = n
+                elif mtype == "histogram":
+                    nm[lk] = {
+                        "count": val.get("count", 0),
+                        "sum": val.get("sum", 0.0),
+                    }
+                else:
+                    nm[lk] = {"last": float(val)}
+    points = [
+        {"ts": (b + 1) * res_s, "m": bm} for b, bm in sorted(bins.items())
+    ]
+    return {
+        "v": 1,
+        "tier": "ds",
+        "proc": seg.get("proc"),
+        "pid": seg.get("pid"),
+        "seq": seg.get("seq"),
+        "res_s": res_s,
+        "start_ts": seg.get("start_ts"),
+        "end_ts": seg.get("end_ts"),
+        "meta": meta,
+        "points": points,
+    }
+
+
+def _load_segment(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            seg = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(seg, dict) or "points" not in seg:
+        return None
+    return seg
+
+
+# ----------------------------------------------------------------------
+# reader
+
+
+class HistoryReader:
+    """Window queries over every process's segments under ``ts_dir``."""
+
+    def __init__(self, directory: str, *, clock=time.time):
+        self.directory = directory
+        self._clock = clock
+        # path -> (mtime_ns, size, parsed-or-None)
+        self._cache: dict[str, tuple[int, int, dict | None]] = {}
+
+    def _segments(self) -> list[dict]:
+        segs: list[dict] = []
+        seen: set[str] = set()
+        try:
+            proc_names = sorted(os.listdir(self.directory))
+        except OSError:
+            return segs
+        for pn in proc_names:
+            pdir = os.path.join(self.directory, pn)
+            try:
+                names = sorted(os.listdir(pdir))
+            except OSError:
+                continue
+            raw_seqs = {
+                n.split("-")[1].split(".")[0]
+                for n in names
+                if n.startswith("raw-") and n.endswith(_SEG_SUFFIX)
+            }
+            for name in names:
+                if not name.endswith(_SEG_SUFFIX):
+                    continue
+                # crash between ds-write and raw-remove leaves both
+                # tiers for one seq: the raw one wins (full detail).
+                if name.startswith("ds-"):
+                    seq = name.split("-")[1].split(".")[0]
+                    if seq in raw_seqs:
+                        continue
+                path = os.path.join(pdir, name)
+                seen.add(path)
+                seg = self._load_cached(path)
+                if seg is not None:
+                    segs.append(seg)
+        for stale in set(self._cache) - seen:
+            del self._cache[stale]
+        return segs
+
+    def _load_cached(self, path: str) -> dict | None:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        hit = self._cache.get(path)
+        if hit is not None and hit[0] == st.st_mtime_ns and hit[1] == st.st_size:
+            return hit[2]
+        seg = _load_segment(path)
+        self._cache[path] = (st.st_mtime_ns, st.st_size, seg)
+        return seg
+
+    # -- series assembly ------------------------------------------------
+
+    def _series(
+        self, name: str, start: float, end: float
+    ) -> dict[tuple[str, str], dict]:
+        """``(proc, label_key) -> {"meta", "tier", "points"}`` with
+        points ``(ts, value)`` sorted, clipped to [start, end]."""
+        out: dict[tuple[str, str], dict] = {}
+        for seg in self._segments():
+            if name not in seg.get("meta", {}):
+                continue
+            seg_start = seg.get("start_ts") or 0.0
+            seg_end = seg.get("end_ts") or seg_start
+            if seg_end < start or seg_start > end:
+                continue
+            proc = str(seg.get("proc", "?"))
+            meta = seg["meta"][name]
+            tier = seg.get("tier", "raw")
+            for pt in seg.get("points", ()):
+                ts = pt.get("ts", 0.0)
+                if ts < start or ts > end:
+                    continue
+                series = pt.get("m", {}).get(name)
+                if not series:
+                    continue
+                for lk, val in series.items():
+                    ent = out.setdefault(
+                        (proc, lk),
+                        {"meta": meta, "points": []},
+                    )
+                    ent["points"].append((ts, val, tier))
+        for ent in out.values():
+            ent["points"].sort(key=lambda p: p[0])
+        return out
+
+    @staticmethod
+    def _scalar(meta: dict, val, tier: str) -> float | None:
+        mtype = meta.get("type")
+        if mtype == "histogram":
+            return None
+        if tier == "ds":
+            if isinstance(val, dict):
+                v = val.get("mean", val.get("last"))
+                return None if v is None else float(v)
+            return None
+        try:
+            return float(val)
+        except (TypeError, ValueError):
+            return None
+
+    # -- queries --------------------------------------------------------
+
+    def range(
+        self, name: str, *, window_s: float, now: float | None = None
+    ) -> list[tuple[float, float]]:
+        """All scalar points of ``name`` inside the window, merged
+        across processes and label sets, time-sorted. Gauges and
+        counters; histograms have no single scalar (use
+        :meth:`hist_mean` / :meth:`hist_percentile`)."""
+        if now is None:
+            now = self._clock()
+        pts: list[tuple[float, float]] = []
+        for ent in self._series(name, now - window_s, now).values():
+            for ts, val, tier in ent["points"]:
+                v = self._scalar(ent["meta"], val, tier)
+                if v is not None:
+                    pts.append((ts, v))
+        pts.sort(key=lambda p: p[0])
+        return pts
+
+    def gauge_last(
+        self, name: str, *, window_s: float, now: float | None = None
+    ) -> float | None:
+        """Latest value per (proc, labels) series combined by the
+        family's declared agg (mirrors the merge semantics of the
+        instantaneous plane)."""
+        if now is None:
+            now = self._clock()
+        lasts: list[float] = []
+        agg = "sum"
+        for ent in self._series(name, now - window_s, now).values():
+            agg = ent["meta"].get("agg", "sum")
+            pts = ent["points"]
+            if not pts:
+                continue
+            ts, val, tier = pts[-1]
+            if tier == "ds" and isinstance(val, dict):
+                val = val.get("last", val.get("mean"))
+            if val is None:
+                continue
+            try:
+                lasts.append(float(val))
+            except (TypeError, ValueError):
+                continue
+        if not lasts:
+            return None
+        if agg == "max":
+            return max(lasts)
+        if agg == "min":
+            return min(lasts)
+        if agg == "last":
+            return lasts[-1]
+        return sum(lasts)
+
+    @staticmethod
+    def _cum_delta(points: list, pick) -> float:
+        """Reset-tolerant delta over one series of cumulative values:
+        a drop means the process restarted from zero, so the new
+        cumulative value IS the post-reset delta."""
+        delta = 0.0
+        prev = None
+        for _ts, val, tier in points:
+            v = pick(val, tier)
+            if v is None:
+                continue
+            if prev is None:
+                prev = v
+                continue
+            delta += (v - prev) if v >= prev else v
+            prev = v
+        return delta
+
+    def counter_delta(
+        self, name: str, *, window_s: float, now: float | None = None
+    ) -> float | None:
+        if now is None:
+            now = self._clock()
+
+        def pick(val, tier):
+            if tier == "ds" and isinstance(val, dict):
+                val = val.get("last")
+            try:
+                return float(val)
+            except (TypeError, ValueError):
+                return None
+
+        series = self._series(name, now - window_s, now)
+        if not series:
+            return None
+        return sum(
+            self._cum_delta(ent["points"], pick) for ent in series.values()
+        )
+
+    def counter_rate(
+        self, name: str, *, window_s: float, now: float | None = None
+    ) -> float | None:
+        d = self.counter_delta(name, window_s=window_s, now=now)
+        return None if d is None else d / max(1e-9, window_s)
+
+    def hist_mean(
+        self, name: str, *, window_s: float, now: float | None = None
+    ) -> float | None:
+        """Mean observed value over the window: Σ delta(sum) over
+        Σ delta(count) across all series."""
+        if now is None:
+            now = self._clock()
+        d_count = d_sum = 0.0
+        found = False
+        for ent in self._series(name, now - window_s, now).values():
+            if ent["meta"].get("type") != "histogram":
+                continue
+            found = True
+            d_count += self._cum_delta(
+                ent["points"],
+                lambda v, t: float(v.get("count", 0))
+                if isinstance(v, dict) else None,
+            )
+            d_sum += self._cum_delta(
+                ent["points"],
+                lambda v, t: float(v.get("sum", 0.0))
+                if isinstance(v, dict) else None,
+            )
+        if not found or d_count <= 0:
+            return None
+        return d_sum / d_count
+
+    def hist_counts(
+        self, name: str, *, window_s: float, now: float | None = None
+    ) -> tuple[tuple[float, ...], list[float], float] | None:
+        """``(buckets, cumulative-count deltas, total delta)`` over the
+        window (raw tier only — the ds tier drops buckets by design).
+        The SLO monitor's over-threshold math and :meth:`hist_percentile`
+        both stand on this."""
+        if now is None:
+            now = self._clock()
+        buckets: tuple[float, ...] | None = None
+        deltas: list[float] | None = None
+        total = 0.0
+        for ent in self._series(name, now - window_s, now).values():
+            meta = ent["meta"]
+            if meta.get("type") != "histogram":
+                continue
+            bks = tuple(meta.get("buckets") or ())
+            if not bks:
+                continue
+            if buckets is None:
+                buckets = bks
+                deltas = [0.0] * len(bks)
+            if bks != buckets:
+                continue
+            for i in range(len(bks)):
+                deltas[i] += self._cum_delta(
+                    ent["points"],
+                    lambda v, t, i=i: float(v["counts"][i])
+                    if isinstance(v, dict) and len(v.get("counts") or ()) > i
+                    else None,
+                )
+            total += self._cum_delta(
+                ent["points"],
+                lambda v, t: float(v.get("count", 0))
+                if isinstance(v, dict) else None,
+            )
+        if buckets is None or deltas is None:
+            return None
+        return buckets, deltas, total
+
+    def hist_percentile(
+        self,
+        name: str,
+        q: float,
+        *,
+        window_s: float,
+        now: float | None = None,
+    ) -> float | None:
+        got = self.hist_counts(name, window_s=window_s, now=now)
+        if got is None:
+            return None
+        buckets, deltas, total = got
+        if total <= 0:
+            return None
+        target = max(0.0, min(1.0, q)) * total
+        for le, c in zip(buckets, deltas):
+            if c >= target:
+                return le
+        return buckets[-1]
+
+    # -- surface for the incident bundle / CLI --------------------------
+
+    def procs(self) -> list[str]:
+        return sorted({str(s.get("proc", "?")) for s in self._segments()})
+
+    def families(self) -> list[str]:
+        fams: set[str] = set()
+        for seg in self._segments():
+            fams.update(seg.get("meta", {}).keys())
+        return sorted(fams)
+
+    def slice(
+        self, *, window_s: float, now: float | None = None
+    ) -> dict:
+        """Everything in the window, as one JSON-able dict — the
+        ``timeseries.json`` payload of an incident bundle."""
+        if now is None:
+            now = self._clock()
+        start = now - window_s
+        out: dict = {"start_ts": start, "end_ts": now, "procs": {}}
+        for seg in self._segments():
+            seg_start = seg.get("start_ts") or 0.0
+            seg_end = seg.get("end_ts") or seg_start
+            if seg_end < start or seg_start > now:
+                continue
+            proc = str(seg.get("proc", "?"))
+            ent = out["procs"].setdefault(
+                proc, {"meta": {}, "points": []}
+            )
+            ent["meta"].update(seg.get("meta", {}))
+            for pt in seg.get("points", ()):
+                ts = pt.get("ts", 0.0)
+                if start <= ts <= now:
+                    ent["points"].append(pt)
+        for ent in out["procs"].values():
+            ent["points"].sort(key=lambda p: p.get("ts", 0.0))
+        return out
+
+
+# ----------------------------------------------------------------------
+# env plumbing
+
+
+def writer_from_env(
+    *, proc: str, clock=time.time
+) -> HistoryWriter | None:
+    """The per-process writer ``DCT_TS_DIR`` arms, or None. Every
+    SnapshotPublisher asks here, so arming the store is one env var —
+    no per-call-site wiring."""
+    from dct_tpu.config import ObservabilityConfig
+
+    obs = ObservabilityConfig.from_env()
+    if not obs.ts_dir:
+        return None
+    try:
+        return HistoryWriter(
+            obs.ts_dir,
+            proc=proc,
+            families=obs.ts_families,
+            seg_points=obs.ts_seg_points,
+            seg_s=obs.ts_seg_s,
+            flush_s=obs.ts_flush_s,
+            retention_s=obs.ts_retention_s,
+            downsample_s=obs.ts_downsample_s,
+            ds_res_s=obs.ts_ds_res_s,
+            clock=clock,
+        )
+    except Exception:  # noqa: BLE001 — telemetry never fails the run
+        return None

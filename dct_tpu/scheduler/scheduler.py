@@ -139,6 +139,7 @@ class WorkloadScheduler:
         self._saved_cache_env: dict | None = None
         self._metrics = None
         self._publisher = None
+        self._anomaly_monitor = None
         if tenants is None:
             tenants = parse_tenants(self.sched_cfg.spec)
         if not tenants:
@@ -224,6 +225,14 @@ class WorkloadScheduler:
             proc=f"scheduler-{os.getpid()}",
             interval_s=self.cfg.obs.metrics_publish_s,
             clock=self._clock,
+        )
+        # Telemetry history plane (ISSUE 17): the scheduler watches its
+        # tenants' metric history (goodput dips, grad-norm spikes) and
+        # assembles incident bundles; None unless DCT_TS_DIR arms it.
+        from dct_tpu.observability import detect as _detect
+
+        self._anomaly_monitor = _detect.arm_from_env(
+            registry=reg, emit=self.events.emit,
         )
 
     def _shared_cache_env(self) -> dict:
@@ -615,6 +624,8 @@ class WorkloadScheduler:
         summary = self.summary()
         self.events.emit("sched", "sched.stop", **summary)
         self.events.close()
+        if self._anomaly_monitor is not None:
+            self._anomaly_monitor.close()
         if self._publisher is not None:
             self._refresh_share_gauges()
             self._publisher.close(final=True)

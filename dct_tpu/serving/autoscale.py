@@ -275,12 +275,21 @@ def batcher_signal_fn(server):
 
 
 def pool_signal_fn(metrics_dir: str, *, stale_s: float | None = None,
-                   slo_monitor=None, clock=time.time):
+                   slo_monitor=None, history=None,
+                   signal_window_s: float = 30.0, clock=time.time):
     """Fleet signals for the pool parent, read off the PR 8 metrics
     plane: average queue depth behind recent flushes (histogram delta
     between polls), shed-counter delta, and — when an
     :class:`~dct_tpu.observability.slo.SLOMonitor` is supplied —
-    whether any SLO is burning on the merged view."""
+    whether any SLO is burning on the merged view.
+
+    When a :class:`~dct_tpu.observability.timeseries.HistoryReader` is
+    supplied (the ISSUE 17 store armed via ``DCT_TS_DIR``), the
+    queue-depth and shed-rate windows come from the on-disk history —
+    one source of truth for "what happened over the last
+    ``signal_window_s`` seconds", shared with the anomaly detector and
+    the SLO monitor — and the in-memory between-poll deltas are only
+    the no-data fallback."""
     from dct_tpu.observability import aggregate
 
     if stale_s is None:
@@ -294,11 +303,28 @@ def pool_signal_fn(metrics_dir: str, *, stale_s: float | None = None,
             )
         )
         out = {"queue_rows": 0.0, "shed_rate": 0.0, "slo_burning": False}
+        from_history_q = from_history_s = False
+        if history is not None:
+            try:
+                q = history.hist_mean(
+                    "dct_serve_queue_depth", window_s=signal_window_s
+                )
+                if q is not None:
+                    out["queue_rows"] = q
+                    from_history_q = True
+                d = history.counter_delta(
+                    "dct_serve_shed_total", window_s=signal_window_s
+                )
+                if d is not None:
+                    out["shed_rate"] = max(0.0, d)
+                    from_history_s = True
+            except Exception:  # noqa: BLE001 — a torn segment or racing
+                pass  # compaction falls back to the in-memory deltas
         hist = merged.histogram_total("dct_serve_queue_depth")
         if hist is not None:
             prev = state["q"]
             state["q"] = (hist["count"], hist["sum"])
-            if prev is not None:
+            if prev is not None and not from_history_q:
                 d_count = hist["count"] - prev[0]
                 d_sum = hist["sum"] - prev[1]
                 if d_count > 0:
@@ -307,7 +333,7 @@ def pool_signal_fn(metrics_dir: str, *, stale_s: float | None = None,
         if sheds is not None:
             prev = state["sheds"]
             state["sheds"] = sheds
-            if prev is not None:
+            if prev is not None and not from_history_s:
                 out["shed_rate"] = max(0.0, sheds - prev)
         if slo_monitor is not None:
             try:

@@ -474,6 +474,9 @@ class _BatchedHTTPServer(ThreadingHTTPServer):
             # snapshot so the still-alive pid does not keep yesterday's
             # counts in every later scrape of the same metrics dir.
             publisher.close()
+        monitor = getattr(self, "history_monitor", None)
+        if monitor is not None:
+            monitor.close()
 
 
 class _ReusePortHTTPServer(_BatchedHTTPServer):
@@ -870,6 +873,15 @@ def _arm_metrics_plane(server) -> None:
     )
     server.slot_metrics.publisher = server.metrics_publisher
     server.metrics_stale_s = obs.metrics_stale_s
+    # The ISSUE 17 detection plane: history reader + anomaly detector +
+    # incident assembler + poll thread, self-armed off DCT_TS_DIR (None
+    # otherwise). Its gauges land on the same registry the publisher
+    # already snapshots, so dct_anomaly_* reach every scrape for free.
+    from dct_tpu.observability import detect as _detect
+
+    server.history_monitor = _detect.arm_from_env(
+        registry=server.slot_metrics.registry, emit=_emit_default,
+    )
     try:
         specs = parse_slo_spec(obs.slo_spec)
     except SLOSpecError as e:
@@ -879,6 +891,7 @@ def _arm_metrics_plane(server) -> None:
               file=_sys.stderr, flush=True)
         return
     if specs:
+        monitor = server.history_monitor
         server.slo_monitor = SLOMonitor(
             specs,
             fast_window_s=obs.slo_fast_window_s,
@@ -888,6 +901,14 @@ def _arm_metrics_plane(server) -> None:
             events_path=(
                 os.path.join(obs.events_dir, "events.jsonl")
                 if obs.enabled and obs.events_dir else None
+            ),
+            # Armed: burn windows come from the on-disk history and an
+            # alert edge triggers an incident bundle.
+            history=monitor.reader if monitor is not None else None,
+            on_alert=(
+                monitor.incidents.on_slo_alert
+                if monitor is not None and monitor.incidents is not None
+                else None
             ),
         )
 

@@ -245,6 +245,16 @@ class Trainer:
         # norm) flows through the monitor; findings become health.*
         # events and, under a halting policy, stop the run.
         health = HealthMonitor.from_config(cfg.obs, emit=events.emit)
+        # Live per-epoch metrics (ISSUE 17): the coordinator publishes
+        # val-loss / goodput / step-time gauges to the metrics plane at
+        # epoch cadence, so the telemetry history store (DCT_TS_DIR)
+        # sees the run WHILE it happens — the final dump replaces this
+        # stream at run end. None when the plane is unarmed.
+        from dct_tpu.observability.dump import live_train_metrics
+
+        live_metrics = live_train_metrics(
+            cfg.obs, run_id=events.run_id, rank=jax.process_index()
+        )
         # Resilience plane: the deterministic fault plan (installed as
         # the process default so the checkpoint tiers consult the SAME
         # instance — shared save ordinals and fired flags), and the
@@ -934,6 +944,17 @@ class Trainer:
                     val_loss=val_loss, val_acc=val_acc,
                     goodput_fraction=span_goodput["goodput_fraction"],
                 )
+                if live_metrics is not None:
+                    live_metrics.epoch_end(
+                        val_loss=val_loss,
+                        goodput_fraction=span_goodput["goodput_fraction"],
+                        samples_per_sec=epoch_stats.samples_per_sec,
+                        step_seconds=(
+                            (epoch_stats.seconds / k)
+                            / max(1, per_epoch_updates)
+                        ),
+                        grad_norm=health.last_grad_norm,
+                    )
                 last_rec = epoch_rec
                 # Early stopping (monitor val_loss, min mode — the
                 # companion of the reference's ModelCheckpoint
@@ -1670,6 +1691,11 @@ class Trainer:
         if self.coordinator:
             for r in roofline_rep:
                 events.emit("roofline", "roofline.report", **r)
+        # Retire the live per-epoch snapshot BEFORE the final dump
+        # writes the terminal one under the same proc name — close()
+        # removes the live file, the dump re-creates it as final.
+        if live_metrics is not None:
+            live_metrics.close()
         # An explicit DCT_METRICS_PROM must work even with the event log
         # disabled (textfile-collector-only rigs clear DCT_EVENTS_DIR).
         if self.coordinator and cfg.obs.enabled and (

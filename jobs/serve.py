@@ -60,6 +60,7 @@ def _serve_pool(build_server, what: str, serving, host: str,
     )
     autoscaler = None
     publisher = None
+    history_monitor = None
     if serving.autoscale:
         from dct_tpu.config import ObservabilityConfig
 
@@ -102,11 +103,25 @@ def _serve_pool(build_server, what: str, serving, host: str,
                     )
             except SLOSpecError:
                 pass  # the serving children already report it loudly
+        # Telemetry history (ISSUE 17): when DCT_TS_DIR arms the store
+        # the pool parent runs the fleet-wide anomaly/incident monitor
+        # (children each see 1/N of traffic; the parent reads it all),
+        # and the autoscaler's queue/shed windows come from the same
+        # on-disk history instead of between-poll deltas.
+        from dct_tpu.observability import detect as _detect
+
+        history_monitor = _detect.arm_from_env(
+            registry=registry, emit=_autoscale.emit_default,
+        )
         autoscaler = _autoscale.Autoscaler.from_config(
             _autoscale.PoolScaleTarget(pool), serving,
             signal_fn=_autoscale.pool_signal_fn(
                 obs.metrics_dir, stale_s=obs.metrics_stale_s,
                 slo_monitor=slo_monitor,
+                history=(
+                    history_monitor.reader
+                    if history_monitor is not None else None
+                ),
             ),
             emit=_autoscale.emit_default,
             registry=registry,
@@ -133,6 +148,8 @@ def _serve_pool(build_server, what: str, serving, host: str,
     finally:
         if autoscaler is not None:
             autoscaler.close()
+        if history_monitor is not None:
+            history_monitor.close()
         if publisher is not None:
             publisher.close()
         pool.close()

@@ -1,0 +1,238 @@
+#!/usr/bin/env python3
+"""Incident-plane chaos smoke — the tier1.yml ``incident-smoke`` job.
+
+A REAL forked SO_REUSEPORT serving pool (2 children) with the full
+ISSUE 17 telemetry history plane armed in every child — on-disk
+time-series store, online anomaly detector, incident assembler with
+triggered profiling — driven through one complete detect-and-explain
+cycle. The parent process never touches in-process detector state: it
+observes ONLY what the children leave behind on disk (the shared
+store, the event log, the incidents directory), which is exactly the
+operator's view.
+
+1. **Arm**: a ``deploy_package`` lineage node is planted (as the
+   promotion path would have), then the pool comes up under
+   ``DCT_TS_DIR`` + ``DCT_ANOMALY`` + ``DCT_INCIDENT`` +
+   ``DCT_INCIDENT_PROFILE=1``. A planted REPEATING ``slow_score:ms10``
+   fault pins per-worker capacity (~100 rows/s) so the overload knee
+   is deterministic on any host.
+2. **Detect from the store**: healthy traffic warms each child's EWMA
+   baseline; then a 4x spike ramps queue depth past it. Within budget,
+   ``anomaly.detected`` (signal ``queue_depth``) must land on the
+   event log — each child's detector reads ONLY the on-disk store.
+3. **Explain**: the anomaly edge must auto-assemble a bundle whose
+   manifest names the planted deploy_package lineage id, and (armed)
+   the bundle must hold a TensorBoard-loadable ``plugins/profile``
+   capture from the PR 14 flight recorder (jax imports lazily INSIDE
+   the child at capture time — the scoring path itself stays numpy).
+4. **Drain**: ``close()`` must end the supervised ``wait()`` with
+   rc 0 — the telemetry plane never turns teardown into the failure
+   path.
+
+Run: ``python scripts/incident_smoke.py`` (exit 0 = pass).
+"""
+
+from __future__ import annotations
+
+import glob
+import http.client
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+DETECT_BUDGET_S = 20.0
+BUNDLE_BUDGET_S = 25.0
+
+
+def _events(path: str) -> list[dict]:
+    try:
+        with open(path) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
+    except (OSError, ValueError):
+        return []
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="incident-smoke-")
+    incidents_dir = os.path.join(tmp, "incidents")
+    events_path = os.path.join(tmp, "events", "events.jsonl")
+    os.environ["DCT_OBSERVABILITY"] = "1"
+    os.environ["DCT_EVENTS_DIR"] = os.path.join(tmp, "events")
+    os.environ["DCT_METRICS_DIR"] = os.path.join(tmp, "metrics")
+    os.environ["DCT_LINEAGE_DIR"] = tmp
+    os.environ["DCT_TS_DIR"] = os.path.join(tmp, "ts")
+    os.environ["DCT_INCIDENT_DIR"] = incidents_dir
+    # Deterministic capacity: every flush (max_batch=1 => every
+    # request) costs 10 ms, so one worker serves ~100 rows/s anywhere.
+    os.environ["DCT_FAULT_SPEC"] = "slow_score:ms10"
+    # Fast cadences: second-scale publish/flush/poll so one smoke run
+    # covers baseline + detection inside a CI-friendly wall clock.
+    os.environ["DCT_METRICS_PUBLISH_S"] = "0.1"
+    os.environ["DCT_TS_FLUSH_S"] = "0.15"
+    os.environ["DCT_ANOMALY_POLL_S"] = "0.1"
+    os.environ["DCT_ANOMALY_MIN_POINTS"] = "5"
+    os.environ["DCT_ANOMALY_WINDOW_S"] = "8"
+    os.environ["DCT_ANOMALY_Z"] = "3.5"
+    os.environ["DCT_INCIDENT"] = "1"
+    os.environ["DCT_INCIDENT_PROFILE"] = "1"
+    os.environ["DCT_INCIDENT_PROFILE_S"] = "0.5"
+    os.environ["DCT_SLO_SPEC"] = ""
+
+    from dct_tpu.config import ServingConfig
+    from dct_tpu.observability import incident, lineage
+    from dct_tpu.resilience.supervisor import RestartPolicy
+    from dct_tpu.serving import loadgen
+    from dct_tpu.serving.server import ServerPool, make_server_from_weights
+
+    # Plant the lineage the promotion path would have left behind: the
+    # bundle's manifest must point the responder at THIS deploy.
+    ledger = lineage.LineageLedger(
+        lineage.default_ledger_path(), run_id="smoke-run"
+    )
+    pkg_id = ledger.node(
+        "deploy_package", content={"model": "synthetic-mlp", "v": 1}
+    )
+    ledger.close()
+
+    failures: list[str] = []
+
+    def check(cond: bool, what: str) -> None:
+        print(("PASS " if cond else "FAIL ") + what, flush=True)
+        if not cond:
+            failures.append(what)
+
+    weights, meta = loadgen.synthetic_mlp()
+    serving = ServingConfig(max_batch=1, workers=1, processes=2)
+    body = json.dumps({"data": [[0.1, -0.2, 0.3, 0.0, 1.1]]}).encode()
+
+    pool = ServerPool(
+        lambda h, p, reuse_port: make_server_from_weights(
+            weights, meta, host=h, port=p, serving=serving,
+            reuse_port=reuse_port,
+        ),
+        processes=serving.processes, host="127.0.0.1",
+        restart_policy=RestartPolicy(max_restarts=3, backoff_s=0.1),
+    )
+    rc = [None]
+    wait_thread = threading.Thread(
+        target=lambda: rc.__setitem__(0, pool.wait()), daemon=True
+    )
+    wait_thread.start()
+
+    detect_latency = None
+    manifest = None
+    try:
+        check(pkg_id is not None, "deploy_package lineage node planted")
+
+        # readiness: the shared port must answer before traffic starts
+        deadline = time.time() + 20
+        up = False
+        while time.time() < deadline and not up:
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", pool.port, timeout=5
+                )
+                conn.request("GET", "/healthz")
+                conn.getresponse().read()
+                conn.close()
+                up = True
+            except OSError:
+                time.sleep(0.2)
+        check(up, "pool came up")
+
+        # --- baseline: warm every child's EWMA under healthy load --------
+        loadgen.run_open_loop(
+            "127.0.0.1", pool.port, body, qps=40.0, duration_s=2.0,
+            max_inflight=64,
+        )
+
+        # --- 4x spike: queue depth ramps, children must detect it --------
+        spike = threading.Thread(
+            target=loadgen.run_open_loop,
+            args=("127.0.0.1", pool.port, body),
+            kwargs={"qps": 800.0, "duration_s": DETECT_BUDGET_S,
+                    "max_inflight": 400},
+            daemon=True,
+        )
+        t_plant = time.perf_counter()
+        spike.start()
+        while time.perf_counter() - t_plant < DETECT_BUDGET_S:
+            if any(
+                e.get("event") == "anomaly.detected"
+                and e.get("signal") == "queue_depth"
+                for e in _events(events_path)
+            ):
+                detect_latency = time.perf_counter() - t_plant
+                break
+            time.sleep(0.05)
+        check(
+            detect_latency is not None,
+            f"queue_depth anomaly detected from the store "
+            f"({None if detect_latency is None else round(detect_latency, 2)} s, "
+            f"budget {DETECT_BUDGET_S} s)",
+        )
+
+        # --- the bundle: assembled, lineage-attributed, profiled ---------
+        deadline = time.monotonic() + BUNDLE_BUDGET_S
+        while time.monotonic() < deadline:
+            bundles = [
+                b for b in incident.list_bundles(incidents_dir)
+                if b.get("signal") == "queue_depth"
+                and "profile/" in b.get("files", [])
+            ]
+            if bundles:
+                manifest = bundles[-1]
+                break
+            time.sleep(0.1)
+        check(manifest is not None,
+              "incident bundle assembled with a profile capture")
+        if manifest is not None:
+            check(manifest["kind"] == "anomaly",
+                  f"bundle kind is the anomaly edge ({manifest['kind']})")
+            check(manifest["lineage_id"] == pkg_id,
+                  f"bundle names the active deploy "
+                  f"({manifest['lineage_id']} == {pkg_id})")
+            check("timeseries.json" in manifest["files"],
+                  "bundle holds the time-series slice")
+            bundle_dir = manifest["bundle"]
+            ts_slice = json.load(
+                open(os.path.join(bundle_dir, "timeseries.json"))
+            )
+            sliced_families = set()
+            for ent in ts_slice.get("procs", {}).values():
+                sliced_families.update(ent.get("meta", {}))
+            check("dct_serve_queue_depth" in sliced_families,
+                  "sliced store covers the firing family")
+            # TensorBoard-loadable: the flight recorder writes xplane
+            # protos under plugins/profile/<run>/.
+            xplanes = glob.glob(os.path.join(
+                bundle_dir, "profile", "*", "plugins", "profile",
+                "*", "*.xplane.pb",
+            ))
+            check(bool(xplanes),
+                  f"loadable plugins/profile capture in the bundle "
+                  f"({len(xplanes)} xplane file(s))")
+    finally:
+        pool.close()
+        wait_thread.join(15)
+
+    # --- clean drain ----------------------------------------------------
+    print(f"drain rc: {rc[0]}", flush=True)
+    if rc[0] != 0:
+        failures.append(f"clean drain rc (got {rc[0]})")
+    if failures:
+        print("FAILURES: " + "; ".join(failures), flush=True)
+        return 1
+    print("incident smoke: all checks passed", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -358,6 +358,14 @@ def _worst_case_record() -> dict:
             "shed_fraction": 0.1154, "admitted_errors": 0,
             "scale_events": 4, "bounded": True,
         },
+        "telemetry_history": {
+            "plain_publish_p50_ms": 0.2131, "armed_publish_p50_ms": 0.2298,
+            "publish_overhead_ms": 0.0167, "overhead_frac": 0.0784,
+            "detected": True, "detect_latency_s": 1.847,
+            "rig": {"service_ms": 2.0, "fault_ms": 30.0,
+                    "base_qps": 40.0, "spike_qps": 80.0,
+                    "baseline_s": 1.6, "budget_s": 12.0},
+        },
     }
 
 
@@ -472,13 +480,19 @@ def test_stdout_record_typical_round_is_not_collapsed(bench_mod):
     assert sl["levels"]["p99_ms"] == [0.9883, 3.7727, 11.4212]
     assert sl["batched_over_single"] == 1.14
     assert sl["score_batched_over_single"] == 15.96
-    # ...elastic_serving keeps both sentinel series + the A/B ratio
-    # pair on stdout; the per-phase replay dicts stay in the partial.
+    # ...elastic_serving keeps both sentinel series on stdout (the A/B
+    # ratio pair may yield to the partial when every stanza is
+    # populated at once — the late rung funding telemetry_history);
+    # the per-phase replay dicts stay in the partial.
     es = out["elastic_serving"]
     assert es["overload_p99_s"] == 0.0262
     assert es["shed_fraction"] == 0.1154
-    assert es["p99_ratio_on"] == 2.39 and es["p99_ratio_off"] == 227.46
     assert "off" not in es and "on" not in es and "trace" not in es
+    # ...telemetry_history keeps exactly its two sentinel series; the
+    # plain/armed p50 pair and the rig knobs stay in the partial.
+    assert out["telemetry_history"] == {
+        "detect_latency_s": 1.847, "publish_overhead_ms": 0.0167,
+    }
 
 
 def test_stdout_record_bounds_error_strings(bench_mod):

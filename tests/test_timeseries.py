@@ -1,0 +1,863 @@
+"""Telemetry history plane (ISSUE 17): on-disk time-series store
+round-trip/rotation/retention, EWMA anomaly-detector semantics,
+incident-bundle assembly, the aggregate.py edge cases the writer leans
+on, history-fed SLO/autoscaler windows, and the acceptance e2e rigs —
+a planted slow_score fault on a REAL serving chain and a loss spike on
+the training publisher, both detected from the on-disk store (never
+from in-process state), plus bitwise loss parity armed vs off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from dct_tpu.observability import aggregate, detect, incident, lineage, slo
+from dct_tpu.observability.metrics import MetricsRegistry
+from dct_tpu.observability.timeseries import (
+    HistoryReader,
+    HistoryWriter,
+    downsample_segment,
+    writer_from_env,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# ----------------------------------------------------------------------
+# crafted wire-format snapshots (the exact shape registry.snapshot emits)
+
+
+def _gauge(name, value, *, agg="last", labels=None):
+    return {
+        "name": name, "type": "gauge", "help": "", "agg": agg,
+        "samples": [{"labels": labels or {}, "value": value}],
+    }
+
+
+def _counter(name, value, *, labels=None):
+    return {
+        "name": name, "type": "counter", "help": "",
+        "samples": [{"labels": labels or {}, "value": value}],
+    }
+
+
+def _hist(name, buckets, counts, count, total):
+    return {
+        "name": name, "type": "histogram", "help": "",
+        "buckets": list(buckets),
+        "samples": [{
+            "labels": {}, "counts": list(counts),
+            "count": count, "sum": total,
+        }],
+    }
+
+
+def _snap(proc, ts, metrics, *, pid=None, final=False):
+    return {
+        "proc": proc, "pid": pid or os.getpid(), "ts": ts,
+        "final": final, "metrics": metrics,
+    }
+
+
+# ======================================================================
+# store: append / flush / rotation
+
+
+def test_append_flush_roundtrip(tmp_path):
+    clk = FakeClock()
+    w = HistoryWriter(str(tmp_path), proc="p1", clock=clk)
+    for i in range(5):
+        w.append(_snap("p1", clk.advance(1.0), [
+            _gauge("dct_train_goodput_fraction", 0.9 + i / 100),
+        ]))
+    w.flush()
+    r = HistoryReader(str(tmp_path), clock=clk)
+    pts = r.range("dct_train_goodput_fraction", window_s=100, now=clk())
+    assert [v for _ts, v in pts] == pytest.approx(
+        [0.9, 0.91, 0.92, 0.93, 0.94]
+    )
+    # flush is synchronous: the active segment is on disk right now.
+    assert os.path.exists(tmp_path / "p1" / "active.seg.json")
+
+
+def test_segment_seal_rotation_merges_sealed_and_active(tmp_path):
+    clk = FakeClock()
+    w = HistoryWriter(
+        str(tmp_path), proc="p1", seg_points=4, flush_points=1, clock=clk
+    )
+    for i in range(10):
+        w.append(_snap("p1", clk.advance(1.0), [
+            _gauge("dct_train_goodput_fraction", float(i)),
+        ]))
+    w.flush()
+    names = sorted(os.listdir(tmp_path / "p1"))
+    assert "raw-00000001.seg.json" in names
+    assert "raw-00000002.seg.json" in names
+    assert "active.seg.json" in names
+    r = HistoryReader(str(tmp_path), clock=clk)
+    pts = r.range("dct_train_goodput_fraction", window_s=100, now=clk())
+    # sealed + active merge time-sorted with no gaps or duplicates
+    assert [v for _ts, v in pts] == [float(i) for i in range(10)]
+
+
+def test_family_filter_excludes_unselected(tmp_path):
+    clk = FakeClock()
+    w = HistoryWriter(str(tmp_path), proc="p1", clock=clk)
+    w.append(_snap("p1", clk.advance(1.0), [
+        _gauge("dct_train_goodput_fraction", 0.5),
+        _counter("dct_lineage_nodes_total", 3),
+        _counter("unprefixed_total", 9),
+    ]))
+    w.flush()
+    r = HistoryReader(str(tmp_path), clock=clk)
+    assert r.families() == ["dct_train_goodput_fraction"]
+
+
+def test_writer_survives_unwritable_directory(tmp_path):
+    target = tmp_path / "blocked"
+    target.write_text("a plain file where the store dir should be")
+    clk = FakeClock()
+    w = HistoryWriter(str(target), proc="p1", flush_points=1, clock=clk)
+    # every append hits the dead path; none may raise
+    for i in range(3):
+        w.append(_snap("p1", clk.advance(1.0), [
+            _gauge("dct_train_goodput_fraction", 0.5),
+        ]))
+    w.flush()
+    w.close()
+
+
+def test_restart_continues_sequence_numbering(tmp_path):
+    clk = FakeClock()
+    w = HistoryWriter(str(tmp_path), proc="p1", clock=clk)
+    w.append(_snap("p1", clk.advance(1.0), [
+        _gauge("dct_train_goodput_fraction", 1.0),
+    ]))
+    w.close()  # seals raw-00000001
+    w2 = HistoryWriter(str(tmp_path), proc="p1", clock=clk)
+    w2.append(_snap("p1", clk.advance(1.0), [
+        _gauge("dct_train_goodput_fraction", 2.0),
+    ]))
+    w2.close()
+    names = sorted(os.listdir(tmp_path / "p1"))
+    assert names == ["raw-00000001.seg.json", "raw-00000002.seg.json"]
+
+
+# ======================================================================
+# store: queries
+
+
+def test_counter_delta_is_reset_tolerant(tmp_path):
+    clk = FakeClock()
+    w = HistoryWriter(str(tmp_path), proc="p1", clock=clk)
+    # 10 -> 20 (+10), restart to 5 (+5: the new cumulative IS the
+    # post-reset delta), -> 8 (+3)
+    for v in (10, 20, 5, 8):
+        w.append(_snap("p1", clk.advance(1.0), [
+            _counter("dct_serve_shed_total", v),
+        ]))
+    w.flush()
+    r = HistoryReader(str(tmp_path), clock=clk)
+    assert r.counter_delta(
+        "dct_serve_shed_total", window_s=100, now=clk()
+    ) == pytest.approx(18.0)
+
+
+def test_gauge_last_combines_procs_by_declared_agg(tmp_path):
+    clk = FakeClock()
+    for proc, v in (("a", 0.2), ("b", 0.8)):
+        w = HistoryWriter(str(tmp_path), proc=proc, clock=clk)
+        w.append(_snap(proc, clk.advance(1.0), [
+            _gauge("dct_anomaly_active", v, agg="max"),
+        ]))
+        w.flush()
+    r = HistoryReader(str(tmp_path), clock=clk)
+    assert r.gauge_last(
+        "dct_anomaly_active", window_s=100, now=clk()
+    ) == pytest.approx(0.8)
+
+
+def test_hist_mean_and_percentile_from_window_deltas(tmp_path):
+    clk = FakeClock()
+    w = HistoryWriter(str(tmp_path), proc="p1", clock=clk)
+    buckets = (1.0, 4.0, 16.0)
+    # cumulative: 10 obs of ~1 (sum 10), then +10 obs of ~16 (sum +160)
+    w.append(_snap("p1", clk.advance(1.0), [
+        _hist("dct_serve_queue_depth", buckets, [10, 10, 10], 10, 10.0),
+    ]))
+    w.append(_snap("p1", clk.advance(1.0), [
+        _hist("dct_serve_queue_depth", buckets, [10, 10, 20], 20, 170.0),
+    ]))
+    w.flush()
+    r = HistoryReader(str(tmp_path), clock=clk)
+    # window delta: count +10, sum +160 -> mean 16
+    assert r.hist_mean(
+        "dct_serve_queue_depth", window_s=100, now=clk()
+    ) == pytest.approx(16.0)
+    # all 10 delta observations land in the top bucket
+    assert r.hist_percentile(
+        "dct_serve_queue_depth", 0.5, window_s=100, now=clk()
+    ) == pytest.approx(16.0)
+
+
+def test_downsample_folds_gauges_and_keeps_cumulative_last():
+    seg = {
+        "v": 1, "tier": "raw", "proc": "p", "seq": 1,
+        "start_ts": 0.0, "end_ts": 100.0,
+        "meta": {
+            "g": {"type": "gauge", "agg": "last"},
+            "c": {"type": "counter"},
+        },
+        "points": [
+            {"ts": float(t), "m": {"g": {"": float(t)}, "c": {"": t * 2.0}}}
+            for t in (1, 2, 3, 61, 62)
+        ],
+    }
+    ds = downsample_segment(seg, res_s=60.0)
+    assert ds["tier"] == "ds"
+    bins = {pt["ts"]: pt["m"] for pt in ds["points"]}
+    assert len(bins) == 2
+    first = bins[min(bins)]["g"][""]
+    assert first["min"] == 1.0 and first["max"] == 3.0
+    assert first["last"] == 3.0 and first["n"] == 3
+    # counters keep the last cumulative value (rates stay computable)
+    assert bins[min(bins)]["c"][""]["last"] == 6.0
+    assert bins[max(bins)]["c"][""]["last"] == 124.0
+
+
+# ======================================================================
+# store: compaction / retention
+
+
+def test_retention_provably_compacts_past_env_knob(
+    tmp_path, monkeypatch
+):
+    """Acceptance: segments whose newest point is older than
+    ``DCT_TS_RETENTION_S`` are deleted; between downsample and
+    retention age they are folded to the ds tier."""
+    monkeypatch.setenv("DCT_TS_DIR", str(tmp_path))
+    monkeypatch.setenv("DCT_TS_RETENTION_S", "100")
+    monkeypatch.setenv("DCT_TS_DOWNSAMPLE_S", "30")
+    clk = FakeClock()
+    w = writer_from_env(proc="p1", clock=clk)
+    assert isinstance(w, HistoryWriter)
+    assert w.retention_s == 100.0 and w.downsample_s == 30.0
+    w.append(_snap("p1", clk.advance(1.0), [
+        _gauge("dct_train_goodput_fraction", 0.9),
+    ]))
+    w.close()  # seals raw-00000001 at ts ~1001
+    assert os.path.exists(tmp_path / "p1" / "raw-00000001.seg.json")
+    # past downsample_s: raw folds to ds (raw removed, data retained)
+    clk.advance(60.0)
+    out = w.compact(now=clk())
+    assert out["downsampled"] == 1
+    names = sorted(os.listdir(tmp_path / "p1"))
+    assert names == ["ds-00000001.seg.json"]
+    r = HistoryReader(str(tmp_path), clock=clk)
+    assert r.range(
+        "dct_train_goodput_fraction", window_s=1000, now=clk()
+    ) != []
+    # past retention_s: the ds segment is deleted too
+    clk.advance(100.0)
+    out = w.compact(now=clk())
+    assert out["deleted"] == 1
+    assert os.listdir(tmp_path / "p1") == []
+
+
+def test_reader_prefers_raw_over_ds_for_same_seq(tmp_path):
+    """Same-proc newest-wins across a compaction boundary: a crash
+    between the ds write and the raw remove leaves BOTH tiers for one
+    seq — the reader must use the raw (full-detail) one, not
+    double-count."""
+    clk = FakeClock()
+    w = HistoryWriter(
+        str(tmp_path), proc="p1", downsample_s=10.0, clock=clk
+    )
+    for i in range(5):
+        w.append(_snap("p1", clk.advance(1.0), [
+            _gauge("dct_train_goodput_fraction", float(i)),
+        ]))
+    w.close()
+    raw = tmp_path / "p1" / "raw-00000001.seg.json"
+    saved = raw.read_text()
+    clk.advance(60.0)
+    assert w.compact(now=clk())["downsampled"] == 1
+    # simulate the crash ordering: ds landed, raw removal did not
+    raw.write_text(saved)
+    names = sorted(os.listdir(tmp_path / "p1"))
+    assert names == ["ds-00000001.seg.json", "raw-00000001.seg.json"]
+    r = HistoryReader(str(tmp_path), clock=clk)
+    pts = r.range("dct_train_goodput_fraction", window_s=1000, now=clk())
+    assert [v for _ts, v in pts] == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
+# ======================================================================
+# aggregate.py edge cases the history writer leans on
+
+
+def test_final_snapshot_persists_under_concurrent_rotation(tmp_path):
+    """A FINAL snapshot (dead pid, aged mtime) must keep counting while
+    the history writer rotates segments in a subtree of the same
+    metrics dir; a live-but-stale one must age out. The concurrent
+    seal/compact churn may never break a read."""
+    metrics_dir = str(tmp_path)
+    dead_pid = 2 ** 22 - 7
+    p_final = aggregate.write_snapshot(
+        _snap("batch", 0.0, [_counter("dct_requests_total", 3)],
+              pid=dead_pid, final=True),
+        metrics_dir,
+    )
+    p_stale = aggregate.write_snapshot(
+        _snap("stale", 0.0, [_counter("dct_requests_total", 9)]),
+        metrics_dir,
+    )
+    old = time.time() - 1000
+    os.utime(p_final, (old, old))
+    os.utime(p_stale, (old, old))
+    aggregate.write_snapshot(
+        _snap("live", 0.0, [_counter("dct_requests_total", 2)]),
+        metrics_dir,
+    )
+
+    stop = threading.Event()
+
+    def churn():
+        clk = FakeClock()
+        w = HistoryWriter(
+            os.path.join(metrics_dir, "ts"), proc="rot",
+            seg_points=3, flush_points=1, downsample_s=5.0, clock=clk,
+        )
+        i = 0
+        while not stop.is_set():
+            w.append(_snap("rot", clk.advance(10.0), [
+                _gauge("dct_train_goodput_fraction", float(i)),
+            ]))
+            i += 1
+        w.close()
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 1.0
+        reads = 0
+        while time.monotonic() < deadline:
+            snaps = aggregate.read_snapshots(metrics_dir, stale_s=30.0)
+            assert sorted(s["proc"] for s in snaps) == ["batch", "live"]
+            reads += 1
+    finally:
+        stop.set()
+        t.join(5.0)
+    assert reads > 10
+
+
+def test_same_proc_newest_wins_across_snapshot_files(tmp_path):
+    """Two snapshot files claiming the same proc (a renamed leftover
+    from before a rotation vs the live file): the newest mtime wins —
+    the merge never double-counts one process against itself."""
+    d = str(tmp_path)
+    aggregate.write_snapshot(
+        _snap("worker", 0.0, [_counter("dct_requests_total", 100)]), d
+    )
+    os.replace(
+        os.path.join(d, "worker.metrics.json"),
+        os.path.join(d, "worker-old.metrics.json"),
+    )
+    old = time.time() - 5
+    os.utime(os.path.join(d, "worker-old.metrics.json"), (old, old))
+    aggregate.write_snapshot(
+        _snap("worker", 0.0, [_counter("dct_requests_total", 7)]), d
+    )
+    snaps = aggregate.read_snapshots(d, stale_s=30.0)
+    assert len(snaps) == 1
+    merged = aggregate.merge_snapshots(snaps)
+    assert merged.total("dct_requests_total") == 7
+
+
+# ======================================================================
+# anomaly detector
+
+
+def _loss_watch(**kw):
+    return detect.Watch(
+        "val_loss", "dct_train_val_loss", direction="high", **kw
+    )
+
+
+def test_detector_edge_trigger_freeze_and_resolve():
+    events: list[tuple] = []
+    reg = MetricsRegistry()
+    det = detect.AnomalyDetector(
+        HistoryReader("/nonexistent"),
+        watches=[_loss_watch()],
+        z=4.0, min_points=4, registry=reg,
+        emit=lambda comp, ev, **kw: events.append((ev, kw)),
+    )
+    watch = det.watches[0]
+    for i in range(8):
+        det.observe(watch, 1.0 + 0.001 * i, now=100.0 + i)
+    baseline_mean = det._states["val_loss"].mean
+    det.observe(watch, 10.0, now=110.0)  # >> z * (5% variance floor)
+    assert [ev for ev, _ in events] == ["anomaly.detected"]
+    assert det.active()[0]["signal"] == "val_loss"
+    # frozen: the anomalous plateau must not become the new normal,
+    # and no duplicate edge fires while it persists
+    det.observe(watch, 10.0, now=111.0)
+    det.observe(watch, 11.0, now=112.0)
+    assert [ev for ev, _ in events] == ["anomaly.detected"]
+    assert det._states["val_loss"].mean == pytest.approx(
+        baseline_mean
+    )
+    # re-entry within z/2 sigmas resolves, with a duration stamp
+    det.observe(watch, 1.0, now=120.0)
+    assert [ev for ev, _ in events] == [
+        "anomaly.detected", "anomaly.resolved",
+    ]
+    assert events[1][1]["duration_s"] == pytest.approx(10.0)
+    assert det.active() == []
+    # registry rendering: episode counted once, active back to 0
+    snap = {m["name"]: m for m in reg.snapshot(proc="t")["metrics"]}
+    assert snap["dct_anomaly_total"]["samples"][0]["value"] == 1
+    active = {
+        s["labels"]["signal"]: s["value"]
+        for s in snap["dct_anomaly_active"]["samples"]
+    }
+    assert active["val_loss"] == 0.0
+
+
+def test_detector_low_direction_only_fires_downward():
+    def fresh():
+        det = detect.AnomalyDetector(
+            HistoryReader("/nonexistent"),
+            watches=[detect.Watch(
+                "goodput_fraction", "dct_train_goodput_fraction",
+                direction="low",
+            )],
+            z=4.0, min_points=4,
+        )
+        watch = det.watches[0]
+        # long warmup: the EWMA starts cold at mean 0, so the variance
+        # needs a few half-lives to settle onto the flat baseline
+        for i in range(24):
+            det.observe(watch, 0.9, now=100.0 + i)
+        return det, watch
+
+    det, watch = fresh()
+    det.observe(watch, 5.0, now=110.0)  # spike UP: not trouble for low
+    assert det.active() == []
+    det, watch = fresh()
+    det.observe(watch, 0.1, now=110.0)  # collapse DOWN: trouble
+    assert [a["signal"] for a in det.active()] == ["goodput_fraction"]
+
+
+def test_detector_needs_min_points_before_alerting():
+    det = detect.AnomalyDetector(
+        HistoryReader("/nonexistent"),
+        watches=[_loss_watch()], z=4.0, min_points=8,
+    )
+    watch = det.watches[0]
+    for i in range(7):
+        det.observe(watch, 1.0, now=100.0 + i)
+    det.observe(watch, 100.0, now=108.0)  # baseline not warm yet
+    assert det.active() == []
+
+
+def test_variance_floor_makes_flat_zero_signal_alertable():
+    det = detect.AnomalyDetector(
+        HistoryReader("/nonexistent"),
+        watches=[detect.Watch(
+            "shed_rate", "dct_serve_shed_total", kind="rate",
+            direction="high",
+        )],
+        z=4.0, min_points=4,
+    )
+    watch = det.watches[0]
+    for i in range(8):
+        det.observe(watch, 0.0, now=100.0 + i)
+    det.observe(watch, 1.0, now=110.0)  # first real burst ever
+    assert [a["signal"] for a in det.active()] == ["shed_rate"]
+
+
+def test_detector_poll_reads_from_the_store(tmp_path):
+    """The production entry: poll() reduces each watch from the ON-DISK
+    store — a detector fed only by segments another process wrote."""
+    clk = FakeClock()
+    w = HistoryWriter(str(tmp_path), proc="train", clock=clk)
+    r = HistoryReader(str(tmp_path), clock=clk)
+    det = detect.AnomalyDetector(
+        r, watches=[_loss_watch(window_s=300.0)],
+        z=4.0, min_points=4, clock=clk,
+    )
+    for i in range(8):
+        w.append(_snap("train", clk.advance(1.0), [
+            _gauge("dct_train_val_loss", 0.5 + 0.001 * i),
+        ]))
+        w.flush()
+        det.poll(now=clk())
+    assert det.active() == []
+    w.append(_snap("train", clk.advance(1.0), [
+        _gauge("dct_train_val_loss", 50.0),
+    ]))
+    w.flush()
+    anomalies = det.poll(now=clk())
+    assert [a["signal"] for a in anomalies] == ["val_loss"]
+
+
+# ======================================================================
+# incident bundles
+
+
+def _plant_ledger(path: str) -> str:
+    led = lineage.LineageLedger(path, run_id="run-1")
+    led.node("dataset_snapshot", content={"rows": 10})
+    pkg_id = led.node("deploy_package", content={"model": "mlp", "v": 3})
+    led.close()
+    assert pkg_id is not None
+    return pkg_id
+
+
+def test_incident_bundle_contents_and_lineage_id(tmp_path):
+    clk = FakeClock(t=2000.0)
+    ts_dir = tmp_path / "ts"
+    w = HistoryWriter(str(ts_dir), proc="serve", clock=clk)
+    w.append(_snap("serve", 1995.0, [
+        _gauge("dct_train_goodput_fraction", 0.9),
+    ]))
+    w.flush()
+    events_dir = tmp_path / "events"
+    events_dir.mkdir()
+    with open(events_dir / "events.jsonl", "w") as f:
+        f.write(json.dumps({"ts": 1990.0, "event": "in_window"}) + "\n")
+        f.write(json.dumps({"ts": 5.0, "event": "ancient"}) + "\n")
+    ledger = str(tmp_path / "lineage.jsonl")
+    pkg_id = _plant_ledger(ledger)
+
+    mgr = incident.IncidentManager(
+        str(tmp_path / "incidents"),
+        reader=HistoryReader(str(ts_dir), clock=clk),
+        events_dir=str(events_dir),
+        lineage_path=ledger,
+        window_s=60.0, cooldown_s=0.0, clock=clk,
+    )
+    bundle = mgr.assemble(
+        "anomaly", "val_loss", {"signal": "val_loss", "zscore": 9.0}
+    )
+    assert bundle is not None
+    manifest = json.load(open(os.path.join(bundle, "incident.json")))
+    assert manifest["kind"] == "anomaly"
+    assert manifest["signal"] == "val_loss"
+    # the bundle names the active deploy_package lineage id
+    assert manifest["lineage_id"] == pkg_id
+    assert set(manifest["files"]) == {
+        "timeseries.json", "events.jsonl", "lineage.json",
+    }
+    ts_slice = json.load(open(os.path.join(bundle, "timeseries.json")))
+    assert "serve" in ts_slice["procs"]
+    ev = [json.loads(line) for line in
+          open(os.path.join(bundle, "events.jsonl"))]
+    assert [e["event"] for e in ev] == ["in_window"]
+    node = json.load(open(os.path.join(bundle, "lineage.json")))
+    assert node["kind"] == "deploy_package" and node["id"] == pkg_id
+
+
+def test_incident_manifest_is_the_completion_marker(tmp_path):
+    clk = FakeClock()
+    mgr = incident.IncidentManager(
+        str(tmp_path), window_s=60.0, cooldown_s=0.0, clock=clk
+    )
+    bundle = mgr.assemble("manual", "probe", {})
+    # a bundle missing its manifest (crash mid-assembly) is invisible
+    os.rename(
+        os.path.join(bundle, "incident.json"),
+        os.path.join(bundle, "incident.json.partial"),
+    )
+    assert incident.list_bundles(str(tmp_path)) == []
+    os.rename(
+        os.path.join(bundle, "incident.json.partial"),
+        os.path.join(bundle, "incident.json"),
+    )
+    got = incident.list_bundles(str(tmp_path))
+    assert len(got) == 1 and got[0]["signal"] == "probe"
+
+
+def test_incident_cooldown_rate_limits_per_signal(tmp_path):
+    clk = FakeClock()
+    mgr = incident.IncidentManager(
+        str(tmp_path), window_s=10.0, cooldown_s=300.0, clock=clk
+    )
+    assert mgr.trigger("anomaly", "val_loss", {}) is True
+    clk.advance(10.0)
+    assert mgr.trigger("anomaly", "val_loss", {}) is False
+    # a DIFFERENT signal is not throttled by val_loss's cooldown
+    assert mgr.trigger("anomaly", "queue_depth", {}) is True
+    clk.advance(400.0)
+    assert mgr.trigger("anomaly", "val_loss", {}) is True
+    mgr.close()
+
+
+def test_incident_cli_list_and_show(tmp_path, capsys):
+    clk = FakeClock()
+    mgr = incident.IncidentManager(
+        str(tmp_path), window_s=10.0, cooldown_s=0.0, clock=clk
+    )
+    bundle = mgr.assemble("manual", "probe", {})
+    assert incident.main(["list", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "signal=probe" in out
+    assert incident.main(["show", bundle]) == 0
+    assert '"signal": "probe"' in capsys.readouterr().out
+
+
+# ======================================================================
+# history-fed control loops (SLO monitor + autoscaler)
+
+
+def test_slo_availability_burn_comes_from_history_store(tmp_path):
+    clk = FakeClock()
+    w = HistoryWriter(str(tmp_path), proc="serve", clock=clk)
+    for req, err in ((100, 0), (150, 0), (200, 25)):
+        w.append(_snap("serve", clk.advance(10.0), [
+            _counter("dct_requests_total", req),
+            _counter("dct_request_errors_total", err),
+        ]))
+    w.flush()
+    mon = slo.SLOMonitor(
+        [slo.SLOSpec(name="avail", kind="availability", objective=0.99)],
+        history=HistoryReader(str(tmp_path), clock=clk),
+        clock=clk,
+    )
+    burn = mon._history_burn(mon.specs[0], 100.0, clk())
+    # window deltas: +100 requests, +25 errors -> 25% bad / 1% budget
+    assert burn == pytest.approx(25.0)
+
+
+def test_autoscaler_signals_come_from_history_store(tmp_path):
+    from dct_tpu.serving.autoscale import pool_signal_fn
+
+    clk = FakeClock()
+    w = HistoryWriter(str(tmp_path / "ts"), proc="serve", clock=clk)
+    buckets = (1.0, 8.0, 64.0)
+    w.append(_snap("serve", clk.advance(1.0), [
+        _hist("dct_serve_queue_depth", buckets, [5, 5, 5], 5, 10.0),
+        _counter("dct_serve_shed_total", 0),
+    ]))
+    w.append(_snap("serve", clk.advance(1.0), [
+        _hist("dct_serve_queue_depth", buckets, [5, 5, 15], 15, 330.0),
+        _counter("dct_serve_shed_total", 12),
+    ]))
+    w.flush()
+    signal = pool_signal_fn(
+        str(tmp_path / "metrics"),  # EMPTY: no instantaneous snapshots
+        history=HistoryReader(str(tmp_path / "ts"), clock=clk),
+        signal_window_s=100.0, clock=clk,
+    )
+    out = signal()
+    # queue mean 32 rows/flush and 12 sheds, read purely from disk
+    assert out["queue_rows"] == pytest.approx(32.0)
+    assert out["shed_rate"] == pytest.approx(12.0)
+
+
+# ======================================================================
+# acceptance e2e: serving slow_score fault -> store -> detector -> bundle
+
+
+def test_e2e_slow_score_detected_from_store_with_bundle(
+    tmp_path, monkeypatch
+):
+    import numpy as np
+
+    from dct_tpu.config import ServingConfig
+    from dct_tpu.resilience import faults
+    from dct_tpu.serving import loadgen
+    from dct_tpu.serving.server import make_server_from_weights
+
+    ledger = str(tmp_path / "lineage.jsonl")
+    pkg_id = _plant_ledger(ledger)
+    monkeypatch.setenv("DCT_METRICS_DIR", str(tmp_path / "metrics"))
+    monkeypatch.setenv("DCT_TS_DIR", str(tmp_path / "ts"))
+    monkeypatch.setenv("DCT_EVENTS_DIR", str(tmp_path / "events"))
+    monkeypatch.setenv("DCT_LINEAGE_DIR", str(tmp_path))
+    monkeypatch.setenv("DCT_INCIDENT_DIR", str(tmp_path / "incidents"))
+    monkeypatch.setenv("DCT_METRICS_PUBLISH_S", "0.1")
+    monkeypatch.setenv("DCT_TS_FLUSH_S", "0.15")
+    monkeypatch.setenv("DCT_ANOMALY_POLL_S", "0.1")
+    monkeypatch.setenv("DCT_ANOMALY_MIN_POINTS", "5")
+    monkeypatch.setenv("DCT_ANOMALY_WINDOW_S", "8")
+    monkeypatch.setenv("DCT_ANOMALY_Z", "3.5")
+    monkeypatch.setenv("DCT_INCIDENT", "1")
+    monkeypatch.setenv("DCT_INCIDENT_COOLDOWN_S", "300")
+    monkeypatch.setenv("DCT_SLO_SPEC", "")
+
+    weights, meta = loadgen.synthetic_mlp()
+    rng = np.random.default_rng(0)
+    body = json.dumps({
+        "data": rng.standard_normal((1, meta["input_dim"]))
+        .round(4).tolist()
+    }).encode()
+    detect_latency = None
+    bundle_manifest = None
+    faults.set_default(faults.FaultPlan.parse("slow_score:ms2"))
+    server = make_server_from_weights(weights, meta, serving=ServingConfig(
+        max_batch=1, workers=1, batch_window_ms=0.0,
+    ))
+    monitor = getattr(server, "history_monitor", None)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        assert monitor is not None, "history monitor failed to arm"
+        # warm the EWMA baseline under healthy load
+        loadgen.run_open_loop(
+            host, port, body, qps=40.0, duration_s=1.6, max_inflight=64
+        )
+        # plant the fault: scoring now 15x slower, queue depth ramps
+        faults.set_default(faults.FaultPlan.parse("slow_score:ms30"))
+        spike = threading.Thread(
+            target=loadgen.run_open_loop, args=(host, port, body),
+            kwargs={"qps": 80.0, "duration_s": 12.0, "max_inflight": 400},
+            daemon=True,
+        )
+        t_plant = time.perf_counter()
+        spike.start()
+        while time.perf_counter() - t_plant < 12.0:
+            if any(
+                a.get("signal") == "queue_depth"
+                for a in monitor.detector.active()
+            ):
+                detect_latency = time.perf_counter() - t_plant
+                break
+            time.sleep(0.02)
+        # the anomaly edge handed the record to the incident assembler
+        # (daemon thread): wait for the manifest, the completion marker
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            bundles = incident.list_bundles(str(tmp_path / "incidents"))
+            if bundles:
+                bundle_manifest = bundles[-1]
+                break
+            time.sleep(0.05)
+    finally:
+        faults.set_default(None)
+        server.shutdown()
+        server.server_close()
+        if monitor is not None:
+            monitor.close()
+
+    # detected FROM THE ON-DISK STORE within the configured window
+    assert detect_latency is not None, "queue_depth anomaly not detected"
+    assert detect_latency < 12.0
+    assert bundle_manifest is not None, "incident bundle not assembled"
+    assert bundle_manifest["kind"] == "anomaly"
+    assert bundle_manifest["signal"] == "queue_depth"
+    # the bundle names the active deploy_package lineage id
+    assert bundle_manifest["lineage_id"] == pkg_id
+    assert "timeseries.json" in bundle_manifest["files"]
+    assert "lineage.json" in bundle_manifest["files"]
+
+
+# ======================================================================
+# acceptance e2e: training loss spike through the live-metrics plumbing
+
+
+def test_e2e_training_loss_spike_detected_from_store(
+    tmp_path, monkeypatch
+):
+    """The trainer's real publishing chain (LiveTrainMetrics ->
+    SnapshotPublisher -> HistoryWriter) feeds the store at epoch
+    cadence; the detector flags the spike from DISK, not from any
+    in-process state it shares with the trainer."""
+    from dct_tpu.config import ObservabilityConfig
+    from dct_tpu.observability.dump import live_train_metrics
+
+    monkeypatch.setenv("DCT_TS_DIR", str(tmp_path / "ts"))
+    obs = ObservabilityConfig(
+        metrics_dir=str(tmp_path / "metrics"), metrics_publish_s=0.0
+    )
+    lm = live_train_metrics(obs, run_id="run-e2e", rank=0)
+    assert lm is not None
+    assert lm.publisher.history is not None, "store failed to arm"
+    det = detect.AnomalyDetector(
+        HistoryReader(str(tmp_path / "ts")),
+        watches=[_loss_watch(window_s=600.0)],
+        z=4.0, min_points=4,
+    )
+    try:
+        for i in range(8):
+            lm.epoch_end(
+                val_loss=0.5 + 0.002 * i, goodput_fraction=0.9,
+                step_seconds=0.1, grad_norm=1.0,
+            )
+            lm.publisher.history.flush()
+            det.poll()
+        assert det.active() == []
+        lm.epoch_end(val_loss=40.0)  # the spike epoch
+        lm.publisher.history.flush()
+        anomalies = det.poll()
+    finally:
+        lm.close()
+    assert [a["signal"] for a in anomalies] == ["val_loss"]
+    assert [a["metric"] for a in anomalies] == ["dct_train_val_loss"]
+
+
+# ======================================================================
+# acceptance: arming the plane cannot perturb training numerics
+
+
+def _tiny_fit(processed_dir, work, *, armed_ts_dir=None):
+    from dct_tpu.config import (
+        DataConfig, ObservabilityConfig, RunConfig, TrainConfig,
+    )
+    from dct_tpu.train.trainer import Trainer
+
+    obs = ObservabilityConfig(
+        events_dir=os.path.join(work, "events"),
+        heartbeat_dir=os.path.join(work, "heartbeats"),
+        metrics_dir=(
+            os.path.join(work, "metrics") if armed_ts_dir else ""
+        ),
+    )
+    cfg = RunConfig(
+        data=DataConfig(
+            processed_dir=processed_dir,
+            models_dir=os.path.join(work, "models"),
+        ),
+        train=TrainConfig(epochs=2, batch_size=8, bf16_compute=False),
+        obs=obs,
+    )
+    return Trainer(cfg).fit()
+
+
+def test_training_loss_bitwise_identical_armed_vs_off(
+    processed_dir, tmp_path, monkeypatch
+):
+    # keep the tracking client out of the repo cwd (its default root)
+    monkeypatch.setenv("DCT_TRACKING_DIR", str(tmp_path / "tracking"))
+    monkeypatch.delenv("DCT_TS_DIR", raising=False)
+    off = _tiny_fit(processed_dir, str(tmp_path / "off"))
+    monkeypatch.setenv("DCT_TS_DIR", str(tmp_path / "armed" / "ts"))
+    monkeypatch.setenv("DCT_ANOMALY", "1")
+    armed = _tiny_fit(
+        processed_dir, str(tmp_path / "armed"),
+        armed_ts_dir=str(tmp_path / "armed" / "ts"),
+    )
+    off_losses = [e.get("val_loss") for e in off.history]
+    armed_losses = [e.get("val_loss") for e in armed.history]
+    assert off_losses == armed_losses  # bitwise, not approx
+    assert off.val_loss == armed.val_loss
+    # and the armed run actually recorded history (the parity is not
+    # vacuous: the plane was on)
+    r = HistoryReader(str(tmp_path / "armed" / "ts"))
+    assert r.procs() != []
