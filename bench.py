@@ -2121,6 +2121,237 @@ def bench_cycle_freshness(tmp: str) -> dict:
     return out
 
 
+#: stream_ingest leg shape: a timed arrival process (bursts on a fixed
+#: schedule) driven through BOTH deployed watchers — the stream-mode
+#: watcher at its DCT_STREAM_POLL_S cadence vs the CSV polling watcher
+#: at the loop's DCT_LOOP_POLL_S default. Freshness is the product
+#: claim, so the sentinel is IN-BOUND throughput: events made trainable
+#: within the arrival→trainable bound, per second of wall.
+_STREAM_BENCH_EVENTS = 4000
+_STREAM_BENCH_BURST = 50
+_STREAM_BENCH_BURST_EVERY_S = 0.05
+#: The configured arrival→trainable bound (seconds). Deliberately under
+#: the CSV watcher's 2 s poll cadence: sub-cadence freshness is exactly
+#: what the streaming plane exists to buy (docs/STREAMING.md).
+_STREAM_BENCH_LAG_BOUND_S = 0.25
+#: The CSV comparator's cadence = the loop's production default
+#: (config.LoopConfig.poll_s); pinned here so a drifting loop default
+#: silently changing the bench comparator would show up in review.
+_STREAM_BENCH_CSV_POLL_S = 2.0
+
+
+def bench_stream_ingest(tmp: str) -> dict:
+    """Streaming ingest data plane (ISSUE 19): sustained events/s at
+    bounded arrival→trainable lag, stream mode vs the polling watcher.
+
+    The same timed arrival process (bursts of rows on a fixed schedule)
+    feeds both DEPLOYED watchers: the stream side produces each burst
+    onto the partitioned event log and :class:`StreamIngestWatcher`
+    runs the exactly-once offset-range ETL at its ``DCT_STREAM_POLL_S``
+    cadence; the CSV side appends each burst to the staging file and
+    ``IngestWatcher`` runs the PR 10 incremental re-digest at the
+    loop's default ``DCT_LOOP_POLL_S`` cadence. Per-event
+    arrival→trainable lag = (the pass that covered it completing) −
+    (its burst's arrival wall). The sentinels:
+
+    - ``stream_events_per_s`` (up) — events made trainable WITHIN the
+      configured bound, per second of wall. The CSV watcher's cadence
+      floors its lag near ``poll_s``, so most of its events miss a
+      sub-cadence bound — the acceptance bar is the stream side
+      sustaining >= 5x the poller's in-bound rate.
+    - ``stream_lag_p99_s`` (down) — the stream side's lag p99, which
+      must itself stay under the bound.
+
+    A backpressure sub-phase runs a producer with a tiny lag budget and
+    NO consumer: the shed counter must engage and end-of-phase lag must
+    stay at or under budget — the "never unbounded" acceptance bit."""
+    import threading
+
+    import numpy as np
+
+    from dct_tpu.config import StreamConfig
+    from dct_tpu.continuous.ingest import IngestWatcher, StreamIngestWatcher
+    from dct_tpu.etl.preprocess import DEFAULT_FEATURES
+    from dct_tpu.stream.log import PartitionedEventLog, StreamProducer
+
+    n_events = _STREAM_BENCH_EVENTS
+    burst, every = _STREAM_BENCH_BURST, _STREAM_BENCH_BURST_EVERY_S
+    bound = _STREAM_BENCH_LAG_BOUND_S
+    rng = np.random.default_rng(19)
+
+    def _rows(n: int) -> list[dict]:
+        vals = {
+            "Temperature": rng.uniform(-5, 40, n),
+            "Humidity": rng.uniform(10, 100, n),
+            "Wind_Speed": rng.uniform(0, 30, n),
+            "Cloud_Cover": rng.uniform(0, 100, n),
+            "Pressure": rng.uniform(980, 1040, n),
+        }
+        rain = rng.random(n) < 0.3
+        return [
+            {
+                **{k: round(float(vals[k][i]), 2) for k in DEFAULT_FEATURES},
+                "Rain": "rain" if rain[i] else "no rain",
+            }
+            for i in range(n)
+        ]
+
+    bursts = [_rows(burst) for _ in range(n_events // burst)]
+
+    def _drive(watcher, deliver, *, warm_rows: int = 0) -> dict:
+        """Run ``watcher`` (its deployed ``run`` thread) against the
+        timed arrival schedule; ``deliver(rows, ts)`` lands one burst.
+        A warm-up burst (outside the clock, the bench-wide idiom — cold
+        numpy/pyarrow import and the first full-basis publish are
+        one-time costs, not the sustained path) precedes the schedule
+        when ``warm_rows`` is 0. Returns per-event lags + in-bound
+        throughput."""
+        stop = threading.Event()
+        marks: list[tuple[float, int]] = []  # (trainable wall, rows)
+        check_once = watcher.check_once
+
+        def _instrumented():
+            state = check_once()
+            if state is not None:
+                marks.append((time.time(), int(state.get("rows") or 0)))
+            return state
+
+        watcher.check_once = _instrumented
+        thread = threading.Thread(
+            target=watcher.run, args=(stop,), daemon=True
+        )
+        thread.start()
+        if warm_rows == 0:
+            deliver(_rows(burst), time.time())
+            deadline = time.time() + 3.0 * max(
+                getattr(watcher, "poll_s", 1.0), 1.0
+            )
+            while time.time() < deadline and not marks:
+                time.sleep(0.02)
+            warm_rows = marks[-1][1] if marks else 0
+        t_start = time.time()
+        arrivals: list[float] = []
+        for rows in bursts:
+            t_arr = time.time()
+            deliver(rows, t_arr)
+            arrivals.extend([t_arr] * len(rows))
+            time.sleep(every)
+        # Drain: give the slower cadence two more fires to catch up.
+        deadline = time.time() + 2.5 * max(
+            getattr(watcher, "poll_s", 1.0), 1.0
+        )
+        target = warm_rows + n_events
+        while time.time() < deadline:
+            if marks and marks[-1][1] >= target:
+                break
+            time.sleep(0.05)
+        stop.set()
+        thread.join(timeout=10.0)
+        lags: list[float] = []
+        covered = 0
+        for t_mark, rows_total in marks:
+            done = min(rows_total - warm_rows, n_events)
+            for i in range(covered, max(covered, done)):
+                lags.append(t_mark - arrivals[i])
+            covered = max(covered, done)
+        wall = (marks[-1][0] - t_start) if marks else (time.time() - t_start)
+        in_bound = sum(1 for x in lags if x <= bound)
+        return {
+            "trainable": len(lags),
+            "in_bound": in_bound,
+            "in_bound_events_per_s": round(in_bound / max(wall, 1e-9), 1),
+            "lag_p99_s": (
+                round(float(np.percentile(lags, 99)), 4) if lags else None
+            ),
+            "wall_s": round(wall, 2),
+        }
+
+    # -- stream side: producer bursts + deployed stream watcher --------
+    sdir = os.path.join(tmp, "si_stream")
+    scfg = StreamConfig()
+    scfg.mode, scfg.dir, scfg.topic = "stream", sdir, "bench"
+    log = PartitionedEventLog(sdir, "bench", partitions=2)
+    prod = StreamProducer(
+        log, groups=(scfg.group,), backpressure="block",
+        lag_budget=max(n_events, 1), batch_records=burst,
+    )
+    s_watch = StreamIngestWatcher(
+        scfg, os.path.join(tmp, "si_stream_out"),
+        poll_s=scfg.poll_s, prefetch=True,
+    )
+
+    def _deliver_stream(rows: list[dict], ts: float) -> None:
+        for r in rows:
+            prod.produce(dict(r), ts=ts)
+        prod.flush()
+
+    stream = _drive(s_watch, _deliver_stream)
+    prod.close()
+    s_watch.close()
+
+    # -- CSV side: staged appends + deployed polling watcher -----------
+    csv = os.path.join(tmp, "si_poll.csv")
+    cols = DEFAULT_FEATURES + ["Rain"]
+    with open(csv, "w") as f:
+        f.write(",".join(cols) + "\n")
+    p_watch = IngestWatcher(
+        csv, os.path.join(tmp, "si_poll_out"),
+        poll_s=_STREAM_BENCH_CSV_POLL_S,
+    )
+
+    def _deliver_csv(rows: list[dict], ts: float) -> None:
+        with open(csv, "a") as f:
+            for r in rows:
+                f.write(",".join(str(r[c]) for c in cols) + "\n")
+
+    poll = _drive(p_watch, _deliver_csv)
+
+    # -- backpressure: tiny budget, dead consumer ----------------------
+    bp_log = PartitionedEventLog(os.path.join(tmp, "si_bp"), "bp",
+                                 partitions=1)
+    bp = StreamProducer(
+        bp_log, groups=("etl",), backpressure="shed",
+        lag_budget=64, batch_records=32,
+    )
+    for r in _rows(512):
+        bp.produce(r)
+    bp.flush()
+    bp_lag = bp.lag_records()
+    bp.close()
+
+    out: dict = {
+        "n_events": n_events,
+        "burst": burst,
+        "burst_every_s": every,
+        "lag_bound_s": bound,
+        "stream_poll_s": scfg.poll_s,
+        "csv_poll_s": _STREAM_BENCH_CSV_POLL_S,
+        "stream_events_per_s": stream["in_bound_events_per_s"],
+        "poll_events_per_s": poll["in_bound_events_per_s"],
+        "stream_lag_p99_s": stream["lag_p99_s"],
+        "poll_lag_p99_s": poll["lag_p99_s"],
+        "stream": stream,
+        "poll": poll,
+        "backpressure": {
+            "lag_budget": 64,
+            "produced": bp.produced,
+            "shed": bp.shed,
+            "end_lag_records": bp_lag,
+            "bounded": bp.shed > 0 and bp_lag <= 64,
+        },
+    }
+    if poll["in_bound_events_per_s"] > 0:
+        out["events_per_s_speedup"] = round(
+            stream["in_bound_events_per_s"] / poll["in_bound_events_per_s"],
+            2,
+        )
+    if out["stream_lag_p99_s"] is not None:
+        out["lag_bounded"] = out["stream_lag_p99_s"] <= bound
+    _leg("stream_events_per_s", out["stream_events_per_s"])
+    _leg("stream_lag_p99_s", out["stream_lag_p99_s"])
+    return out
+
+
 #: multi_tenant leg shape: two same-family always-on tenants at 1:2
 #: quota weights time-sharing the rig through round leases (ISSUE 12).
 #: Rounds are small so the deficit scheduler gets enough boundaries to
@@ -2651,6 +2882,24 @@ def _stdout_record(record: dict) -> dict:
             for k in ("detect_latency_s", "publish_overhead_ms")
             if k in th
         }
+    si = out.get("stream_ingest")
+    if isinstance(si, dict) and "error" not in si:
+        # Stdout carries the two sentinel series, the vs-polling
+        # speedup and the two acceptance bits; the polling comparator's
+        # raw numbers, the chunk shape and the backpressure counter
+        # detail stay in the partial (bounded is the story in one bit).
+        digest = {
+            k: si[k]
+            for k in (
+                "stream_events_per_s", "stream_lag_p99_s",
+                "events_per_s_speedup", "lag_bounded",
+            )
+            if k in si
+        }
+        bp = si.get("backpressure")
+        if isinstance(bp, dict):
+            digest["backpressure_bounded"] = bp.get("bounded")
+        out["stream_ingest"] = digest
     hd = out.get("host_dataplane")
     if isinstance(hd, dict) and "error" not in hd:
         # The native timings are derivable (numpy_ms / speedup) and
@@ -2800,6 +3049,10 @@ def _shrink_to_budget(out: dict) -> dict:
         # keeps exactly these two sentinel series).
         ("telemetry_history", ("detect_latency_s",
                                "publish_overhead_ms")),
+        # Stream ingest: reachability guard (the digest already keeps
+        # the sentinels + speedup + acceptance bits; the speedup and
+        # bits yield to the partial under squeeze, the series last).
+        ("stream_ingest", ("stream_events_per_s", "stream_lag_p99_s")),
         # Late probe squeeze: the fallback-reason prose yields before
         # the serving levels do (the partial keeps the full reason; a
         # cpu `platform` on the record already says a fallback
@@ -2826,6 +3079,14 @@ def _shrink_to_budget(out: dict) -> dict:
         # before the serving_load level columns do — the two elastic
         # sentinel series always survive tier 1.
         ("elastic_serving", ("overload_p99_s", "shed_fraction")),
+        # Late squeeze funding the stream_ingest sentinel series: the
+        # freshness goodput pair and the gpipe bubble comparator yield
+        # (verbatim in the partial — and bubble_reduction/goodput live
+        # on there) before the serving_load level columns do; both
+        # stanzas' sentinel series always survive tier 1.
+        ("cycle_freshness", ("freshness_speedup",
+                             "loop_mean_freshness_s")),
+        ("mpmd_pipeline", ("mpmd_steady_bubble", "mpmd_sps_ratio")),
         # The serving tier's headline stanza goes LAST in tier 1: its
         # per-level qps/p50/p99 columns outlive every other stanza's
         # detail (the acceptance contract wants >= 2 levels on the
@@ -2871,6 +3132,7 @@ def _shrink_to_budget(out: dict) -> dict:
         ("roofline", ("mfu",)),
         ("elastic_serving", ("overload_p99_s", "shed_fraction")),
         ("telemetry_history", ("detect_latency_s",)),
+        ("stream_ingest", ("stream_events_per_s", "stream_lag_p99_s")),
         ("moe", ("sorted_speedup",)),
         ("trainer_gap", ("fused_over_fit", "prefetch_spans")),
         ("scaled", ("step_time_ms", "attn_blockwise_ms",
@@ -3467,6 +3729,20 @@ def main():
             )
             _flush_partial(record)
 
+        # Streaming ingest data plane (ISSUE 19): sustained events/s +
+        # arrival→trainable lag p99 through the partitioned log and the
+        # exactly-once stream ETL, vs the polling watcher moving the
+        # same rows — plus the backpressure bounded-lag proof. Host-CPU
+        # leg; DCT_BENCH_STREAM=0 skips (the streaming smoke's knob).
+        skip_stream = os.environ.get(
+            "DCT_BENCH_STREAM", "1"
+        ).strip().lower() in ("0", "false", "no")
+        if not (skip_stream or _gate("stream_ingest", frac=0.97)):
+            record["stream_ingest"] = _optional(
+                "stream_ingest", bench_stream_ingest, tmp
+            )
+            _flush_partial(record)
+
         if not _gate("host_dataplane"):
             dataplane = _optional(
                 "host_dataplane", bench_host_dataplane
@@ -3488,7 +3764,8 @@ def main():
         "scaled", "moe", "val_parity", "serving", "serving_load",
         "elastic_serving", "restart_spinup", "cycle_freshness",
         "model_sharded", "multi_tenant", "mpmd_pipeline",
-        "telemetry_history", "host_dataplane", "roofline",
+        "telemetry_history", "stream_ingest", "host_dataplane",
+        "roofline",
     ):
         record.setdefault(skippable, None)
     _flush_partial(record)

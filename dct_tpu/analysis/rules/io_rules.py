@@ -186,6 +186,7 @@ _PUBLISH_LAYERS = (
     "dct_tpu/tracking/",
     "dct_tpu/evaluation/",
     "dct_tpu/observability/",
+    "dct_tpu/stream/",
 )
 
 #: Destination-bearing copy/move callees: (callee -> dest arg index).

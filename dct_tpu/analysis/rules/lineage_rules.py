@@ -27,6 +27,7 @@ _LINEAGE_LAYERS = (
     "dct_tpu/etl/",
     "dct_tpu/checkpoint/",
     "dct_tpu/deploy/",
+    "dct_tpu/stream/",
 )
 
 

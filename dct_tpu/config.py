@@ -976,6 +976,69 @@ class LoopConfig:
 
 
 @dataclass
+class StreamConfig:
+    """Streaming ingest data plane (dct_tpu.stream; docs/STREAMING.md):
+    per-tenant partitioned event logs, consumer-group offsets, and the
+    exactly-once stream ETL.
+
+    ``mode`` (``DCT_INGEST_MODE``) selects the continuous loop's ingest
+    source: ``poll`` keeps the CSV stat-polling watcher (the default,
+    reference-shaped path), ``stream`` consumes the partitioned event
+    log under ``dir``/``topic`` through consumer group ``group``.
+    Backpressure bounds consumer lag: when the slowest registered group
+    falls more than ``lag_budget`` records behind, producers ``block``
+    (up to ``block_timeout_s``, then shed) or ``shed`` outright —
+    unbounded lag is unexpressible.
+    """
+
+    mode: str = "poll"
+    dir: str = "data/stream"
+    topic: str = "events"
+    partitions: int = 1
+    segment_records: int = 4096
+    segment_bytes: int = 1 << 22
+    group: str = "etl"
+    backpressure: str = "block"
+    lag_budget: int = 50000
+    block_timeout_s: float = 30.0
+    # Records consumed per ETL pass (one pass = one parquet part).
+    max_batch: int = 8192
+    # Stream-watcher poll cadence. Deliberately MUCH tighter than the
+    # CSV watcher's DCT_LOOP_POLL_S: a no-change stream poll reads two
+    # sidecar JSONs (~µs), where the CSV path's change-processing
+    # re-hashes the whole staging file — the cheap pre-check is what
+    # buys sub-second arrival→trainable freshness.
+    poll_s: float = 0.1
+
+    @classmethod
+    def from_env(cls) -> "StreamConfig":
+        c = cls()
+        c.mode = _env("DCT_INGEST_MODE", c.mode, str).strip().lower()
+        c.dir = _env("DCT_STREAM_DIR", c.dir, str)
+        c.topic = _env("DCT_STREAM_TOPIC", c.topic, str)
+        c.partitions = max(
+            1, _env("DCT_STREAM_PARTITIONS", c.partitions, int)
+        )
+        c.segment_records = _env(
+            "DCT_STREAM_SEGMENT_RECORDS", c.segment_records, int
+        )
+        c.segment_bytes = _env(
+            "DCT_STREAM_SEGMENT_BYTES", c.segment_bytes, int
+        )
+        c.group = _env("DCT_STREAM_GROUP", c.group, str)
+        c.backpressure = _env(
+            "DCT_STREAM_BACKPRESSURE", c.backpressure, str
+        ).strip().lower()
+        c.lag_budget = _env("DCT_STREAM_LAG_BUDGET", c.lag_budget, int)
+        c.block_timeout_s = _env(
+            "DCT_STREAM_BLOCK_TIMEOUT_S", c.block_timeout_s, float
+        )
+        c.max_batch = _env("DCT_STREAM_MAX_BATCH", c.max_batch, int)
+        c.poll_s = _env("DCT_STREAM_POLL_S", c.poll_s, float)
+        return c
+
+
+@dataclass
 class SchedulerConfig:
     """Multi-tenant workload scheduler (dct_tpu.scheduler;
     docs/SCHEDULER.md): N always-on tenants sharing one pod with
@@ -1085,6 +1148,7 @@ class RunConfig:
     evaluation: EvaluationConfig = field(default_factory=EvaluationConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     loop: LoopConfig = field(default_factory=LoopConfig)
+    stream: StreamConfig = field(default_factory=StreamConfig)
     sched: SchedulerConfig = field(default_factory=SchedulerConfig)
     mpmd: MpmdConfig = field(default_factory=MpmdConfig)
 
@@ -1103,6 +1167,7 @@ class RunConfig:
             evaluation=EvaluationConfig.from_env(),
             serving=ServingConfig.from_env(),
             loop=LoopConfig.from_env(),
+            stream=StreamConfig.from_env(),
             sched=SchedulerConfig.from_env(),
             mpmd=MpmdConfig.from_env(),
         )
@@ -1219,6 +1284,20 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_LOOP_MAX_PROMOTIONS": "loop stop budget: promotions (0 = unbounded)",
     "DCT_LOOP_DAG_HOURS": "always-on DAG: one task occupancy before re-trigger",
     "DCT_LOOP_SMOKE_WAIT_S": "continuous-loop CI smoke: wall budget (s)",
+    # --- streaming ingest data plane (dct_tpu.stream; docs/STREAMING.md) -
+    "DCT_INGEST_MODE": "loop ingest source: poll (CSV stat-poll) | stream (event log)",
+    "DCT_STREAM_DIR": "partitioned event-log root (per tenant)",
+    "DCT_STREAM_TOPIC": "topic name under the stream root",
+    "DCT_STREAM_PARTITIONS": "partitions per topic (single-writer each)",
+    "DCT_STREAM_SEGMENT_RECORDS": "records per segment before the atomic seal",
+    "DCT_STREAM_SEGMENT_BYTES": "bytes per segment before the atomic seal",
+    "DCT_STREAM_GROUP": "consumer group the stream ETL commits under",
+    "DCT_STREAM_BACKPRESSURE": "over-budget producer action: block | shed | off",
+    "DCT_STREAM_LAG_BUDGET": "bounded-lag budget (records) before backpressure",
+    "DCT_STREAM_BLOCK_TIMEOUT_S": "blocked-producer wait before shedding (s)",
+    "DCT_STREAM_MAX_BATCH": "records per stream-ETL pass (one parquet part)",
+    "DCT_STREAM_POLL_S": "stream-watcher poll cadence (s; idle poll is two sidecar reads)",
+    "DCT_STREAM_SMOKE_WAIT_S": "streaming CI smoke: wall budget (s)",
     # --- multi-tenant scheduler (dct_tpu.scheduler; docs/SCHEDULER.md) -
     "DCT_TENANTS": "tenant roster: inline JSON or tenants.json path",
     "DCT_SCHED_ROOT": "per-tenant run-dir root (+ shared cache home)",
@@ -1398,6 +1477,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_BENCH_ROOFLINE": "bench roofline (local cost-model MFU) leg on/off",
     "DCT_BENCH_ELASTIC": "bench elastic_serving (overload controls A/B) leg on/off",
     "DCT_BENCH_TELEMETRY": "bench telemetry_history (detect latency + publish overhead) leg on/off",
+    "DCT_BENCH_STREAM": "bench stream_ingest (events/s + lag p99 vs polling) leg on/off",
     "DCT_BENCH_DEADLINE": "bench wall-clock deadline (s); legs self-gate",
     "DCT_BENCH_PARTIAL": "path for the partial-results stash",
     "DCT_VAL_PARITY_EPOCHS": "val-loss parity leg epoch budget",

@@ -1,16 +1,29 @@
-"""Ingest watcher: content-digest polling of the raw staging path.
+"""Ingest watchers: the continuous loop's data-arrival edge.
 
 The episodic DAG runs ETL once per trigger whether or not the data
-changed; the watcher inverts that — it polls the staging CSV on a
-cadence (cheap ``stat`` pre-check, so an idle loop costs two syscalls
-per poll) and hands any change to the incremental ETL
-(:func:`dct_tpu.etl.preprocess.preprocess_csv_to_parquet`), which
-digests the content and decides no-op / append-only delta / full
-rebuild. ETL therefore runs CONCURRENTLY with training: by the time the
-trainer's next round starts, the fresh generation is already published.
+changed; the watchers invert that. Two modes (``DCT_INGEST_MODE``):
+
+- :class:`IngestWatcher` (``poll``, the default): polls the staging
+  CSV on a cadence (cheap ``stat`` pre-check, so an idle loop costs
+  two syscalls per poll) and hands any change to the incremental ETL
+  (:func:`dct_tpu.etl.preprocess.preprocess_csv_to_parquet`), which
+  digests the content and decides no-op / append-only delta / full
+  rebuild.
+- :class:`StreamIngestWatcher` (``stream``): consumes the partitioned
+  event log (:mod:`dct_tpu.stream`) through a durable consumer group
+  and runs the exactly-once stream ETL
+  (:func:`dct_tpu.stream.stream_etl.stream_etl_pass`) — one pass per
+  committed offset range, with a background prefetcher staging the
+  next span off the log while the trainer dispatches.
+
+Either way ETL runs CONCURRENTLY with training: by the time the
+trainer's next round starts, the fresh generation is already
+published. Both watchers share one interface (``check_once`` /
+``run`` / ``processed`` / ``errors``), so the loop is mode-blind.
 
 Events (``ingest`` component, documented in docs/OBSERVABILITY.md):
-``ingest.detected`` when the stat pre-check sees a change,
+``ingest.detected`` when the pre-check sees a change (stream mode
+reports pending record/second lag instead of file size),
 ``ingest.processed`` when a generation was actually published (mode,
 rows, etl seconds), ``ingest.error`` when the ETL raised.
 """
@@ -116,3 +129,162 @@ class IngestWatcher:
         while not stop_event.is_set():
             self.check_once()
             stop_event.wait(self.poll_s)
+
+
+class StreamIngestWatcher:
+    """Consumes the partitioned event log and feeds the stream ETL.
+
+    Drop-in for :class:`IngestWatcher` on the loop side (``check_once``
+    / ``run`` / ``processed`` / ``errors``), but the change pre-check
+    is consumer-group lag instead of a file stat, and processing is the
+    exactly-once offset-range pass instead of a CSV re-digest. A
+    :class:`~dct_tpu.stream.prefetch.StreamPrefetcher` stages the next
+    span off the log in the background so the pass overlaps training
+    dispatch.
+
+    ``stream_cfg`` is a :class:`dct_tpu.config.StreamConfig` (duck-typed
+    in tests). When ``metrics_dir`` is set the watcher owns a registry +
+    :class:`~dct_tpu.observability.aggregate.SnapshotPublisher` so the
+    ``dct_stream_*`` series reach the metrics plane (and, via the
+    publisher's history hook, the telemetry store).
+    """
+
+    def __init__(
+        self,
+        stream_cfg,
+        processed_dir: str,
+        *,
+        poll_s: float = 2.0,
+        metrics_dir: str = "",
+        prefetch: bool = True,
+        emit=None,
+        clock=time.time,
+    ):
+        self.cfg = stream_cfg
+        self.processed_dir = processed_dir
+        self.poll_s = float(poll_s)
+        self.metrics_dir = metrics_dir
+        self._prefetch_enabled = bool(prefetch)
+        self._emit = emit or (lambda *a, **k: None)
+        self._clock = clock
+        self._log = None
+        self._consumer = None
+        self._prefetcher = None
+        self._publisher = None
+        self._retries = 0
+        self.processed = 0
+        self.errors = 0
+
+    def _ensure(self) -> bool:
+        """Lazily open the log + consumer. Returns False while the topic
+        does not exist yet (producer not started) — a cheap idle poll,
+        mirroring the CSV watcher's missing-file stat."""
+        if self._consumer is not None:
+            return True
+        if not os.path.isdir(os.path.join(self.cfg.dir, self.cfg.topic)):
+            return False
+        from dct_tpu.stream.consumer import ConsumerGroup
+        from dct_tpu.stream.log import PartitionedEventLog
+        from dct_tpu.stream.prefetch import StreamPrefetcher
+
+        registry = None
+        if self.metrics_dir:
+            from dct_tpu.observability.aggregate import SnapshotPublisher
+            from dct_tpu.observability.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()
+            self._publisher = SnapshotPublisher(
+                registry, self.metrics_dir,
+                proc=f"stream-{self.cfg.group}", clock=self._clock,
+            )
+        self._log = PartitionedEventLog(
+            self.cfg.dir, self.cfg.topic, readonly=True,
+            emit=self._emit, clock=self._clock,
+        )
+        self._consumer = ConsumerGroup(
+            self._log, self.cfg.group,
+            emit=self._emit, clock=self._clock, registry=registry,
+        )
+        if self._prefetch_enabled:
+            self._prefetcher = StreamPrefetcher(
+                self._log, self.cfg.group,
+                span_records=self.cfg.max_batch, clock=self._clock,
+            ).start()
+        return True
+
+    def check_once(self) -> dict | None:
+        """One poll: lag pre-check, then the exactly-once ETL pass on
+        any pending records. Returns the published etl_state when a
+        generation was processed, None otherwise."""
+        if not self._ensure():
+            return None
+        lag = self._consumer.lag()  # also refreshes the lag gauges
+        if lag["records"] <= 0:
+            if self._publisher is not None:
+                self._publisher.maybe_publish()
+            return None
+        self._emit(
+            "ingest", "ingest.detected",
+            source="stream", topic=self.cfg.topic, group=self.cfg.group,
+            lag_records=lag["records"], lag_seconds=round(lag["seconds"], 4),
+        )
+        from dct_tpu.stream.stream_etl import stream_etl_pass
+
+        records = None
+        if self._prefetcher is not None:
+            records = self._prefetcher.take(self.cfg.max_batch)
+        t0 = self._clock()
+        try:
+            state = stream_etl_pass(
+                self._consumer, self.processed_dir,
+                max_records=self.cfg.max_batch, records=records,
+                emit=self._emit, clock=self._clock,
+            )
+        except Exception as e:  # noqa: BLE001 — the loop must outlive one bad pass
+            self.errors += 1
+            # Unlike the CSV watcher there is nothing to park: the
+            # uncommitted range replays on the next poll, and exactly-
+            # once semantics make the retry free of duplicates.
+            self._retries += 1
+            self._emit(
+                "ingest", "ingest.error",
+                source="stream", retries=self._retries,
+                error=f"{type(e).__name__}: {e}"[:300],
+            )
+            return None
+        self._retries = 0
+        if self._publisher is not None:
+            self._publisher.maybe_publish()
+        if state is None:
+            return None
+        self.processed += 1
+        self._emit(
+            "ingest", "ingest.processed",
+            source="stream",
+            generation=state.get("generation"),
+            mode=state.get("mode"),
+            rows=state.get("rows"),
+            rows_delta=state.get("rows_delta"),
+            etl_s=round(self._clock() - t0, 4),
+            arrival_ts=state.get("arrival_ts"),
+        )
+        return state
+
+    def run(self, stop_event) -> None:
+        """Thread body: poll until ``stop_event`` is set. Under
+        sustained arrivals passes run BACK-TO-BACK (a processed pass
+        re-checks immediately — sleeping with a backlog pending would
+        add ``poll_s`` to every event's arrival→trainable lag); the
+        cadence wait only happens when the group is caught up."""
+        while not stop_event.is_set():
+            if self.check_once() is None:
+                stop_event.wait(self.poll_s)
+        self.close()
+
+    def close(self) -> None:
+        if self._prefetcher is not None:
+            self._prefetcher.stop()
+        if self._publisher is not None:
+            self._publisher.close(final=True)
+        if self._log is not None:
+            self._log.close()

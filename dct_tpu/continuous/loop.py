@@ -127,13 +127,23 @@ class AlwaysOnLoop:
             )
         self.client = client
         from dct_tpu.continuous.evaluator import PromotionEvaluator
-        from dct_tpu.continuous.ingest import IngestWatcher
-
-        self.ingest = IngestWatcher(
-            cfg.data.raw_csv, cfg.data.processed_dir,
-            poll_s=self.loop_cfg.poll_s,
-            emit=self.events.emit, clock=clock,
+        from dct_tpu.continuous.ingest import (
+            IngestWatcher, StreamIngestWatcher,
         )
+
+        if cfg.stream.mode == "stream":
+            self.ingest = StreamIngestWatcher(
+                cfg.stream, cfg.data.processed_dir,
+                poll_s=cfg.stream.poll_s,
+                metrics_dir=cfg.obs.metrics_dir,
+                emit=self.events.emit, clock=clock,
+            )
+        else:
+            self.ingest = IngestWatcher(
+                cfg.data.raw_csv, cfg.data.processed_dir,
+                poll_s=self.loop_cfg.poll_s,
+                emit=self.events.emit, clock=clock,
+            )
         self.evaluator = PromotionEvaluator(
             cfg.data.models_dir, self.loop_cfg.packages_dir,
             client=self.client, endpoint=self.loop_cfg.endpoint,
@@ -250,6 +260,14 @@ class AlwaysOnLoop:
                 "1" if self.cfg.train.shard_opt_state else "0"
             ),
             "DCT_SHARD_PARAMS": "1" if self.cfg.train.shard_params else "0",
+            # Stream-mode identity: the child trainer reads etl_state
+            # written by THIS loop's stream ETL, and its provenance
+            # stamp (stream_offsets → checkpoint meta) must name the
+            # same log + group the watcher commits against.
+            "DCT_INGEST_MODE": self.cfg.stream.mode,
+            "DCT_STREAM_DIR": self.cfg.stream.dir,
+            "DCT_STREAM_TOPIC": self.cfg.stream.topic,
+            "DCT_STREAM_GROUP": self.cfg.stream.group,
         }
         # Env-only knob: an operator's rule overrides ride along when
         # set (os.environ inheritance covers the CLI path; this covers
@@ -350,7 +368,13 @@ class AlwaysOnLoop:
             max_promotions=lc.max_promotions,
         )
         threads = []
-        if self.cfg.data.raw_csv and lc.poll_s > 0:
+        # Stream mode needs no raw_csv — the event log is the source;
+        # poll mode keeps the CSV requirement (nothing to watch without
+        # a staging file).
+        ingest_armed = lc.poll_s > 0 and (
+            self.cfg.stream.mode == "stream" or bool(self.cfg.data.raw_csv)
+        )
+        if ingest_armed:
             # Prime the snapshot BEFORE round 1: a cold start must not
             # race the first fit against an absent parquet.
             self.ingest.check_once()
@@ -367,6 +391,23 @@ class AlwaysOnLoop:
             )
             t.start()
             threads.append(t)
+        if ingest_armed and self.cfg.stream.mode == "stream":
+            # Stream cold start: the topic may not exist yet (the
+            # producer is its own process and can come up later), so
+            # unlike the CSV path there may be NOTHING to prime. Idle
+            # at the stream cadence until the first generation
+            # publishes instead of crashing round 1 on an absent
+            # parquet; the wall/stop budgets still bound the wait.
+            from dct_tpu.etl.preprocess import read_etl_state
+
+            while (
+                not self._stop.is_set()
+                and self._budget_exhausted(t0) is None
+                and not read_etl_state(
+                    self.cfg.data.processed_dir
+                ).get("generation")
+            ):
+                self._stop.wait(max(self.cfg.stream.poll_s, 0.05))
         error: str | None = None
         try:
             while not self._stop.is_set():

@@ -72,7 +72,9 @@ class Watch:
 def default_watches(*, window_s: float = 30.0) -> list[Watch]:
     """The ISSUE 17 signal set: step time, goodput, queue depth, shed
     rate, program MFU, grad norm — plus val-loss (the loss-spike
-    detector's fleet-visible twin)."""
+    detector's fleet-visible twin) and stream consumer lag (a stalled
+    or slow consumer shows up as a lag level shift long before the
+    freshness SLO budget burns)."""
     w = window_s
     return [
         Watch("step_time", "dct_train_step_seconds",
@@ -88,6 +90,8 @@ def default_watches(*, window_s: float = 30.0) -> list[Watch]:
         Watch("grad_norm", "dct_train_grad_norm",
               direction="high", window_s=w),
         Watch("val_loss", "dct_train_val_loss",
+              direction="high", window_s=w),
+        Watch("stream_lag", "dct_stream_lag_seconds",
               direction="high", window_s=w),
     ]
 

@@ -105,6 +105,15 @@ SERIES = (
      ("telemetry_history", "detect_latency_s"), "down"),
     ("history_publish_overhead_ms",
      ("telemetry_history", "publish_overhead_ms"), "down"),
+    # Streaming ingest (the stream_ingest bench leg): events made
+    # trainable WITHIN the configured arrival->trainable bound per
+    # second of wall through the deployed stream watcher (a >10% drop
+    # means the log/consumer/ETL path stopped keeping events fresh at
+    # rate), and the stream side's arrival->trainable lag p99 — gated
+    # like a latency (a >25% rise means the bounded-lag contract the
+    # plane exists for started slipping).
+    ("stream_events_per_s", ("stream_ingest", "stream_events_per_s"), "up"),
+    ("stream_lag_p99_s", ("stream_ingest", "stream_lag_p99_s"), "down"),
 )
 
 

@@ -22,6 +22,10 @@ optionally prefixed ``name=``):
 - ``freshness:<max_age_s>`` — seconds since the cycle's last successful
   deploy (``full_rollout`` / ``deploy_new_slot`` on the event log) must
   stay under the budget: the continuous-training promise, measured.
+  Stream-fed deployments (``DCT_INGEST_MODE=stream``) measure consumer
+  lag instead — seconds the trainer's group trails the producer
+  watermark, i.e. the arrival→trainable age of the oldest pending
+  event.
 
 Burn rate = (observed bad fraction) / (budgeted bad fraction); 1.0
 means spending the budget exactly at the rate that exhausts it at the
@@ -166,6 +170,33 @@ def last_deploy_ts(events_path: str | None) -> float | None:
     return latest
 
 
+def stream_freshness_age() -> float | None:
+    """Arrival→trainable age from stream consumer lag, or None when the
+    deployment is not stream-fed (``DCT_INGEST_MODE`` != ``stream``).
+
+    In stream mode "fresh" means the trainer's consumer group is keeping
+    up with the producer watermark: seconds-behind IS the age of the
+    oldest event not yet trainable, a strictly tighter signal than the
+    deploy-event mtime proxy (a promotion can be recent while the
+    consumer silently stalls). Falls back to the event-log source when
+    the topic has no data yet."""
+    if os.environ.get("DCT_INGEST_MODE", "poll") != "stream":
+        return None
+    stream_dir = os.environ.get("DCT_STREAM_DIR", "")
+    if not stream_dir:
+        return None
+    from dct_tpu.stream.consumer import group_lag_seconds
+
+    try:
+        return group_lag_seconds(
+            stream_dir,
+            os.environ.get("DCT_STREAM_TOPIC", "events"),
+            os.environ.get("DCT_STREAM_GROUP", "etl"),
+        )
+    except OSError:
+        return None
+
+
 # ----------------------------------------------------------------------
 # monitor
 
@@ -253,11 +284,17 @@ class SLOMonitor:
             worst = min(float(v) for v in m["totals"].values())
             burn = (1.0 - worst) / sp.budget
             return (now, worst, burn), False
-        # freshness
-        ts = last_deploy_ts(self.events_path)
-        if ts is None:
-            return None, False
-        age = max(0.0, now - ts)
+        # freshness — stream consumer lag when the deployment is
+        # stream-fed (arrival→trainable seconds), deploy-event age
+        # otherwise.
+        lag_s = stream_freshness_age()
+        if lag_s is not None:
+            age = max(0.0, lag_s)
+        else:
+            ts = last_deploy_ts(self.events_path)
+            if ts is None:
+                return None, False
+            age = max(0.0, now - ts)
         return (now, age, age / sp.threshold), False
 
     @staticmethod

@@ -273,6 +273,15 @@ class WorkloadScheduler:
         # Spec validation already rejects reserved keys, so the merge
         # order only decides base_env vs spec.env (tenant wins).
         rt.env = {**self._base_env, **spec.env, **assigned}
+        # Stream mode: the N workloads become N streams. Each tenant
+        # defaults to its own topic (named after the tenant) under its
+        # own root — setdefault, because a tenant may point at a shared
+        # log or an explicit topic and that must win.
+        if rt.env.get(
+            "DCT_INGEST_MODE", os.environ.get("DCT_INGEST_MODE", "poll")
+        ) == "stream":
+            rt.env.setdefault("DCT_STREAM_DIR", os.path.join(troot, "stream"))
+            rt.env.setdefault("DCT_STREAM_TOPIC", spec.name)
         with _env_overlay(rt.env):
             rt.cfg = RunConfig.from_env()
         rt.chips = max(1, int(rt.env.get("DCT_WORLD_SIZE") or

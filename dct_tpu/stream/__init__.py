@@ -1,0 +1,39 @@
+"""Streaming ingest data plane (ISSUE 19, ROADMAP item 1).
+
+Per-tenant append-only partitioned event logs, consumer groups with
+durable atomically-committed offsets, and an exactly-once streaming
+delta ETL that reuses the PR 10 frozen z-score basis machinery — the
+substrate that replaces stat-polling a staging CSV with consuming a
+partitioned log at event rates, while keeping the trainer's data
+contract (the Spark-style parquet snapshot + ``etl_state.json``)
+byte-for-byte unchanged.
+
+Modules:
+
+- :mod:`~dct_tpu.stream.log` — topics -> partitions -> CRC-framed
+  segment files; single-writer producer with batched appends, watermark
+  sidecars, tmp+``os.replace`` segment seals, crash-safe torn-tail
+  truncation on reopen, and lag-budget backpressure (block or shed).
+- :mod:`~dct_tpu.stream.consumer` — consumer groups: a resumable
+  iterator over the partition set, durable offset commits (the offset
+  vector rides into checkpoint meta exactly like ``data_generation``),
+  and per-group lag accounting in records and seconds behind the
+  producer watermark.
+- :mod:`~dct_tpu.stream.stream_etl` — one committed offset range ->
+  one idempotent offset-range-named parquet part under the frozen
+  basis; a crash between transform and commit replays without
+  duplicate rows.
+- :mod:`~dct_tpu.stream.prefetch` — background staging of the next
+  uncommitted span off the consumer, overlapping log reads and JSON
+  decode with the trainer's pipelined dispatch.
+
+Wiring lives where the consumers are: ``DCT_INGEST_MODE=stream`` flips
+the continuous loop's watcher (:mod:`dct_tpu.continuous.ingest`), the
+SLO freshness spec (:mod:`dct_tpu.observability.slo`) to consumer lag,
+and the scheduler's tenants to one stream per workload.
+"""
+
+from dct_tpu.stream.log import PartitionedEventLog, StreamProducer
+from dct_tpu.stream.consumer import ConsumerGroup
+
+__all__ = ["PartitionedEventLog", "StreamProducer", "ConsumerGroup"]

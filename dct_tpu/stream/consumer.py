@@ -1,0 +1,261 @@
+"""Consumer groups over the partitioned event log.
+
+A group is a durable offset vector (one next-offset per partition)
+committed atomically to ``<topic>/offsets/<group>.json``. ``poll()``
+is the resumable iterator: it reads from the in-memory position
+(seeded from the last commit), round-robin across partitions;
+``commit()`` makes a position durable together with arbitrary
+``meta`` — the stream ETL parks its whole ``etl_state`` payload there,
+which is what makes the commit the exactly-once transaction boundary
+(a crash after the parquet part but before the commit replays the
+same records; a crash after the commit heals the state file FROM the
+commit).
+
+Lag accounting, both units the freshness plane needs:
+
+- ``records``: producer end offsets minus the committed vector;
+- ``seconds``: producer watermark timestamp minus the committed
+  watermark timestamp (event time, so it measures how old the newest
+  TRAINABLE event is relative to the newest ARRIVED event).
+
+Each commit becomes an ``offset_commit`` lineage node with
+``consumed`` edges to the sealed segments the committed range covered
+(via the seal-time sidecar — no segment re-hash).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from dct_tpu.stream.log import (
+    TS_KEY,
+    PartitionedEventLog,
+    _atomic_json,
+    _read_json,
+)
+
+COMMIT_VERSION = 1
+
+
+def _commit_path(offsets_dir: str, group: str) -> str:
+    return os.path.join(offsets_dir, f"{group}.json")
+
+
+def read_commit(offsets_dir: str, group: str) -> dict:
+    """The group's last durable commit record ({} when none/torn)."""
+    rec = _read_json(_commit_path(offsets_dir, group))
+    if rec.get("version") != COMMIT_VERSION:
+        return {}
+    return rec
+
+
+def committed_offsets(
+    offsets_dir: str, group: str, n_partitions: int
+) -> list[int]:
+    """The committed next-offset vector, zero-padded to the partition
+    count (a group that never committed is at the log's beginning)."""
+    rec = read_commit(offsets_dir, group)
+    offsets = [int(o) for o in (rec.get("offsets") or [])]
+    while len(offsets) < n_partitions:
+        offsets.append(0)
+    return offsets[:n_partitions]
+
+
+class ConsumerGroup:
+    """One group's resumable cursor over a :class:`PartitionedEventLog`
+    (opened readonly by the caller — consumers never create or truncate
+    log files)."""
+
+    def __init__(
+        self,
+        log: PartitionedEventLog,
+        group: str = "etl",
+        *,
+        emit=None,
+        clock=time.time,
+        registry=None,
+    ):
+        self.log = log
+        self.group = group
+        self._emit = emit or (lambda *a, **k: None)
+        self._clock = clock
+        self.consumed = 0
+        self.commits = 0
+        self._consumed_c = self._commits_c = None
+        self._lag_rec_g = self._lag_sec_g = None
+        if registry is not None:
+            self._consumed_c = registry.counter(
+                "dct_stream_consumed_total",
+                "Records polled off the event log per consumer group.",
+            )
+            self._commits_c = registry.counter(
+                "dct_stream_commits_total",
+                "Durable offset commits per consumer group.",
+            )
+            self._lag_rec_g = registry.gauge(
+                "dct_stream_lag_records",
+                "Records behind the producer end offsets per group.",
+                agg="max",
+            )
+            self._lag_sec_g = registry.gauge(
+                "dct_stream_lag_seconds",
+                "Seconds the newest trainable event trails the newest "
+                "arrived event (event time) per group.", agg="max",
+            )
+        self.positions = committed_offsets(
+            log.offsets_dir, group, log.n_partitions
+        )
+
+    # -- cursor --------------------------------------------------------
+    def seek_committed(self) -> list[int]:
+        """Reset the in-memory cursor to the last durable commit (the
+        replay entry point after any failed pass)."""
+        self.positions = committed_offsets(
+            self.log.offsets_dir, self.group, self.log.n_partitions
+        )
+        return list(self.positions)
+
+    def poll(self, max_records: int = 1024) -> list[tuple[int, int, dict]]:
+        """Up to ``max_records`` (partition, offset, record) triples
+        from the current position, advancing it (in memory only —
+        nothing is durable until :meth:`commit`). Partition order is
+        fixed p0..pN so a replay from the same committed vector reads
+        the same prefix in the same order."""
+        out: list[tuple[int, int, dict]] = []
+        for k in range(self.log.n_partitions):
+            budget = max_records - len(out)
+            if budget <= 0:
+                break
+            got = self.log.read(k, self.positions[k], max_records=budget)
+            for off, rec in got:
+                out.append((k, off, rec))
+            if got:
+                self.positions[k] = got[-1][0] + 1
+        self.consumed += len(out)
+        if self._consumed_c is not None and out:
+            self._consumed_c.inc(len(out), labels={"group": self.group})
+        return out
+
+    # -- durability ----------------------------------------------------
+    def commit(
+        self,
+        offsets: list[int] | None = None,
+        *,
+        watermark_ts: float | None = None,
+        meta: dict | None = None,
+    ) -> dict:
+        """Atomically publish the offset vector (+ the committed
+        watermark timestamp and the caller's ``meta`` payload). Returns
+        the commit record, with its lineage node id under
+        ``lineage_node`` when the ledger is armed."""
+        offsets = list(self.positions if offsets is None else offsets)
+        os.makedirs(self.log.offsets_dir, exist_ok=True)
+        rec = {
+            "version": COMMIT_VERSION,
+            "group": self.group,
+            "offsets": offsets,
+            "watermark_ts": watermark_ts,
+            "committed_ts": round(self._clock(), 6),
+            "meta": meta or {},
+        }
+        rec["lineage_node"] = self._record_commit_lineage(rec)
+        _atomic_json(_commit_path(self.log.offsets_dir, self.group), rec)
+        self.positions = list(offsets)
+        self.commits += 1
+        if self._commits_c is not None:
+            self._commits_c.inc(labels={"group": self.group})
+        return rec
+
+    def _record_commit_lineage(self, rec: dict) -> str | None:
+        """offset_commit node (content-addressed from the group +
+        vector) with ``consumed`` edges to every sealed segment the
+        committed range covers."""
+        from dct_tpu.observability import lineage as _lineage
+
+        lin = _lineage.get_default()
+        if not lin.enabled:
+            return None
+        nid = lin.node(
+            "offset_commit",
+            content={"group": self.group, "offsets": rec["offsets"]},
+            attrs={
+                "group": self.group,
+                "offsets": rec["offsets"],
+                "watermark_ts": rec["watermark_ts"],
+            },
+        )
+        for k, part in enumerate(self.log.partitions):
+            for info in part.segment_lineage().values():
+                base = int(info.get("base") or 0)
+                if base < rec["offsets"][k] and info.get("nid"):
+                    lin.edge("consumed", nid, info["nid"])
+        return nid
+
+    # -- lag -----------------------------------------------------------
+    def lag(self) -> dict:
+        """{"records", "seconds"} behind the producer (event time).
+        ``seconds`` falls back to the log's OLDEST event timestamp for
+        a group that never committed — pending data is late data."""
+        ends = self.log.end_offsets(fresh=True)
+        committed = committed_offsets(
+            self.log.offsets_dir, self.group, self.log.n_partitions
+        )
+        records = max(0, sum(ends) - sum(committed))
+        seconds = 0.0
+        if records > 0:
+            wm = self.log.watermark()
+            newest = wm.get("ts")
+            rec = read_commit(self.log.offsets_dir, self.group)
+            floor = rec.get("watermark_ts")
+            if floor is None:
+                floor = wm.get("first_ts")
+            if isinstance(newest, (int, float)) and isinstance(
+                floor, (int, float)
+            ):
+                seconds = max(0.0, float(newest) - float(floor))
+        if self._lag_rec_g is not None:
+            self._lag_rec_g.set(records, labels={"group": self.group})
+        if self._lag_sec_g is not None:
+            self._lag_sec_g.set(seconds, labels={"group": self.group})
+        return {"records": records, "seconds": round(seconds, 6)}
+
+
+def group_lag_seconds(
+    stream_dir: str, topic: str, group: str
+) -> float | None:
+    """Event-time lag of ``group`` behind the producer watermark, from
+    the on-disk tree alone (no producer/consumer object needed) — the
+    SLO freshness plane's stream source. None when the topic has no
+    data yet (no evidence is not an alert)."""
+    topic_dir = os.path.join(stream_dir, topic)
+    if not os.path.isdir(topic_dir):
+        return None
+    log = PartitionedEventLog(stream_dir, topic, readonly=True)
+    wm = log.watermark()
+    newest = wm.get("ts")
+    if not isinstance(newest, (int, float)):
+        return None
+    ends = log.end_offsets(fresh=True)
+    committed = committed_offsets(
+        log.offsets_dir, group, log.n_partitions
+    )
+    if sum(ends) <= sum(committed):
+        return 0.0
+    rec = read_commit(log.offsets_dir, group)
+    floor = rec.get("watermark_ts")
+    if floor is None:
+        floor = wm.get("first_ts")
+    if not isinstance(floor, (int, float)):
+        return None
+    return max(0.0, float(newest) - float(floor))
+
+
+__all__ = [
+    "ConsumerGroup",
+    "read_commit",
+    "committed_offsets",
+    "group_lag_seconds",
+    "TS_KEY",
+]

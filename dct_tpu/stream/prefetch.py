@@ -1,0 +1,121 @@
+"""Host-side span prefetch off the consumer.
+
+The PR 5 trainer already double-buffers spans: an ``epoch-prefetch``
+executor assembles the NEXT span's stacked host arrays while the
+devices dispatch the current one. In stream mode the rows feeding that
+span come off the event log, and reading + CRC-checking + JSON-decoding
+them is host work that would otherwise serialize into the ETL pass.
+:class:`StreamPrefetcher` moves it off the critical path: a background
+thread tails the consumer group and stages the next uncommitted span
+in memory, so when the ingest watcher's next pass fires, the records
+are already decoded and the pass goes straight to transform + publish —
+the log read overlaps the trainer's pipelined dispatch instead of
+delaying the next generation.
+
+Exactly-once is untouched: staging is in-memory read-ahead only.
+Offsets advance durably ONLY via the ETL pass's commit; on any crash
+the staged span evaporates and the pass replays from the committed
+vector. ``take()`` hands a span to the pass only when it exactly
+continues the committed vector (a replay or external commit discards
+the stage and re-seeks).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dct_tpu.stream.consumer import ConsumerGroup, committed_offsets
+
+
+class StreamPrefetcher:
+    """Background staging of the next span of records for one group.
+
+    Owns a private :class:`ConsumerGroup` cursor over the same durable
+    group (commits are the ETL pass's job); ``take()`` is called from
+    the watcher thread, staging happens on the daemon thread.
+    """
+
+    def __init__(
+        self,
+        log,
+        group: str = "etl",
+        *,
+        span_records: int = 8192,
+        poll_s: float = 0.2,
+        clock=time.time,
+    ):
+        self.log = log
+        self.group = group
+        self.span_records = max(1, int(span_records))
+        self.poll_s = float(poll_s)
+        self._clock = clock
+        self._cursor = ConsumerGroup(log, group, clock=clock)
+        self._lock = threading.Lock()
+        self._staged: list[tuple[int, int, dict]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.staged_spans = 0
+        self.hits = 0
+        self.misses = 0
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "StreamPrefetcher":
+        self._thread = threading.Thread(
+            target=self._run, name="stream-prefetch", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # -- staging thread ------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._fill()
+            except Exception:  # noqa: BLE001 — read-ahead must never
+                pass  # kill the watcher; the pass falls back to poll()
+            self._stop.wait(self.poll_s)
+
+    def _fill(self) -> None:
+        with self._lock:
+            budget = self.span_records - len(self._staged)
+        if budget <= 0:
+            return
+        got = self._cursor.poll(budget)
+        if not got:
+            return
+        with self._lock:
+            self._staged.extend(got)
+            self.staged_spans += 1
+
+    # -- the watcher-side handoff --------------------------------------
+    def take(self, max_records: int) -> list[tuple[int, int, dict]] | None:
+        """The staged span prefix (up to ``max_records``) if it exactly
+        continues the group's committed vector; None on a miss (the
+        stage is discarded and the cursor re-seeked — the caller polls
+        directly)."""
+        committed = committed_offsets(
+            self.log.offsets_dir, self.group, self.log.n_partitions
+        )
+        with self._lock:
+            staged = self._staged
+            first: dict[int, int] = {}
+            for k, off, _rec in staged:
+                first[k] = min(first.get(k, off), off)
+            if not staged or any(first[k] != committed[k] for k in first):
+                # Stale stage (replay, or a commit this stager did not
+                # make): drop it and restart from the durable vector.
+                self._staged = []
+                self._cursor.seek_committed()
+                if staged:
+                    self.misses += 1
+                return None
+            span = staged[:max_records]
+            self._staged = staged[max_records:]
+        self.hits += 1
+        return span

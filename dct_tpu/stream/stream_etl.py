@@ -1,0 +1,388 @@
+"""Exactly-once streaming delta ETL under the frozen z-score basis.
+
+One pass = one transaction: poll a batch off the consumer group,
+transform it with the PR 10 machinery (frozen basis, Chan-merged
+cumulative moments, rebuild tolerance — reused unchanged from
+:mod:`dct_tpu.etl.preprocess`), publish ONE parquet part named by the
+batch's offset range, then commit the consumed offsets with the whole
+new ``etl_state`` payload riding in the commit's ``meta``. The commit
+is the only durability point that counts:
+
+- crash BETWEEN transform and commit: the part file exists but its
+  start offset is at/after the committed total — the next pass deletes
+  it as an orphan and replays the same records from the committed
+  vector (partition order is fixed, so the replay is the same rows);
+  zero duplicates by construction;
+- crash AFTER commit but before ``etl_state.json``: the next pass
+  heals the state file FROM the commit meta, so the trainer only ever
+  observes generation N once generation N's rows are both published
+  and committed.
+
+Part naming: ``part-stream-<start>-<end>.parquet`` over the FLATTENED
+offset total (sum across partitions) — monotone, so orphan detection
+is a name comparison. The trainer's loader globs ``*.parquet`` exactly
+as it does for the polling path's ``part-NNNNN`` files.
+
+When the merged full-distribution stats drift past
+``DCT_ETL_REBUILD_TOL`` (the same :func:`~dct_tpu.etl.preprocess
+._basis_stale` gate the CSV path uses), the pass re-reads the WHOLE
+log from offset zero and republishes the snapshot under a fresh basis
+with the same atomic directory swap the CSV rebuild uses.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import time
+
+from dct_tpu.etl.preprocess import (
+    DEFAULT_FEATURES,
+    ETL_STATE_VERSION,
+    _accum_from,
+    _basis_stale,
+    _chan_merge,
+    _moments_stats,
+    _publish_part,
+    _rebuild_tolerance,
+    _stats_from_accum,
+    _transform_columns,
+    _write_etl_state,
+    persist_stats_and_drift,
+    read_etl_state,
+    read_previous_stats,
+)
+from dct_tpu.stream.consumer import ConsumerGroup, read_commit
+from dct_tpu.stream.log import TS_KEY
+
+_PART_RE = re.compile(r"^part-stream-(\d{12})-(\d{12})\.parquet$")
+
+
+def _part_name(start: int, end: int) -> str:
+    return f"part-stream-{start:012d}-{end:012d}.parquet"
+
+
+def _records_table(records: list[dict], feature_cols: list[str],
+                   label_col: str):
+    """Arrow table from event records — feature columns coerced through
+    ``float()`` (correctly-rounded, same IEEE double the CSV parser
+    yields for the same decimal text) so a stream-fed snapshot is
+    bit-identical to a file-fed one over the same logical rows."""
+    import pyarrow as pa
+
+    cols: dict = {}
+    for name in feature_cols:
+        cols[name] = pa.array(
+            [float(r[name]) for r in records], type=pa.float64()
+        )
+    cols[label_col] = pa.array([str(r[label_col]) for r in records])
+    return pa.table(cols)
+
+
+def _remove_orphan_parts(
+    parquet_dir: str, committed_total: int, *, emit=None
+) -> int:
+    """Delete stream parts whose start offset is at/after the committed
+    total: output of a torn attempt that never reached its commit. The
+    replay re-publishes the same rows under a fresh range name."""
+    removed = 0
+    try:
+        names = os.listdir(parquet_dir)
+    except OSError:
+        return 0
+    for name in names:
+        m = _PART_RE.match(name)
+        if m and int(m.group(1)) >= committed_total:
+            try:
+                os.remove(os.path.join(parquet_dir, name))
+            except OSError:
+                continue
+            removed += 1
+            if emit is not None:
+                emit(
+                    "stream", "stream.replay",
+                    orphan_part=name, committed_total=committed_total,
+                )
+    return removed
+
+
+def _heal_state_from_commit(output_dir: str, commit: dict) -> dict:
+    """Re-derive ``etl_state.json`` from the last commit's meta when a
+    crash separated the two (commit wins — it is the transaction)."""
+    meta = commit.get("meta") or {}
+    state = read_etl_state(output_dir)
+    if (
+        meta.get("version") == ETL_STATE_VERSION
+        and int(meta.get("generation") or 0)
+        > int(state.get("generation") or 0)
+    ):
+        _write_etl_state(output_dir, meta)
+        return meta
+    return state
+
+
+def _read_all_records(consumer: ConsumerGroup,
+                      upto: list[int]) -> list[tuple[int, int, dict]]:
+    """Every record from offset zero up to the ``upto`` vector (the
+    full-rebuild read)."""
+    out: list[tuple[int, int, dict]] = []
+    log = consumer.log
+    for k in range(log.n_partitions):
+        off = 0
+        while off < upto[k]:
+            got = log.read(k, off, max_records=upto[k] - off)
+            if not got:
+                break
+            out.extend((k, o, r) for o, r in got)
+            off = got[-1][0] + 1
+    return out
+
+
+def _record_stream_lineage(
+    parquet_dir: str,
+    basis: dict,
+    prev_state: dict,
+    *,
+    generation: int,
+    mode: str,
+    rows: int,
+) -> str | None:
+    """The stream twin of the CSV path's ``_record_lineage``: snapshot
+    node + frozen-basis edges + generation chain. The consumed
+    offset-commit edge is added by the caller once the commit exists."""
+    from dct_tpu.observability import lineage as _lineage
+
+    lin = _lineage.get_default()
+    if not lin.enabled:
+        return None
+    basis_nid = lin.node(
+        "etl_basis", content=basis, attrs={"generation": generation},
+    )
+    snap_nid = lin.node(
+        "dataset_snapshot", path=parquet_dir,
+        attrs={"generation": generation, "mode": mode, "rows": rows},
+    )
+    lin.edge("consumed", snap_nid, basis_nid)
+    lin.edge("consumed", snap_nid, prev_state.get("lineage_node"))
+    return snap_nid
+
+
+def _link_commit(snap_nid: str | None, commit_nid: str | None) -> None:
+    from dct_tpu.observability import lineage as _lineage
+
+    _lineage.get_default().edge("produced", commit_nid, snap_nid)
+
+
+def _publish_snapshot_swap(
+    parquet_dir: str, part_name: str, out_cols: dict
+) -> None:
+    """Full-(re)build publish: stage the snapshot in a tmp build dir,
+    then swap — the CSV rebuild's two-rename pattern, so a concurrent
+    reader never observes a half-written directory."""
+    tmp_build = f"{parquet_dir}.build.{os.getpid()}"
+    if os.path.isdir(tmp_build):
+        shutil.rmtree(tmp_build)
+    os.makedirs(tmp_build)
+    _publish_part(tmp_build, part_name, out_cols)
+    # Spark-parity commit marker (jobs/preprocess.py writes _SUCCESS).
+    open(os.path.join(tmp_build, "_SUCCESS"), "w").close()
+    trash_dir = f"{parquet_dir}.old.{os.getpid()}"
+    if os.path.isdir(trash_dir):
+        shutil.rmtree(trash_dir)
+    if os.path.isdir(parquet_dir):
+        os.rename(parquet_dir, trash_dir)
+    os.rename(tmp_build, parquet_dir)
+    if os.path.isdir(trash_dir):
+        shutil.rmtree(trash_dir)
+
+
+def stream_etl_pass(
+    consumer: ConsumerGroup,
+    output_dir: str,
+    *,
+    feature_cols: list[str] | None = None,
+    label_col: str = "Rain",
+    positive_label: str = "rain",
+    max_records: int = 8192,
+    parquet_name: str = "data.parquet",
+    records: list[tuple[int, int, dict]] | None = None,
+    emit=None,
+    clock=time.time,
+) -> dict | None:
+    """One exactly-once pass; returns the published ``etl_state`` dict
+    when a generation landed, None when the log had nothing new.
+    ``records`` lets a prefetcher hand over an already-polled span
+    (its offsets must continue the committed vector — the prefetcher
+    guarantees this by construction)."""
+    feature_cols = feature_cols or DEFAULT_FEATURES
+    parquet_dir = os.path.join(output_dir, parquet_name)
+    os.makedirs(output_dir, exist_ok=True)
+
+    commit = read_commit(consumer.log.offsets_dir, consumer.group)
+    state = _heal_state_from_commit(output_dir, commit)
+    committed = consumer.seek_committed()
+    _remove_orphan_parts(parquet_dir, sum(committed), emit=emit)
+
+    if records is not None:
+        # A staged span is only usable if it CONTINUES the committed
+        # vector (a commit may have landed between staging and now —
+        # or the stager may have been seeded before a replay).
+        first: dict[int, int] = {}
+        for k, off, _rec in records:
+            first[k] = min(first.get(k, off), off)
+        if any(first[k] != committed[k] for k in first):
+            records = None
+    if records is None:
+        records = consumer.poll(max_records)
+    if not records:
+        return None
+    new_offsets = list(committed)
+    for k, off, _rec in records:
+        new_offsets[k] = max(new_offsets[k], off + 1)
+    start, end = sum(committed), sum(new_offsets)
+    rows = [r for _k, _off, r in records]
+    stamps = [
+        r[TS_KEY] for r in rows if isinstance(r.get(TS_KEY), (int, float))
+    ]
+    arrival_ts = max(stamps) if stamps else clock()
+
+    basis = state.get("norm_basis") or {}
+    prev_accum = state.get("accum") or {}
+    fresh_basis = (
+        set(basis) != set(feature_cols)
+        or set(prev_accum.get("features") or {}) != set(feature_cols)
+    )
+    table = _records_table(rows, feature_cols, label_col)
+
+    if fresh_basis:
+        # First pass (or schema change): reference full-run semantics —
+        # the basis IS this chunk's stats, snapshot swap-published.
+        out_cols, moments, basis, labels = _transform_columns(
+            table, feature_cols, label_col, positive_label
+        )
+        accum = _accum_from(moments, labels)
+        mode, parts = "stream_full", 1
+        rows_delta = int(len(labels))
+        prev_stats = read_previous_stats(output_dir)
+        _publish_snapshot_swap(
+            parquet_dir, _part_name(start, end), out_cols
+        )
+    else:
+        out_cols, delta_moments, _, delta_labels = _transform_columns(
+            table, feature_cols, label_col, positive_label, basis=basis
+        )
+        merged = {
+            name: _chan_merge(
+                prev_accum["features"][name], delta_moments[name]
+            )
+            for name in feature_cols
+        }
+        merged_stats = {n: _moments_stats(m) for n, m in merged.items()}
+        if _basis_stale(basis, merged_stats, _rebuild_tolerance()):
+            return _stream_full_rebuild(
+                consumer, output_dir, parquet_dir, state, new_offsets,
+                feature_cols, label_col, positive_label,
+                arrival_ts=arrival_ts, emit=emit, clock=clock,
+            )
+        accum = {
+            "features": merged,
+            "label_pos": int(prev_accum["label_pos"])
+            + int(delta_labels.sum()),
+            "rows": int(prev_accum["rows"]) + int(len(delta_labels)),
+        }
+        mode = "stream"
+        parts = int(state.get("parts") or 1) + 1
+        rows_delta = int(len(delta_labels))
+        prev_stats = read_previous_stats(output_dir)
+        # Ordering: part BEFORE stats/commit/state, so a reader that
+        # saw generation N can always load generation N's rows.
+        _publish_part(parquet_dir, _part_name(start, end), out_cols)
+
+    stats = _stats_from_accum(accum)
+    persist_stats_and_drift(output_dir, stats, prev_stats)
+    generation = int(state.get("generation") or 0) + 1
+    snap_nid = _record_stream_lineage(
+        parquet_dir, basis, state,
+        generation=generation, mode=mode, rows=stats["rows"],
+    )
+    new_state = {
+        "version": ETL_STATE_VERSION,
+        "generation": generation,
+        "mode": mode,
+        "arrival_ts": arrival_ts,
+        "parts": parts,
+        "rows": stats["rows"],
+        "rows_delta": rows_delta,
+        "norm_basis": basis,
+        "accum": accum,
+        "stream_offsets": new_offsets,
+        "lineage_node": snap_nid,
+    }
+    # THE durability point: part + stats are on disk, now the offsets
+    # (and the state payload) become the committed truth.
+    commit_rec = consumer.commit(
+        new_offsets, watermark_ts=arrival_ts, meta=new_state,
+    )
+    _link_commit(snap_nid, commit_rec.get("lineage_node"))
+    _write_etl_state(output_dir, new_state)
+    return new_state
+
+
+def _stream_full_rebuild(
+    consumer: ConsumerGroup,
+    output_dir: str,
+    parquet_dir: str,
+    state: dict,
+    upto: list[int],
+    feature_cols: list[str],
+    label_col: str,
+    positive_label: str,
+    *,
+    arrival_ts: float,
+    emit=None,
+    clock=time.time,
+) -> dict:
+    """Basis went stale: re-read the WHOLE log up to the polled vector
+    and republish the snapshot under a fresh basis (atomic swap)."""
+    all_records = _read_all_records(consumer, upto)
+    rows = [r for _k, _off, r in all_records]
+    table = _records_table(rows, feature_cols, label_col)
+    out_cols, moments, basis, labels = _transform_columns(
+        table, feature_cols, label_col, positive_label
+    )
+    accum = _accum_from(moments, labels)
+    stats = _stats_from_accum(accum)
+    prev_stats = read_previous_stats(output_dir)
+    _publish_snapshot_swap(parquet_dir, _part_name(0, sum(upto)), out_cols)
+    persist_stats_and_drift(output_dir, stats, prev_stats)
+    generation = int(state.get("generation") or 0) + 1
+    if emit is not None:
+        emit(
+            "stream", "stream.rebuild",
+            generation=generation, rows=stats["rows"],
+            reason="basis_stale",
+        )
+    snap_nid = _record_stream_lineage(
+        parquet_dir, basis, state,
+        generation=generation, mode="stream_full", rows=stats["rows"],
+    )
+    new_state = {
+        "version": ETL_STATE_VERSION,
+        "generation": generation,
+        "mode": "stream_full",
+        "arrival_ts": arrival_ts,
+        "parts": 1,
+        "rows": stats["rows"],
+        "rows_delta": int(len(labels)),
+        "norm_basis": basis,
+        "accum": accum,
+        "stream_offsets": list(upto),
+        "lineage_node": snap_nid,
+    }
+    commit_rec = consumer.commit(
+        list(upto), watermark_ts=arrival_ts, meta=new_state,
+    )
+    _link_commit(snap_nid, commit_rec.get("lineage_node"))
+    _write_etl_state(output_dir, new_state)
+    return new_state

@@ -321,6 +321,14 @@ class Trainer:
                         _etl_state.get("arrival_ts") or 0.0
                     ),
                 }
+                # Stream-fed generations carry the committed offset
+                # vector: the checkpoint names the exact log positions
+                # its rows came from, the same way ``data_generation``
+                # names the parquet snapshot.
+                if _etl_state.get("stream_offsets") is not None:
+                    _data_provenance["stream_offsets"] = [
+                        int(o) for o in _etl_state["stream_offsets"]
+                    ]
                 # The ETL stamped its snapshot's lineage node id into the
                 # state file — adopt it (no parquet re-hash) and put the
                 # provenance dict on the graph record. A pre-lineage

@@ -301,6 +301,93 @@ class TestAtomicPublish:
             tmp_path, {"dct_tpu/train/foo.py": BAD_PUBLISH}, "atomic-publish"
         )
 
+    def test_stream_layer_in_place_write_flagged(self, tmp_path):
+        # The stream plane's durability story IS the atomic publish
+        # (offset commits, watermark sidecars): an in-place write there
+        # is a torn-commit bug, not a style nit.
+        found = run_rule(
+            tmp_path, {"dct_tpu/stream/offsets.py": BAD_PUBLISH},
+            "atomic-publish",
+        )
+        assert len(found) == 1
+        assert "non-atomic publish" in found[0].message
+
+    def test_stream_layer_tmp_then_replace_clean(self, tmp_path):
+        assert not run_rule(
+            tmp_path, {"dct_tpu/stream/offsets.py": GOOD_PUBLISH},
+            "atomic-publish",
+        )
+
+
+# ----------------------------------------------------------------------
+# lineage-publish
+
+
+LINEAGE_BAD = """\
+import json, os
+
+def commit(d, obj):
+    tmp = os.path.join(d, "etl.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, os.path.join(d, "etl.json"))
+"""
+
+LINEAGE_GOOD = """\
+import json, os
+
+from dct_tpu.observability import lineage
+
+def commit(d, obj):
+    tmp = os.path.join(d, "etl.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    final = os.path.join(d, "etl.json")
+    os.replace(tmp, final)
+    lineage.get_default().node("offset_commit", path=final, attrs=obj)
+"""
+
+
+class TestLineagePublish:
+    def test_stream_publish_without_lineage_flagged(self, tmp_path):
+        found = run_rule(
+            tmp_path, {"dct_tpu/stream/offsets.py": LINEAGE_BAD},
+            "lineage-publish",
+        )
+        assert len(found) == 1
+        assert "never records lineage" in found[0].message
+
+    def test_stream_publish_recording_lineage_clean(self, tmp_path):
+        assert not run_rule(
+            tmp_path, {"dct_tpu/stream/offsets.py": LINEAGE_GOOD},
+            "lineage-publish",
+        )
+
+    def test_etl_layer_covered_too(self, tmp_path):
+        found = run_rule(
+            tmp_path, {"dct_tpu/etl/state.py": LINEAGE_BAD},
+            "lineage-publish",
+        )
+        assert len(found) == 1
+
+    def test_outside_lineage_layers_exempt(self, tmp_path):
+        # serving/ hot paths publish plenty of state files; the ledger
+        # records them from the orchestrating layers instead.
+        assert not run_rule(
+            tmp_path, {"dct_tpu/serving/pool.py": LINEAGE_BAD},
+            "lineage-publish",
+        )
+
+    def test_noqa_marks_deliberate_state_file(self, tmp_path):
+        src = LINEAGE_BAD.replace(
+            "    os.replace(tmp, os.path.join(d, \"etl.json\"))",
+            "    os.replace(tmp, os.path.join(d, \"etl.json\"))"
+            "  # dct: noqa[lineage-publish] -- scratch state, not an artifact",
+        )
+        assert not run_rule(
+            tmp_path, {"dct_tpu/stream/offsets.py": src}, "lineage-publish"
+        )
+
 
 # ----------------------------------------------------------------------
 # gather-on-publish

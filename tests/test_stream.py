@@ -1,0 +1,719 @@
+"""Streaming ingest data plane (ISSUE 19): partitioned event log
+round-trips and torn-tail crash recovery, consumer-group offsets and
+lag accounting, producer backpressure, the exactly-once stream ETL
+(crash between transform and commit replays with ZERO duplicate rows),
+bit-identical stream-fed vs file-fed snapshots, the freshness SLO
+watching consumer lag in both directions, and the lineage nodes the
+plane contributes (stream_segment / offset_commit / dataset_snapshot).
+"""
+
+import json
+import os
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from dct_tpu.stream.consumer import (
+    ConsumerGroup,
+    committed_offsets,
+    group_lag_seconds,
+    read_commit,
+)
+from dct_tpu.stream.log import (
+    TS_KEY,
+    PartitionedEventLog,
+    StreamProducer,
+)
+
+
+def _collector():
+    events = []
+
+    def emit(component, event, **fields):
+        events.append({"component": component, "event": event, **fields})
+
+    return events, emit
+
+
+def _rows(n, start=0):
+    """Deterministic weather-shaped records (2-decimal values so the
+    stream path's float() and the CSV parser bind the same doubles)."""
+    out = []
+    for i in range(start, start + n):
+        out.append({
+            "Temperature": round(-5 + (i * 7 % 45) + 0.25, 2),
+            "Humidity": round(10 + (i * 13 % 90) + 0.5, 2),
+            "Wind_Speed": round((i * 3 % 30) + 0.75, 2),
+            "Cloud_Cover": round((i * 11 % 100) + 0.1, 2),
+            "Pressure": round(980 + (i * 5 % 60) + 0.3, 2),
+            "Rain": "rain" if i % 3 == 0 else "no rain",
+        })
+    return out
+
+
+# ----------------------------------------------------------------------
+# Event log: append / read / seal / recovery
+
+
+def test_log_append_read_roundtrip_across_partitions(tmp_path):
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=2)
+    log.append(0, [{"a": 1}, {"a": 2}])
+    log.append(1, [{"b": 3}])
+    assert log.end_offsets() == [2, 1]
+    got = log.read(0, 0)
+    assert [(off, r["a"]) for off, r in got] == [(0, 1), (1, 2)]
+    assert log.read(0, 1)[0][1] == {"a": 2}
+    assert log.read(1, 0)[0][1] == {"b": 3}
+    log.close()
+
+
+def test_log_seals_at_segment_boundary_and_reads_span_segments(tmp_path):
+    events, emit = _collector()
+    log = PartitionedEventLog(
+        str(tmp_path), "t", partitions=1, segment_records=3, emit=emit
+    )
+    for lo in (0, 3, 6):
+        log.append(0, [{"i": i} for i in range(lo, min(lo + 3, 7))])
+    pdir = tmp_path / "t" / "p0"
+    sealed = sorted(p.name for p in pdir.glob("segment-*.log"))
+    # 7 records at 3/segment: two sealed segments + one active tail.
+    assert sealed == [
+        "segment-00000000000000000000.log",
+        "segment-00000000000000000003.log",
+    ]
+    assert (pdir / "segment-00000000000000000006.log.tmp").exists()
+    seals = [e for e in events if e["event"] == "stream.seal"]
+    assert [s["base_offset"] for s in seals] == [0, 3]
+    # A single read walks sealed + active segments in offset order.
+    got = log.read(0, 0, max_records=100)
+    assert [r["i"] for _off, r in got] == list(range(7))
+    log.close()
+
+
+def test_torn_tail_truncated_on_reopen_and_append_resumes(tmp_path):
+    events, emit = _collector()
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=1)
+    log.append(0, [{"i": i} for i in range(5)])
+    log.close()
+    active = tmp_path / "t" / "p0" / "segment-00000000000000000000.log.tmp"
+    # A killed producer leaves a torn frame: garbage after the last
+    # durable record.
+    with open(active, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\xde\xad\xbe\xefpartial")
+    reopened = PartitionedEventLog(
+        str(tmp_path), "t", partitions=1, emit=emit
+    )
+    trunc = [e for e in events if e["event"] == "stream.truncated"]
+    assert len(trunc) == 1 and trunc[0]["end_offset"] == 5
+    assert reopened.end_offsets() == [5]
+    # Appends resume at exactly the last durable offset.
+    start, end = reopened.append(0, [{"i": 5}])
+    assert (start, end) == (5, 6)
+    got = reopened.read(0, 0, max_records=100)
+    assert [r["i"] for _off, r in got] == list(range(6))
+    reopened.close()
+
+
+def test_readonly_reader_tolerates_torn_tail_without_truncating(tmp_path):
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=1)
+    log.append(0, [{"i": i} for i in range(3)])
+    log.close()
+    active = tmp_path / "t" / "p0" / "segment-00000000000000000000.log.tmp"
+    size_before = active.stat().st_size
+    with open(active, "ab") as f:
+        f.write(b"\x10\x00\x00\x00torn")
+    reader = PartitionedEventLog(str(tmp_path), "t", readonly=True)
+    assert [r["i"] for _off, r in reader.read(0, 0)] == [0, 1, 2]
+    # Readonly never repairs the file — that is the producer's job.
+    assert active.stat().st_size > size_before
+    reader.close()
+
+
+def test_watermark_sidecar_rederived_after_truncation(tmp_path):
+    clock = lambda: 100.0  # noqa: E731
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=1, clock=clock)
+    log.append(0, [{"i": 0, TS_KEY: 50.0}], ts=50.0)
+    log.append(0, [{"i": 1, TS_KEY: 60.0}], ts=60.0)
+    log.close()
+    pdir = tmp_path / "t" / "p0"
+    active = pdir / "segment-00000000000000000000.log.tmp"
+    # Chop the SECOND record's bytes mid-frame: the sidecar (end 2,
+    # ts 60) now outruns the durable tail (1 record, ts 50).
+    data = active.read_bytes()
+    active.write_bytes(data[: len(data) - 4])
+    reopened = PartitionedEventLog(
+        str(tmp_path), "t", partitions=1, clock=clock
+    )
+    wm = reopened.partitions[0].watermark()
+    assert wm["end_offset"] == 1
+    assert wm["ts"] == 50.0
+    reopened.close()
+
+
+# ----------------------------------------------------------------------
+# Consumer groups: offsets, resume, lag
+
+
+def test_consumer_commit_resume_and_fixed_poll_order(tmp_path):
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=2)
+    log.append(0, [{"i": i} for i in (0, 2, 4)])
+    log.append(1, [{"i": i} for i in (1, 3)])
+    reader = PartitionedEventLog(str(tmp_path), "t", readonly=True)
+    cg = ConsumerGroup(reader, "etl")
+    got = cg.poll(3)
+    # Partition order is fixed p0..pN: a replay reads the same prefix.
+    assert [(k, off) for k, off, _r in got] == [(0, 0), (0, 1), (0, 2)]
+    cg.commit(watermark_ts=1.0)
+    assert committed_offsets(reader.offsets_dir, "etl", 2) == [3, 0]
+    # A NEW group instance (fresh process) resumes at the commit.
+    cg2 = ConsumerGroup(reader, "etl")
+    got2 = cg2.poll(10)
+    assert [(k, off) for k, off, _r in got2] == [(1, 0), (1, 1)]
+    # Uncommitted progress is memory-only: a third instance replays it.
+    cg3 = ConsumerGroup(reader, "etl")
+    assert [(k, off) for k, off, _r in cg3.poll(10)] == [(1, 0), (1, 1)]
+    reader.close()
+    log.close()
+
+
+def test_consumer_lag_records_and_event_time_seconds(tmp_path):
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=1)
+    log.append(0, [{"i": 0, TS_KEY: 100.0}], ts=100.0)
+    log.append(0, [{"i": 1, TS_KEY: 107.5}], ts=107.5)
+    reader = PartitionedEventLog(str(tmp_path), "t", readonly=True)
+    cg = ConsumerGroup(reader, "etl")
+    lag = cg.lag()
+    # Never-committed group: seconds fall back to the OLDEST event
+    # timestamp — pending data is late data.
+    assert lag["records"] == 2
+    assert lag["seconds"] == pytest.approx(7.5)
+    cg.poll(1)
+    cg.commit(watermark_ts=100.0)
+    lag = cg.lag()
+    assert lag["records"] == 1
+    assert lag["seconds"] == pytest.approx(7.5)
+    cg.poll(1)
+    cg.commit(watermark_ts=107.5)
+    assert cg.lag() == {"records": 0, "seconds": 0.0}
+    reader.close()
+    log.close()
+
+
+def test_group_lag_seconds_standalone_on_disk(tmp_path):
+    # No topic yet: no evidence is not an alert.
+    assert group_lag_seconds(str(tmp_path), "t", "etl") is None
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=1)
+    log.append(0, [{"i": 0, TS_KEY: 10.0}], ts=10.0)
+    log.append(0, [{"i": 1, TS_KEY: 25.0}], ts=25.0)
+    log.close()
+    assert group_lag_seconds(str(tmp_path), "t", "etl") == pytest.approx(
+        15.0
+    )
+    reader = PartitionedEventLog(str(tmp_path), "t", readonly=True)
+    cg = ConsumerGroup(reader, "etl")
+    cg.poll(10)
+    cg.commit(watermark_ts=25.0)
+    reader.close()
+    assert group_lag_seconds(str(tmp_path), "t", "etl") == 0.0
+
+
+def test_consumer_metrics_flow_to_registry(tmp_path):
+    from dct_tpu.observability.metrics import MetricsRegistry
+
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=1)
+    registry = MetricsRegistry()
+    prod = StreamProducer(
+        log, groups=("etl",), backpressure="off", registry=registry
+    )
+    prod.produce({"i": 0})
+    prod.flush()
+    reader = PartitionedEventLog(str(tmp_path), "t", readonly=True)
+    cg = ConsumerGroup(reader, "etl", registry=registry)
+    cg.poll(10)
+    cg.commit(watermark_ts=1.0)
+    cg.lag()
+    text = registry.render()
+    for name in (
+        "dct_stream_produced_total",
+        "dct_stream_watermark_ts",
+        "dct_stream_consumed_total",
+        "dct_stream_commits_total",
+        "dct_stream_lag_records",
+        "dct_stream_lag_seconds",
+    ):
+        assert name in text, name
+    prod.close()
+    reader.close()
+
+
+# ----------------------------------------------------------------------
+# Producer backpressure: bounded lag, provably engaging
+
+
+def test_backpressure_shed_keeps_lag_at_budget(tmp_path):
+    events, emit = _collector()
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=1)
+    prod = StreamProducer(
+        log, groups=("etl",), backpressure="shed",
+        lag_budget=8, batch_records=4, emit=emit,
+    )
+    for r in _rows(32):
+        prod.produce(r)
+    prod.flush()
+    assert prod.produced == 8
+    assert prod.shed == 24
+    assert prod.lag_records() <= 8
+    sheds = [e for e in events if e["event"] == "stream.backpressure"]
+    assert sheds and all(e["action"] == "shed" for e in sheds)
+    prod.close()
+
+
+def test_backpressure_block_unblocks_when_consumer_catches_up(tmp_path):
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=1)
+    t = [0.0]
+
+    def catch_up(_s):
+        t[0] += 0.05
+        reader = PartitionedEventLog(str(tmp_path), "t", readonly=True)
+        cg = ConsumerGroup(reader, "etl")
+        cg.poll(100)
+        cg.commit(watermark_ts=t[0])
+        reader.close()
+
+    prod = StreamProducer(
+        log, groups=("etl",), backpressure="block",
+        lag_budget=4, block_timeout_s=5.0, batch_records=4,
+        clock=lambda: t[0], sleep=catch_up,
+    )
+    for r in _rows(8):
+        prod.produce(r)
+    prod.flush()
+    assert prod.produced == 8
+    assert prod.shed == 0
+    assert prod.blocks == 1
+    assert prod.blocked_s > 0
+    prod.close()
+
+
+def test_backpressure_block_timeout_sheds_against_dead_consumer(tmp_path):
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=1)
+    t = [0.0]
+
+    def tick(s):
+        t[0] += s
+
+    prod = StreamProducer(
+        log, groups=("etl",), backpressure="block",
+        lag_budget=4, block_timeout_s=1.0, batch_records=4,
+        clock=lambda: t[0], sleep=tick,
+    )
+    for r in _rows(8):
+        prod.produce(r)
+    prod.flush()
+    # First batch admitted; second blocked until timeout, then SHED —
+    # the lag bound survives a dead consumer.
+    assert prod.produced == 4
+    assert prod.shed == 4
+    assert prod.blocks == 1
+    assert prod.lag_records() == 4
+    prod.close()
+
+
+# ----------------------------------------------------------------------
+# Exactly-once stream ETL
+
+
+def _produce(tmp_path, records, *, topic="t", partitions=1, ts=None):
+    log = PartitionedEventLog(str(tmp_path), topic, partitions=partitions)
+    prod = StreamProducer(log, groups=("etl",), backpressure="off")
+    for r in records:
+        prod.produce(dict(r), ts=ts)
+    prod.close()
+
+
+def _consumer(tmp_path, topic="t"):
+    reader = PartitionedEventLog(str(tmp_path), topic, readonly=True)
+    return ConsumerGroup(reader, "etl")
+
+
+def _parquet_rows(processed_dir) -> int:
+    import pyarrow.parquet as pq
+
+    return pq.read_table(os.path.join(processed_dir, "data.parquet")).num_rows
+
+
+def test_stream_etl_first_pass_then_delta(tmp_path):
+    from dct_tpu.stream.stream_etl import stream_etl_pass
+
+    sdir, out = tmp_path / "stream", str(tmp_path / "out")
+    _produce(sdir, _rows(10))
+    cg = _consumer(sdir)
+    state = stream_etl_pass(cg, out)
+    assert state["generation"] == 1 and state["mode"] == "stream_full"
+    assert state["rows"] == 10 and state["stream_offsets"] == [10]
+    assert _parquet_rows(out) == 10
+    # Nothing new: no generation, no side effects.
+    assert stream_etl_pass(cg, out) is None
+    _produce(sdir, _rows(6, start=10))
+    state = stream_etl_pass(cg, out)
+    assert state["generation"] == 2 and state["mode"] == "stream"
+    assert state["rows"] == 16 and state["rows_delta"] == 6
+    assert _parquet_rows(out) == 16
+    # The commit carries the whole etl_state payload.
+    commit = read_commit(cg.log.offsets_dir, "etl")
+    assert commit["offsets"] == [16]
+    assert commit["meta"]["generation"] == 2
+    cg.log.close()
+
+
+def test_crash_between_transform_and_commit_replays_without_dupes(
+    tmp_path,
+):
+    """THE exactly-once acceptance: kill the pass after the parquet part
+    publishes but before the offset commit; the replay must delete the
+    orphan part and land the SAME rows exactly once (pinned row count).
+    """
+    from dct_tpu.stream.stream_etl import stream_etl_pass
+
+    events, emit = _collector()
+    sdir, out = tmp_path / "stream", str(tmp_path / "out")
+    _produce(sdir, _rows(40))
+    cg = _consumer(sdir)
+    assert stream_etl_pass(cg, out)["generation"] == 1
+    _produce(sdir, _rows(24, start=40))
+
+    real_commit = cg.commit
+
+    def boom(*a, **k):
+        raise OSError("killed between transform and commit")
+
+    cg.commit = boom
+    with pytest.raises(OSError):
+        stream_etl_pass(cg, out)
+    cg.commit = real_commit
+    # The torn attempt left its part behind, uncommitted.
+    parts = sorted(os.listdir(os.path.join(out, "data.parquet")))
+    assert "part-stream-000000000040-000000000064.parquet" in parts
+    assert committed_offsets(cg.log.offsets_dir, "etl", 1) == [40]
+
+    state = stream_etl_pass(cg, out, emit=emit)
+    assert state["generation"] == 2
+    assert state["rows"] == 64 and state["rows_delta"] == 24
+    # Zero duplicates: exactly 40 + 24 rows, not 40 + 24 + 24.
+    assert _parquet_rows(out) == 64
+    replays = [e for e in events if e["event"] == "stream.replay"]
+    assert len(replays) == 1
+    assert replays[0]["orphan_part"].startswith("part-stream-000000000040")
+    cg.log.close()
+
+
+def test_crash_after_commit_heals_state_from_commit_meta(tmp_path):
+    from dct_tpu.etl.preprocess import read_etl_state
+    from dct_tpu.stream.stream_etl import stream_etl_pass
+
+    sdir, out = tmp_path / "stream", str(tmp_path / "out")
+    _produce(sdir, _rows(12))
+    cg = _consumer(sdir)
+    state = stream_etl_pass(cg, out)
+    # Crash AFTER the commit but before etl_state.json: the commit is
+    # the transaction — the next pass heals the state file from it.
+    os.remove(os.path.join(out, "etl_state.json"))
+    assert stream_etl_pass(cg, out) is None  # nothing new to consume
+    healed = read_etl_state(out)
+    assert healed["generation"] == state["generation"] == 1
+    assert healed["stream_offsets"] == [12]
+    cg.log.close()
+
+
+def test_stream_fed_snapshot_bit_identical_to_file_fed(tmp_path):
+    """Acceptance: the SAME logical rows through the stream ETL and the
+    CSV ETL produce bit-identical training arrays and the same frozen
+    basis, across a full + delta generation each."""
+    import numpy as np
+
+    from dct_tpu.data import load_processed_dataset
+    from dct_tpu.etl.preprocess import (
+        preprocess_csv_to_parquet, read_etl_state,
+    )
+    from dct_tpu.stream.stream_etl import stream_etl_pass
+
+    cols = ["Temperature", "Humidity", "Wind_Speed", "Cloud_Cover",
+            "Pressure", "Rain"]
+    gen1, gen2 = _rows(30), _rows(18, start=30)
+
+    # File-fed: staging CSV through the PR 10 incremental path.
+    csv = tmp_path / "raw.csv"
+    out_csv = str(tmp_path / "out_csv")
+    with open(csv, "w") as f:
+        f.write(",".join(cols) + "\n")
+        for r in gen1:
+            f.write(",".join(str(r[c]) for c in cols) + "\n")
+    preprocess_csv_to_parquet(str(csv), out_csv, incremental=True)
+    with open(csv, "a") as f:
+        for r in gen2:
+            f.write(",".join(str(r[c]) for c in cols) + "\n")
+    preprocess_csv_to_parquet(str(csv), out_csv, incremental=True)
+
+    # Stream-fed: one partition so consumption order == arrival order.
+    sdir, out_stream = tmp_path / "stream", str(tmp_path / "out_stream")
+    _produce(sdir, gen1)
+    cg = _consumer(sdir)
+    stream_etl_pass(cg, out_stream)
+    _produce(sdir, gen2)
+    stream_etl_pass(cg, out_stream)
+    cg.log.close()
+
+    a = load_processed_dataset(out_csv)
+    b = load_processed_dataset(out_stream)
+    assert a.feature_names == b.feature_names
+    np.testing.assert_array_equal(a.features, b.features)
+    np.testing.assert_array_equal(a.labels, b.labels)
+    assert (
+        read_etl_state(out_csv)["norm_basis"]
+        == read_etl_state(out_stream)["norm_basis"]
+    )
+
+
+# ----------------------------------------------------------------------
+# Prefetcher handoff semantics
+
+
+def test_prefetcher_take_hits_then_discards_stale_stage(tmp_path):
+    from dct_tpu.stream.prefetch import StreamPrefetcher
+
+    sdir = tmp_path / "stream"
+    _produce(sdir, _rows(8))
+    reader = PartitionedEventLog(str(sdir), "t", readonly=True)
+    pf = StreamPrefetcher(reader, "etl", span_records=16)
+    pf._fill()  # deterministic: stage synchronously, no thread
+    span = pf.take(4)
+    assert span is not None and len(span) == 4
+    assert [off for _k, off, _r in span] == [0, 1, 2, 3]
+    assert pf.hits == 1
+    # An external commit moves the durable vector past the stage: the
+    # remaining staged records no longer continue it — miss, re-seek.
+    cg = ConsumerGroup(
+        PartitionedEventLog(str(sdir), "t", readonly=True), "etl"
+    )
+    cg.poll(6)
+    cg.commit(watermark_ts=1.0)
+    assert pf.take(4) is None
+    assert pf.misses == 1
+    pf._fill()
+    span = pf.take(8)
+    assert [off for _k, off, _r in span] == [6, 7]
+    cg.log.close()
+    reader.close()
+
+
+# ----------------------------------------------------------------------
+# The stream ingest watcher (the loop's data edge in stream mode)
+
+
+def _stream_cfg(tmp_path, **kw):
+    return SimpleNamespace(
+        mode="stream", dir=str(tmp_path / "stream"), topic="t",
+        group="etl", max_batch=8192, poll_s=0.05, **kw,
+    )
+
+
+def test_stream_watcher_idle_then_processes_and_emits(tmp_path):
+    from dct_tpu.continuous.ingest import StreamIngestWatcher
+
+    events, emit = _collector()
+    cfg = _stream_cfg(tmp_path)
+    out = str(tmp_path / "out")
+    watcher = StreamIngestWatcher(
+        cfg, out, poll_s=cfg.poll_s, prefetch=False, emit=emit,
+    )
+    # Topic absent: cheap idle poll, no error.
+    assert watcher.check_once() is None
+    _produce(tmp_path / "stream", _rows(12))
+    state = watcher.check_once()
+    assert state is not None and state["generation"] == 1
+    assert watcher.processed == 1 and watcher.errors == 0
+    names = [e["event"] for e in events]
+    assert "ingest.detected" in names and "ingest.processed" in names
+    detected = next(e for e in events if e["event"] == "ingest.detected")
+    assert detected["source"] == "stream"
+    assert detected["lag_records"] == 12
+    processed = next(e for e in events if e["event"] == "ingest.processed")
+    assert processed["source"] == "stream" and processed["rows"] == 12
+    # Caught up: back to idle polls.
+    assert watcher.check_once() is None
+    watcher.close()
+
+
+def test_stream_watcher_run_drains_backlog_back_to_back(tmp_path):
+    from dct_tpu.continuous.ingest import StreamIngestWatcher
+
+    cfg = _stream_cfg(tmp_path)
+    _produce(tmp_path / "stream", _rows(20))
+    watcher = StreamIngestWatcher(
+        cfg, str(tmp_path / "out"), poll_s=cfg.poll_s, prefetch=False,
+    )
+    # A small max_batch forces multiple passes over the backlog; run()
+    # must drain them back-to-back, not one per poll cadence.
+    watcher.cfg.max_batch = 5
+    stop = threading.Event()
+    orig = watcher.check_once
+
+    def until_drained():
+        state = orig()
+        if watcher.processed >= 4:
+            stop.set()
+        return state
+
+    watcher.check_once = until_drained
+    # daemon: a failed drain must fail THIS test, not hang the session.
+    thread = threading.Thread(target=watcher.run, args=(stop,), daemon=True)
+    thread.start()
+    thread.join(timeout=10.0)
+    assert not thread.is_alive()
+    assert watcher.processed == 4
+    from dct_tpu.etl.preprocess import read_etl_state
+
+    assert read_etl_state(str(tmp_path / "out"))["rows"] == 20
+
+
+# ----------------------------------------------------------------------
+# Freshness SLO over consumer lag — both directions
+
+
+def test_freshness_slo_stream_lag_alerts_then_resolves(
+    tmp_path, monkeypatch
+):
+    from dct_tpu.observability.slo import SLOMonitor, parse_slo_spec
+
+    monkeypatch.setenv("DCT_INGEST_MODE", "stream")
+    monkeypatch.setenv("DCT_STREAM_DIR", str(tmp_path))
+    monkeypatch.setenv("DCT_STREAM_TOPIC", "t")
+    monkeypatch.setenv("DCT_STREAM_GROUP", "etl")
+    log = PartitionedEventLog(str(tmp_path), "t", partitions=1)
+    log.append(0, [{"i": 0, TS_KEY: 100.0}], ts=100.0)
+    log.append(0, [{"i": 1, TS_KEY: 112.0}], ts=112.0)
+    log.close()
+
+    events, emit = _collector()
+    mon = SLOMonitor(
+        parse_slo_spec("freshness:5"), emit=emit, clock=lambda: 200.0,
+    )
+    # Stalled consumer: 12 s arrival→trainable lag burns a 5 s budget.
+    states = mon.evaluate(None)
+    assert states[0]["alerting"] is True
+    assert states[0]["burn_fast"] == pytest.approx(12.0 / 5.0)
+    alerts = [e for e in events if e["event"] == "slo.alert"]
+    assert len(alerts) == 1 and alerts[0]["kind"] == "freshness"
+    # Edge-triggered: still burning, no second alert event.
+    mon.evaluate(None)
+    assert len([e for e in events if e["event"] == "slo.alert"]) == 1
+
+    # A live stream-fed promotion catches the group up: resolved.
+    reader = PartitionedEventLog(str(tmp_path), "t", readonly=True)
+    cg = ConsumerGroup(reader, "etl")
+    cg.poll(10)
+    cg.commit(watermark_ts=112.0)
+    reader.close()
+    states = mon.evaluate(None)
+    assert states[0]["alerting"] is False
+    resolved = [e for e in events if e["event"] == "slo.resolved"]
+    assert len(resolved) == 1 and resolved[0]["slo"] == "freshness"
+
+
+def test_stream_freshness_age_gated_on_stream_mode(tmp_path, monkeypatch):
+    from dct_tpu.observability.slo import stream_freshness_age
+
+    monkeypatch.setenv("DCT_INGEST_MODE", "poll")
+    monkeypatch.setenv("DCT_STREAM_DIR", str(tmp_path))
+    assert stream_freshness_age() is None
+    monkeypatch.setenv("DCT_INGEST_MODE", "stream")
+    monkeypatch.setenv("DCT_STREAM_TOPIC", "t")
+    # Stream mode but no topic yet: None, so the monitor falls back to
+    # the deploy-event source instead of alerting on no evidence.
+    assert stream_freshness_age() is None
+
+
+# ----------------------------------------------------------------------
+# Lineage: segments, commits and snapshots join the provenance graph
+
+
+def test_stream_artifacts_become_lineage_nodes(tmp_path, monkeypatch):
+    from dct_tpu.observability import events as _events
+    from dct_tpu.observability import lineage
+    from dct_tpu.stream.stream_etl import stream_etl_pass
+
+    monkeypatch.setenv("DCT_EVENTS_DIR", str(tmp_path / "events"))
+    monkeypatch.delenv("DCT_LINEAGE_DIR", raising=False)
+    _events.set_default(None)
+    ledger_path = str(tmp_path / "events" / lineage.LEDGER_NAME)
+    lineage.set_default(
+        lineage.LineageLedger(ledger_path, run_id="dct-stream-test")
+    )
+    try:
+        sdir, out = tmp_path / "stream", str(tmp_path / "out")
+        log = PartitionedEventLog(
+            str(sdir), "t", partitions=1, segment_records=8
+        )
+        prod = StreamProducer(log, groups=("etl",), backpressure="off")
+        for r in _rows(8):  # exactly one sealed segment
+            prod.produce(r)
+        prod.close()
+        cg = _consumer(sdir)
+        state = stream_etl_pass(cg, out)
+        cg.log.close()
+
+        graph = lineage.build_graph(lineage.read_ledger(ledger_path))
+        kinds = {nid.split(":", 1)[0] for nid in graph["nodes"]}
+        assert {
+            "stream_segment", "offset_commit", "dataset_snapshot",
+            "etl_basis",
+        } <= kinds
+        commit_nid = read_commit(
+            os.path.join(str(sdir), "t", "offsets"), "etl"
+        )["lineage_node"]
+        snap_nid = state["lineage_node"]
+        edges = [
+            (e["edge"], e["src"], e["dst"]) for e in graph["edges"]
+        ]
+        # The commit PRODUCED the snapshot and CONSUMED the sealed
+        # segment it covered: served score → snapshot → commit →
+        # segment is walkable.
+        assert ("produced", commit_nid, snap_nid) in edges
+        seg_nid = next(
+            nid for nid in graph["nodes"]
+            if nid.startswith("stream_segment:")
+        )
+        assert ("consumed", commit_nid, seg_nid) in edges
+    finally:
+        lineage.set_default(None)
+        _events.set_default(None)
+
+
+# ----------------------------------------------------------------------
+# Commit record shape (the cross-process contract)
+
+
+def test_commit_record_is_versioned_and_atomic(tmp_path):
+    sdir = tmp_path / "stream"
+    _produce(sdir, _rows(4))
+    cg = _consumer(sdir)
+    cg.poll(10)
+    rec = cg.commit(watermark_ts=9.5, meta={"generation": 1})
+    path = os.path.join(cg.log.offsets_dir, "etl.json")
+    on_disk = json.loads(open(path).read())
+    assert on_disk["version"] == 1
+    assert on_disk["offsets"] == [4]
+    assert on_disk["watermark_ts"] == 9.5
+    assert on_disk["meta"] == {"generation": 1}
+    assert on_disk["group"] == "etl"
+    assert rec["offsets"] == [4]
+    # No tmp debris from the atomic publish.
+    debris = [n for n in os.listdir(cg.log.offsets_dir) if ".tmp" in n]
+    assert debris == []
+    # A torn/garbage commit file reads as "never committed".
+    with open(path, "w") as f:
+        f.write('{"version": 1, "offs')
+    assert read_commit(cg.log.offsets_dir, "etl") == {}
+    assert committed_offsets(cg.log.offsets_dir, "etl", 1) == [0]
+    cg.log.close()
