@@ -378,6 +378,281 @@ def bench_roofline() -> dict:
     return out
 
 
+def bench_low_precision(tmp: str) -> dict:
+    """Low-precision end-to-end (ISSUE 20): the int8/bf16 story as two
+    tracked A/Bs plus the gate safety net, every round.
+
+    - **Serving**: the int8 weight-quantized and bf16 numpy twins vs the
+      f32 twin at serving width — single-row p50, batch-64 throughput,
+      and the max-abs-prob delta. All three run through the micro-
+      batcher's ``rows_mm`` row-invariant hook; the int8 path's
+      integer-exact GEMM (runtime.QuantTensor) collapses the per-row
+      loop into ONE quantized GEMM while keeping bit-identical rows,
+      which is where the batched speedup comes from. The sentinel's
+      ``quant_serving_speedup`` series is the batch-64 throughput ratio.
+    - **Training**: one transformer train step, f32 vs
+      ``DCT_DTYPE_RULES='.*=bf16'`` (f32 master weights, bf16 compute)
+      at matched config — samples/s, cost-model bytes_accessed and MFU
+      per variant. Bytes come from the LOWERED program (the roofline
+      plane's pre-backend capture): the CPU rig's backend wraps every
+      bf16 dot in f32 converts (no native bf16 FMA), so the compiled
+      CPU cost model would charge bf16 MORE bytes — the lowered HLO is
+      the dtype-honest accounting and matches what a native-bf16 chip
+      executes. The sentinel's ``bf16_bytes_ratio`` series is
+      bf16/f32 bytes (down = better).
+    - **Gates**: a quantized challenger built from this run's own
+      trained checkpoint walks the PR-4 promotion gate against its f32
+      champion (clean -> promote), then again with a corrupted scale
+      column (-> blocked) — the accuracy safety net proven on every
+      record.
+    """
+    import numpy as np
+
+    from dct_tpu.serving.quant import quantize_weights
+    from dct_tpu.serving.runtime import (
+        assemble_weights, forward_numpy, rows_mm, softmax_numpy,
+    )
+
+    out: dict = {}
+    rng = np.random.default_rng(0)
+
+    # --- serving twins: f32 vs int8 vs bf16 at serving width ---------
+    # 1024-wide so the weight matrix (4 MB in f32) outruns L2: the f32
+    # rows_mm loop re-reads it per row while the int8 GEMM streams it
+    # once as int8 — the regime the quantized scorer is FOR. Fan-in
+    # scaling keeps logits in a realistic range (saturated random
+    # logits would understate the prob delta).
+    input_dim, hidden, classes = 256, 1024, 2
+    def _fan_in(n_in, n_out):
+        w = rng.standard_normal((n_in, n_out)) / np.sqrt(n_in)
+        return w.astype(np.float32)
+
+    weights = {
+        "w0": _fan_in(input_dim, hidden),
+        "b0": np.zeros(hidden, np.float32),
+        "w1": _fan_in(hidden, hidden),
+        "b1": np.zeros(hidden, np.float32),
+        "w2": _fan_in(hidden, classes),
+        "b2": np.zeros(classes, np.float32),
+    }
+    meta = {"model": "weather_mlp", "input_dim": input_dim}
+    variants = {"f32": weights}
+    for dt in ("int8", "bf16"):
+        flat, _qmeta = quantize_weights(weights, meta, dt)
+        variants[dt] = assemble_weights(flat)
+
+    x1 = rng.standard_normal((1, input_dim)).astype(np.float32)
+    x64 = rng.standard_normal((64, input_dim)).astype(np.float32)
+    ref64 = softmax_numpy(forward_numpy(weights, meta, x64, mm=rows_mm))
+    serving: dict = {}
+    for name, w in variants.items():
+        for _ in range(5):  # warmup
+            forward_numpy(w, meta, x64, mm=rows_mm)
+        p50 = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            forward_numpy(w, meta, x1, mm=rows_mm)
+            p50.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        reps = 30
+        for _ in range(reps):
+            probs = softmax_numpy(forward_numpy(w, meta, x64, mm=rows_mm))
+        dt_batch = (time.perf_counter() - t0) / reps
+        serving[name] = {
+            "p50_ms": round(float(np.median(p50)) * 1e3, 4),
+            "batch64_rows_per_s": round(64 / dt_batch, 1),
+            "max_abs_prob_delta": round(
+                float(np.abs(probs - ref64).max()), 6
+            ),
+        }
+    f32_rps = serving["f32"]["batch64_rows_per_s"]
+    for name in ("int8", "bf16"):
+        serving[name]["speedup_batch64"] = round(
+            serving[name]["batch64_rows_per_s"] / f32_rps, 2
+        )
+    out["serving"] = serving
+    out["quant_serving_speedup"] = serving["int8"]["speedup_batch64"]
+    _leg("quant_serving_speedup", out["quant_serving_speedup"])
+
+    # --- training A/B: f32 vs bf16 dtype rules at matched config -----
+    out["train"] = _lowprec_train_ab()
+    if out["train"].get("bf16_bytes_ratio") is not None:
+        out["bf16_bytes_ratio"] = out["train"]["bf16_bytes_ratio"]
+        _leg("bf16_bytes_ratio", out["bf16_bytes_ratio"])
+
+    # --- gate parity: quantized challenger through the PR-4 gate -----
+    try:
+        out["gate"] = _lowprec_gate_parity(tmp)
+    except Exception as e:  # noqa: BLE001 — the A/Bs above must land
+        print(
+            f"[bench] low_precision gate leg FAILED "
+            f"({type(e).__name__}: {e})",
+            file=sys.stderr, flush=True,
+        )
+        out["gate"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return out
+
+
+def _lowprec_train_ab() -> dict:
+    """One transformer train step, f32 vs bf16 dtype rules, matched
+    config: samples/s + lowered-cost-model bytes/flops/MFU per variant.
+    FFN-dominated shape (d_ff=8*d_model, short seq): the attention
+    softmax stays f32 by the numerics contract (ops/attention.py
+    computes scores with preferred_element_type=f32), so an
+    attention-dominated shape would understate the rules' effect."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dct_tpu.config import ModelConfig
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.observability import roofline as _rf
+    from dct_tpu.train.state import create_train_state
+    from dct_tpu.train.steps import make_train_step
+
+    shape = dict(d_model=128, n_heads=4, n_layers=2, d_ff=1024, seq_len=64)
+    batch, input_dim = 64, 5
+    xrng = np.random.default_rng(0)
+    x = jnp.asarray(xrng.standard_normal(
+        (batch, shape["seq_len"], input_dim)
+    ).astype(np.float32))
+    y = jnp.asarray(xrng.integers(0, 2, (batch,)), jnp.int32)
+    w = jnp.ones((batch,), jnp.float32)
+    peak, peak_source = _rf.resolve_peak_flops()
+
+    def run_variant(rules: str | None) -> dict:
+        saved = os.environ.get("DCT_DTYPE_RULES")
+        try:
+            if rules is None:
+                os.environ.pop("DCT_DTYPE_RULES", None)
+            else:
+                os.environ["DCT_DTYPE_RULES"] = rules
+            cfg = ModelConfig(name="weather_transformer", **shape)
+            model = get_model(
+                cfg, input_dim=input_dim,
+                compute_dtype=jnp.bfloat16 if rules else jnp.float32,
+            )
+            state = create_train_state(
+                model, input_dim=input_dim, lr=1e-3, seed=0,
+                example_shape=(1, shape["seq_len"], input_dim),
+            )
+            step = make_train_step(donate=False)
+            # The rules are read at TRACE time (steps.py casts inside
+            # the jitted body), so lower() must happen inside the env
+            # window.
+            lowered = step.lower(state, x, y, w)
+            cost = _rf.analyze_lowered(lowered) or {}
+            compiled = lowered.compile()
+        finally:
+            if saved is None:
+                os.environ.pop("DCT_DTYPE_RULES", None)
+            else:
+                os.environ["DCT_DTYPE_RULES"] = saved
+        st, metrics = compiled(state, x, y, w)
+        jax.block_until_ready(metrics["train_loss"])
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            st, metrics = compiled(st, x, y, w)
+            jax.block_until_ready(metrics["train_loss"])
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        # Master-weight contract, asserted where it is measured: the
+        # bf16 variant's params and optimizer state stay dense f32.
+        pd = {str(l.dtype) for l in jax.tree.leaves(st.params)}
+        if pd != {"float32"}:
+            raise RuntimeError(f"master weights leaked off f32: {pd}")
+        flops = cost.get("flops")
+        res = {
+            "samples_per_s": round(batch / best, 1),
+            "bytes_accessed": cost.get("bytes_accessed"),
+            "flops": flops,
+        }
+        if flops and peak and best:
+            res["mfu"] = round(flops / best / peak, 6)
+        return res
+
+    f32 = run_variant(None)
+    bf16 = run_variant(".*=bf16")
+    out = {
+        "config": {**shape, "batch": batch},
+        "peak_source": peak_source,
+        "f32": f32,
+        "bf16_rules": bf16,
+    }
+    if f32.get("bytes_accessed") and bf16.get("bytes_accessed"):
+        out["bf16_bytes_ratio"] = round(
+            bf16["bytes_accessed"] / f32["bytes_accessed"], 3
+        )
+        out["bytes_reduction_pct"] = round(
+            100 * (1 - out["bf16_bytes_ratio"]), 1
+        )
+    if f32.get("samples_per_s") and bf16.get("samples_per_s"):
+        out["bf16_sps_ratio"] = round(
+            bf16["samples_per_s"] / f32["samples_per_s"], 2
+        )
+    if f32.get("mfu") and bf16.get("mfu"):
+        out["bf16_mfu_delta"] = round(bf16["mfu"] - f32["mfu"], 6)
+    return out
+
+
+def _lowprec_gate_parity(tmp: str) -> dict:
+    """The quantized challenger through the real promotion gate, twice:
+    clean (must promote) and with one scale column corrupted (must be
+    blocked). Uses this bench run's own trained checkpoint and
+    processed split — the exact artifacts a production rollout would
+    gate. The gate's regression tolerance is widened to the documented
+    quant prob bound (SERVING.md: a quantized challenger trades <=
+    prob_bound of per-example accuracy for the speedup; the gate's job
+    here is catching BROKEN quantization, not the documented rounding)."""
+    import numpy as np
+
+    from dct_tpu.config import EvaluationConfig
+    from dct_tpu.evaluation.gates import PromotionGate
+    from dct_tpu.serving.quant import prob_bound, quantize_package
+    from dct_tpu.serving.score_gen import generate_score_package
+
+    ckpts = sorted(
+        f for f in os.listdir(os.path.join(tmp, "bench_models"))
+        if f.endswith(".ckpt")
+    )
+    champ = os.path.join(tmp, "lowprec_champion")
+    chall = os.path.join(tmp, "lowprec_challenger")
+    generate_score_package(
+        os.path.join(tmp, "bench_models", ckpts[0]), champ
+    )
+    quantize_package(champ, chall, dtype="int8")
+
+    cfg = EvaluationConfig.from_env()
+    cfg.max_regression = max(cfg.max_regression, prob_bound())
+    gate = PromotionGate(cfg, processed_dir=os.path.join(tmp, "processed"))
+    clean = gate.evaluate(
+        challenger_dir=chall, champion_dir=champ, stage="shadow"
+    )
+
+    # Corrupt ONE int8 scale column (x64): the challenger now scores
+    # garbage on that output channel — the gate must block it.
+    npz_path = os.path.join(chall, "model.npz")
+    with np.load(npz_path) as z:
+        flat = {k: z[k] for k in z.files}
+    scale_key = next(k for k in sorted(flat) if k.endswith("::scale"))
+    flat[scale_key] = flat[scale_key] * np.float32(64.0)
+    np.savez(npz_path, **flat)
+    # Bust the package-cached eval evidence: the corrupted npz must be
+    # re-scored, not read from the clean run's cache.
+    cache = os.path.join(chall, "eval_report.json")
+    if os.path.exists(cache):
+        os.remove(cache)
+    corrupted = gate.evaluate(
+        challenger_dir=chall, champion_dir=champ, stage="shadow"
+    )
+    return {
+        "clean": clean.decision,
+        "corrupted": corrupted.decision,
+        "parity": bool(clean.promoted and not corrupted.promoted),
+    }
+
+
 def bench_scaled_transformer() -> dict:
     """MXU-relevant transformer: step time, MFU, flash vs blockwise.
 
@@ -2900,6 +3175,28 @@ def _stdout_record(record: dict) -> dict:
         if isinstance(bp, dict):
             digest["backpressure_bounded"] = bp.get("bounded")
         out["stream_ingest"] = digest
+    lp = out.get("low_precision")
+    if isinstance(lp, dict) and "error" not in lp:
+        # Stdout carries the two sentinel series + the accuracy bound
+        # evidence + the gate parity bit ONLY — the train A/B ratios
+        # are derivable (reduction_pct = 100 x (1 - bytes_ratio)) or
+        # verbatim in the partial (sps ratio), and the per-variant
+        # p50/throughput/bytes detail and the size config stay there
+        # too (the line has no typical-round headroom left for more).
+        digest = {
+            k: lp[k]
+            for k in ("quant_serving_speedup", "bf16_bytes_ratio")
+            if k in lp
+        }
+        sv = lp.get("serving")
+        if isinstance(sv, dict) and isinstance(sv.get("int8"), dict):
+            digest["int8_prob_delta"] = sv["int8"].get(
+                "max_abs_prob_delta"
+            )
+        gt = lp.get("gate")
+        if isinstance(gt, dict) and "error" not in gt:
+            digest["gate_parity"] = gt.get("parity")
+        out["low_precision"] = digest
     hd = out.get("host_dataplane")
     if isinstance(hd, dict) and "error" not in hd:
         # The native timings are derivable (numpy_ms / speedup) and
@@ -3053,6 +3350,12 @@ def _shrink_to_budget(out: dict) -> dict:
         # the sentinels + speedup + acceptance bits; the speedup and
         # bits yield to the partial under squeeze, the series last).
         ("stream_ingest", ("stream_events_per_s", "stream_lag_p99_s")),
+        # Low precision: reachability guard (the digest already keeps
+        # exactly these four — both sentinel series, the accuracy
+        # bound and the gate bit; the train A/B ratios never ride
+        # stdout, they are derivable/verbatim in the partial).
+        ("low_precision", ("quant_serving_speedup", "bf16_bytes_ratio",
+                           "int8_prob_delta", "gate_parity")),
         # Late probe squeeze: the fallback-reason prose yields before
         # the serving levels do (the partial keeps the full reason; a
         # cpu `platform` on the record already says a fallback
@@ -3086,6 +3389,18 @@ def _shrink_to_budget(out: dict) -> dict:
         # stanzas' sentinel series always survive tier 1.
         ("cycle_freshness", ("freshness_speedup",
                              "loop_mean_freshness_s")),
+        # Late squeeze funding the low_precision sentinel series: the
+        # prefetch knob, the moe deadline marker + sorted wall
+        # (einsum_ms / sorted_speedup recovers it), the tenant wait
+        # and the load knee (the argmax of the qps column) yield — all
+        # verbatim in the partial — before the gpipe comparator does.
+        ("trainer_gap", ("fused_over_fit",)),
+        ("moe", ("einsum_ms", "sorted_speedup")),
+        ("multi_tenant", ("min_goodput_fraction",)),
+        ("serving_load", ("processes", "levels", "saturated_qps",
+                          "batched_over_single",
+                          "score_batched_over_single", "parity",
+                          "publish_overhead_ms")),
         ("mpmd_pipeline", ("mpmd_steady_bubble", "mpmd_sps_ratio")),
         # The serving tier's headline stanza goes LAST in tier 1: its
         # per-level qps/p50/p99 columns outlive every other stanza's
@@ -3120,19 +3435,19 @@ def _shrink_to_budget(out: dict) -> dict:
         ("serving", ()),
         ("scaled_legs", ("attn_blockwise_ms", "attn_flash_ms")),
         ("serving_load", ("saturated_qps", "batched_over_single",
-                          "score_batched_over_single", "parity",
-                          "publish_overhead_ms")),
+                          "score_batched_over_single", "parity")),
         ("probe", ("platform",)),
         ("val_parity", ("abs_diff",)),
         ("restart_spinup", ("step_speedup", "score_speedup")),
-        ("cycle_freshness", ("freshness_speedup", "loop_mean_freshness_s")),
+        ("cycle_freshness", ("freshness_speedup",)),
         ("model_sharded", ("sharded_sps_ratio",)),
         ("multi_tenant", ("min_goodput_fraction",)),
-        ("mpmd_pipeline", ("mpmd_steady_bubble", "mpmd_sps_ratio")),
+        ("mpmd_pipeline", ("mpmd_steady_bubble",)),
         ("roofline", ("mfu",)),
         ("elastic_serving", ("overload_p99_s", "shed_fraction")),
         ("telemetry_history", ("detect_latency_s",)),
         ("stream_ingest", ("stream_events_per_s", "stream_lag_p99_s")),
+        ("low_precision", ("quant_serving_speedup", "bf16_bytes_ratio")),
         ("moe", ("sorted_speedup",)),
         ("trainer_gap", ("fused_over_fit", "prefetch_spans")),
         ("scaled", ("step_time_ms", "attn_blockwise_ms",
@@ -3743,6 +4058,21 @@ def main():
             )
             _flush_partial(record)
 
+        # Low-precision A/Bs + gate safety net (ISSUE 20): int8/bf16
+        # serving twins vs f32, bf16-dtype-rules train step vs f32, and
+        # the quantized challenger's promote/block pair through the
+        # real gate. Host-CPU leg (the serving twins are numpy; the
+        # train A/B lowers locally); DCT_BENCH_LOWPREC=0 skips (the
+        # lowprec smoke's knob, like DCT_BENCH_SCALED).
+        skip_lowprec = os.environ.get(
+            "DCT_BENCH_LOWPREC", "1"
+        ).strip().lower() in ("0", "false", "no")
+        if not (skip_lowprec or _gate("low_precision", frac=0.97)):
+            record["low_precision"] = _optional(
+                "low_precision", bench_low_precision, tmp
+            )
+            _flush_partial(record)
+
         if not _gate("host_dataplane"):
             dataplane = _optional(
                 "host_dataplane", bench_host_dataplane
@@ -3764,8 +4094,8 @@ def main():
         "scaled", "moe", "val_parity", "serving", "serving_load",
         "elastic_serving", "restart_spinup", "cycle_freshness",
         "model_sharded", "multi_tenant", "mpmd_pipeline",
-        "telemetry_history", "stream_ingest", "host_dataplane",
-        "roofline",
+        "telemetry_history", "stream_ingest", "low_precision",
+        "host_dataplane", "roofline",
     ):
         record.setdefault(skippable, None)
     _flush_partial(record)

@@ -262,11 +262,20 @@ class TrainStateCheckpointer:  # dct: noqa[rank0-io] — per-process BY DESIGN: 
                     else "whole"
                 ),
             })
+        from dct_tpu.parallel.sharding_rules import dtype_rules_digest
+
         return {
             "version": 1,
             "process_index": jax.process_index(),
             "process_count": jax.process_count(),
             "mesh": mesh_shape,
+            # Precision provenance (docs/PARALLELISM.md §dtype rules):
+            # the SAVED arrays are always the dense f32 masters — the
+            # dtype rules only shape the traced compute — but a
+            # checkpoint written under active rules records which, so
+            # a trajectory's precision history is auditable from its
+            # manifests alone. "off" = the bitwise status quo.
+            "dtype_rules": dtype_rules_digest(),
             "leaves": entries,
         }
 

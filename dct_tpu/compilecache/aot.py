@@ -339,6 +339,19 @@ class ExecutableStore:
         return CachedProgram(fn, self, program=program)
 
 
+def _stamp_dtypes(cost: dict | None, args) -> dict | None:
+    """Join the dispatch's parameter/activation dtype summary into a
+    roofline cost record (``dtypes`` field): precision is program
+    identity on the efficiency plane — a bf16-rules step and its f32
+    twin must be tellable apart from one scrape."""
+    if not cost:
+        return cost
+    from dct_tpu.observability import roofline as _roofline
+
+    summary = _roofline.dtype_summary(args)
+    return {**cost, "dtypes": summary} if summary else cost
+
+
 class CachedProgram:
     """A jitted function fronted by the executable store.
 
@@ -386,7 +399,9 @@ class CachedProgram:
             lowered = self._fn.lower(*args)
         except Exception:  # noqa: BLE001 — non-jit callables have no HLO
             return
-        self._store.note_cost(program, _roofline.analyze_lowered(lowered))
+        self._store.note_cost(
+            program, _stamp_dtypes(_roofline.analyze_lowered(lowered), args)
+        )
 
     def __call__(self, *args, key: str | None = None):
         program = key or self._program
@@ -436,7 +451,10 @@ class CachedProgram:
 
                     if _roofline.roofline_enabled():
                         store.note_cost(
-                            program, _roofline.analyze_compiled(loaded)
+                            program,
+                            _stamp_dtypes(
+                                _roofline.analyze_compiled(loaded), args
+                            ),
                         )
                 with self._lock:
                     self._entries[(program, sig)] = loaded
@@ -454,7 +472,7 @@ class CachedProgram:
         from dct_tpu.observability import roofline as _roofline
 
         cost = (
-            _roofline.analyze_compiled(compiled)
+            _stamp_dtypes(_roofline.analyze_compiled(compiled), args)
             if _roofline.roofline_enabled() else None
         )
         store.note_cost(program, cost)
@@ -520,9 +538,10 @@ def warm_package_scorer(
         import numpy as np
 
         from dct_tpu.serving.batching import _build_jax_scorer
+        from dct_tpu.serving.runtime import assemble_weights
 
         npz = np.load(os.path.join(package_dir, "model.npz"))
-        weights = {k: npz[k] for k in npz.files}
+        weights = assemble_weights({k: npz[k] for k in npz.files})
         with open(os.path.join(package_dir, "model_meta.json")) as f:
             meta = json.load(f)
         meta["_aot_dir"] = os.path.join(package_dir, "aot")

@@ -247,9 +247,10 @@ def _first_score_main(package_dir: str, sizes: tuple) -> int:
 
     from dct_tpu.compilecache.aot import _example_batch
     from dct_tpu.serving.batching import _build_jax_scorer
+    from dct_tpu.serving.runtime import assemble_weights
 
     npz = np.load(os.path.join(package_dir, "model.npz"))
-    weights = {k: npz[k] for k in npz.files}
+    weights = assemble_weights({k: npz[k] for k in npz.files})
     with open(os.path.join(package_dir, "model_meta.json")) as f:
         meta = json.load(f)
     meta["_aot_dir"] = os.path.join(package_dir, "aot")

@@ -1239,6 +1239,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_SHARD_OPT_STATE": "ZeRO-1 weight-update sharding over data axis",
     "DCT_SHARD_PARAMS": "FSDP/ZeRO-3 param + moment sharding",
     "DCT_SHARD_RULES": "partition-rule overrides: pattern=axes[;...] (docs/PARALLELISM.md)",
+    "DCT_DTYPE_RULES": "mixed-precision compute rules: pattern=dtype[;...] (f32 masters; docs/PARALLELISM.md)",
     "DCT_GRAD_ACCUM_STEPS": "microbatches summed per optimizer update",
     "DCT_EARLY_STOP_PATIENCE": "epochs without val_loss improvement (0 = off)",
     "DCT_EARLY_STOP_MIN_DELTA": "improvement threshold for early stop",
@@ -1425,6 +1426,8 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_SERVE_PROCS": "SO_REUSEPORT serving processes (1 = no fork)",
     "DCT_SERVE_ENGINE": "batched scorer: numpy (bit-identical) | jax (jitted)",
     "DCT_SERVE_FAST_PARSE": "zero-copy JSON envelope parsing on/off",
+    "DCT_QUANT_DTYPE": "package quantization default: int8 | bf16 (docs/SERVING.md)",
+    "DCT_QUANT_PROB_BOUND": "quantized-vs-f32 max-abs-prob parity bound",
     "DCT_SERVE_LOADGEN_QPS": "loadgen open-loop target qps (0 = closed loop)",
     "DCT_SERVE_LOADGEN_DURATION_S": "loadgen per-level wall budget (s)",
     "DCT_SERVE_LOADGEN_REQUESTS": "loadgen requests per concurrency level",
@@ -1478,6 +1481,7 @@ ENV_REGISTRY: dict[str, str] = {
     "DCT_BENCH_ELASTIC": "bench elastic_serving (overload controls A/B) leg on/off",
     "DCT_BENCH_TELEMETRY": "bench telemetry_history (detect latency + publish overhead) leg on/off",
     "DCT_BENCH_STREAM": "bench stream_ingest (events/s + lag p99 vs polling) leg on/off",
+    "DCT_BENCH_LOWPREC": "bench low_precision (int8/bf16 serving + bf16 rules A/B) leg on/off",
     "DCT_BENCH_DEADLINE": "bench wall-clock deadline (s); legs self-gate",
     "DCT_BENCH_PARTIAL": "path for the partial-results stash",
     "DCT_VAL_PARITY_EPOCHS": "val-loss parity leg epoch budget",

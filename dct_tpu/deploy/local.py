@@ -31,8 +31,13 @@ class _Deployment:
         if self._weights is None:
             import numpy as np
 
+            from dct_tpu.serving.runtime import assemble_weights
+
             npz = np.load(os.path.join(self.package_dir, "model.npz"))
-            self._weights = {k: npz[k] for k in npz.files}
+            # Quantized packages reconstitute (::q8/::scale/::bf16 key
+            # pairs -> QuantTensor / widened f32); plain packages pass
+            # through unchanged.
+            self._weights = assemble_weights({k: npz[k] for k in npz.files})
             with open(os.path.join(self.package_dir, "model_meta.json")) as f:
                 self._meta = json.load(f)
             # In-memory only (never persisted back): where this

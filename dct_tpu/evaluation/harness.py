@@ -50,9 +50,15 @@ def model_from_package(package_dir: str) -> tuple[dict, dict]:
     Raises :class:`EvalError` for a missing/incomplete package."""
     npz_path = os.path.join(package_dir, "model.npz")
     meta_path = os.path.join(package_dir, "model_meta.json")
+    from dct_tpu.serving.runtime import assemble_weights
+
     try:
         npz = np.load(npz_path)
-        weights = {k: npz[k] for k in npz.files}
+        # Quantized packages (serving/quant.py) reconstitute to
+        # QuantTensor / widened-f32 leaves; plain packages pass through.
+        # Every downstream consumer (numpy engine, gates, jax scorer)
+        # sees the ORIGINAL keys either way.
+        weights = assemble_weights({k: npz[k] for k in npz.files})
         with open(meta_path) as f:
             meta = json.load(f)
     except (OSError, ValueError) as e:
@@ -175,7 +181,10 @@ def _batched_probs_jax(
     """The training-side inference path: registry model rebuilt from the
     self-describing meta, jitted forward, chunks sharded over the mesh
     ``data`` axis (the same batched-apply idiom as train/steps.py's
-    eval body and jobs/predict.py's jax engine)."""
+    eval body and jobs/predict.py's jax engine). Quantized packages are
+    host-dequantized to dense f32 first — the harness's jax engine is a
+    correctness path; the resident-int8 throughput variant lives in the
+    serving batcher (serving/batching.py)."""
     import dataclasses
 
     import jax
@@ -188,6 +197,7 @@ def _batched_probs_jax(
     from dct_tpu.ops.attention import make_attention_fn
     from dct_tpu.parallel.mesh import batch_sharding, make_mesh
 
+    weights = dense_weights(weights)
     family = meta.get("model", "weather_mlp")
     fields = {f.name for f in dataclasses.fields(ModelConfig)}
     cfg = ModelConfig(name=family, **{
@@ -242,6 +252,17 @@ def _batched_probs_jax(
         ))
         parts.append(out[:real])
     return np.concatenate(parts, axis=0)
+
+
+def dense_weights(weights: dict) -> dict:
+    """Host-dequantize a serving weights dict: QuantTensor leaves back
+    to dense f32, everything else untouched (no copy)."""
+    from dct_tpu.serving.runtime import QuantTensor
+
+    return {
+        k: v.dequantize() if isinstance(v, QuantTensor) else v
+        for k, v in weights.items()
+    }
 
 
 def _unflatten_weights(weights: dict, family: str) -> dict:

@@ -625,6 +625,8 @@ def build_report(
         for name in sorted(by_prog):
             r = by_prog[name]
             parts = [f"  {name}:"]
+            if r.get("dtypes"):
+                parts.append(f"dtype={r['dtypes']}")
             if r.get("flops") is not None:
                 parts.append(f"flops={r['flops']:.4g}")
             if r.get("bytes_accessed") is not None:

@@ -114,6 +114,16 @@ SERIES = (
     # plane exists for started slipping).
     ("stream_events_per_s", ("stream_ingest", "stream_events_per_s"), "up"),
     ("stream_lag_p99_s", ("stream_ingest", "stream_lag_p99_s"), "down"),
+    # Low precision (the low_precision bench leg): the int8 scorer's
+    # batch-64 throughput over the f32 twin (a drop means the
+    # integer-exact GEMM stopped paying for its quantize overhead),
+    # and the bf16-dtype-rules train step's lowered bytes_accessed
+    # over f32 at matched config (a rise means the mixed-precision
+    # rules stopped shrinking the program's memory traffic — gated
+    # like a latency, down = better).
+    ("quant_serving_speedup",
+     ("low_precision", "quant_serving_speedup"), "up"),
+    ("bf16_bytes_ratio", ("low_precision", "bf16_bytes_ratio"), "down"),
 )
 
 

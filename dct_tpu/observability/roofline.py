@@ -98,6 +98,47 @@ def _normalize_cost(raw, source: str) -> dict | None:
     return out if len(out) > 1 else None
 
 
+#: dtype -> roofline short name (anything unlisted keeps its full name).
+_DTYPE_SHORT = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16", "int64": "i64", "int32": "i32", "int16": "i16",
+    "int8": "i8", "uint8": "u8", "uint16": "u16", "uint32": "u32",
+    "bool": "b1",
+}
+
+
+def dtype_summary(args) -> str:
+    """The program's parameter/activation dtypes as dispatched: sorted
+    unique short names of the call's array leaves, comma-joined — the
+    roofline record's ``dtypes`` stamp, so a bf16-vs-f32
+    ``bytes_accessed`` delta is attributable on one scrape. When dtype
+    rules are active (``DCT_DTYPE_RULES``) the dispatched args are
+    still the f32 masters (the cast happens inside the traced body), so
+    the active rules digest is appended (``+rules:<digest>``) to keep
+    the stamp honest about the compute precision."""
+    names: set = set()
+    try:
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(args):
+            dt = getattr(leaf, "dtype", None)
+            if dt is not None:
+                names.add(_DTYPE_SHORT.get(str(dt), str(dt)))
+    except Exception:  # noqa: BLE001 — accounting never fails a program
+        return ""
+    summary = ",".join(sorted(names))
+    try:
+        from dct_tpu.parallel.sharding_rules import (
+            dtype_rules, dtype_rules_digest,
+        )
+
+        if dtype_rules():
+            summary += f"+rules:{dtype_rules_digest()}"
+    except Exception:  # noqa: BLE001 — a malformed env must not bite here
+        pass
+    return summary
+
+
 def analyze_lowered(lowered) -> dict | None:
     """Cost analysis of a ``jax.stages.Lowered`` (pre-compile HLO): the
     capture path for programs the AOT store never compiles explicitly
@@ -323,6 +364,11 @@ def add_roofline_metrics(reg, report: list[dict], labels: dict) -> None:
             "family": rec.get("family", ""),
             "mesh": rec.get("mesh", ""),
         }
+        # Precision attribution: one scrape separates the bf16
+        # program's bytes from its f32 twin's. Unstamped records keep
+        # the pre-dtype label set so their series identity is stable.
+        if rec.get("dtypes"):
+            wl["dtype"] = rec["dtypes"]
         if rec.get("flops") is not None:
             flops_g.set(rec["flops"], wl)
         if rec.get("bytes_accessed") is not None:

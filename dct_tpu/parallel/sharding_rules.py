@@ -170,6 +170,122 @@ def path_str(path) -> str:
     )
 
 
+# ----------------------------------------------------------------------
+# Dtype rules: the precision analog of the partition rules. The SAME
+# regex-over-param-path grammar as DCT_SHARD_RULES selects which param
+# leaves run the forward/backward in low precision
+# (``DCT_DTYPE_RULES='.*=bf16'`` = bf16 compute everywhere), while the
+# MASTER params, gradients-as-accumulated, and optimizer state stay
+# f32: the cast happens INSIDE the traced loss body (train/steps.py),
+# so autodiff's cast-vjp routes the bf16 gradients back into f32
+# accumulation and nothing below the loss ever sees the low-precision
+# copy. Rules off (the default) is the bitwise status quo.
+
+#: Accepted dtype tokens (right-hand side of a clause) -> canonical
+#: jax dtype name.
+DTYPE_ALIASES = {
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "f16": "float16", "float16": "float16",
+    "f32": "float32", "float32": "float32",
+}
+
+
+def parse_dtype_rules(text: str):
+    """``DCT_DTYPE_RULES`` grammar -> tuple of (regex, dtype name).
+
+    ``pattern=dtype[;pattern=dtype...]`` — the clause grammar of
+    :func:`parse_rules` with a dtype token (bf16/bfloat16, f16/float16,
+    f32/float32) where the axis list would be. Malformed specs raise
+    ``ValueError`` naming the offending clause — a typo'd precision
+    must never silently train full-width."""
+    rules = []
+    for clause in (text or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            raise ValueError(
+                f"DCT_DTYPE_RULES clause {clause!r} has no '=': expected "
+                "pattern=dtype"
+            )
+        pattern, _, dname = clause.rpartition("=")
+        pattern = pattern.strip()
+        try:
+            re.compile(pattern)
+        except re.error as e:
+            raise ValueError(
+                f"DCT_DTYPE_RULES pattern {pattern!r} is not a valid "
+                f"regex: {e}"
+            ) from e
+        canonical = DTYPE_ALIASES.get(dname.strip().lower())
+        if canonical is None:
+            raise ValueError(
+                f"DCT_DTYPE_RULES clause {clause!r}: unknown dtype "
+                f"{dname.strip()!r} (valid: "
+                f"{', '.join(sorted(set(DTYPE_ALIASES)))})"
+            )
+        rules.append((pattern, canonical))
+    return tuple(rules)
+
+
+_DTYPE_PARSE_CACHE: dict[str, tuple] = {}
+
+
+def dtype_rules():
+    """The active ``DCT_DTYPE_RULES`` table (empty tuple when unset) —
+    memoized per env string like the partition-rule cache."""
+    env = os.environ.get("DCT_DTYPE_RULES")
+    if not env:
+        return ()
+    cached = _DTYPE_PARSE_CACHE.get(env)
+    if cached is None:
+        cached = parse_dtype_rules(env)
+        if len(_DTYPE_PARSE_CACHE) > 8:
+            _DTYPE_PARSE_CACHE.clear()
+        _DTYPE_PARSE_CACHE[env] = cached
+    return cached
+
+
+def dtype_rules_digest() -> str:
+    """Content digest of the active dtype rules, joined into the AOT
+    program identity (trainer) and the checkpoint layout manifest: a
+    precision change is a LOUD cache miss, never a stale executable.
+    ``"off"`` when no rules are set, so every pre-rules artifact and
+    manifest keys identically."""
+    rules = dtype_rules()
+    if not rules:
+        return "off"
+    blob = "|".join(f"{pat}={dname}" for pat, dname in rules)
+    return hashlib.sha1(blob.encode()).hexdigest()[:10]
+
+
+def cast_params_by_rules(params):
+    """Cast float param leaves whose ``/``-joined path matches a dtype
+    rule (first match wins; unmatched and non-float leaves untouched).
+
+    Called INSIDE the jitted loss/eval bodies on the f32 master params:
+    under ``jax.value_and_grad`` the cast's vjp widens the incoming
+    bf16 cotangents back to f32, so gradient ACCUMULATION and the
+    optimizer update run full-width — the mixed-precision
+    master-weight contract (docs/PARALLELISM.md §dtype rules)."""
+    rules = dtype_rules()
+    if not rules:
+        return params
+    import jax.numpy as jnp
+
+    def one(path, leaf):
+        dt = getattr(leaf, "dtype", None)
+        if dt is None or not jnp.issubdtype(dt, jnp.floating):
+            return leaf
+        name = path_str(path)
+        for pattern, dname in rules:
+            if re.search(pattern, name):
+                return leaf.astype(getattr(jnp, dname))
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
 def match_partition_rules(rules, tree):
     """Spec tree for ``tree`` under ``rules`` (the snippet-style
     primitive): scalars and unmatched leaves replicate (``P()`` — the
@@ -321,7 +437,35 @@ def gather_tree(tree):
     return jax.tree.map(gather_leaf, tree)
 
 
-def make_shard_and_gather_fns(shardings):
+def _as_dtype(spec) -> np.dtype:
+    """A dtype-like (np/jnp dtype, scalar type, or alias string like
+    ``'bf16'``) -> concrete ``np.dtype`` (bfloat16 resolves through
+    jax's extended-dtype registry)."""
+    if isinstance(spec, str):
+        import jax.numpy as jnp
+
+        name = DTYPE_ALIASES.get(spec.strip().lower(), spec)
+        return np.dtype(getattr(jnp, name, name))
+    return np.dtype(spec)
+
+
+def _is_dtype_like(x) -> bool:
+    """True for anything ``_as_dtype`` accepts as ONE dtype (a string,
+    dtype, or scalar type) — i.e. NOT a per-leaf pytree of specs."""
+    if isinstance(x, str):
+        return True
+    if isinstance(x, (dict, list, tuple)):
+        # Containers are per-leaf spec trees (np.dtype would try to
+        # parse a dict as a STRUCTURED dtype and raise ValueError).
+        return False
+    try:
+        np.dtype(x)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def make_shard_and_gather_fns(shardings, dtype_specs=None):
     """(shard_fns, gather_fns) trees from a tree of NamedShardings.
 
     ``shard_fn(host_array)`` places a leaf under its declared sharding
@@ -329,23 +473,59 @@ def make_shard_and_gather_fns(shardings):
     ``gather_fn(device_array)`` brings it back as a dense host ndarray
     (cross-process allgather where the layout spans hosts). The pair is
     the checkpoint/publish contract: save/restore and package export go
-    through these, never through raw per-leaf copies."""
+    through these, never through raw per-leaf copies.
 
-    def make_shard_fn(s):
-        return lambda x: jax.device_put(x, s)
+    ``dtype_specs`` optionally casts float leaves on the way through:
+    either ONE dtype-like applied tree-wide, or a pytree shaped like
+    ``shardings`` carrying a per-leaf dtype (``None`` = leave alone).
+    The upstream snippet's ``dtype_specs in float_dtypes`` membership
+    test only ever worked for the scalar case (a pytree on the left of
+    ``in`` compares elementwise and crashes); per-leaf specs are
+    first-class here. Non-float leaves (step counters, int stats) are
+    never cast."""
+    is_sharding = lambda x: isinstance(x, NamedSharding)  # noqa: E731
+    if dtype_specs is None:
+        spec_tree = jax.tree.map(lambda _s: None, shardings,
+                                 is_leaf=is_sharding)
+    elif _is_dtype_like(dtype_specs):
+        dt = _as_dtype(dtype_specs)
+        spec_tree = jax.tree.map(lambda _s: dt, shardings,
+                                 is_leaf=is_sharding)
+    else:
+        spec_tree = jax.tree.map(
+            lambda d: None if d is None else _as_dtype(d), dtype_specs,
+            is_leaf=lambda x: x is None or _is_dtype_like(x),
+        )
 
-    def make_gather_fn(_s):
-        return gather_leaf
+    def _cast(x, dt):
+        if dt is None:
+            return x
+        src = getattr(x, "dtype", None)
+        if src is None or not jnp_issubdtype_floating(src):
+            return x
+        return x.astype(dt) if hasattr(x, "astype") else np.asarray(x, dt)
+
+    def make_shard_fn(s, dt):
+        return lambda x: jax.device_put(_cast(x, dt), s)
+
+    def make_gather_fn(_s, dt):
+        return lambda x: _cast(gather_leaf(x), dt)
 
     shard_fns = jax.tree.map(
-        make_shard_fn, shardings,
-        is_leaf=lambda x: isinstance(x, NamedSharding),
+        make_shard_fn, shardings, spec_tree, is_leaf=is_sharding,
     )
     gather_fns = jax.tree.map(
-        make_gather_fn, shardings,
-        is_leaf=lambda x: isinstance(x, NamedSharding),
+        make_gather_fn, shardings, spec_tree, is_leaf=is_sharding,
     )
     return shard_fns, gather_fns
+
+
+def jnp_issubdtype_floating(dt) -> bool:
+    """Float check that also covers jax extended dtypes (bfloat16 is
+    not an ``np.floating`` subtype under plain numpy)."""
+    import jax.numpy as jnp
+
+    return bool(jnp.issubdtype(dt, jnp.floating))
 
 
 # ----------------------------------------------------------------------

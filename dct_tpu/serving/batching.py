@@ -79,6 +79,22 @@ def score_rows_invariant(weights: dict, meta: dict,
     return out
 
 
+def _digest_view(weights: dict) -> dict:
+    """Flat ndarray view of a serving weights dict for content hashing:
+    QuantTensor leaves expand to their q8/scale planes (the npz key
+    grammar), everything else passes through."""
+    from dct_tpu.serving.runtime import QuantTensor
+
+    out: dict = {}
+    for k, v in weights.items():
+        if isinstance(v, QuantTensor):
+            out[f"{k}::q8"] = v.q
+            out[f"{k}::scale"] = v.scale
+        else:
+            out[k] = v
+    return out
+
+
 def _build_jax_scorer(weights: dict, meta: dict, force_store: bool = False):
     """Jitted batched scorer: registry model rebuilt from the package's
     self-describing meta (the evaluation harness's jax-engine idiom),
@@ -104,22 +120,52 @@ def _build_jax_scorer(weights: dict, meta: dict, force_store: bool = False):
     from dct_tpu.evaluation.harness import _unflatten_weights
     from dct_tpu.models.registry import get_model, is_causal_model
 
+    from dct_tpu.serving.runtime import QuantTensor
+
     family = meta.get("model", "weather_mlp")
     fields = {f.name for f in dataclasses.fields(ModelConfig)}
     cfg = ModelConfig(name=family, **{
         k: v for k, v in meta.items() if k in fields and k != "name"
     })
+    qdtype = (meta.get("quant") or {}).get("dtype")
     model = get_model(
-        cfg, input_dim=int(meta["input_dim"]), compute_dtype=jnp.float32
+        cfg, input_dim=int(meta["input_dim"]),
+        compute_dtype=jnp.bfloat16 if qdtype == "bf16" else jnp.float32,
     )
-    params = _unflatten_weights(weights, family)
+    # Low-precision residency (docs/SERVING.md §quantized scorers): the
+    # int8 variant keeps q8 + per-channel scales resident (a quarter of
+    # the f32 weight bytes) and dequantizes INSIDE the jitted forward;
+    # the bf16 variant keeps params resident as bf16 (the package's
+    # widened-f32 values are bf16-exact, so this cast is lossless) and
+    # runs the model at bf16 compute. A plain f32 package takes neither
+    # branch — bits unchanged.
+    flat_plain: dict = {}
+    flat_q: dict = {}
+    for k, v in weights.items():
+        if isinstance(v, QuantTensor):
+            flat_q[k] = (jnp.asarray(v.q), jnp.asarray(v.scale))
+        elif qdtype == "bf16" and np.issubdtype(
+            np.asarray(v).dtype, np.floating
+        ):
+            flat_plain[k] = jnp.asarray(v, jnp.bfloat16)
+        else:
+            flat_plain[k] = jnp.asarray(v)
+
+    def _materialize_params():
+        flat = dict(flat_plain)
+        for k, (q, s) in flat_q.items():
+            flat[k] = q.astype(jnp.float32) * s
+        return _unflatten_weights(flat, family)
+
     causal = is_causal_model(family)
     horizon = int(meta.get("horizon", 1))
     moe = family == "weather_moe"
 
     @jax.jit
     def forward(xb):
-        logits = model.apply({"params": params}, xb, train=False)
+        logits = model.apply({"params": _materialize_params()}, xb,
+                             train=False)
+        logits = logits.astype(jnp.float32)
         if causal:
             # Per-position head: [B, S, C] (horizon 1) or [B, S, H, C];
             # serving answers for the window's LAST position, keeping
@@ -155,7 +201,13 @@ def _build_jax_scorer(weights: dict, meta: dict, force_store: bool = False):
         # identity (a meta-identical package with different weights
         # would otherwise load a stale model's executable). Hashed only
         # when the store can actually engage (one build-time pass).
-        extra={"weights": _weights_digest(weights)} if armed else None,
+        # QuantTensor leaves hash as their npz representation (q8 +
+        # scale planes), so an int8/bf16 variant of the same checkpoint
+        # keys a DISTINCT artifact from its f32 twin by content alone.
+        extra=(
+            {"weights": _weights_digest(_digest_view(weights))}
+            if armed else None
+        ),
         emit=_emit_compile_event,
     )
     if force_store and aot_root:

@@ -1,0 +1,166 @@
+"""Pack-side weight quantization: f32 deploy package -> int8/bf16 twin.
+
+The serve side lives in :mod:`dct_tpu.serving.runtime`
+(:class:`~dct_tpu.serving.runtime.QuantTensor`,
+:func:`~dct_tpu.serving.runtime.assemble_weights`) so the generated
+``score.py`` stays self-contained; this module only PRODUCES quantized
+packages and is never embedded.
+
+Two variants:
+
+- ``int8`` — per-output-channel symmetric scales over every 2D matmul
+  kernel (``w\\d+`` MLP stacks, any 2D ``*kernel`` flax path); biases,
+  layernorm affines, and stacked 3D+ trees (MoE experts, pp_stages)
+  stay f32. Served through the integer-exact GEMM, which is
+  row-invariant by construction AND faster than the f32 twin's per-row
+  ``rows_mm`` flush path.
+- ``bf16`` — every float leaf rounded to bf16 bit patterns (uint16
+  storage, half the npz bytes), widened back to f32 at load; compute
+  stays f32 on bf16-rounded weights, so the row-invariance machinery is
+  untouched.
+
+The quantized package is just another challenger: ship it through the
+champion/challenger gates (docs/SERVING.md §quantized scorers) so an
+accuracy regression is a gate ``hold`` with bootstrap evidence, never a
+silent cliff. ``DCT_QUANT_PROB_BOUND`` documents the max-abs-prob
+parity bound the smoke/bench rigs assert against the f32 twin.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import numpy as np
+
+#: Documented serving parity bound: max |p_quant - p_f32| over the eval
+#: batch must stay below this for a healthy int8 package (bf16 lands far
+#: inside it). The gate pipeline remains the real safety net — this
+#: bound is the loud first tripwire.
+DEFAULT_PROB_BOUND = 0.05
+
+_MLP_KERNEL_RE = re.compile(r"w\d+$")
+
+
+def prob_bound() -> float:
+    """The asserted max-abs-prob parity bound (env-overridable)."""
+    from dct_tpu.config import _env
+
+    return float(_env("DCT_QUANT_PROB_BOUND", DEFAULT_PROB_BOUND, float))
+
+
+def is_matmul_kernel(key: str, arr: np.ndarray) -> bool:
+    """True for the 2D matmul kernels the int8 variant packs: MLP
+    ``w<i>`` stacks and any 2D flax ``*kernel`` leaf. 3D+ stacks
+    (``pp_stages/*``, MoE expert banks) are structurally excluded by
+    the ndim check."""
+    return arr.ndim == 2 and (
+        key.endswith("kernel") or _MLP_KERNEL_RE.fullmatch(key) is not None
+    )
+
+
+def quantize_array_int8(a: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """[K, M] f32 -> (int8 [K, M], f32 per-output-channel scale [M]).
+
+    Symmetric: scale = max|w|/127 per column; an all-zero channel keeps
+    scale 0 (dequantizes to exact zeros)."""
+    a = np.asarray(a, np.float32)
+    scale = (np.abs(a).max(axis=0) / np.float32(127.0)).astype(np.float32)
+    safe = np.where(scale > 0, scale, 1).astype(np.float32)
+    q = np.clip(np.rint(a / safe[None, :]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def quantize_weights(
+    weights: dict, meta: dict, dtype: str = "int8"
+) -> tuple[dict, dict]:
+    """(f32 serving weights, meta) -> (flat quantized dict, meta').
+
+    The returned flat dict uses the ``k::q8``/``k::scale``/``k::bf16``
+    key grammar :func:`runtime.assemble_weights` reconstitutes; meta'
+    carries a ``quant`` stanza ({dtype, prob_bound}) so every consumer
+    (package loader, jax scorer, gates, bench) can see the variant
+    without sniffing key suffixes."""
+    from dct_tpu.serving.runtime import bf16_pack
+
+    if dtype not in ("int8", "bf16"):
+        raise ValueError(
+            f"quantize dtype must be 'int8' or 'bf16', got {dtype!r}"
+        )
+    flat: dict = {}
+    for k, v in weights.items():
+        v = np.asarray(v)
+        if dtype == "int8" and is_matmul_kernel(k, v):
+            q, scale = quantize_array_int8(v)
+            flat[f"{k}::q8"] = q
+            flat[f"{k}::scale"] = scale
+        elif dtype == "bf16" and np.issubdtype(v.dtype, np.floating):
+            flat[f"{k}::bf16"] = bf16_pack(v)
+        else:
+            flat[k] = v
+    meta_out = dict(meta)
+    meta_out["quant"] = {"dtype": dtype, "prob_bound": prob_bound()}
+    return flat, meta_out
+
+
+def quantize_package(
+    package_dir: str, out_dir: str, dtype: str | None = None
+) -> dict:
+    """An f32 deploy package -> a fully servable quantized sibling.
+
+    Reads ``model.npz``/``model_meta.json`` from ``package_dir``,
+    quantizes (``dtype`` defaults to ``DCT_QUANT_DTYPE``, int8), and
+    writes a COMPLETE package (npz + meta + generated score.py +
+    conda.yaml) into ``out_dir`` — a first-class challenger for the
+    promotion gates. Returns the quantized meta."""
+    from dct_tpu.config import _env
+    from dct_tpu.serving.score_gen import _publish_text, render_score_py
+
+    if dtype is None:
+        dtype = str(_env("DCT_QUANT_DTYPE", "int8", str)).strip().lower()
+    npz = np.load(os.path.join(package_dir, "model.npz"))
+    weights = {k: npz[k] for k in npz.files}
+    with open(os.path.join(package_dir, "model_meta.json")) as f:
+        meta = json.load(f)
+    if "quant" in meta:
+        raise ValueError(
+            f"{package_dir} is already quantized "
+            f"({meta['quant'].get('dtype')}) — re-quantizing compounds "
+            "rounding; start from the f32 package"
+        )
+    flat, meta_out = quantize_weights(weights, meta, dtype)
+    os.makedirs(out_dir, exist_ok=True)
+    npz_path = os.path.join(out_dir, "model.npz")
+    npz_tmp = f"{npz_path}.tmp.{os.getpid()}"
+    with open(npz_tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(npz_tmp, npz_path)
+    _publish_text(
+        os.path.join(out_dir, "model_meta.json"),
+        json.dumps(meta_out, indent=2),
+    )
+    _publish_text(os.path.join(out_dir, "score.py"), render_score_py())
+    from dct_tpu.serving.score_gen import _CONDA_YAML
+
+    _publish_text(os.path.join(out_dir, "conda.yaml"), _CONDA_YAML)
+    return meta_out
+
+
+def main(argv: list | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Quantize an f32 deploy package (int8/bf16 twin)."
+    )
+    ap.add_argument("package_dir")
+    ap.add_argument("out_dir")
+    ap.add_argument("--dtype", choices=("int8", "bf16"), default=None)
+    args = ap.parse_args(argv)
+    meta = quantize_package(args.package_dir, args.out_dir, args.dtype)
+    print(json.dumps(meta.get("quant", {})))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

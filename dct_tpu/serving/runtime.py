@@ -49,6 +49,131 @@ def rows_mm(x: np.ndarray, w: np.ndarray) -> np.ndarray:
     return (x[:, None, :] @ w)[:, 0, :]
 
 
+#: K-reduction block for the int8 integer GEMM: every partial product is
+#: <= 127*127 and 1024 of them sum below 2**24, so each block's
+#: accumulation is EXACT in float32 — no rounding for any BLAS kernel or
+#: FMA grouping to disagree about.
+_INT8_CHUNK = 1024
+
+
+def bf16_pack(a: np.ndarray) -> np.ndarray:
+    """float32 -> bf16 bit pattern (round-to-nearest-even) as uint16.
+
+    Pure-numpy twin of ``jnp.asarray(a, jnp.bfloat16)``'s rounding:
+    halves the stored bytes; :func:`bf16_unpack` widens back exactly."""
+    u = np.ascontiguousarray(a, np.float32).view(np.uint32)
+    return ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype(np.uint16)
+
+
+def bf16_unpack(u: np.ndarray) -> np.ndarray:
+    """bf16 bit pattern (uint16) -> float32 (exact widening)."""
+    return (
+        np.ascontiguousarray(u, np.uint16).astype(np.uint32) << 16
+    ).view(np.float32)
+
+
+class QuantTensor:
+    """An int8 weight matrix with per-output-channel symmetric scales,
+    dequantized INSIDE the matmul.
+
+    ``np.matmul(x, qt)`` (and the ``@`` operator — numpy routes both
+    through ``__array_ufunc__``) quantizes the activation rows
+    dynamically (symmetric int8, one scale per row), runs the GEMM as a
+    float32-carried INTEGER product, and rescales by
+    ``row_scale * channel_scale``. Because every intermediate value of
+    the integer reduction is an integer below 2**24 (the K axis is
+    chunked to ``_INT8_CHUNK`` columns), the float32 accumulation is
+    exact — the result is bit-identical under any BLAS kernel, batch
+    size, or row stacking. That restores the micro-batcher's
+    row-invariance contract through ONE plain GEMM, where the f32 twin
+    must fall back to the per-row ``rows_mm`` path: quantization here
+    buys speed precisely by making the fast path exact.
+
+    Only 2D matmul kernels are packed this way (serving/quant.py);
+    biases, layernorm affines, and stacked 3D+ trees stay f32, so every
+    other op in the forward pass is untouched.
+    """
+
+    __slots__ = ("q", "scale", "qf")
+
+    #: Logical dtype: the tensor stands in for a float32 weight matrix.
+    dtype = np.dtype(np.float32)
+
+    def __init__(self, q: np.ndarray, scale: np.ndarray):
+        self.q = np.ascontiguousarray(q, np.int8)
+        self.scale = np.ascontiguousarray(scale, np.float32)
+        # float32 carrier of the int8 entries: cast once at load — the
+        # GEMM consumes it directly on every call.
+        self.qf = self.q.astype(np.float32)
+
+    @property
+    def shape(self) -> tuple:
+        return self.q.shape
+
+    @property
+    def ndim(self) -> int:
+        return self.q.ndim
+
+    def dequantize(self) -> np.ndarray:
+        """Dense f32 reconstruction (jax-engine and debugging path)."""
+        return self.qf * self.scale[None, :]
+
+    def matmul(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        lead, k = x.shape[:-1], x.shape[-1]
+        x2 = x.reshape(-1, k)
+        amax = np.abs(x2).max(axis=1) if x2.size else np.zeros(
+            x2.shape[0], np.float32
+        )
+        sx = (amax / np.float32(127.0)).astype(np.float32)
+        inv = np.where(sx > 0, np.float32(1.0) / np.where(sx > 0, sx, 1), 0)
+        xq = x2 * inv[:, None].astype(np.float32)
+        np.rint(xq, out=xq)
+        np.clip(xq, -127.0, 127.0, out=xq)
+        acc = None
+        for c in range(0, k, _INT8_CHUNK):
+            part = xq[:, c:c + _INT8_CHUNK] @ self.qf[c:c + _INT8_CHUNK]
+            # Fixed-order elementwise adds between exact integer blocks:
+            # still deterministic and row-independent.
+            acc = part if acc is None else acc + part
+        acc *= sx[:, None]
+        acc *= self.scale
+        return acc.reshape(*lead, self.q.shape[1])
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if (
+            ufunc is np.matmul and method == "__call__"
+            and len(inputs) == 2 and inputs[1] is self and not kwargs
+        ):
+            return self.matmul(inputs[0])
+        return NotImplemented
+
+    def __rmatmul__(self, x):
+        return self.matmul(x)
+
+
+def assemble_weights(flat: dict) -> dict:
+    """Reconstitute serving weights from a flat npz-style mapping.
+
+    Quantized packages (serving/quant.py) store ``k::q8`` (int8) +
+    ``k::scale`` (f32 per output channel) pairs and ``k::bf16`` (uint16
+    bf16 bit patterns); a plain f32 package passes through unchanged.
+    The ``::`` separator cannot collide with flax ``/`` paths. Returns
+    the original keys mapped to f32 arrays or :class:`QuantTensor`s —
+    every forward above consumes either transparently."""
+    out: dict = {}
+    for k, v in flat.items():
+        if k.endswith("::q8"):
+            out[k[:-4]] = QuantTensor(v, flat[k[:-4] + "::scale"])
+        elif k.endswith("::scale"):
+            continue
+        elif k.endswith("::bf16"):
+            out[k[:-6]] = bf16_unpack(v)
+        else:
+            out[k] = v
+    return out
+
+
 def _sigmoid(x: np.ndarray) -> np.ndarray:
     return 1.0 / (1.0 + np.exp(-x))
 

@@ -168,7 +168,10 @@ def _locate(name):
 def init():
     global _WEIGHTS, _META
     npz = np.load(_locate("model.npz"))
-    _WEIGHTS = {{k: npz[k] for k in npz.files}}
+    # assemble_weights reconstitutes quantized packages (::q8/::scale/
+    # ::bf16 key pairs -> QuantTensor / widened f32); a plain f32
+    # package passes through unchanged.
+    _WEIGHTS = assemble_weights({{k: npz[k] for k in npz.files}})
     with open(_locate("model_meta.json")) as f:
         _META = json.load(f)
     print(f"Model loaded: input_dim={{_META['input_dim']}}")
@@ -194,10 +197,10 @@ dependencies:
 """
 
 
-def generate_score_package(ckpt_path: str, deploy_dir: str) -> dict:
-    """Write the full deploy package; returns the model meta."""
-    meta = export_npz_weights(ckpt_path, deploy_dir)
-
+def render_score_py() -> str:
+    """The generated score.py text (shared by the f32 packager and the
+    quantized-package writer, serving/quant.py — both must embed the
+    SAME tested runtime)."""
     from dct_tpu.serving import runtime
 
     # Embed the WHOLE runtime module (every family's forward + dispatch);
@@ -208,9 +211,14 @@ def generate_score_package(ckpt_path: str, deploy_dir: str) -> dict:
     )
     # str.format substitutes values verbatim (braces inside runtime_source
     # are untouched); only the template's own {{ }} literals are unescaped.
-    score_py = _SCORE_TEMPLATE.format(runtime_source=runtime_source)
+    return _SCORE_TEMPLATE.format(runtime_source=runtime_source)
 
-    _publish_text(os.path.join(deploy_dir, "score.py"), score_py)
+
+def generate_score_package(ckpt_path: str, deploy_dir: str) -> dict:
+    """Write the full deploy package; returns the model meta."""
+    meta = export_npz_weights(ckpt_path, deploy_dir)
+
+    _publish_text(os.path.join(deploy_dir, "score.py"), render_score_py())
     _publish_text(os.path.join(deploy_dir, "conda.yaml"), _CONDA_YAML)
 
     # Packaging-time scorer warm-up (compilecache): with the compile

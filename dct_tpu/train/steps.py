@@ -30,7 +30,19 @@ from dct_tpu.ops.losses import (
     masked_binary_counts,
     masked_cross_entropy,
 )
+from dct_tpu.parallel.sharding_rules import cast_params_by_rules
 from dct_tpu.train.state import TrainState
+
+# Mixed-precision dispatch (docs/PARALLELISM.md §dtype rules): every
+# loss/eval body below applies ``cast_params_by_rules`` to the f32
+# MASTER params as the first traced op. With DCT_DTYPE_RULES unset the
+# call is the identity (bits unchanged — the contract every resume/
+# parity test pins); with rules set, matching param leaves enter the
+# forward in bf16 while value_and_grad differentiates w.r.t. the
+# UNCAST masters — the cast's vjp widens cotangents back to f32, so
+# gradient accumulation and optimizer state stay full-width. The env
+# is read at TRACE time: the trainer joins dtype_rules_digest() into
+# the AOT program identity so a precision change recompiles loudly.
 
 
 def _position_weight(logits, y, weight):
@@ -59,8 +71,8 @@ def _train_body(state: TrainState, x, y, weight):
 
     def loss_fn(params):
         logits, updates = state.apply_fn(
-            params, x, train=True, rngs={"dropout": step_rng},
-            mutable=["aux_loss"],
+            cast_params_by_rules(params), x, train=True,
+            rngs={"dropout": step_rng}, mutable=["aux_loss"],
         )
         w = _position_weight(logits, y, weight)
         loss_sum, count = masked_cross_entropy(logits, y, w)
@@ -84,7 +96,8 @@ def _eval_body(state: TrainState, x, y, weight):
     classifier lacks). Sown aux losses are training regularizers only;
     val_loss stays pure CE."""
     logits, _ = state.apply_fn(
-        state.params, x, train=False, mutable=["aux_loss"]
+        cast_params_by_rules(state.params), x, train=False,
+        mutable=["aux_loss"],
     )
     w = _position_weight(logits, y, weight)
     loss_sum, count = masked_cross_entropy(logits, y, w)
@@ -114,8 +127,8 @@ def _train_accum_body(state: TrainState, x, y, weight, accum_steps: int):
 
     def chunk_loss(params, cx, cy, cw, rng):
         logits, updates = state.apply_fn(
-            params, cx, train=True, rngs={"dropout": rng},
-            mutable=["aux_loss"],
+            cast_params_by_rules(params), cx, train=True,
+            rngs={"dropout": rng}, mutable=["aux_loss"],
         )
         loss_sum, _ = masked_cross_entropy(
             logits, cy, _position_weight(logits, cy, cw)

@@ -49,6 +49,7 @@ from dct_tpu.parallel.mesh import (
     process_data_block,
 )
 from dct_tpu.parallel.sharding_rules import (
+    dtype_rules_digest,
     layout_mismatches,
     rules_digest,
     shard_state_with_rules,
@@ -650,6 +651,14 @@ class Trainer:
         # DIFFERENT executable — it must miss; the same layout must
         # warm-relaunch, sharded exactly like DP.
         _train_identity["shard_rules"] = rules_digest(cfg.model.name)
+        # Same contract for the PRECISION table: the dtype rules pick
+        # which param leaves run the step in bf16 (cast inside the
+        # traced loss body, train/steps.py), so the compiled program
+        # differs whenever they do — a precision change must be a loud
+        # cache miss, never a stale full-width (or half-width)
+        # executable. "off" when unset keys identically to every
+        # pre-rules artifact.
+        _train_identity["dtype_rules"] = dtype_rules_digest()
         aot_store = _compilecache.store_from_env(
             os.environ.get("DCT_COMPILE_CACHE_AOT_DIR")
             or os.path.join(cfg.data.models_dir, "aot"),

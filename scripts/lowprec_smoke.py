@@ -1,0 +1,182 @@
+#!/usr/bin/env python3
+"""Low-precision serving smoke (tier1.yml job, ISSUE 20).
+
+A REAL trained package through the quantized-challenger workflow,
+end to end on CPU:
+
+1. a tiny ``Trainer.fit`` run over synthetic weather data produces a
+   genuine checkpoint (quantization error on random unscaled weights
+   saturates softmax and overstates the prob delta — the accuracy
+   contract is only meaningful on trained weights);
+2. ``generate_score_package`` builds the f32 champion,
+   ``quantize_package`` its int8 challenger — a COMPLETE sibling
+   package (npz + meta + generated score.py);
+3. the challenger's own generated ``score.py`` is imported and served
+   (init() + run()) — the embedded runtime must reconstitute the
+   ``::q8``/``::scale`` pairs and score;
+4. prob parity: max-abs-prob delta challenger vs champion over real
+   validation rows must stay within the documented bound
+   (``DCT_QUANT_PROB_BOUND``, serving/quant.py), and the quantized
+   forward must be row-invariant (each row scored alone bit-equals its
+   slice of the batch — the micro-batcher contract);
+5. the PR-4 promotion gate passes the clean challenger (promote) and
+   blocks the same package after one scale column is corrupted — the
+   gate-as-safety-net workflow from SERVING.md, proven on every CI run.
+
+Exit 0 = all gates hold; nonzero with the evidence printed otherwise.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import tempfile
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory() as work:
+        os.environ.update({
+            "DCT_EVENTS_DIR": os.path.join(work, "events"),
+            "DCT_HEARTBEAT_DIR": os.path.join(work, "hb"),
+            "DCT_SPANS_DIR": os.path.join(work, "spans"),
+        })
+        import numpy as np
+
+        from dct_tpu.config import EvaluationConfig, RunConfig
+        from dct_tpu.data.synthetic import generate_weather_csv
+        from dct_tpu.etl.preprocess import preprocess_csv_to_parquet
+        from dct_tpu.evaluation import harness
+        from dct_tpu.evaluation.gates import PromotionGate
+        from dct_tpu.serving.quant import prob_bound, quantize_package
+        from dct_tpu.serving.runtime import rows_mm
+        from dct_tpu.serving.score_gen import generate_score_package
+        from dct_tpu.tracking.client import LocalTracking
+        from dct_tpu.train.trainer import Trainer
+
+        # -- 1. real training run -> checkpoint ------------------------
+        csv = os.path.join(work, "raw", "weather.csv")
+        generate_weather_csv(csv, rows=600, seed=0)
+        processed = os.path.join(work, "processed")
+        preprocess_csv_to_parquet(csv, processed)
+        cfg = RunConfig.from_env()
+        cfg.data.processed_dir = processed
+        cfg.data.models_dir = os.path.join(work, "models")
+        cfg.train.epochs = 5
+        cfg.train.batch_size = 16
+        tracker = LocalTracking(
+            root=os.path.join(work, "runs"), experiment="lowprec"
+        )
+        res = Trainer(cfg, tracker=tracker).fit()
+        print(f"fit done: val_loss={res.val_loss:.4f}")
+        ckpts = sorted(
+            f for f in os.listdir(cfg.data.models_dir)
+            if f.endswith(".ckpt")
+        )
+        if not ckpts:
+            print("FAIL: trainer produced no checkpoint")
+            return 1
+
+        # -- 2. champion package + quantized challenger ----------------
+        champ = os.path.join(work, "champion")
+        chall = os.path.join(work, "challenger")
+        generate_score_package(
+            os.path.join(cfg.data.models_dir, ckpts[0]), champ
+        )
+        quantize_package(champ, chall, dtype="int8")
+        for name in ("model.npz", "model_meta.json", "score.py"):
+            if not os.path.exists(os.path.join(chall, name)):
+                failures.append(f"challenger package missing {name}")
+
+        # -- 3. serve through the challenger's own generated score.py --
+        os.environ["AZUREML_MODEL_DIR"] = chall
+        spec = importlib.util.spec_from_file_location(
+            "lowprec_score", os.path.join(chall, "score.py")
+        )
+        score_mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(score_mod)
+        score_mod.init()
+        cw, cmeta = harness.model_from_package(champ)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal(
+            (32, int(cmeta["input_dim"]))
+        ).astype(np.float32)
+        served = score_mod.run(json.dumps({"data": x.tolist()}))
+        if "error" in served:
+            failures.append(f"generated score.py errored: {served}")
+        qprobs = np.asarray(served.get("probabilities", []), np.float32)
+
+        # -- 4. prob parity + bit-exact row invariance -----------------
+        from dct_tpu.serving.runtime import forward_numpy, softmax_numpy
+
+        ref = softmax_numpy(forward_numpy(cw, cmeta, x))
+        delta = float(np.abs(qprobs - ref).max()) if qprobs.size else 1.0
+        bound = prob_bound()
+        print(f"max_abs_prob_delta={delta:.5f} bound={bound}")
+        if not qprobs.size or delta > bound:
+            failures.append(
+                f"quantized prob delta {delta:.5f} exceeds bound {bound}"
+            )
+        qw, qmeta = harness.model_from_package(chall)
+        if (qmeta.get("quant") or {}).get("dtype") != "int8":
+            failures.append(f"challenger meta lacks quant stanza: {qmeta}")
+        batch_logits = forward_numpy(qw, qmeta, x, mm=rows_mm)
+        for i in (0, 7, 31):
+            alone = forward_numpy(qw, qmeta, x[i:i + 1], mm=rows_mm)
+            if not np.array_equal(alone[0], batch_logits[i]):
+                failures.append(
+                    f"row {i}: quantized forward not row-invariant"
+                )
+                break
+
+        # -- 5. gate parity: clean promotes, corrupted is blocked ------
+        gcfg = EvaluationConfig.from_env()
+        gcfg.max_regression = max(gcfg.max_regression, bound)
+        gate = PromotionGate(gcfg, processed_dir=processed)
+        clean = gate.evaluate(
+            challenger_dir=chall, champion_dir=champ, stage="shadow"
+        )
+        print(f"clean gate: {clean.decision} ({clean.reason})")
+        if not clean.promoted:
+            failures.append(
+                f"clean quantized challenger not promoted: "
+                f"{clean.decision} ({clean.reason})"
+            )
+        npz_path = os.path.join(chall, "model.npz")
+        with np.load(npz_path) as z:
+            flat = {k: z[k] for k in z.files}
+        scale_key = next(
+            k for k in sorted(flat) if k.endswith("::scale")
+        )
+        flat[scale_key] = flat[scale_key] * np.float32(64.0)
+        np.savez(npz_path, **flat)
+        cache = os.path.join(chall, "eval_report.json")
+        if os.path.exists(cache):
+            os.remove(cache)
+        corrupted = gate.evaluate(
+            challenger_dir=chall, champion_dir=champ, stage="shadow"
+        )
+        print(f"corrupted gate: {corrupted.decision} ({corrupted.reason})")
+        if corrupted.promoted:
+            failures.append(
+                "corrupted-scale challenger was promoted "
+                f"({corrupted.decision})"
+            )
+
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1
+    print("lowprec smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -382,6 +382,37 @@ def _worst_case_record() -> dict:
                              "bounded": True},
             "events_per_s_speedup": 7.57, "lag_bounded": True,
         },
+        "low_precision": {
+            "serving": {
+                "f32": {"p50_ms": 0.3161, "batch64_rows_per_s": 5340.4,
+                        "max_abs_prob_delta": 0.0},
+                "int8": {"p50_ms": 0.4166,
+                         "batch64_rows_per_s": 20485.8,
+                         "max_abs_prob_delta": 0.004959,
+                         "speedup_batch64": 3.84},
+                "bf16": {"p50_ms": 0.2808,
+                         "batch64_rows_per_s": 5413.1,
+                         "max_abs_prob_delta": 0.001306,
+                         "speedup_batch64": 1.01},
+            },
+            "quant_serving_speedup": 3.84,
+            "train": {
+                "config": {"d_model": 128, "n_heads": 4, "n_layers": 2,
+                           "d_ff": 1024, "seq_len": 64, "batch": 64},
+                "peak_source": "measured_gemm",
+                "f32": {"samples_per_s": 73.2,
+                        "bytes_accessed": 5206724608.0,
+                        "flops": 17284323328.0, "mfu": 0.169985},
+                "bf16_rules": {"samples_per_s": 46.7,
+                               "bytes_accessed": 3648292608.0,
+                               "flops": 17310842880.0, "mfu": 0.108695},
+                "bf16_bytes_ratio": 0.701, "bytes_reduction_pct": 29.9,
+                "bf16_sps_ratio": 0.64, "bf16_mfu_delta": -0.06129,
+            },
+            "bf16_bytes_ratio": 0.701,
+            "gate": {"clean": "promote", "corrupted": "rollback",
+                     "parity": True},
+        },
     }
 
 
@@ -416,6 +447,9 @@ def test_stdout_record_worst_case_fits_driver_tail(bench_mod):
     assert out["val_parity"]["abs_diff"] == 0.01057
     assert out["probe"]["platform"] == "tpu"
     assert out["deadline_skipped"] == record["deadline_skipped"]
+    # Both low-precision sentinel series survive every shrink rung.
+    assert out["low_precision"]["quant_serving_speedup"] == 3.84
+    assert out["low_precision"]["bf16_bytes_ratio"] == 0.701
 
 
 def test_stdout_record_typical_round_is_not_collapsed(bench_mod):
@@ -522,6 +556,16 @@ def test_stdout_record_typical_round_is_not_collapsed(bench_mod):
     assert out["stream_ingest"] == {
         "stream_events_per_s": 936.6, "stream_lag_p99_s": 0.112,
     }
+    # ...and low_precision rides stdout as its digest: both sentinel
+    # series + the accuracy-bound evidence + the gate bit (the train
+    # A/B ratios may yield under a full-record squeeze; the per-variant
+    # p50/bytes detail always stays in the partial).
+    lp = out["low_precision"]
+    assert lp["quant_serving_speedup"] == 3.84
+    assert lp["bf16_bytes_ratio"] == 0.701
+    assert lp["int8_prob_delta"] == 0.004959
+    assert lp["gate_parity"] is True
+    assert "serving" not in lp and "train" not in lp
 
 
 def test_stdout_record_bounds_error_strings(bench_mod):

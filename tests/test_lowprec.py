@@ -1,0 +1,342 @@
+"""Low-precision end-to-end satellites (ISSUE 20).
+
+Three planes of the int8/bf16 story, each pinned at the unit level:
+
+- the ``DCT_DTYPE_RULES`` grammar (parallel/sharding_rules.py): the
+  accept/reject matrix, the digest that joins AOT program identity, and
+  the cast that implements the f32 master-weight contract;
+- the f32 master-weight invariant itself, proven over REAL train steps
+  (params and optimizer state never leave float32 while the loss body
+  computes in bf16);
+- the serving pack machinery (serving/quant.py, serving/runtime.py):
+  per-channel int8 scales, the bit-exact row-invariant integer GEMM,
+  bf16 bit-pattern round-trips, and the ``::q8``/``::scale``/``::bf16``
+  package grammar end to end through ``quantize_package``.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dct_tpu.parallel.sharding_rules import (
+    cast_params_by_rules,
+    dtype_rules_digest,
+    make_shard_and_gather_fns,
+    parse_dtype_rules,
+)
+from dct_tpu.serving.quant import (
+    prob_bound,
+    quantize_array_int8,
+    quantize_package,
+    quantize_weights,
+)
+from dct_tpu.serving.runtime import (
+    QuantTensor,
+    assemble_weights,
+    bf16_pack,
+    bf16_unpack,
+    forward_numpy,
+    rows_mm,
+    softmax_numpy,
+)
+
+F = 5
+
+
+# ----------------------------------------------------------------------
+# DCT_DTYPE_RULES grammar
+
+
+def test_parse_dtype_rules_accepts_grammar():
+    rules = parse_dtype_rules("attn.*/kernel=bf16; .*=f32")
+    assert rules == (("attn.*/kernel", "bfloat16"), (".*", "float32"))
+    # Aliases and long names canonicalize identically; empty clauses
+    # (trailing ';') are skipped.
+    assert parse_dtype_rules("k=bfloat16;") == (("k", "bfloat16"),)
+    assert parse_dtype_rules("k=F16") == (("k", "float16"),)
+    assert parse_dtype_rules("") == ()
+
+
+@pytest.mark.parametrize(
+    "text",
+    ["kernel", "k(=bf16", "k=float8"],
+    ids=["no-equals-clause", "bad-regex", "bad-dtype"],
+)
+def test_parse_dtype_rules_rejects(text):
+    """A typo'd precision spec must raise, never silently train
+    full-width — the ValueError names the offending clause."""
+    with pytest.raises(ValueError):
+        parse_dtype_rules(text)
+
+
+def test_dtype_rules_digest_off_and_content_keyed(monkeypatch):
+    monkeypatch.delenv("DCT_DTYPE_RULES", raising=False)
+    assert dtype_rules_digest() == "off"
+    monkeypatch.setenv("DCT_DTYPE_RULES", ".*=bf16")
+    d1 = dtype_rules_digest()
+    assert len(d1) == 10 and d1 != "off"
+    int(d1, 16)  # hex
+    monkeypatch.setenv("DCT_DTYPE_RULES", "attn.*=bf16")
+    assert dtype_rules_digest() != d1
+
+
+def test_cast_params_by_rules_matches_and_preserves(monkeypatch):
+    params = {
+        "dense": {
+            "kernel": jnp.ones((3, 2), jnp.float32),
+            "bias": jnp.zeros((2,), jnp.float32),
+        },
+        "step": jnp.zeros((), jnp.int32),
+    }
+    monkeypatch.setenv("DCT_DTYPE_RULES", "dense/kernel=bf16")
+    out = cast_params_by_rules(params)
+    assert out["dense"]["kernel"].dtype == jnp.bfloat16
+    assert out["dense"]["bias"].dtype == jnp.float32  # unmatched
+    assert out["step"].dtype == jnp.int32  # ints never cast
+    # No rules -> identity (the bitwise status quo, zero tracing cost).
+    monkeypatch.delenv("DCT_DTYPE_RULES")
+    assert cast_params_by_rules(params) is params
+
+
+def test_grad_cotangent_widens_to_f32(monkeypatch):
+    """The cast's vjp widens bf16 cotangents back to f32: gradients
+    w.r.t. the f32 masters are f32 even when the loss body computes in
+    bf16 — accumulation and the optimizer update run full-width."""
+    monkeypatch.setenv("DCT_DTYPE_RULES", ".*=bf16")
+    p = {"kernel": jnp.full((4, 4), 0.5, jnp.float32)}
+
+    def loss(params):
+        q = cast_params_by_rules(params)
+        assert q["kernel"].dtype == jnp.bfloat16  # trace-time check
+        return jnp.sum(q["kernel"] ** 2).astype(jnp.float32)
+
+    g = jax.grad(loss)(p)
+    assert g["kernel"].dtype == jnp.float32
+
+
+def test_master_weights_stay_f32_under_bf16_rules(monkeypatch, rng):
+    """The end-to-end invariant over REAL train steps: under a
+    blanket ``.*=bf16`` rule the trained params AND every float leaf of
+    the optimizer state stay float32 (the bench leg asserts the same
+    contract on the transformer shape before timing)."""
+    from dct_tpu.config import ModelConfig
+    from dct_tpu.models.registry import get_model
+    from dct_tpu.train.state import create_train_state
+    from dct_tpu.train.steps import make_train_step
+
+    monkeypatch.setenv("DCT_DTYPE_RULES", ".*=bf16")
+    model = get_model(ModelConfig(hidden_dim=16), input_dim=F)
+    state = create_train_state(model, input_dim=F, lr=0.01, seed=0)
+    step = make_train_step(donate=False)
+    x = rng.standard_normal((16, F)).astype(np.float32)
+    y = rng.integers(0, 2, 16).astype(np.int32)
+    w = np.ones(16, np.float32)
+    before = jax.device_get(state.params)
+    for _ in range(2):
+        state, metrics = step(state, x, y, w)
+    assert np.isfinite(float(metrics["train_loss"]))
+    for tree in (state.params, state.opt_state):
+        for leaf in jax.tree_util.tree_leaves(tree):
+            dt = getattr(leaf, "dtype", None)
+            if dt is not None and jnp.issubdtype(dt, jnp.floating):
+                assert dt == jnp.float32, leaf
+    # And the bf16 compute actually trained (not a frozen no-op).
+    after = jax.device_get(state.params)
+    deltas = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(a - b).max()), before, after
+    )
+    assert max(jax.tree_util.tree_leaves(deltas)) > 0
+
+
+# ----------------------------------------------------------------------
+# int8/bf16 pack machinery
+
+
+def test_quantize_array_int8_per_channel_scales(rng):
+    a = rng.standard_normal((64, 8)).astype(np.float32)
+    a[:, 3] = 0.0  # an all-zero output channel must stay safe
+    q, scale = quantize_array_int8(a)
+    assert q.dtype == np.int8 and scale.dtype == np.float32
+    assert q.shape == a.shape and scale.shape == (8,)
+    np.testing.assert_allclose(
+        scale, np.abs(a).max(axis=0) / np.float32(127.0)
+    )
+    assert scale[3] == 0.0 and not q[:, 3].any()
+    # Symmetric round-trip: within half a quantization step per channel.
+    deq = q.astype(np.float32) * scale[None, :]
+    assert (np.abs(deq - a) <= scale[None, :] * 0.5 + 1e-9).all()
+
+
+def test_quant_tensor_row_invariant_any_stacking(rng):
+    """The integer GEMM's contract: row i of a batched matmul is
+    BIT-identical to scoring that row alone — at K > _INT8_CHUNK the
+    fixed-order chunked reduction must preserve it too."""
+    k, m = 1536, 6  # k spans two reduction chunks
+    q, scale = quantize_array_int8(
+        rng.standard_normal((k, m)).astype(np.float32)
+    )
+    qt = QuantTensor(q, scale)
+    x = rng.standard_normal((8, k)).astype(np.float32)
+    batch = x @ qt
+    assert batch.shape == (8, m)
+    for via in (lambda r: r @ qt, lambda r: np.matmul(r, qt),
+                lambda r: rows_mm(r, qt)):
+        got = via(x)
+        for i in (0, 3, 7):
+            alone = via(x[i:i + 1])
+            np.testing.assert_array_equal(alone[0], got[i])
+            np.testing.assert_array_equal(got[i], batch[i])
+    # 3D stacking reshapes through the same kernel: same bits.
+    three = (x.reshape(2, 4, k) @ qt).reshape(8, m)
+    np.testing.assert_array_equal(three, batch)
+
+
+def test_bf16_pack_round_trip_matches_jnp(rng):
+    a = rng.standard_normal((33,)).astype(np.float32)
+    a[0] = 0.0
+    u = bf16_pack(a)
+    assert u.dtype == np.uint16
+    want = np.asarray(
+        jnp.asarray(a, jnp.bfloat16).astype(jnp.float32)
+    )
+    np.testing.assert_array_equal(bf16_unpack(u), want)
+    # Values exactly representable in bf16 survive bit-for-bit.
+    exact = np.array([0.0, 1.0, -2.5, 0.15625], np.float32)
+    np.testing.assert_array_equal(bf16_unpack(bf16_pack(exact)), exact)
+
+
+def test_assemble_weights_grammar(rng):
+    w = rng.standard_normal((16, 4)).astype(np.float32)
+    q, scale = quantize_array_int8(w)
+    flat = {
+        "a::q8": q, "a::scale": scale,
+        "b::bf16": bf16_pack(w[:, 0]),
+        "c": w,
+    }
+    out = assemble_weights(flat)
+    assert set(out) == {"a", "b", "c"}
+    assert isinstance(out["a"], QuantTensor)
+    assert out["b"].dtype == np.float32
+    np.testing.assert_array_equal(out["b"], bf16_unpack(flat["b::bf16"]))
+    assert out["c"] is w
+    # An f32 package passes through untouched.
+    assert assemble_weights({"c": w})["c"] is w
+
+
+def test_quantize_weights_selects_matmul_kernels(rng):
+    weights = {
+        "w0": rng.standard_normal((F, 8)).astype(np.float32),
+        "b0": np.zeros(8, np.float32),
+        "experts": rng.standard_normal((2, 8, 8)).astype(np.float32),
+    }
+    flat, meta = quantize_weights(weights, {"input_dim": F}, "int8")
+    assert set(flat) == {"w0::q8", "w0::scale", "b0", "experts"}
+    assert meta["quant"] == {"dtype": "int8", "prob_bound": prob_bound()}
+    # bf16 packs EVERY float leaf, 3D stacks included.
+    flat16, _ = quantize_weights(weights, {"input_dim": F}, "bf16")
+    assert set(flat16) == {"w0::bf16", "b0::bf16", "experts::bf16"}
+    with pytest.raises(ValueError):
+        quantize_weights(weights, {}, "fp4")
+
+
+def test_quantize_package_round_trip_and_refusal(tmp_path, rng):
+    """f32 package -> int8 challenger: a COMPLETE sibling package whose
+    assembled forward stays inside the documented prob bound — and a
+    second quantization pass is refused (rounding must not compound)."""
+    src = tmp_path / "champion"
+    src.mkdir()
+    weights = {
+        "w0": (rng.standard_normal((F, 32)) * 0.4).astype(np.float32),
+        "b0": np.zeros(32, np.float32),
+        "w1": (rng.standard_normal((32, 3)) * 0.4).astype(np.float32),
+        "b1": np.zeros(3, np.float32),
+    }
+    np.savez(src / "model.npz", **weights)
+    meta = {"model": "weather_mlp", "input_dim": F}
+    (src / "model_meta.json").write_text(json.dumps(meta))
+
+    out = tmp_path / "challenger"
+    meta_q = quantize_package(str(src), str(out), dtype="int8")
+    assert meta_q["quant"]["dtype"] == "int8"
+    for name in ("model.npz", "model_meta.json", "score.py",
+                 "conda.yaml"):
+        assert (out / name).exists(), name
+    with np.load(out / "model.npz") as z:
+        flat = {k: z[k] for k in z.files}
+    assert "w0::q8" in flat and "w0::scale" in flat
+    qw = assemble_weights(flat)
+    x = rng.standard_normal((16, F)).astype(np.float32)
+    ref = softmax_numpy(forward_numpy(weights, meta, x, mm=rows_mm))
+    got = softmax_numpy(forward_numpy(qw, meta_q, x, mm=rows_mm))
+    assert np.abs(got - ref).max() <= prob_bound()
+    # Re-quantizing the quantized package compounds rounding: refused.
+    with pytest.raises(ValueError, match="already quantized"):
+        quantize_package(str(out), str(tmp_path / "twice"))
+
+
+# ----------------------------------------------------------------------
+# Per-leaf dtype specs in the shard/gather plumbing
+
+
+def test_make_shard_gather_dtype_specs():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dct_tpu.config import MeshConfig
+    from dct_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(MeshConfig(data=8))
+    ns = NamedSharding(mesh, P())
+    shardings = {"kernel": ns, "bias": ns, "step": ns}
+    tree = {
+        "kernel": np.ones((8, 4), np.float32),
+        "bias": np.ones((4,), np.float32),
+        "step": np.zeros((), np.int32),
+    }
+
+    # ONE dtype-like applied tree-wide (alias strings resolve through
+    # DTYPE_ALIASES): floats cast, the int step counter never.
+    shard_fns, gather_fns = make_shard_and_gather_fns(shardings, "bf16")
+    placed = {k: shard_fns[k](v) for k, v in tree.items()}
+    assert placed["kernel"].dtype == jnp.bfloat16
+    assert placed["bias"].dtype == jnp.bfloat16
+    assert placed["step"].dtype == jnp.int32
+    back = gather_fns["kernel"](placed["kernel"])
+    assert isinstance(back, np.ndarray)
+
+    # A per-leaf spec tree: None leaves ride through untouched.
+    shard_fns, gather_fns = make_shard_and_gather_fns(
+        shardings,
+        {"kernel": np.float16, "bias": None, "step": "bf16"},
+    )
+    placed = {k: shard_fns[k](v) for k, v in tree.items()}
+    assert placed["kernel"].dtype == jnp.float16
+    assert placed["bias"].dtype == jnp.float32
+    assert placed["step"].dtype == jnp.int32  # non-float: spec ignored
+
+    # No specs at all: pure placement, bitwise status quo.
+    shard_fns, gather_fns = make_shard_and_gather_fns(shardings)
+    assert shard_fns["kernel"](tree["kernel"]).dtype == jnp.float32
+    got = gather_fns["bias"](shard_fns["bias"](tree["bias"]))
+    np.testing.assert_array_equal(got, tree["bias"])
+
+
+# ----------------------------------------------------------------------
+# Roofline dtype stamp
+
+
+def test_roofline_dtype_summary(monkeypatch):
+    from dct_tpu.observability.roofline import dtype_summary
+
+    monkeypatch.delenv("DCT_DTYPE_RULES", raising=False)
+    args = {
+        "x": jnp.ones((2, 2), jnp.float32),
+        "i": jnp.ones((2,), jnp.int32),
+    }
+    assert dtype_summary(args) == "f32,i32"
+    monkeypatch.setenv("DCT_DTYPE_RULES", "kernel=bf16")
+    stamped = dtype_summary(args)
+    assert stamped == f"f32,i32+rules:{dtype_rules_digest()}"
